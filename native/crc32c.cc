@@ -1,0 +1,120 @@
+// CRC32C (Castagnoli, reflected poly 0x82F63B78) — slice-by-8 implementation.
+//
+// TPU-native twin of the reference's checksum path (the reference computes
+// per-512-byte-chunk CRC32C sidecars in dfs/chunkserver/src/chunkserver.rs:182-190
+// with the crc32fast crate). This library is the host-side hot path; the device
+// twin is tpudfs/tpu/crc32c_pallas.py which must match bit-exactly.
+//
+// Exported C ABI (used from Python via ctypes, tpudfs/common/native.py):
+//   uint32_t tpudfs_crc32c(uint32_t crc, const uint8_t* buf, size_t len);
+//   void     tpudfs_crc32c_chunks(const uint8_t* buf, size_t len,
+//                                 size_t chunk, uint32_t* out);
+//   void     tpudfs_crc32c_contrib_table(uint32_t* out, size_t positions);
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+constexpr uint32_t kPoly = 0x82F63B78u;  // reflected 0x1EDC6F41
+
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ kPoly : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = t[0][i];
+      for (int s = 1; s < 8; s++) {
+        c = t[0][c & 0xff] ^ (c >> 8);
+        t[s][i] = c;
+      }
+    }
+  }
+};
+
+const Tables g_tables;
+
+inline uint32_t crc_update(uint32_t crc, const uint8_t* buf, size_t len) {
+  const uint32_t(*t)[256] = g_tables.t;
+  // Head: align to 8 bytes.
+  while (len && (reinterpret_cast<uintptr_t>(buf) & 7)) {
+    crc = t[0][(crc ^ *buf++) & 0xff] ^ (crc >> 8);
+    len--;
+  }
+  // Body: slice-by-8.
+  while (len >= 8) {
+    uint64_t word;
+    __builtin_memcpy(&word, buf, 8);
+#if __BYTE_ORDER__ == __ORDER_BIG_ENDIAN__
+    word = __builtin_bswap64(word);
+#endif
+    word ^= crc;
+    crc = t[7][word & 0xff] ^ t[6][(word >> 8) & 0xff] ^
+          t[5][(word >> 16) & 0xff] ^ t[4][(word >> 24) & 0xff] ^
+          t[3][(word >> 32) & 0xff] ^ t[2][(word >> 40) & 0xff] ^
+          t[1][(word >> 48) & 0xff] ^ t[0][(word >> 56) & 0xff];
+    buf += 8;
+    len -= 8;
+  }
+  // Tail.
+  while (len--) crc = t[0][(crc ^ *buf++) & 0xff] ^ (crc >> 8);
+  return crc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Incremental CRC32C. Pass crc=0 for a fresh checksum; the pre/post inversion
+// is handled internally (matches crc32fast / RFC 3720 semantics).
+uint32_t tpudfs_crc32c(uint32_t crc, const uint8_t* buf, size_t len) {
+  return ~crc_update(~crc, buf, len);
+}
+
+// Per-chunk CRC32C: out[i] = crc32c(buf[i*chunk : min((i+1)*chunk, len)]).
+// Mirrors the reference's calculate_checksums (chunkserver.rs:182-190) which
+// checksums each 512-byte chunk independently.
+void tpudfs_crc32c_chunks(const uint8_t* buf, size_t len, size_t chunk,
+                          uint32_t* out) {
+  size_t n = (len + chunk - 1) / chunk;
+  for (size_t i = 0; i < n; i++) {
+    size_t off = i * chunk;
+    size_t clen = (off + chunk <= len) ? chunk : len - off;
+    out[i] = ~crc_update(0xFFFFFFFFu, buf + off, clen);
+  }
+}
+
+// Positional contribution table for the vectorized (Pallas / numpy) twin:
+// out[(positions-1-i)*256 + b] is the CRC register contribution of byte value
+// b at distance i from the END of a `positions`-byte message, EXCLUDING the
+// init/final inversions. A chunk CRC is then
+//   ~( xor_i table[i][data[i]] ^ inv_contrib )
+// where inv_contrib is the contribution of the initial 0xFFFFFFFF register,
+// returned in out[positions*256] (one extra slot).
+void tpudfs_crc32c_contrib_table(uint32_t* out, size_t positions) {
+  // Contribution of byte b at position i (0-based from message start) in a
+  // message of `positions` bytes, all other bytes zero, init register zero:
+  // run crc_update over the one-hot message.
+  for (size_t i = 0; i < positions; i++) {
+    for (uint32_t b = 0; b < 256; b++) {
+      uint32_t crc = 0;
+      // Process byte b, then (positions-1-i) zero bytes.
+      crc = g_tables.t[0][(crc ^ b) & 0xff] ^ (crc >> 8);
+      for (size_t z = i + 1; z < positions; z++)
+        crc = g_tables.t[0][crc & 0xff] ^ (crc >> 8);
+      out[i * 256 + b] = crc;
+    }
+  }
+  // Contribution of the init register 0xFFFFFFFF across `positions` bytes:
+  // feed `positions` zero bytes starting from register 0xFFFFFFFF.
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t z = 0; z < positions; z++)
+    crc = g_tables.t[0][crc & 0xff] ^ (crc >> 8);
+  out[positions * 256] = crc;
+}
+
+}  // extern "C"

@@ -1,0 +1,95 @@
+// GF(2^8) arithmetic + Reed-Solomon matrix application.
+//
+// TPU-native twin of the reference's erasure path (dfs/common/src/erasure.rs:7-59,
+// which uses the reed-solomon-erasure crate: GF(2^8) with polynomial 0x11D and a
+// systematic Vandermonde code). Matrix construction/inversion lives in Python
+// (tpudfs/common/erasure.py); this library provides the byte-crunching inner
+// loop: out = M x shards over GF(2^8), used for both encode (M = parity rows)
+// and decode (M = inverted surviving rows).
+//
+// Exported C ABI:
+//   void tpudfs_gf256_matmul(const uint8_t* mat, size_t rows, size_t cols,
+//                            const uint8_t* const* shards, size_t shard_len,
+//                            uint8_t* const* out);
+//   void tpudfs_gf256_mul_slice(uint8_t c, const uint8_t* in, size_t len,
+//                               uint8_t* acc);   // acc ^= c * in
+//   uint8_t tpudfs_gf256_mul(uint8_t a, uint8_t b);
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kPoly = 0x11D;  // x^8 + x^4 + x^3 + x^2 + 1
+
+struct Tables {
+  uint8_t exp[512];
+  uint8_t log[256];
+  // mul[c] = 256-byte row: mul[c][x] = c*x in GF(2^8).
+  uint8_t mul[256][256];
+  Tables() {
+    uint32_t x = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = static_cast<uint8_t>(x);
+      log[x] = static_cast<uint8_t>(i);
+      x <<= 1;
+      if (x & 0x100) x ^= kPoly;
+    }
+    for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+    log[0] = 0;
+    for (int c = 0; c < 256; c++) {
+      for (int v = 0; v < 256; v++) {
+        mul[c][v] = (c && v)
+            ? exp[log[c] + log[v]]
+            : 0;
+      }
+    }
+  }
+};
+
+const Tables g;
+
+}  // namespace
+
+extern "C" {
+
+uint8_t tpudfs_gf256_mul(uint8_t a, uint8_t b) { return g.mul[a][b]; }
+
+// acc[i] ^= c * in[i] for i in [0, len). The RS inner loop.
+void tpudfs_gf256_mul_slice(uint8_t c, const uint8_t* in, size_t len,
+                            uint8_t* acc) {
+  if (c == 0) return;
+  if (c == 1) {
+    for (size_t i = 0; i < len; i++) acc[i] ^= in[i];
+    return;
+  }
+  const uint8_t* row = g.mul[c];
+  size_t i = 0;
+  // Unrolled by 8 so the compiler can vectorize the gather-free XOR tail;
+  // the table gather itself is the bottleneck (no PSHUFB without intrinsics).
+  for (; i + 8 <= len; i += 8) {
+    acc[i] ^= row[in[i]];
+    acc[i + 1] ^= row[in[i + 1]];
+    acc[i + 2] ^= row[in[i + 2]];
+    acc[i + 3] ^= row[in[i + 3]];
+    acc[i + 4] ^= row[in[i + 4]];
+    acc[i + 5] ^= row[in[i + 5]];
+    acc[i + 6] ^= row[in[i + 6]];
+    acc[i + 7] ^= row[in[i + 7]];
+  }
+  for (; i < len; i++) acc[i] ^= row[in[i]];
+}
+
+// out[r] = xor_c mat[r*cols + c] * shards[c], each shard `shard_len` bytes.
+void tpudfs_gf256_matmul(const uint8_t* mat, size_t rows, size_t cols,
+                         const uint8_t* const* shards, size_t shard_len,
+                         uint8_t* const* out) {
+  for (size_t r = 0; r < rows; r++) {
+    std::memset(out[r], 0, shard_len);
+    for (size_t c = 0; c < cols; c++)
+      tpudfs_gf256_mul_slice(mat[r * cols + c], shards[c], shard_len, out[r]);
+  }
+}
+
+}  // extern "C"

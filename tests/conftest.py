@@ -1,0 +1,30 @@
+"""Test harness config.
+
+- Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
+  (mesh/pjit/shard_map) is exercised without TPU hardware, per the reference
+  test strategy of model-level multi-node simulation (SURVEY.md §4 tier 2).
+- Runs ``async def`` tests via asyncio.run (no pytest-asyncio in this image).
+"""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None

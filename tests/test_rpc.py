@@ -1,0 +1,108 @@
+"""RPC substrate: echo round-trip, error conventions, request-id propagation,
+large payloads (100 MB cap parity with reference bin/master.rs:20)."""
+
+import grpc
+import pytest
+
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
+from tpudfs.common.telemetry import current_request_id
+
+
+async def _make_server(handlers):
+    server = RpcServer()
+    server.add_service("TestService", handlers)
+    await server.start()
+    return server
+
+
+async def test_echo_roundtrip():
+    async def echo(req):
+        return {"echo": req, "rid": current_request_id()}
+
+    server = await _make_server({"Echo": echo})
+    client = RpcClient()
+    try:
+        resp = await client.call(server.address, "TestService", "Echo", {"x": 1, "b": b"\x00\xff"})
+        assert resp["echo"] == {"x": 1, "b": b"\x00\xff"}
+        assert len(resp["rid"]) == 16
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_error_mapping_and_hints():
+    async def not_leader(_):
+        raise RpcError.not_leader("10.0.0.5:4000")
+
+    async def redirect(_):
+        raise RpcError.redirect("shard-b")
+
+    async def boom(_):
+        raise ValueError("oops")
+
+    server = await _make_server(
+        {"NotLeader": not_leader, "Redirect": redirect, "Boom": boom}
+    )
+    client = RpcClient()
+    try:
+        with pytest.raises(RpcError) as ei:
+            await client.call(server.address, "TestService", "NotLeader", {})
+        assert ei.value.is_not_leader
+        assert ei.value.not_leader_hint == "10.0.0.5:4000"
+
+        with pytest.raises(RpcError) as ei:
+            await client.call(server.address, "TestService", "Redirect", {})
+        assert ei.value.redirect_hint == "shard-b"
+
+        with pytest.raises(RpcError) as ei:
+            await client.call(server.address, "TestService", "Boom", {})
+        assert ei.value.code == grpc.StatusCode.INTERNAL
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_request_id_propagates():
+    seen = []
+
+    async def record(_):
+        seen.append(current_request_id())
+        return None
+
+    server = await _make_server({"Record": record})
+    client = RpcClient()
+    try:
+        rid = current_request_id()
+        await client.call(server.address, "TestService", "Record", {})
+        await client.call(server.address, "TestService", "Record", {})
+        assert seen == [rid, rid]
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_large_payload():
+    async def size(req):
+        return len(req["data"])
+
+    server = await _make_server({"Size": size})
+    client = RpcClient()
+    try:
+        blob = b"\xab" * (8 * 1024 * 1024)
+        assert await client.call(server.address, "TestService", "Size", {"data": blob}) == len(blob)
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_unavailable_target():
+    client = RpcClient()
+    try:
+        with pytest.raises(RpcError) as ei:
+            await client.call("127.0.0.1:1", "TestService", "Echo", {}, timeout=2.0)
+        assert ei.value.code in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    finally:
+        await client.close()

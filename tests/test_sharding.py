@@ -1,0 +1,120 @@
+"""ShardMap semantics (parity with reference sharding.rs:343-452 tests)."""
+
+import json
+
+from tpudfs.common.sharding import RANGE_MAX, ShardMap, hash_key, load_shard_map_from_config
+
+
+def test_range_bootstrap_two_shards():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("shard-a", ["m1"])
+    # First shard covers everything.
+    assert sm.get_shard("/anything") == "shard-a"
+    sm.add_shard("shard-b", ["m2"])
+    # Second shard splits at "/m": b takes keys < "/m", a keeps the rest.
+    assert sm.get_shard("/apple") == "shard-b"
+    assert sm.get_shard("/zebra") == "shard-a"
+    # Lookup is first range-end >= key (reference sharding.rs:171-175), so a
+    # key equal to a boundary belongs to the range it terminates.
+    assert sm.get_shard("/m") == "shard-b"
+
+
+def test_range_split_and_lookup():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s1", ["m1"])
+    assert sm.split_shard("/g", "s2", ["m2"])
+    assert sm.get_shard("/a") == "s2"
+    assert sm.get_shard("/g") == "s2"  # boundary key belongs to its range
+    assert sm.get_shard("/h") == "s1"
+    assert sm.get_shard("/x") == "s1"
+    # duplicate split key / existing shard rejected
+    assert not sm.split_shard("/g", "s3", ["m3"])
+    assert not sm.split_shard("/q", "s2", ["m2"])
+
+
+def test_range_merge():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s1", ["m1"])
+    sm.split_shard("/g", "s2", ["m2"])
+    assert sm.merge_shards("s2", "s1")
+    assert sm.get_shard("/a") == "s1"
+    assert not sm.has_shard("s2")
+
+
+def test_range_merge_victim_owns_tail():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s1", ["m1"])
+    sm.split_shard("/g", "s2", ["m2"])
+    # Victim s1 owns the RANGE_MAX tail; retained s2 must take it over.
+    assert sm.merge_shards("s1", "s2")
+    assert sm.get_shard("/zzz") == "s2"
+    assert sm.get_shard("/a") == "s2"
+
+
+def test_rebalance_boundary():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s1", ["m1"])
+    sm.split_shard("/g", "s2", ["m2"])
+    assert sm.rebalance_boundary("/g", "/k")
+    assert sm.get_shard("/h") == "s2"
+    assert sm.get_shard("/k") == "s2"
+    assert sm.get_shard("/l") == "s1"
+    assert not sm.rebalance_boundary("/nope", "/x")
+
+
+def test_neighbors_and_range_of():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s1", ["m1"])
+    sm.split_shard("/g", "s2", ["m2"])
+    sm.split_shard("/t", "s3", ["m3"])
+    # Order: /g->s2, /t->s3, MAX->s1
+    assert sm.get_neighbors("s3") == ("s2", "s1")
+    assert sm.get_neighbors("s2") == (None, "s3")
+    assert sm.range_of("s3") == ("/g", "/t")
+    assert sm.range_of("s1") == ("/t", RANGE_MAX)
+
+
+def test_remove_shard():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s1", ["m1"])
+    sm.split_shard("/g", "s2", ["m2"])
+    sm.remove_shard("s2")
+    assert not sm.has_shard("s2")
+    assert sm.get_shard("/a") == "s1"
+
+
+def test_hash_ring_deterministic():
+    sm1 = ShardMap(strategy="hash", virtual_nodes=8)
+    sm2 = ShardMap(strategy="hash", virtual_nodes=8)
+    for sm in (sm1, sm2):
+        sm.add_shard("a", ["m1"])
+        sm.add_shard("b", ["m2"])
+    keys = [f"/file-{i}" for i in range(100)]
+    assert [sm1.get_shard(k) for k in keys] == [sm2.get_shard(k) for k in keys]
+    assert {sm1.get_shard(k) for k in keys} == {"a", "b"}
+    sm1.remove_shard("a")
+    assert all(sm1.get_shard(k) == "b" for k in keys)
+
+
+def test_hash_key_is_crc32():
+    assert hash_key("abc") == 0x352441C2  # CRC32("abc")
+
+
+def test_serialization_roundtrip():
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s1", ["m1", "m1b"])
+    sm.split_shard("/g", "s2", ["m2"])
+    back = ShardMap.from_dict(sm.to_dict())
+    assert back.get_shard("/a") == "s2"
+    assert back.get_peers("s1") == ["m1", "m1b"]
+    assert back.version == sm.version
+
+
+def test_config_loader(tmp_path):
+    cfg = tmp_path / "shard_config.json"
+    cfg.write_text(json.dumps({"shards": {"shard-b": ["mB"], "shard-a": ["mA"]}}))
+    sm = load_shard_map_from_config(str(cfg))
+    # Sorted insertion: shard-a first (covers all), then shard-b splits at /m.
+    assert sm.get_shard("/a") == "shard-b"
+    assert sm.get_shard("/z") == "shard-a"
+    assert load_shard_map_from_config(str(tmp_path / "missing.json")).get_shard("/a") is None

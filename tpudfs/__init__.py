@@ -1,0 +1,19 @@
+"""tpudfs — a TPU-native distributed file system framework.
+
+A ground-up re-architecture of a GFS/HDFS-style DFS (reference:
+getumen/rust-hadoop-generated-by-llm) for TPU pods:
+
+- Control/metadata plane: Raft-replicated range-sharded masters (asyncio + gRPC
+  over DCN), cross-shard 2PC transactions, dynamic split/merge.
+- Data plane: ChunkServers colocated on TPU-host VMs; pipeline replication that
+  can ride XLA collectives over ICI (``tpudfs.tpu.ici_replication``); CRC32C and
+  Reed-Solomon hot paths as native C++ (``native/``) with bit-exact Pallas
+  device twins (``tpudfs.tpu``).
+- Client: shard-map caching, leader-hint retry, hedged reads, EC, plus a JAX
+  reader that lands chunks directly in TPU HBM as sharded ``jax.Array``s.
+- S3-compatible gateway with SigV4/OIDC/STS/IAM/SSE/audit.
+
+See SURVEY.md for the reference structural analysis this build follows.
+"""
+
+__version__ = "0.1.0"

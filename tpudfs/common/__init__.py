@@ -1,0 +1,1 @@
+"""Shared substrate: checksums, erasure coding, sharding, RPC, telemetry."""

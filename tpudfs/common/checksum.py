@@ -1,0 +1,218 @@
+"""End-to-end CRC32C checksums.
+
+The reference checksums every block both in flight (whole-buffer CRC32C,
+dfs/chunkserver/src/chunkserver.rs:746-766) and at rest (one CRC32C per
+512-byte chunk in a ``.meta`` sidecar, chunkserver.rs:16,182-190). This module
+provides:
+
+- ``crc32c`` / ``crc32c_chunks``: native C++ fast path, numpy fallback.
+- ``crc32c_combine``: GF(2)-matrix CRC concatenation (zlib-style), which lets
+  the vectorized per-chunk path compose into a whole-buffer CRC.
+- ``contrib_table``: the positional contribution table used by the vectorized
+  numpy path — and, identically, by the Pallas device kernel
+  (tpudfs/tpu/crc32c_pallas.py), which must stay bit-exact with this module.
+
+CRC32C = Castagnoli, reflected polynomial 0x82F63B78, init/final 0xFFFFFFFF
+(RFC 3720 / crc32fast semantics).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from functools import lru_cache
+
+import numpy as np
+
+from tpudfs.common import native
+
+#: At-rest checksum granularity (reference: CHECKSUM_CHUNK_SIZE, chunkserver.rs:16).
+CHECKSUM_CHUNK_SIZE = 512
+
+_POLY = 0x82F63B78
+
+
+# ---------------------------------------------------------------------------
+# Table construction (numpy; shared by the fallback path and the Pallas twin)
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=1)
+def _byte_table() -> np.ndarray:
+    """t0[b] = CRC register after absorbing byte b into a zero register."""
+    c = np.arange(256, dtype=np.uint32)
+    for _ in range(8):
+        c = np.where(c & 1, (c >> 1) ^ np.uint32(_POLY), c >> 1)
+    return c
+
+
+def _step(regs: np.ndarray, t0: np.ndarray) -> np.ndarray:
+    """Advance CRC registers by one zero byte."""
+    return t0[regs & 0xFF] ^ (regs >> np.uint32(8))
+
+
+@lru_cache(maxsize=8)
+def contrib_table(n: int) -> tuple[np.ndarray, int]:
+    """Positional contribution table for an ``n``-byte message.
+
+    Returns ``(table, inv_contrib)`` where ``table[i, b]`` (uint32) is the
+    final-register contribution of byte value ``b`` at position ``i`` (from
+    message start) with a zero initial register, and ``inv_contrib`` is the
+    contribution of the 0xFFFFFFFF initial register. The CRC of an ``n``-byte
+    message is then::
+
+        crc = 0xFFFFFFFF ^ inv_contrib ^ XOR_i table[i, data[i]]
+
+    CRC is linear over GF(2) in (init register, message bits), which makes the
+    per-position contributions independent — the basis of the vectorized numpy
+    path below and of the Pallas device kernel.
+    """
+    t0 = _byte_table()
+    rows = np.empty((n, 256), dtype=np.uint32)
+    regs = t0.copy()  # contribution of the last byte (position n-1)
+    rows[n - 1] = regs
+    for i in range(n - 2, -1, -1):
+        regs = _step(regs, t0)
+        rows[i] = regs
+    inv = np.uint32(0xFFFFFFFF)
+    inv_arr = np.array([inv], dtype=np.uint32)
+    for _ in range(n):
+        inv_arr = _step(inv_arr, t0)
+    return rows, int(inv_arr[0])
+
+
+# ---------------------------------------------------------------------------
+# Scalar / whole-buffer CRC
+# ---------------------------------------------------------------------------
+
+
+def crc32c(data: bytes | bytearray | memoryview | np.ndarray, crc: int = 0) -> int:
+    """CRC32C of ``data``, optionally continuing from a previous ``crc``."""
+    buf = _as_bytes(data)
+    lib = native.get_lib()
+    if lib is not None:
+        return int(lib.tpudfs_crc32c(crc & 0xFFFFFFFF, buf, len(buf)))
+    return _crc32c_numpy(buf, crc)
+
+
+def _as_bytes(data) -> bytes:
+    if isinstance(data, np.ndarray):
+        return data.tobytes()
+    if isinstance(data, (bytearray, memoryview)):
+        return bytes(data)
+    return data
+
+
+def _crc32c_numpy(buf: bytes, crc: int = 0) -> int:
+    if not buf:
+        return crc & 0xFFFFFFFF
+    n = len(buf)
+    chunk = CHECKSUM_CHUNK_SIZE
+    crcs = _crc32c_chunks_numpy(buf, chunk)
+    out = crc & 0xFFFFFFFF
+    done = 0
+    for c in crcs:
+        clen = min(chunk, n - done)
+        out = crc32c_combine(out, int(c), clen)
+        done += clen
+    return out
+
+
+def _crc32c_chunks_numpy(buf: bytes, chunk: int) -> np.ndarray:
+    n = len(buf)
+    nfull = n // chunk
+    out = []
+    if nfull:
+        rows, inv = contrib_table(chunk)
+        arr = np.frombuffer(buf, dtype=np.uint8, count=nfull * chunk)
+        arr = arr.reshape(nfull, chunk)
+        contribs = rows[np.arange(chunk)[None, :], arr]
+        folded = np.bitwise_xor.reduce(contribs, axis=1)
+        out.append(folded ^ np.uint32(inv) ^ np.uint32(0xFFFFFFFF))
+    tail = n - nfull * chunk
+    if tail:
+        rows, inv = contrib_table(tail)
+        arr = np.frombuffer(buf, dtype=np.uint8, offset=nfull * chunk)
+        contribs = rows[np.arange(tail), arr]
+        folded = np.bitwise_xor.reduce(contribs)
+        out.append(
+            np.array([folded ^ np.uint32(inv) ^ np.uint32(0xFFFFFFFF)], dtype=np.uint32)
+        )
+    if not out:
+        return np.zeros(0, dtype=np.uint32)
+    return np.concatenate(out)
+
+
+def crc32c_chunks(
+    data: bytes | bytearray | memoryview | np.ndarray,
+    chunk: int = CHECKSUM_CHUNK_SIZE,
+) -> np.ndarray:
+    """Per-chunk CRC32C (uint32 array), as stored in the ``.meta`` sidecar."""
+    buf = _as_bytes(data)
+    if not buf:
+        return np.zeros(0, dtype=np.uint32)
+    lib = native.get_lib()
+    if lib is None:
+        return _crc32c_chunks_numpy(buf, chunk)
+    n = (len(buf) + chunk - 1) // chunk
+    out = np.empty(n, dtype=np.uint32)
+    lib.tpudfs_crc32c_chunks(
+        buf, len(buf), chunk, out.ctypes.data_as(ctypes.c_void_p)
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CRC concatenation (zlib crc32_combine ported to the Castagnoli polynomial)
+# ---------------------------------------------------------------------------
+
+
+def _gf2_matrix_times(mat: list[int], vec: int) -> int:
+    total = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            total ^= mat[i]
+        vec >>= 1
+        i += 1
+    return total
+
+
+def _gf2_matrix_square(mat: list[int]) -> list[int]:
+    return [_gf2_matrix_times(mat, m) for m in mat]
+
+
+@lru_cache(maxsize=64)
+def _zero_operator(len2: int) -> tuple[int, ...]:
+    """GF(2) matrix advancing a CRC register across ``len2`` zero bytes."""
+    # Matrix for one zero bit, squared up to one zero byte, then composed by
+    # binary decomposition of len2 (zlib crc32_combine structure).
+    odd = [_POLY] + [1 << i for i in range(31)]
+    even = _gf2_matrix_square(odd)  # two bits
+    odd = _gf2_matrix_square(even)  # four bits
+    result = [1 << i for i in range(32)]  # identity
+    n = len2
+    while n:
+        even = _gf2_matrix_square(odd)  # even = odd^2: next power-of-two bytes
+        if n & 1:
+            result = [_gf2_matrix_times(even, r) for r in result]
+        odd = even
+        n >>= 1
+    return tuple(result)
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """CRC32C of A+B given crc32c(A), crc32c(B), and len(B)."""
+    if len2 == 0:
+        return crc1 & 0xFFFFFFFF
+    op = _zero_operator(len2)
+    return (_gf2_matrix_times(op, crc1 & 0xFFFFFFFF) ^ crc2) & 0xFFFFFFFF
+
+
+def verify_chunks(
+    data: bytes, checksums: np.ndarray, chunk: int = CHECKSUM_CHUNK_SIZE
+) -> bool:
+    """Verify ``data`` against stored per-chunk checksums (full-block verify,
+    reference chunkserver.rs:238-292)."""
+    actual = crc32c_chunks(data, chunk)
+    expected = np.asarray(checksums, dtype=np.uint32)
+    return actual.shape == expected.shape and bool(np.array_equal(actual, expected))

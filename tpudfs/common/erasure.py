@@ -1,0 +1,250 @@
+"""Reed-Solomon erasure coding over GF(2^8).
+
+API parity with the reference's dfs/common/src/erasure.rs:7-59 (which wraps the
+reed-solomon-erasure crate): ``encode(data, k, m)`` pads ``data`` to
+``k * shard_len`` and returns ``k + m`` shards (systematic: first ``k`` are the
+data), ``decode`` reconstructs from any ``k`` surviving shards and truncates to
+the original length, ``shard_len`` is ``ceil(len / k)``.
+
+Construction: Vandermonde matrix ``V[r][c] = r**c`` over GF(2^8) (poly 0x11D),
+made systematic by multiplying with the inverse of its top k x k block. Any k
+rows of the resulting matrix remain linearly independent, which is what decode
+relies on.
+
+The byte-crunching inner loop (matrix application over shard bytes) dispatches
+to native C++ (native/gf256.cc); a numpy mul-table gather is the fallback. The
+device twin is the Pallas bit-plane kernel in tpudfs/tpu/rs_pallas.py, which
+must stay bit-exact with ``encode``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from functools import lru_cache
+
+import numpy as np
+
+from tpudfs.common import native
+
+_POLY = 0x11D
+
+
+class ErasureError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# GF(2^8) primitives (numpy)
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=1)
+def _tables() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(exp, log, mul) tables. mul[a, b] = a*b in GF(2^8)."""
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _POLY
+    exp[255:510] = exp[:255]
+    a = np.arange(256)
+    la, lb = np.meshgrid(log[a], log[a], indexing="ij")
+    mul = exp[(la + lb) % 255].astype(np.uint8)
+    mul[0, :] = 0
+    mul[:, 0] = 0
+    return exp, log, mul
+
+
+def gf_mul(a: int, b: int) -> int:
+    return int(_tables()[2][a, b])
+
+
+def gf_pow(a: int, n: int) -> int:
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    exp, log, _ = _tables()
+    return int(exp[(int(log[a]) * n) % 255])
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(2^8) inverse of 0")
+    exp, log, _ = _tables()
+    return int(exp[(255 - int(log[a])) % 255])
+
+
+def _matrix_invert(m: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(2^8). m is (n, n) uint8."""
+    _, _, mul = _tables()
+    n = m.shape[0]
+    aug = np.concatenate([m.astype(np.uint8), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = None
+        for r in range(col, n):
+            if aug[r, col]:
+                pivot = r
+                break
+        if pivot is None:
+            raise ErasureError("singular matrix")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        inv = gf_inv(int(aug[col, col]))
+        aug[col] = mul[inv, aug[col]]
+        for r in range(n):
+            if r != col and aug[r, col]:
+                aug[r] ^= mul[int(aug[r, col]), aug[col]]
+    return aug[:, n:]
+
+
+def _gf_matmul_numpy(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """out[r] = xor_c mat[r, c] * shards[c] — numpy fallback."""
+    _, _, mul = _tables()
+    rows, cols = mat.shape
+    out = np.zeros((rows, shards.shape[1]), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            coef = int(mat[r, c])
+            if coef:
+                out[r] ^= mul[coef, shards[c]]
+    return out
+
+
+def _gf_matmul(mat: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """Apply a GF(2^8) matrix to shard rows; native C++ when available."""
+    lib = native.get_lib()
+    if lib is None:
+        return _gf_matmul_numpy(mat, shards)
+    rows, cols = mat.shape
+    shard_len = shards.shape[1]
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    out = np.empty((rows, shard_len), dtype=np.uint8)
+    in_ptrs = (ctypes.c_void_p * cols)(
+        *(shards.ctypes.data + c * shards.strides[0] for c in range(cols))
+    )
+    out_ptrs = (ctypes.c_void_p * rows)(
+        *(out.ctypes.data + r * out.strides[0] for r in range(rows))
+    )
+    lib.tpudfs_gf256_matmul(
+        np.ascontiguousarray(mat, dtype=np.uint8).tobytes(),
+        rows,
+        cols,
+        in_ptrs,
+        shard_len,
+        out_ptrs,
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Code construction
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=32)
+def encode_matrix(k: int, m: int) -> np.ndarray:
+    """Systematic (k+m) x k generator matrix; top k rows are identity."""
+    if k <= 0 or m <= 0:
+        raise ErasureError("data_shards and parity_shards must both be > 0")
+    if k + m > 256:
+        raise ErasureError("k + m must be <= 256 for GF(2^8)")
+    vand = np.zeros((k + m, k), dtype=np.uint8)
+    for r in range(k + m):
+        for c in range(k):
+            vand[r, c] = gf_pow(r, c)
+    top_inv = _matrix_invert(vand[:k])
+    _, _, mul = _tables()
+    # out = vand @ top_inv over GF(2^8)
+    out = np.zeros((k + m, k), dtype=np.uint8)
+    for r in range(k + m):
+        for c in range(k):
+            acc = 0
+            for i in range(k):
+                acc ^= int(mul[vand[r, i], top_inv[i, c]])
+            out[r, c] = acc
+    return out
+
+
+def shard_len(data_len: int, data_shards: int) -> int:
+    """Bytes per shard (reference erasure.rs:56-59)."""
+    if data_shards <= 0:
+        raise ErasureError("data_shards must be > 0")
+    return -(-data_len // data_shards)
+
+
+def encode(data: bytes, data_shards: int, parity_shards: int) -> list[bytes]:
+    """Split ``data`` into k data shards (zero-padded) + m parity shards."""
+    if not data:
+        raise ErasureError("data must not be empty")
+    k, m = data_shards, parity_shards
+    size = shard_len(len(data), k)
+    padded = np.zeros(k * size, dtype=np.uint8)
+    padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    shards = padded.reshape(k, size)
+    parity = _gf_matmul(encode_matrix(k, m)[k:], shards)
+    return [shards[i].tobytes() for i in range(k)] + [
+        parity[i].tobytes() for i in range(m)
+    ]
+
+
+def reconstruct(
+    shards: list[bytes | None], data_shards: int, parity_shards: int
+) -> list[bytes]:
+    """Fill in every missing shard from any ``k`` survivors.
+
+    Mirrors the reed-solomon-erasure ``reconstruct`` the reference uses for
+    ChunkServer EC repair (chunkserver.rs:503-640).
+    """
+    k, m = data_shards, parity_shards
+    if len(shards) != k + m:
+        raise ErasureError(f"expected {k + m} shard slots, got {len(shards)}")
+    present = [i for i, s in enumerate(shards) if s is not None]
+    if len(present) < k:
+        raise ErasureError(f"need at least {k} shards, have {len(present)}")
+    sizes = {len(shards[i]) for i in present}  # type: ignore[arg-type]
+    if len(sizes) != 1:
+        raise ErasureError("present shards have differing lengths")
+    size = sizes.pop()
+    if all(s is not None for s in shards):
+        return list(shards)  # type: ignore[return-value]
+    gen = encode_matrix(k, m)
+    rows = present[:k]
+    sub = gen[rows]
+    sub_inv = _matrix_invert(sub)
+    avail = np.stack(
+        [np.frombuffer(shards[i], dtype=np.uint8) for i in rows]  # type: ignore[arg-type]
+    )
+    data = _gf_matmul(sub_inv, avail)
+    out: list[bytes] = []
+    missing_parity_rows = [i for i in range(k + m) if shards[i] is None and i >= k]
+    parity_fill = (
+        _gf_matmul(gen[missing_parity_rows], data) if missing_parity_rows else None
+    )
+    pi = 0
+    for i in range(k + m):
+        if shards[i] is not None:
+            out.append(shards[i])  # type: ignore[arg-type]
+        elif i < k:
+            out.append(data[i].tobytes())
+        else:
+            assert parity_fill is not None
+            out.append(parity_fill[pi].tobytes())
+            pi += 1
+    del size
+    return out
+
+
+def decode(
+    shards: list[bytes | None],
+    data_shards: int,
+    parity_shards: int,
+    original_len: int,
+) -> bytes:
+    """Recover the original data (truncated to ``original_len``)."""
+    full = reconstruct(shards, data_shards, parity_shards)
+    return b"".join(full[:data_shards])[:original_len]

@@ -1,0 +1,101 @@
+"""ctypes loader for the native C++ hot-path library (native/libtpudfs_native.so).
+
+The native library carries the byte-crunching inner loops the reference
+implements in Rust (crc32fast checksums, reed-solomon-erasure GF(2^8) math —
+see SURVEY.md §2.4). Pure-numpy fallbacks live next to each call site so the
+framework still runs where the shared library can't be built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_NAME = "libtpudfs_native.so"
+
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _try_build() -> bool:
+    makefile = _NATIVE_DIR / "Makefile"
+    if not makefile.exists():
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("native build failed: %s", e)
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """Load (building on first use if needed) the native library, or None."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    path = os.environ.get("TPUDFS_NATIVE_LIB", str(_NATIVE_DIR / _LIB_NAME))
+    # Always invoke make (no-op when the .so is newer than its sources) so an
+    # edited .cc is never shadowed by a stale binary.
+    if "TPUDFS_NATIVE_LIB" not in os.environ or not Path(path).exists():
+        _try_build()
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        logger.warning("native library unavailable (%s); using numpy fallbacks", e)
+        return None
+
+    lib.tpudfs_crc32c.restype = ctypes.c_uint32
+    lib.tpudfs_crc32c.argtypes = [
+        ctypes.c_uint32,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    lib.tpudfs_crc32c_chunks.restype = None
+    lib.tpudfs_crc32c_chunks.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+    ]
+    lib.tpudfs_crc32c_contrib_table.restype = None
+    lib.tpudfs_crc32c_contrib_table.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    lib.tpudfs_gf256_mul.restype = ctypes.c_uint8
+    lib.tpudfs_gf256_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
+    lib.tpudfs_gf256_mul_slice.restype = None
+    lib.tpudfs_gf256_mul_slice.argtypes = [
+        ctypes.c_uint8,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+    ]
+    lib.tpudfs_gf256_matmul.restype = None
+    lib.tpudfs_gf256_matmul.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    _lib = lib
+    return _lib
+
+
+def have_native() -> bool:
+    return get_lib() is not None

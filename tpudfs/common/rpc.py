@@ -1,0 +1,282 @@
+"""msgpack-over-gRPC RPC substrate (control plane over DCN).
+
+The reference speaks tonic gRPC with protobuf messages (proto/dfs.proto) for
+client ↔ master ↔ chunkserver RPC, with a 100 MB message cap
+(bin/master.rs:20, chunkserver.rs:15). This build keeps gRPC/HTTP2 as the wire
+(grpcio generic methods) but serializes with msgpack, which removes the codegen
+step while keeping binary framing for block payloads. Raft peer RPC — HTTP/JSON
+axum+reqwest in the reference (bin/master.rs:163-171) — rides the same gRPC
+substrate here (SURVEY.md §7 step 3: "raft-over-gRPC, same semantics").
+
+Error convention (preserved from the reference so clients can react):
+- ``Not Leader|<hint_addr>``  — Raft follower rejecting a write
+  (client handling: dfs/client/src/mod.rs:1442-1467)
+- ``REDIRECT:<shard_hint>``   — wrong shard for this key
+  (master.rs:2141-2159)
+Both travel as FAILED_PRECONDITION status details.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections.abc import Awaitable, Callable, Mapping
+from dataclasses import dataclass
+from typing import Any
+
+import grpc
+import grpc.aio
+import msgpack
+
+from tpudfs.common.telemetry import REQUEST_ID_KEY, current_request_id, set_request_id
+
+logger = logging.getLogger(__name__)
+
+MAX_MESSAGE_BYTES = 100 * 1024 * 1024  # parity: reference bin/master.rs:20
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+]
+
+
+def _dumps(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _loads(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+class RpcError(Exception):
+    """Application-level RPC failure with a gRPC status code."""
+
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+    # -- reference error-string conventions ---------------------------------
+
+    @property
+    def not_leader_hint(self) -> str | None:
+        if self.message.startswith("Not Leader"):
+            parts = self.message.split("|", 1)
+            return parts[1] if len(parts) == 2 and parts[1] else None
+        return None
+
+    @property
+    def is_not_leader(self) -> bool:
+        return self.message.startswith("Not Leader")
+
+    @property
+    def redirect_hint(self) -> str | None:
+        if self.message.startswith("REDIRECT:"):
+            return self.message.split(":", 1)[1]
+        return None
+
+    @classmethod
+    def not_leader(cls, hint: str | None = None) -> "RpcError":
+        return cls(grpc.StatusCode.FAILED_PRECONDITION, f"Not Leader|{hint or ''}")
+
+    @classmethod
+    def redirect(cls, shard_hint: str) -> "RpcError":
+        return cls(grpc.StatusCode.FAILED_PRECONDITION, f"REDIRECT:{shard_hint}")
+
+    @classmethod
+    def not_found(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.NOT_FOUND, message)
+
+    @classmethod
+    def invalid(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.INVALID_ARGUMENT, message)
+
+    @classmethod
+    def unavailable(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.UNAVAILABLE, message)
+
+    @classmethod
+    def failed_precondition(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.FAILED_PRECONDITION, message)
+
+    @classmethod
+    def internal(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.INTERNAL, message)
+
+    @classmethod
+    def already_exists(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.ALREADY_EXISTS, message)
+
+    @classmethod
+    def data_loss(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.DATA_LOSS, message)
+
+
+Handler = Callable[[Any], Awaitable[Any]]
+
+
+@dataclass
+class ServerTls:
+    cert_path: str
+    key_path: str
+    ca_path: str | None = None  # set to require client certs (mTLS)
+
+
+@dataclass
+class ClientTls:
+    ca_path: str
+    cert_path: str | None = None
+    key_path: str | None = None
+
+
+class RpcServer:
+    """gRPC server hosting msgpack generic services.
+
+    Handlers are ``async fn(request) -> response`` taking/returning
+    msgpack-compatible values; raise RpcError to fail with a status code.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 tls: ServerTls | None = None):
+        self._host = host
+        self._port = port
+        self._tls = tls
+        self._server: grpc.aio.Server | None = None
+        self._services: list[grpc.GenericRpcHandler] = []
+        self.bound_port: int | None = None
+
+    def add_service(self, service_name: str, handlers: Mapping[str, Handler]) -> None:
+        method_handlers = {
+            method: grpc.unary_unary_rpc_method_handler(
+                self._wrap(service_name, method, fn),
+                request_deserializer=_loads,
+                response_serializer=_dumps,
+            )
+            for method, fn in handlers.items()
+        }
+        self._services.append(
+            grpc.method_handlers_generic_handler(service_name, method_handlers)
+        )
+
+    @staticmethod
+    def _wrap(service: str, method: str, fn: Handler):
+        async def call(request: Any, context: grpc.aio.ServicerContext) -> Any:
+            md = {k: v for k, v in (context.invocation_metadata() or ())}
+            rid = md.get(REQUEST_ID_KEY)
+            token = set_request_id(rid if isinstance(rid, str) else None)
+            try:
+                return await fn(request)
+            except RpcError as e:
+                await context.abort(e.code, e.message)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("unhandled error in %s/%s", service, method)
+                await context.abort(grpc.StatusCode.INTERNAL, "internal error")
+            finally:
+                set_request_id(None)
+                try:
+                    token.var.reset(token)
+                except ValueError:
+                    pass
+
+        return call
+
+    async def start(self) -> int:
+        server = grpc.aio.server(options=_CHANNEL_OPTIONS)
+        server.add_generic_rpc_handlers(tuple(self._services))
+        address = f"{self._host}:{self._port}"
+        if self._tls is not None:
+            with open(self._tls.key_path, "rb") as f:
+                key = f.read()
+            with open(self._tls.cert_path, "rb") as f:
+                cert = f.read()
+            root = None
+            if self._tls.ca_path:
+                with open(self._tls.ca_path, "rb") as f:
+                    root = f.read()
+            creds = grpc.ssl_server_credentials(
+                [(key, cert)],
+                root_certificates=root,
+                require_client_auth=root is not None,
+            )
+            self.bound_port = server.add_secure_port(address, creds)
+        else:
+            self.bound_port = server.add_insecure_port(address)
+        self._server = server
+        await server.start()
+        return self.bound_port
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.bound_port}"
+
+    async def stop(self, grace: float | None = 0.5) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
+
+
+class RpcClient:
+    """Channel-caching msgpack gRPC client.
+
+    One instance per process is typical; channels are created lazily per
+    target address and reused (the reference maintains per-endpoint tonic
+    channels similarly).
+    """
+
+    def __init__(self, tls: ClientTls | None = None):
+        self._tls = tls
+        self._channels: dict[str, grpc.aio.Channel] = {}
+        self._lock = asyncio.Lock()
+
+    async def _channel(self, addr: str) -> grpc.aio.Channel:
+        ch = self._channels.get(addr)
+        if ch is not None:
+            return ch
+        async with self._lock:
+            ch = self._channels.get(addr)
+            if ch is not None:
+                return ch
+            if self._tls is not None:
+                with open(self._tls.ca_path, "rb") as f:
+                    root = f.read()
+                cert = key = None
+                if self._tls.cert_path and self._tls.key_path:
+                    with open(self._tls.cert_path, "rb") as f:
+                        cert = f.read()
+                    with open(self._tls.key_path, "rb") as f:
+                        key = f.read()
+                creds = grpc.ssl_channel_credentials(
+                    root_certificates=root, private_key=key, certificate_chain=cert
+                )
+                ch = grpc.aio.secure_channel(addr, creds, options=_CHANNEL_OPTIONS)
+            else:
+                ch = grpc.aio.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+            self._channels[addr] = ch
+            return ch
+
+    async def call(
+        self,
+        addr: str,
+        service: str,
+        method: str,
+        request: Any,
+        timeout: float | None = 10.0,
+    ) -> Any:
+        ch = await self._channel(addr)
+        rpc = ch.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=_dumps,
+            response_deserializer=_loads,
+        )
+        metadata = ((REQUEST_ID_KEY, current_request_id()),)
+        try:
+            return await rpc(request, timeout=timeout, metadata=metadata)
+        except grpc.aio.AioRpcError as e:
+            raise RpcError(e.code(), e.details() or "") from None
+
+    async def close(self) -> None:
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()

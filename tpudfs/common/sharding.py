@@ -1,0 +1,256 @@
+"""Key → shard mapping (metadata-plane scale-out).
+
+Behavioral parity with the reference's dfs/common/src/sharding.rs:
+- consistent-hash strategy: CRC32 ring with virtual nodes (sharding.rs:17-24,
+  84-93);
+- range strategy: ordered map of exclusive range-end → shard, lexicographic,
+  last end is U+10FFFF (sharding.rs:25-32,167-177);
+- split / merge / rebalance-boundary / neighbors (sharding.rs:180-273);
+- JSON shard-config loader (sharding.rs:304-341).
+
+Unlike the reference (which clones the whole map per query), ShardMap here is a
+plain mutable object; services hold it inside their Raft state machine and ship
+``to_dict()`` snapshots to clients, tagged with a monotonically increasing
+``version`` for cache invalidation.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import zlib
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+RANGE_MAX = "\U0010ffff"
+
+
+def hash_key(key: str) -> int:
+    """Deterministic CRC32 key hash (reference sharding.rs:9-13)."""
+    return zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF
+
+
+@dataclass
+class ShardMap:
+    strategy: str = "range"  # "range" | "hash"
+    virtual_nodes: int = 16
+    version: int = 0
+    # Range strategy: parallel sorted arrays (range-end key -> shard id).
+    # Lookup picks the first end >= key (reference sharding.rs:171-175), so a
+    # key equal to a boundary belongs to the range that boundary terminates.
+    _range_ends: list[str] = field(default_factory=list)
+    _range_ids: list[str] = field(default_factory=list)
+    # hash strategy: sorted ring of (hash, shard_id)
+    _ring: list[tuple[int, str]] = field(default_factory=list)
+    _peers: dict[str, list[str]] = field(default_factory=dict)
+
+    # -- membership ---------------------------------------------------------
+
+    @property
+    def shards(self) -> set[str]:
+        return set(self._peers)
+
+    def has_shard(self, shard_id: str) -> bool:
+        return shard_id in self._peers
+
+    def get_peers(self, shard_id: str) -> list[str] | None:
+        peers = self._peers.get(shard_id)
+        return list(peers) if peers is not None else None
+
+    def get_all_shards(self) -> list[str]:
+        return sorted(self._peers)
+
+    def get_all_masters(self) -> list[str]:
+        seen: dict[str, None] = {}
+        for peers in self._peers.values():
+            for p in peers:
+                seen[p] = None
+        return list(seen)
+
+    def add_shard(self, shard_id: str, peers: list[str]) -> None:
+        """Add (or update peers of) a shard (reference sharding.rs:70-126)."""
+        if shard_id in self._peers:
+            self._peers[shard_id] = list(peers)
+            self.version += 1
+            return
+        self._peers[shard_id] = list(peers)
+        self.version += 1
+        if self.strategy == "hash":
+            for i in range(self.virtual_nodes):
+                h = hash_key(f"{shard_id}:{i}")
+                bisect.insort(self._ring, (h, shard_id))
+            return
+        # Range strategy: first shard covers everything; second splits at "/m"
+        # (same bootstrap heuristic as the reference); later ones append.
+        if not self._range_ends:
+            self._insert_range(RANGE_MAX, shard_id)
+        elif len(self._range_ends) == 1:
+            old = self._range_ids[0]
+            self._range_ends.clear()
+            self._range_ids.clear()
+            self._insert_range("/m", shard_id)
+            self._insert_range(RANGE_MAX, old)
+        else:
+            self._insert_range(f"z-{shard_id}", shard_id)
+
+    def remove_shard(self, shard_id: str) -> None:
+        if shard_id not in self._peers:
+            return
+        del self._peers[shard_id]
+        self.version += 1
+        if self.strategy == "hash":
+            self._ring = [(h, s) for h, s in self._ring if s != shard_id]
+        else:
+            keep = [
+                (e, s)
+                for e, s in zip(self._range_ends, self._range_ids)
+                if s != shard_id
+            ]
+            self._range_ends = [e for e, _ in keep]
+            self._range_ids = [s for _, s in keep]
+
+    def _insert_range(self, end_key: str, shard_id: str) -> None:
+        idx = bisect.bisect_left(self._range_ends, end_key)
+        self._range_ends.insert(idx, end_key)
+        self._range_ids.insert(idx, shard_id)
+
+    # -- lookup -------------------------------------------------------------
+
+    def get_shard(self, key: str) -> str | None:
+        """Shard owning ``key`` (reference sharding.rs:157-177)."""
+        if self.strategy == "hash":
+            if not self._ring:
+                return None
+            h = hash_key(key)
+            idx = bisect.bisect_left(self._ring, (h, ""))
+            if idx == len(self._ring):
+                idx = 0
+            return self._ring[idx][1]
+        if not self._range_ends:
+            return None
+        idx = bisect.bisect_left(self._range_ends, key)
+        if idx == len(self._range_ends):
+            return None
+        return self._range_ids[idx]
+
+    # -- dynamic resharding (range only) ------------------------------------
+
+    def split_shard(self, split_key: str, new_shard_id: str, peers: list[str]) -> bool:
+        """Insert a split point; new shard takes keys < split_key within the
+        old range (reference sharding.rs:181-208)."""
+        if self.strategy != "range":
+            return False
+        if new_shard_id in self._peers or split_key in self._range_ends:
+            return False
+        if bisect.bisect_left(self._range_ends, split_key) >= len(self._range_ends):
+            return False  # split key beyond all ranges
+        self._insert_range(split_key, new_shard_id)
+        self._peers[new_shard_id] = list(peers)
+        self.version += 1
+        return True
+
+    def merge_shards(self, victim_shard_id: str, retained_shard_id: str) -> bool:
+        """Remove victim's split point, folding its range into a neighbor
+        (reference sharding.rs:212-247)."""
+        if self.strategy != "range":
+            return False
+        if victim_shard_id not in self._peers or retained_shard_id not in self._peers:
+            return False
+        try:
+            vidx = self._range_ids.index(victim_shard_id)
+        except ValueError:
+            return False
+        vkey = self._range_ends[vidx]
+        del self._range_ends[vidx]
+        del self._range_ids[vidx]
+        if vkey == RANGE_MAX:
+            # Victim owned the tail range: retained must take over RANGE_MAX.
+            try:
+                ridx = self._range_ids.index(retained_shard_id)
+                del self._range_ends[ridx]
+                del self._range_ids[ridx]
+            except ValueError:
+                pass
+            self._insert_range(RANGE_MAX, retained_shard_id)
+        del self._peers[victim_shard_id]
+        self.version += 1
+        return True
+
+    def rebalance_boundary(self, old_key: str, new_key: str) -> bool:
+        """Shift a range boundary (reference sharding.rs:251-260)."""
+        if self.strategy != "range":
+            return False
+        try:
+            idx = self._range_ends.index(old_key)
+        except ValueError:
+            return False
+        shard_id = self._range_ids[idx]
+        del self._range_ends[idx]
+        del self._range_ids[idx]
+        self._insert_range(new_key, shard_id)
+        self.version += 1
+        return True
+
+    def get_neighbors(self, shard_id: str) -> tuple[str | None, str | None]:
+        """(previous, next) shards in range order (reference sharding.rs:263-277)."""
+        if self.strategy != "range":
+            return (None, None)
+        for i, sid in enumerate(self._range_ids):
+            if sid == shard_id:
+                prev = self._range_ids[i - 1] if i > 0 else None
+                nxt = self._range_ids[i + 1] if i + 1 < len(self._range_ids) else None
+                return (prev, nxt)
+        return (None, None)
+
+    def range_of(self, shard_id: str) -> tuple[str, str] | None:
+        """[start, end) keyspace owned by shard (start "" for the first)."""
+        if self.strategy != "range":
+            return None
+        for i, sid in enumerate(self._range_ids):
+            if sid == shard_id:
+                start = self._range_ends[i - 1] if i > 0 else ""
+                return (start, self._range_ends[i])
+        return None
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "strategy": self.strategy,
+            "virtual_nodes": self.virtual_nodes,
+            "version": self.version,
+            "ranges": list(zip(self._range_ends, self._range_ids)),
+            "ring": list(self._ring),
+            "peers": {k: list(v) for k, v in self._peers.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ShardMap":
+        sm = cls(
+            strategy=d.get("strategy", "range"),
+            virtual_nodes=d.get("virtual_nodes", 16),
+            version=d.get("version", 0),
+        )
+        sm._range_ends = [e for e, _ in d.get("ranges", [])]
+        sm._range_ids = [s for _, s in d.get("ranges", [])]
+        sm._ring = [(int(h), s) for h, s in d.get("ring", [])]
+        sm._peers = {k: list(v) for k, v in d.get("peers", {}).items()}
+        return sm
+
+
+def load_shard_map_from_config(path: str | None, virtual_nodes: int = 16) -> ShardMap:
+    """Build a range ShardMap from a ``{"shards": {id: [peers]}}`` JSON file,
+    shard ids sorted for determinism (reference sharding.rs:304-341)."""
+    sm = ShardMap(strategy="range", virtual_nodes=virtual_nodes)
+    if path:
+        try:
+            with open(path) as f:
+                cfg = json.load(f)
+            for shard_id in sorted(cfg.get("shards", {})):
+                sm.add_shard(shard_id, cfg["shards"][shard_id])
+            return sm
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("failed to load shard config %s: %s; using empty map", path, e)
+    return sm

@@ -1,0 +1,62 @@
+"""Distributed request correlation.
+
+The reference threads an ``x-request-id`` through every hop: client-side
+interceptor generates/injects it, each server RPC runs inside a span carrying
+it, and replication chains forward the same id (dfs/common/src/lib.rs:5-51,
+chunkserver.rs:787,1045). Here the id lives in a contextvar; the RPC layer
+(tpudfs.common.rpc) injects it into outgoing gRPC metadata and adopts it from
+incoming metadata, so the chain client → master → chunkserver → replica logs a
+single id end to end.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import os
+import uuid
+
+REQUEST_ID_KEY = "x-request-id"
+
+_request_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tpudfs_request_id", default=None
+)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_request_id() -> str:
+    """The in-flight request id, minting one if this is the chain's origin."""
+    rid = _request_id.get()
+    if rid is None:
+        rid = new_request_id()
+        _request_id.set(rid)
+    return rid
+
+
+def set_request_id(rid: str | None) -> contextvars.Token:
+    return _request_id.set(rid)
+
+
+class _RequestIdFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.request_id = _request_id.get() or "-"
+        return True
+
+
+def setup_logging(level: str | None = None) -> None:
+    """Structured logging with the request id on every line (the reference's
+    tracing-subscriber EnvFilter equivalent; bin/master.rs:101-107)."""
+    level = level or os.environ.get("TPUDFS_LOG", "INFO")
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(levelname)s [%(request_id)s] %(name)s: %(message)s"
+        )
+    )
+    handler.addFilter(_RequestIdFilter())
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level.upper())
