@@ -1,0 +1,110 @@
+"""BlockStore: durability, sidecars, verification, tiering
+(coverage model: reference chunkserver.rs:1090-1248 tempdir tests)."""
+
+import numpy as np
+import pytest
+
+from tpudfs.common.checksum import crc32c_chunks
+from tpudfs.chunkserver.blockstore import (
+    BlockCorruptionError,
+    BlockNotFoundError,
+    BlockStore,
+)
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture
+def store(tmp_path):
+    return BlockStore(tmp_path / "hot", tmp_path / "cold")
+
+
+def test_write_read_roundtrip(store):
+    data = _rand(3000)
+    sums = store.write("b1", data)
+    assert store.read("b1") == data
+    assert store.read("b1", 512, 100) == data[512:612]
+    assert store.read("b1", 2900) == data[2900:]
+    np.testing.assert_array_equal(sums, crc32c_chunks(data))
+    np.testing.assert_array_equal(store.read_meta("b1"), sums)
+    assert store.size("b1") == 3000
+
+
+def test_missing_block(store):
+    with pytest.raises(BlockNotFoundError):
+        store.read("ghost")
+    with pytest.raises(BlockNotFoundError):
+        store.size("ghost")
+
+
+def test_invalid_block_ids(store):
+    for bad in ("", "a/b", ".hidden", "x\x00y"):
+        with pytest.raises(ValueError):
+            store.write(bad, b"d")
+
+
+def test_verify_full_detects_corruption(store, tmp_path):
+    data = _rand(2048, 1)
+    store.write("b1", data)
+    store.verify_full("b1")
+    # Flip one byte on disk.
+    path = tmp_path / "hot" / "b1"
+    raw = bytearray(path.read_bytes())
+    raw[1000] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(BlockCorruptionError):
+        store.verify_full("b1")
+
+
+def test_verify_range_scoped_to_touched_chunks(store, tmp_path):
+    data = _rand(4096, 2)
+    store.write("b1", data)
+    path = tmp_path / "hot" / "b1"
+    raw = bytearray(path.read_bytes())
+    raw[3000] ^= 0x01  # corrupt chunk 5 (bytes 2560-3071)
+    path.write_bytes(bytes(raw))
+    store.verify_range("b1", 0, 512)  # chunk 0: fine
+    store.verify_range("b1", 1024, 1024)  # chunks 2-3: fine
+    with pytest.raises(BlockCorruptionError):
+        store.verify_range("b1", 2900, 10)
+    with pytest.raises(BlockCorruptionError):
+        store.verify_full("b1")
+
+
+def test_move_to_cold_and_back_read(store):
+    data = _rand(1024, 3)
+    store.write("b1", data)
+    assert store.move_to_cold("b1")
+    assert store.is_cold("b1")
+    assert store.read("b1") == data
+    store.verify_full("b1")  # sidecar moved too
+    assert not store.move_to_cold("b1")  # already cold
+
+
+def test_delete_and_list(store):
+    store.write("b1", b"one")
+    store.write("b2", b"two")
+    store.move_to_cold("b2")
+    assert store.list_blocks() == ["b1", "b2"]
+    assert store.delete("b2")
+    assert store.list_blocks() == ["b1"]
+    assert not store.delete("b2")
+
+
+def test_stats(store):
+    store.write("b1", _rand(1000))
+    store.write("b2", _rand(500))
+    s = store.stats()
+    assert s["chunk_count"] == 2
+    assert s["used_space"] == 1500
+    assert s["available_space"] > 0
+
+
+def test_rewrite_replaces_atomically(store):
+    store.write("b1", _rand(1000, 4))
+    new = _rand(600, 5)
+    store.write("b1", new)
+    assert store.read("b1") == new
+    store.verify_full("b1")

@@ -1,0 +1,402 @@
+"""ChunkServer service: pipeline replication, fencing, cache, corruption
+recovery, EC reconstruction, scrubber — against real gRPC servers in-process.
+
+Coverage model: reference chunkserver.rs write/read/replicate handlers and the
+docker chaos tests' recovery assertions (SURVEY.md §3.5)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tpudfs.common.checksum import crc32c
+from tpudfs.common.erasure import encode
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
+from tpudfs.chunkserver.blockstore import BlockStore
+from tpudfs.chunkserver.heartbeat import HeartbeatLoop
+from tpudfs.chunkserver.service import SERVICE, ChunkServer
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+class Cluster:
+    """N in-process chunkservers + a fake master locator service."""
+
+    def __init__(self):
+        self.servers: list[ChunkServer] = []
+        self.locations: dict[str, list[str]] = {}
+        self.master_server: RpcServer | None = None
+        self.master_addr: str | None = None
+        self.client = RpcClient()
+
+    async def start_master(self):
+        async def get_block_locations(req):
+            locs = self.locations.get(req["block_id"])
+            return {"found": locs is not None, "locations": locs or []}
+
+        self.master_server = RpcServer()
+        self.master_server.add_service(
+            "MasterService", {"GetBlockLocations": get_block_locations}
+        )
+        await self.master_server.start()
+        self.master_addr = self.master_server.address
+
+    async def add_cs(self, tmp_path, i, **kw) -> ChunkServer:
+        store = BlockStore(tmp_path / f"cs{i}/hot", tmp_path / f"cs{i}/cold")
+        cs = ChunkServer(
+            store,
+            master_addrs=[self.master_addr] if self.master_addr else [],
+            **kw,
+        )
+        await cs.start(scrubber=False)
+        self.servers.append(cs)
+        return cs
+
+    async def stop(self):
+        for cs in self.servers:
+            await cs.stop()
+        if self.master_server:
+            await self.master_server.stop()
+        await self.client.close()
+
+
+@pytest.fixture
+def cluster():
+    return Cluster()
+
+
+async def _write(client, addr, block_id, data, next_servers=(), term=0, crc=None):
+    return await client.call(
+        addr, SERVICE, "WriteBlock",
+        {
+            "block_id": block_id,
+            "data": data,
+            "next_servers": list(next_servers),
+            "expected_crc32c": crc if crc is not None else crc32c(data),
+            "master_term": term,
+        },
+    )
+
+
+async def test_pipeline_replication_3x(cluster, tmp_path):
+    try:
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(3)]
+        data = _rand(1 << 20)
+        resp = await _write(
+            cluster.client, cs[0].address, "blk", data,
+            next_servers=[cs[1].address, cs[2].address],
+        )
+        assert resp["success"] and resp["replicas_written"] == 3
+        for s in cs:
+            assert s.store.read("blk") == data
+            s.store.verify_full("blk")
+    finally:
+        await cluster.stop()
+
+
+async def test_chain_survives_dead_tail(cluster, tmp_path):
+    try:
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(2)]
+        data = _rand(4096, 1)
+        # Third pipeline target is unreachable: write still succeeds with 2
+        # replicas (healer's job to fix — reference logs and continues).
+        resp = await _write(
+            cluster.client, cs[0].address, "blk", data,
+            next_servers=[cs[1].address, "127.0.0.1:1"],
+        )
+        assert resp["success"] and resp["replicas_written"] == 2
+    finally:
+        await cluster.stop()
+
+
+async def test_write_checksum_mismatch_soft_fail(cluster, tmp_path):
+    try:
+        cs = await cluster.add_cs(tmp_path, 0)
+        resp = await _write(cluster.client, cs.address, "blk", b"hello", crc=12345)
+        assert not resp["success"]
+        assert "Checksum mismatch" in resp["error_message"]
+        assert not cs.store.exists("blk")
+    finally:
+        await cluster.stop()
+
+
+async def test_epoch_fencing(cluster, tmp_path):
+    try:
+        cs = await cluster.add_cs(tmp_path, 0)
+        await _write(cluster.client, cs.address, "b1", b"new-era", term=5)
+        assert cs.known_term == 5
+        with pytest.raises(RpcError) as ei:
+            await _write(cluster.client, cs.address, "b2", b"stale", term=3)
+        assert "Stale master term" in ei.value.message
+        # term 0 (unknown) is always allowed
+        resp = await _write(cluster.client, cs.address, "b3", b"legacy", term=0)
+        assert resp["success"]
+    finally:
+        await cluster.stop()
+
+
+async def test_read_offset_length_semantics(cluster, tmp_path):
+    try:
+        cs = await cluster.add_cs(tmp_path, 0)
+        data = _rand(3000, 2)
+        await _write(cluster.client, cs.address, "blk", data)
+        r = await cluster.client.call(
+            cs.address, SERVICE, "ReadBlock", {"block_id": "blk", "offset": 100, "length": 200}
+        )
+        assert r["data"] == data[100:300] and r["total_size"] == 3000
+        # length 0 = rest of block
+        r = await cluster.client.call(
+            cs.address, SERVICE, "ReadBlock", {"block_id": "blk", "offset": 2900, "length": 0}
+        )
+        assert r["data"] == data[2900:]
+        with pytest.raises(RpcError):
+            await cluster.client.call(
+                cs.address, SERVICE, "ReadBlock", {"block_id": "blk", "offset": 3000}
+            )
+        with pytest.raises(RpcError):
+            await cluster.client.call(
+                cs.address, SERVICE, "ReadBlock", {"block_id": "ghost"}
+            )
+    finally:
+        await cluster.stop()
+
+
+async def test_full_read_cache(cluster, tmp_path):
+    try:
+        cs = await cluster.add_cs(tmp_path, 0)
+        data = _rand(2048, 3)
+        await _write(cluster.client, cs.address, "blk", data)
+        for _ in range(2):
+            r = await cluster.client.call(
+                cs.address, SERVICE, "ReadBlock", {"block_id": "blk"}
+            )
+            assert r["data"] == data
+        assert cs.cache.hits == 1 and cs.cache.misses == 1
+    finally:
+        await cluster.stop()
+
+
+def _corrupt_on_disk(cs: ChunkServer, block_id: str, byte_index: int = 10):
+    path = cs.store.block_path(block_id)
+    raw = bytearray(path.read_bytes())
+    raw[byte_index] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    cs.cache.invalidate(block_id)
+
+
+async def test_full_read_corruption_recovers_from_replica(cluster, tmp_path):
+    try:
+        await cluster.start_master()
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(2)]
+        data = _rand(4096, 4)
+        await _write(
+            cluster.client, cs[0].address, "blk", data, next_servers=[cs[1].address]
+        )
+        cluster.locations["blk"] = [cs[0].address, cs[1].address]
+        _corrupt_on_disk(cs[0], "blk")
+        r = await cluster.client.call(
+            cs[0].address, SERVICE, "ReadBlock", {"block_id": "blk"}
+        )
+        assert r["data"] == data  # healed transparently
+        cs[0].store.verify_full("blk")
+    finally:
+        await cluster.stop()
+
+
+async def test_full_read_corruption_no_replica_is_data_loss(cluster, tmp_path):
+    try:
+        await cluster.start_master()
+        cs = await cluster.add_cs(tmp_path, 0)
+        data = _rand(1024, 5)
+        await _write(cluster.client, cs.address, "blk", data)
+        cluster.locations["blk"] = [cs.address]  # only ourselves
+        _corrupt_on_disk(cs, "blk")
+        with pytest.raises(RpcError) as ei:
+            await cluster.client.call(cs.address, SERVICE, "ReadBlock", {"block_id": "blk"})
+        assert "corruption" in ei.value.message.lower()
+    finally:
+        await cluster.stop()
+
+
+async def test_partial_read_corruption_returns_data_and_heals_in_background(
+    cluster, tmp_path
+):
+    try:
+        await cluster.start_master()
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(2)]
+        data = _rand(4096, 6)
+        await _write(
+            cluster.client, cs[0].address, "blk", data, next_servers=[cs[1].address]
+        )
+        cluster.locations["blk"] = [cs[0].address, cs[1].address]
+        _corrupt_on_disk(cs[0], "blk", byte_index=600)  # chunk 1
+        r = await cluster.client.call(
+            cs[0].address, SERVICE, "ReadBlock",
+            {"block_id": "blk", "offset": 512, "length": 512},
+        )
+        # Read is served (possibly corrupt) — but recovery runs in background.
+        assert r["bytes_read"] == 512
+        for _ in range(50):
+            await asyncio.sleep(0.05)
+            try:
+                cs[0].store.verify_full("blk")
+                break
+            except Exception:
+                continue
+        cs[0].store.verify_full("blk")
+        assert cs[0].store.read("blk") == data
+    finally:
+        await cluster.stop()
+
+
+async def test_scrubber_detects_and_heals(cluster, tmp_path):
+    try:
+        await cluster.start_master()
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(2)]
+        data = _rand(2048, 7)
+        await _write(
+            cluster.client, cs[0].address, "blk", data, next_servers=[cs[1].address]
+        )
+        cluster.locations["blk"] = [cs[0].address, cs[1].address]
+        _corrupt_on_disk(cs[0], "blk")
+        corrupted = await cs[0].scrub_once()
+        assert corrupted == ["blk"]
+        cs[0].store.verify_full("blk")
+        assert cs[0].store.read("blk") == data
+    finally:
+        await cluster.stop()
+
+
+async def test_ec_reconstruct_shard(cluster, tmp_path):
+    try:
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(6)]
+        k, m = 4, 2
+        data = _rand(10_000, 8)
+        shards = encode(data, k, m)
+        # Place shard i on cs[i]; all EC shards of a block share the block id.
+        for i in range(k + m):
+            if i == 2:
+                continue  # shard 2 lost
+            await _write(cluster.client, cs[i].address, "ecblk", shards[i])
+        sources = [s.address for s in cs]
+        sources[2] = ""  # unavailable slot
+        err = await cs[2].reconstruct_ec_shard("ecblk", 2, k, m, sources)
+        assert err is None
+        assert cs[2].store.read("ecblk") == shards[2]
+        cs[2].store.verify_full("ecblk")
+        # Too few survivors: drop all but 3 sources.
+        sources2 = ["", "", "", ""] + sources[4:]
+        err = await cs[2].reconstruct_ec_shard("ecblk2", 2, k, m, sources2)
+        assert err and "need at least" in err
+    finally:
+        await cluster.stop()
+
+
+async def test_heartbeat_reports_and_executes_commands(cluster, tmp_path):
+    try:
+        heartbeats = []
+        commands = [
+            {"type": "MOVE_TO_COLD", "block_id": "blk", "master_term": 7},
+        ]
+
+        async def heartbeat(req):
+            heartbeats.append(req)
+            cmds, commands[:] = list(commands), []
+            return {"success": True, "commands": cmds, "master_term": 7}
+
+        master = RpcServer()
+        master.add_service("MasterService", {"Heartbeat": heartbeat})
+        await master.start()
+
+        cs = await cluster.add_cs(tmp_path, 0, rack_id="rack-a")
+        data = _rand(512, 9)
+        await _write(cluster.client, cs.address, "blk", data)
+        cs.pending_bad_blocks.add("bad-1")
+
+        hb = HeartbeatLoop(cs, master_addrs=[master.address])
+        await hb.tick()
+        assert heartbeats[0]["chunk_server_address"] == cs.address
+        assert heartbeats[0]["rack_id"] == "rack-a"
+        assert heartbeats[0]["chunk_count"] == 1
+        assert heartbeats[0]["bad_blocks"] == ["bad-1"]
+        assert cs.known_term == 7
+        assert cs.store.is_cold("blk")  # MOVE_TO_COLD executed
+        await master.stop()
+    finally:
+        await cluster.stop()
+
+
+async def test_empty_block_roundtrip(cluster, tmp_path):
+    try:
+        cs = await cluster.add_cs(tmp_path, 0)
+        resp = await _write(cluster.client, cs.address, "empty", b"")
+        assert resp["success"]
+        r = await cluster.client.call(
+            cs.address, SERVICE, "ReadBlock", {"block_id": "empty"}
+        )
+        assert r["data"] == b"" and r["total_size"] == 0
+    finally:
+        await cluster.stop()
+
+
+async def test_truncated_sidecar_is_corruption_not_crash(cluster, tmp_path):
+    try:
+        await cluster.start_master()
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(2)]
+        data = _rand(1024, 11)
+        await _write(
+            cluster.client, cs[0].address, "blk", data, next_servers=[cs[1].address]
+        )
+        cluster.locations["blk"] = [cs[0].address, cs[1].address]
+        # Truncate the sidecar to 10 bytes — shorter than its header.
+        meta = cs[0].store.block_path("blk").with_name("blk.meta")
+        meta.write_bytes(meta.read_bytes()[:10])
+        cs[0].cache.invalidate("blk")
+        # Scrub must treat it as corruption (not abort) and heal from replica.
+        corrupted = await cs[0].scrub_once()
+        assert corrupted == ["blk"]
+        cs[0].store.verify_full("blk")
+    finally:
+        await cluster.stop()
+
+
+async def test_bad_blocks_retained_until_master_reachable(cluster, tmp_path):
+    try:
+        cs = await cluster.add_cs(tmp_path, 0)
+        cs.pending_bad_blocks.add("bad-1")
+        hb = HeartbeatLoop(cs, master_addrs=["127.0.0.1:1"])  # unreachable
+        await hb.tick()
+        assert cs.pending_bad_blocks == {"bad-1"}  # not lost
+
+        seen = []
+
+        async def heartbeat(req):
+            seen.append(req)
+            return {"success": True, "commands": [], "master_term": 1}
+
+        master = RpcServer()
+        master.add_service("MasterService", {"Heartbeat": heartbeat})
+        await master.start()
+        hb2 = HeartbeatLoop(cs, master_addrs=[master.address])
+        await hb2.tick()
+        assert seen[0]["bad_blocks"] == ["bad-1"]
+        assert cs.pending_bad_blocks == set()  # cleared after delivery
+        await master.stop()
+    finally:
+        await cluster.stop()
+
+
+async def test_healer_replicate_command(cluster, tmp_path):
+    try:
+        cs = [await cluster.add_cs(tmp_path, i) for i in range(2)]
+        data = _rand(1024, 10)
+        await _write(cluster.client, cs[0].address, "blk", data)
+        err = await cs[0].initiate_replication("blk", cs[1].address)
+        assert err is None
+        assert cs[1].store.read("blk") == data
+        err = await cs[0].initiate_replication("ghost", cs[1].address)
+        assert err is not None
+    finally:
+        await cluster.stop()

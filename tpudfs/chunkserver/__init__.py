@@ -1,0 +1,1 @@
+"""Data plane: block storage, pipeline replication, scrubbing, healing."""

@@ -1,0 +1,57 @@
+"""ChunkServer process entrypoint (reference dfs/chunkserver/src/bin/chunkserver.rs).
+
+Run: python -m tpudfs.chunkserver --port 50100 --data-dir /data/cs1 \
+         --masters 127.0.0.1:50051 [--config-servers ...] [--cold-dir ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from tpudfs.common.telemetry import setup_logging
+from tpudfs.chunkserver.blockstore import BlockStore
+from tpudfs.chunkserver.heartbeat import HeartbeatLoop
+from tpudfs.chunkserver.service import ChunkServer
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("tpudfs-chunkserver")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=50100)
+    p.add_argument("--advertise", default="", help="address to report to masters")
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--cold-dir", default=None)
+    p.add_argument("--rack-id", default="default")
+    p.add_argument("--masters", default="", help="comma-separated master addresses")
+    p.add_argument("--config-servers", default="", help="comma-separated config servers")
+    p.add_argument("--heartbeat-interval", type=float, default=5.0)
+    p.add_argument("--scrub-interval", type=float, default=60.0)
+    return p.parse_args(argv)
+
+
+async def amain(args) -> None:
+    store = BlockStore(args.data_dir, args.cold_dir)
+    masters = [m for m in args.masters.split(",") if m]
+    configs = [c for c in args.config_servers.split(",") if c]
+    cs = ChunkServer(
+        store,
+        address=args.advertise,
+        rack_id=args.rack_id,
+        master_addrs=masters,
+        scrub_interval=args.scrub_interval,
+    )
+    await cs.start(args.host, args.port)
+    hb = HeartbeatLoop(cs, masters, configs, interval=args.heartbeat_interval)
+    hb.start()
+    print(f"READY {cs.address}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    asyncio.run(amain(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

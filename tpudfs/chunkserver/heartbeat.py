@@ -1,0 +1,143 @@
+"""ChunkServer heartbeat loop.
+
+Reference: dfs/chunkserver/src/bin/chunkserver.rs:144-355 — every 5 s the CS
+(1) refreshes the shard map from the Config Server so it knows every master,
+(2) reports space / chunk count / bad blocks / rack id to **all** masters, and
+(3) executes the commands each master returns (REPLICATE /
+RECONSTRUCT_EC_SHARD / MOVE_TO_COLD), learning the master Raft term from
+responses for epoch fencing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from tpudfs.common.rpc import RpcError
+from tpudfs.common.sharding import ShardMap
+from tpudfs.chunkserver.service import ChunkServer
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL = 5.0
+
+
+class HeartbeatLoop:
+    def __init__(
+        self,
+        cs: ChunkServer,
+        master_addrs: list[str] | None = None,
+        config_addrs: list[str] | None = None,
+        interval: float = HEARTBEAT_INTERVAL,
+    ):
+        self.cs = cs
+        self.static_masters = list(master_addrs or [])
+        self.config_addrs = list(config_addrs or [])
+        self.interval = interval
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("heartbeat tick failed")
+            await asyncio.sleep(self.interval)
+
+    async def refresh_masters(self) -> list[str]:
+        """Union of static masters and every master in the Config Server's
+        shard map (reference chunkserver.rs:145-180)."""
+        masters = list(self.static_masters)
+        for cfg in self.config_addrs:
+            try:
+                resp = await self.cs.client.call(
+                    cfg, "ConfigService", "FetchShardMap", {}, timeout=5.0
+                )
+                sm = ShardMap.from_dict(resp["shard_map"])
+                for m in sm.get_all_masters():
+                    if m not in masters:
+                        masters.append(m)
+                break
+            except RpcError as e:
+                logger.warning("shard map refresh via %s failed: %s", cfg, e.message)
+        self.cs.master_addrs = masters
+        return masters
+
+    async def tick(self) -> list[dict]:
+        if self.config_addrs:
+            masters = await self.refresh_masters()
+        else:
+            masters = self.static_masters or self.cs.master_addrs
+        self.cs.master_addrs = list(masters)
+        stats = await asyncio.to_thread(self.cs.store.stats)
+        # Snapshot (don't drain) bad blocks: they are only cleared once at
+        # least one master has actually received the report.
+        bad_blocks = sorted(self.cs.pending_bad_blocks)
+        req = {
+            "chunk_server_address": self.cs.address,
+            "used_space": stats["used_space"],
+            "available_space": stats["available_space"],
+            "chunk_count": stats["chunk_count"],
+            "bad_blocks": bad_blocks,
+            "rack_id": self.cs.rack_id,
+        }
+        executed: list[dict] = []
+        reported = False
+        for master in masters:
+            try:
+                resp = await self.cs.client.call(
+                    master, "MasterService", "Heartbeat", req, timeout=5.0
+                )
+            except RpcError as e:
+                logger.warning("heartbeat to %s failed: %s", master, e.message)
+                continue
+            reported = True
+            self.cs.observe_term(int(resp.get("master_term", 0)))
+            for cmd in resp.get("commands") or []:
+                try:
+                    await self.execute_command(cmd)
+                except Exception:
+                    logger.exception("command %s failed", cmd.get("type"))
+                executed.append(cmd)
+        if reported:
+            self.cs.pending_bad_blocks.difference_update(bad_blocks)
+        return executed
+
+    async def execute_command(self, cmd: dict) -> None:
+        """Dispatch a master command (reference bin/chunkserver.rs:271-338)."""
+        ctype = cmd.get("type")
+        block_id = cmd.get("block_id", "")
+        self.cs.observe_term(int(cmd.get("master_term", 0)))
+        if ctype == "REPLICATE":
+            err = await self.cs.initiate_replication(
+                block_id, cmd["target_chunk_server_address"]
+            )
+        elif ctype == "RECONSTRUCT_EC_SHARD":
+            err = await self.cs.reconstruct_ec_shard(
+                block_id,
+                int(cmd["shard_index"]),
+                int(cmd["ec_data_shards"]),
+                int(cmd["ec_parity_shards"]),
+                list(cmd["ec_shard_sources"]),
+            )
+        elif ctype == "MOVE_TO_COLD":
+            moved = await asyncio.to_thread(self.cs.store.move_to_cold, block_id)
+            err = None if moved else f"block {block_id} not in hot tier"
+        elif ctype == "DELETE":
+            await asyncio.to_thread(self.cs.store.delete, block_id)
+            self.cs.cache.invalidate(block_id)
+            err = None
+        else:
+            err = f"unknown command type {ctype!r}"
+        if err:
+            logger.error("command %s for block %s failed: %s", ctype, block_id, err)

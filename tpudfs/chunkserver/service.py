@@ -1,0 +1,466 @@
+"""ChunkServer service: pipeline-replicated, self-healing block RPC.
+
+Behavioral model: reference dfs/chunkserver/src/chunkserver.rs —
+- ``WriteBlock``: epoch fencing, in-flight CRC32C verify (soft failure via
+  ``success=False``, chunkserver.rs:746-766), durable local write, best-effort
+  synchronous chain-forward of the remaining pipeline with aggregated
+  ``replicas_written`` (chunkserver.rs:777-825);
+- ``ReplicateBlock``: the chain hop — same semantics (chunkserver.rs:983-1087);
+- ``ReadBlock``: LRU full-block cache (env BLOCK_CACHE_SIZE, default 100,
+  chunkserver.rs:67-76), full-read verify with recover-and-retry on corruption
+  (chunkserver.rs:914-949), partial-read verify that triggers *background*
+  recovery without failing the read (chunkserver.rs:893-911);
+- ``recover_block``: ask every known master for locations, fetch from a healthy
+  peer, verify, rewrite (chunkserver.rs:353-460);
+- ``reconstruct_ec_shard``: concurrent shard fetch from per-slot sources, RS
+  reconstruct, write local shard — all EC shards of a block share its block id
+  (chunkserver.rs:503-640);
+- scrubber: periodic full-store verify; corrupt blocks are queued for heartbeat
+  bad-block reports and recovered immediately (chunkserver.rs:642-718).
+
+The heartbeat loop lives in tpudfs/chunkserver/heartbeat.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from collections import OrderedDict
+
+import grpc
+
+from tpudfs.common.checksum import crc32c
+from tpudfs.common.erasure import reconstruct
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer, ServerTls
+from tpudfs.chunkserver.blockstore import (
+    BlockCorruptionError,
+    BlockNotFoundError,
+    BlockStore,
+)
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "ChunkServerService"
+DEFAULT_BLOCK_CACHE_SIZE = 100
+
+
+class _LruCache:
+    """Full-block LRU cache (reference chunkserver.rs:67-76)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._d: OrderedDict[str, bytes] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> bytes | None:
+        data = self._d.get(key)
+        if data is None:
+            self.misses += 1
+            return None
+        self._d.move_to_end(key)
+        self.hits += 1
+        return data
+
+    def put(self, key: str, data: bytes) -> None:
+        if self.capacity <= 0:
+            return
+        self._d[key] = data
+        self._d.move_to_end(key)
+        while len(self._d) > self.capacity:
+            self._d.popitem(last=False)
+
+    def invalidate(self, key: str) -> None:
+        self._d.pop(key, None)
+
+
+class ChunkServer:
+    def __init__(
+        self,
+        store: BlockStore,
+        address: str = "",
+        rack_id: str = "default",
+        master_addrs: list[str] | None = None,
+        rpc_client: RpcClient | None = None,
+        cache_size: int | None = None,
+        scrub_interval: float = 60.0,
+    ):
+        self.store = store
+        self.address = address
+        self.rack_id = rack_id
+        self.master_addrs = list(master_addrs or [])
+        self._owns_client = rpc_client is None
+        self.client = rpc_client or RpcClient()
+        if cache_size is None:
+            cache_size = int(os.environ.get("BLOCK_CACHE_SIZE", DEFAULT_BLOCK_CACHE_SIZE))
+        self.cache = _LruCache(cache_size)
+        self.scrub_interval = scrub_interval
+        #: Highest master Raft term seen; stale-term writes are fenced off
+        #: (reference chunkserver.rs:40,732-743; learned from heartbeats too).
+        self.known_term = 0
+        #: Corrupt blocks found by scrubber/reads, drained into heartbeats
+        #: (reference pending_bad_blocks).
+        self.pending_bad_blocks: set[str] = set()
+        self._tasks: set[asyncio.Task] = set()
+        self._server: RpcServer | None = None
+
+    # ------------------------------------------------------------------ RPC
+
+    def handlers(self) -> dict:
+        return {
+            "WriteBlock": self.rpc_write_block,
+            "ReadBlock": self.rpc_read_block,
+            "ReplicateBlock": self.rpc_replicate_block,
+            "Stats": self.rpc_stats,
+        }
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    tls: ServerTls | None = None, scrubber: bool = True) -> str:
+        server = RpcServer(host, port, tls=tls)
+        server.add_service(SERVICE, self.handlers())
+        await server.start()
+        self._server = server
+        if not self.address:
+            self.address = server.address
+        if scrubber:
+            self._spawn(self.run_scrubber())
+        logger.info("chunkserver listening on %s", self.address)
+        return self.address
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def stop(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        self._tasks.clear()
+        if self._server:
+            await self._server.stop()
+            self._server = None
+        if self._owns_client:
+            await self.client.close()
+
+    # ------------------------------------------------------------- fencing
+
+    def _check_term(self, req_term: int) -> str | None:
+        """Epoch fencing (reference chunkserver.rs:732-743). Returns an error
+        string for stale terms; learns newer terms."""
+        if req_term > 0 and req_term < self.known_term:
+            return (
+                f"Stale master term: request has {req_term} "
+                f"but known term is {self.known_term}"
+            )
+        if req_term > self.known_term:
+            self.known_term = req_term
+        return None
+
+    def observe_term(self, term: int) -> None:
+        if term > self.known_term:
+            self.known_term = term
+
+    # ------------------------------------------------------------ write path
+
+    async def rpc_write_block(self, req: dict) -> dict:
+        return await self._write_and_forward(req)
+
+    async def rpc_replicate_block(self, req: dict) -> dict:
+        return await self._write_and_forward(req)
+
+    async def _write_and_forward(self, req: dict) -> dict:
+        stale = self._check_term(int(req.get("master_term", 0)))
+        if stale:
+            raise RpcError.failed_precondition(stale)
+
+        block_id = req["block_id"]
+        data = req["data"]
+        expected = int(req.get("expected_crc32c", 0))
+        if expected != 0:
+            actual = crc32c(data)
+            if actual != expected:
+                logger.error(
+                    "checksum mismatch for block %s: expected %d actual %d",
+                    block_id, expected, actual,
+                )
+                return {
+                    "success": False,
+                    "error_message": f"Checksum mismatch: expected {expected}, actual {actual}",
+                    "replicas_written": 0,
+                }
+
+        try:
+            await asyncio.to_thread(self.store.write, block_id, data)
+        except (OSError, ValueError) as e:
+            return {"success": False, "error_message": str(e), "replicas_written": 0}
+        self.cache.invalidate(block_id)
+
+        replicas_written = 1
+        next_servers = list(req.get("next_servers") or [])
+        if next_servers:
+            # Synchronous chain forward; downstream failure is logged, not
+            # propagated — the master's healer repairs under-replication
+            # (reference chunkserver.rs:777-825).
+            forward = {
+                "block_id": block_id,
+                "data": data,
+                "next_servers": next_servers[1:],
+                "expected_crc32c": expected,
+                "master_term": int(req.get("master_term", 0)),
+            }
+            try:
+                resp = await self.client.call(
+                    next_servers[0], SERVICE, "ReplicateBlock", forward, timeout=30.0
+                )
+                if resp.get("success"):
+                    replicas_written += int(resp.get("replicas_written", 0))
+                else:
+                    logger.error(
+                        "downstream replication failed at %s: %s",
+                        next_servers[0], resp.get("error_message"),
+                    )
+            except RpcError as e:
+                logger.error("failed to replicate to %s: %s", next_servers[0], e.message)
+
+        return {"success": True, "error_message": "", "replicas_written": replicas_written}
+
+    # ------------------------------------------------------------- read path
+
+    async def rpc_read_block(self, req: dict) -> dict:
+        block_id = req["block_id"]
+        offset = int(req.get("offset", 0))
+        length = int(req.get("length", 0))
+        try:
+            total = await asyncio.to_thread(self.store.size, block_id)
+        except BlockNotFoundError:
+            raise RpcError.not_found("Block not found") from None
+        if length == 0:
+            length = max(total - offset, 0)
+        # offset == total == 0 is a legal read of an empty block.
+        if offset >= total and not (offset == 0 and total == 0):
+            raise RpcError(
+                grpc.StatusCode.OUT_OF_RANGE,
+                f"Offset {offset} exceeds block size {total}",
+            )
+        bytes_to_read = min(length, total - offset)
+        full_read = offset == 0 and bytes_to_read == total
+
+        if full_read:
+            cached = self.cache.get(block_id)
+            if cached is not None:
+                return {"data": cached, "bytes_read": len(cached), "total_size": total}
+
+        data = await asyncio.to_thread(self.store.read, block_id, offset, bytes_to_read)
+
+        if not full_read:
+            # Verify only the touched chunks; corruption does not fail the
+            # read but kicks off background recovery (chunkserver.rs:893-911).
+            try:
+                await asyncio.to_thread(
+                    self.store.verify_range, block_id, offset, bytes_to_read
+                )
+            except (BlockCorruptionError, BlockNotFoundError) as e:
+                logger.warning("partial-read verify failed for %s: %s", block_id, e)
+                self.pending_bad_blocks.add(block_id)
+                self._spawn(self._recover_silently(block_id))
+        else:
+            try:
+                await asyncio.to_thread(self.store.verify_full, block_id, data)
+            except (BlockCorruptionError, BlockNotFoundError) as e:
+                logger.error("corruption detected for block %s: %s", block_id, e)
+                self.pending_bad_blocks.add(block_id)
+                err = await self.recover_block(block_id)
+                if err is not None:
+                    raise RpcError.data_loss(
+                        f"Data corruption detected: {e}. Recovery failed: {err}"
+                    ) from None
+                data = await asyncio.to_thread(
+                    self.store.read, block_id, 0, bytes_to_read
+                )
+                try:
+                    await asyncio.to_thread(self.store.verify_full, block_id, data)
+                except BlockCorruptionError as e2:
+                    raise RpcError.data_loss(
+                        f"Recovered block is still corrupted: {e2}"
+                    ) from None
+
+        if full_read:
+            self.cache.put(block_id, data)
+        return {"data": data, "bytes_read": len(data), "total_size": total}
+
+    async def rpc_stats(self, _req: dict) -> dict:
+        stats = await asyncio.to_thread(self.store.stats)
+        stats.update(
+            address=self.address,
+            rack_id=self.rack_id,
+            known_term=self.known_term,
+            cache_hits=self.cache.hits,
+            cache_misses=self.cache.misses,
+        )
+        return stats
+
+    # ------------------------------------------------------------- recovery
+
+    async def _recover_silently(self, block_id: str) -> None:
+        err = await self.recover_block(block_id)
+        if err:
+            logger.error("background recovery failed for %s: %s", block_id, err)
+
+    async def recover_block(self, block_id: str) -> str | None:
+        """Re-fetch a corrupt block from a healthy replica. Returns an error
+        string or None on success (reference chunkserver.rs:353-460)."""
+        locations: list[str] = []
+        for master in self.master_addrs:
+            try:
+                resp = await self.client.call(
+                    master, "MasterService", "GetBlockLocations",
+                    {"block_id": block_id}, timeout=5.0,
+                )
+                if resp.get("found"):
+                    locations = list(resp.get("locations") or [])
+                    break
+            except RpcError as e:
+                logger.warning("GetBlockLocations via %s failed: %s", master, e.message)
+        if not locations:
+            return "No replica locations found for block"
+
+        for loc in locations:
+            if not loc or loc == self.address:
+                continue
+            try:
+                resp = await self.client.call(
+                    loc, SERVICE, "ReadBlock",
+                    {"block_id": block_id, "offset": 0, "length": 0}, timeout=30.0,
+                )
+            except RpcError as e:
+                logger.warning("recovery fetch from %s failed: %s", loc, e.message)
+                continue
+            data = resp["data"]
+            try:
+                await asyncio.to_thread(self.store.write, block_id, data)
+            except OSError as e:
+                logger.error("failed to write recovered block: %s", e)
+                continue
+            self.cache.invalidate(block_id)
+            self.pending_bad_blocks.discard(block_id)
+            logger.info("recovered block %s from %s", block_id, loc)
+            return None
+        return "Failed to recover block from any replica"
+
+    async def initiate_replication(self, block_id: str, target_addr: str) -> str | None:
+        """Push a local block to ``target_addr`` (healer REPLICATE command,
+        reference chunkserver.rs:462-501)."""
+        try:
+            data = await asyncio.to_thread(self.store.read, block_id)
+        except BlockNotFoundError:
+            return f"block {block_id} not found locally"
+        try:
+            resp = await self.client.call(
+                target_addr, SERVICE, "ReplicateBlock",
+                {
+                    "block_id": block_id,
+                    "data": data,
+                    "next_servers": [],
+                    "expected_crc32c": 0,
+                    "master_term": self.known_term,
+                },
+                timeout=30.0,
+            )
+        except RpcError as e:
+            return f"replication to {target_addr} failed: {e.message}"
+        if not resp.get("success"):
+            return f"replication to {target_addr} failed: {resp.get('error_message')}"
+        return None
+
+    async def reconstruct_ec_shard(
+        self,
+        block_id: str,
+        shard_index: int,
+        data_shards: int,
+        parity_shards: int,
+        sources: list[str],
+    ) -> str | None:
+        """Rebuild this server's EC shard from surviving peers. ``sources`` has
+        one CS address per shard slot, "" = unavailable (reference
+        chunkserver.rs:503-640; command fields proto/dfs.proto:76-79)."""
+        total = data_shards + parity_shards
+        if len(sources) != total:
+            return f"ec_shard_sources length {len(sources)} != total shards {total}"
+
+        async def fetch(i: int, addr: str) -> tuple[int, bytes | None]:
+            try:
+                resp = await self.client.call(
+                    addr, SERVICE, "ReadBlock",
+                    {"block_id": block_id, "offset": 0, "length": 0}, timeout=30.0,
+                )
+                return i, resp["data"]
+            except RpcError as e:
+                logger.warning("EC fetch shard %d from %s: %s", i, addr, e.message)
+                return i, None
+
+        coros = [
+            fetch(i, addr)
+            for i, addr in enumerate(sources)
+            if addr and i != shard_index
+        ]
+        shards: list[bytes | None] = [None] * total
+        for i, data in await asyncio.gather(*coros):
+            shards[i] = data
+        available = sum(s is not None for s in shards)
+        if available < data_shards:
+            return (
+                f"Only {available} shards available, need at least "
+                f"{data_shards} for reconstruction"
+            )
+        try:
+            full = await asyncio.to_thread(
+                reconstruct, shards, data_shards, parity_shards
+            )
+        except Exception as e:  # ErasureError or shape errors
+            return f"RS reconstruct error: {e}"
+        await asyncio.to_thread(self.store.write, block_id, full[shard_index])
+        self.cache.invalidate(block_id)
+        logger.info(
+            "EC reconstruct: wrote shard %d of block %s (%d bytes)",
+            shard_index, block_id, len(full[shard_index]),
+        )
+        return None
+
+    # ------------------------------------------------------------- scrubber
+
+    async def scrub_once(self) -> list[str]:
+        """Verify every stored block; queue + recover corrupt ones
+        (reference chunkserver.rs:642-718)."""
+        corrupted: list[str] = []
+
+        def scan() -> list[str]:
+            bad = []
+            for block_id in self.store.list_blocks():
+                try:
+                    self.store.verify_full(block_id)
+                except BlockCorruptionError:
+                    logger.error("scrubber found corruption in block %s", block_id)
+                    bad.append(block_id)
+                except (BlockNotFoundError, OSError) as e:
+                    logger.error("scrubber failed to read block %s: %s", block_id, e)
+            return bad
+
+        corrupted = await asyncio.to_thread(scan)
+        self.pending_bad_blocks.update(corrupted)
+        for block_id in corrupted:
+            err = await self.recover_block(block_id)
+            if err:
+                logger.error("scrub recovery failed for %s: %s", block_id, err)
+        return corrupted
+
+    async def run_scrubber(self) -> None:
+        while True:
+            await asyncio.sleep(self.scrub_interval)
+            try:
+                await self.scrub_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("scrubber iteration failed")

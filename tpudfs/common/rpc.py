@@ -39,6 +39,10 @@ _CHANNEL_OPTIONS = [
     ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
 ]
 
+# Exclusive binds: grpc's default SO_REUSEPORT lets a second server silently
+# share a port and steal a fraction of its traffic — fail loudly instead.
+_SERVER_OPTIONS = _CHANNEL_OPTIONS + [("grpc.so_reuseport", 0)]
+
 
 def _dumps(obj: Any) -> bytes:
     return msgpack.packb(obj, use_bin_type=True)
@@ -183,7 +187,7 @@ class RpcServer:
         return call
 
     async def start(self) -> int:
-        server = grpc.aio.server(options=_CHANNEL_OPTIONS)
+        server = grpc.aio.server(options=_SERVER_OPTIONS)
         server.add_generic_rpc_handlers(tuple(self._services))
         address = f"{self._host}:{self._port}"
         if self._tls is not None:
@@ -203,6 +207,8 @@ class RpcServer:
             self.bound_port = server.add_secure_port(address, creds)
         else:
             self.bound_port = server.add_insecure_port(address)
+        if not self.bound_port:
+            raise OSError(f"failed to bind RPC server to {address}")
         self._server = server
         await server.start()
         return self.bound_port
