@@ -1,0 +1,228 @@
+"""Deterministic in-process Raft cluster simulator.
+
+The sans-io core makes the reference's model-level test approach
+(dfs/metaserver/tests/{raft_logic,network_partition,jepsen_style,
+membership_change_unit,property_based}_tests.rs) natural: this harness owns
+virtual time, a message bus with partitions/drops/delays (the MockNetwork
+analogue, network_partition_tests.rs:8-61), per-node "durable" storage dicts,
+and a pluggable state machine — no sockets, no sleeps, fully seeded.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+
+import msgpack
+
+from tpudfs.raft.core import (
+    Apply,
+    AppendLog,
+    BecameLeader,
+    Config,
+    PersistHardState,
+    RaftCore,
+    ReadReady,
+    RestoreFromSnapshot,
+    SaveSnapshot,
+    Send,
+    SnapshotNeeded,
+    SteppedDown,
+    Timings,
+    TruncateLog,
+    Role,
+)
+
+FAST = Timings(election_min=0.15, election_max=0.30, heartbeat=0.05,
+               snapshot_threshold=20, catchup_rounds=10)
+
+
+class SimNode:
+    def __init__(self, node_id: str, config: Config, seed: int, now: float):
+        self.node_id = node_id
+        self.core = RaftCore(
+            node_id, config, timings=FAST, rng=random.Random(seed), now=now
+        )
+        # "Durable" state for crash/restart tests.
+        self.durable = {"term": 0, "voted_for": None, "log": [], "snapshot": None}
+        self.applied: list = []  # state machine = append-only command list
+        self.read_ready: list = []
+        self.stepdowns = 0
+        self.elections_won = 0
+        self.alive = True
+
+    def restart(self, seed: int, now: float) -> None:
+        """Crash-recover from durable state only (volatile state lost)."""
+        self.core = RaftCore(
+            self.node_id,
+            Config(voters=frozenset()),  # superseded by log/snapshot config
+            term=self.durable["term"],
+            voted_for=self.durable["voted_for"],
+            log=list(self.durable["log"]),
+            snapshot=self.durable["snapshot"],
+            timings=FAST,
+            rng=random.Random(seed),
+            now=now,
+        )
+        snap = self.durable["snapshot"]
+        self.applied = (
+            [tuple(x) for x in msgpack.unpackb(snap.data)] if snap and snap.data else []
+        )
+        # Replay committed-but-unapplied entries happens via Apply effects as
+        # the new leader re-commits; a restarted node re-applies from scratch.
+        self.core.last_applied = snap.last_index if snap else 0
+        self.core.commit_index = snap.last_index if snap else 0
+        self.alive = True
+
+
+class SimCluster:
+    def __init__(self, n: int = 3, seed: int = 0):
+        self.ids = [f"n{i}" for i in range(n)]
+        cfg = Config(voters=frozenset(self.ids))
+        self.now = 0.0
+        self.rng = random.Random(seed)
+        self.nodes: dict[str, SimNode] = {
+            nid: SimNode(nid, cfg, seed * 1000 + i, self.now)
+            for i, nid in enumerate(self.ids)
+        }
+        self.inflight: list[tuple[str, str, dict]] = []  # (src, dst, msg)
+        self.cut: set[frozenset] = set()  # severed links
+        self.drop_rate = 0.0
+        self.msg_log: list[tuple[str, str, str]] = []
+
+    # ------------------------------------------------------------- topology
+
+    def partition(self, *groups: list[str]) -> None:
+        """Sever links between nodes in different groups."""
+        self.cut.clear()
+        group_of = {}
+        for gi, g in enumerate(groups):
+            for nid in g:
+                group_of[nid] = gi
+        for a in self.ids:
+            for b in self.ids:
+                if a < b and group_of.get(a) != group_of.get(b):
+                    self.cut.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self.cut.clear()
+
+    def crash(self, nid: str) -> None:
+        self.nodes[nid].alive = False
+        self.inflight = [m for m in self.inflight if m[1] != nid and m[0] != nid]
+
+    def restart(self, nid: str) -> None:
+        self.nodes[nid].restart(self.rng.randrange(1 << 30), self.now)
+
+    # ------------------------------------------------------------ execution
+
+    def _process_effects(self, node: SimNode, effects: list) -> None:
+        for eff in effects:
+            if isinstance(eff, Send):
+                self.msg_log.append((node.node_id, eff.to, eff.msg["type"]))
+                self.inflight.append((node.node_id, eff.to, eff.msg))
+            elif isinstance(eff, PersistHardState):
+                node.durable["term"] = eff.term
+                node.durable["voted_for"] = eff.voted_for
+            elif isinstance(eff, AppendLog):
+                node.durable["log"] = [
+                    e for e in node.durable["log"] if e.index < eff.entries[0].index
+                ] + list(eff.entries)
+            elif isinstance(eff, TruncateLog):
+                node.durable["log"] = [
+                    e for e in node.durable["log"] if e.index < eff.from_index
+                ]
+            elif isinstance(eff, Apply):
+                for e in eff.entries:
+                    node.applied.append((e.index, e.command))
+            elif isinstance(eff, SaveSnapshot):
+                node.durable["snapshot"] = eff.snapshot
+                node.durable["log"] = [
+                    e for e in node.durable["log"]
+                    if e.index > eff.snapshot.last_index
+                ]
+            elif isinstance(eff, RestoreFromSnapshot):
+                node.applied = (
+                    [tuple(x) for x in msgpack.unpackb(eff.snapshot.data)]
+                    if eff.snapshot.data else []
+                )
+            elif isinstance(eff, ReadReady):
+                node.read_ready.append((eff.request_id, eff.read_index))
+            elif isinstance(eff, SteppedDown):
+                node.stepdowns += 1
+            elif isinstance(eff, BecameLeader):
+                node.elections_won += 1
+            elif isinstance(eff, SnapshotNeeded):
+                data = msgpack.packb(node.applied)
+                self._process_effects(node, node.core.compact(data))
+
+    def step(self, dt: float = 0.01) -> None:
+        """Advance virtual time one tick: deliver queued messages, tick cores."""
+        self.now += dt
+        batch, self.inflight = self.inflight, []
+        for src, dst, msg in batch:
+            if frozenset((src, dst)) in self.cut:
+                continue
+            if self.drop_rate and self.rng.random() < self.drop_rate:
+                continue
+            node = self.nodes[dst]
+            if not node.alive:
+                continue
+            self._process_effects(node, node.core.handle_message(msg, self.now))
+        for node in self.nodes.values():
+            if node.alive:
+                self._process_effects(node, node.core.tick(self.now))
+
+    def run(self, seconds: float) -> None:
+        steps = int(seconds / 0.01)
+        for _ in range(steps):
+            self.step()
+
+    # ------------------------------------------------------------- queries
+
+    def leaders(self) -> list[SimNode]:
+        return [
+            n for n in self.nodes.values()
+            if n.alive and n.core.role == Role.LEADER
+        ]
+
+    def leader(self) -> SimNode | None:
+        """The live leader with the highest term (stale leaders may linger
+        inside partitions)."""
+        ls = self.leaders()
+        return max(ls, key=lambda n: n.core.term) if ls else None
+
+    def wait_for_leader(self, timeout: float = 10.0) -> SimNode:
+        deadline = self.now + timeout
+        while self.now < deadline:
+            self.step()
+            lead = self.leader()
+            if lead is not None:
+                return lead
+        raise AssertionError("no leader elected")
+
+    def propose(self, command, timeout: float = 5.0) -> int:
+        lead = self.wait_for_leader()
+        index, effects = lead.core.propose(command, self.now)
+        self._process_effects(lead, effects)
+        return index
+
+    def propose_and_commit(self, command, timeout: float = 5.0) -> int:
+        index = self.propose(command)
+        deadline = self.now + timeout
+        while self.now < deadline:
+            self.step()
+            lead = self.leader()
+            if lead and lead.core.commit_index >= index:
+                return index
+        raise AssertionError(f"entry {index} not committed")
+
+    def committed_commands(self, nid: str) -> list:
+        return [c for _, c in self.nodes[nid].applied]
+
+    def live_leaders_by_term(self) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = defaultdict(set)
+        for n in self.nodes.values():
+            if n.alive and n.core.role == Role.LEADER:
+                out[n.core.term].add(n.node_id)
+        return out

@@ -1,0 +1,265 @@
+"""Raft invariants on the sans-io core via the deterministic simulator.
+
+Coverage model: reference dfs/metaserver/tests/raft_logic_tests.rs (election
+restriction, log matching, commit advancement, truncation, ReadIndex safety,
+snapshot compaction) and membership_change_unit_tests.rs (joint majority)."""
+
+import pytest
+
+from tests.raft_sim import SimCluster
+from tpudfs.raft.core import Config, NotLeaderError, Role
+
+
+def test_elects_single_leader():
+    c = SimCluster(3, seed=1)
+    lead = c.wait_for_leader()
+    c.run(1.0)
+    assert len(c.leaders()) == 1
+    assert all(
+        n.core.leader_id == lead.node_id for n in c.nodes.values()
+    )
+
+
+def test_at_most_one_leader_per_term_under_churn():
+    c = SimCluster(5, seed=2)
+    c.drop_rate = 0.2
+    seen: dict[int, set[str]] = {}
+    for _ in range(3000):
+        c.step()
+        for term, who in c.live_leaders_by_term().items():
+            seen.setdefault(term, set()).update(who)
+            assert len(seen[term]) <= 1, f"two leaders in term {term}: {seen[term]}"
+
+
+def test_log_replication_and_apply():
+    c = SimCluster(3, seed=3)
+    for i in range(5):
+        c.propose_and_commit({"op": "set", "k": f"k{i}"})
+    c.run(1.0)
+    logs = [c.committed_commands(nid) for nid in c.ids]
+    # State-machine safety: identical applied sequences everywhere.
+    assert logs[0] == logs[1] == logs[2]
+    assert [cmd.get("k") for cmd in logs[0] if isinstance(cmd, dict) and "k" in cmd] \
+        == [f"k{i}" for i in range(5)]
+
+
+def test_election_restriction_stale_log_cannot_win():
+    c = SimCluster(3, seed=4)
+    lead = c.wait_for_leader()
+    others = [nid for nid in c.ids if nid != lead.node_id]
+    # Cut off one follower, commit entries without it.
+    c.partition([lead.node_id, others[0]], [others[1]])
+    for i in range(3):
+        c.propose_and_commit({"i": i})
+    stale = c.nodes[others[1]]
+    # Stale node cannot become leader even with aggressive timeouts.
+    c.heal()
+    c.partition([others[1]], [lead.node_id, others[0]])  # isolate stale again
+    c.run(2.0)  # it campaigns alone, bumping its term
+    assert stale.core.role in (Role.CANDIDATE, Role.FOLLOWER)
+    c.heal()
+    c.run(2.0)
+    final = c.leader()
+    assert final is not None
+    # The new leader must have all 3 committed entries.
+    assert len([x for x in c.committed_commands(final.node_id)
+                if isinstance(x, dict) and "i" in x]) == 3
+
+
+def test_leader_failover_preserves_committed_entries():
+    c = SimCluster(3, seed=5)
+    lead = c.wait_for_leader()
+    idx = c.propose_and_commit({"v": "durable"})
+    c.crash(lead.node_id)
+    new_lead = c.wait_for_leader()
+    assert new_lead.node_id != lead.node_id
+    c.propose_and_commit({"v": "after-failover"})
+    cmds = [x for x in c.committed_commands(new_lead.node_id)
+            if isinstance(x, dict) and "v" in x]
+    assert [x["v"] for x in cmds] == ["durable", "after-failover"]
+    assert idx < new_lead.core.commit_index
+
+
+def test_divergent_follower_log_truncated():
+    c = SimCluster(3, seed=6)
+    lead = c.wait_for_leader()
+    others = [nid for nid in c.ids if nid != lead.node_id]
+    # Leader alone in minority: appends uncommitted entries.
+    c.partition([lead.node_id], others)
+    try:
+        lead.core.propose({"v": "lost-1"}, c.now)
+        lead.core.propose({"v": "lost-2"}, c.now)
+    except NotLeaderError:
+        pass
+    # Majority side elects a new leader and commits different entries.
+    c.run(2.0)
+    maj_lead = c.leader()
+    assert maj_lead is not None and maj_lead.node_id != lead.node_id
+    c.propose_and_commit({"v": "kept"})
+    c.heal()
+    c.run(2.0)
+    # Old leader's uncommitted entries were truncated; all logs agree.
+    vals = [
+        [x["v"] for x in c.committed_commands(nid)
+         if isinstance(x, dict) and "v" in x]
+        for nid in c.ids
+    ]
+    assert vals[0] == vals[1] == vals[2]
+    assert "lost-1" not in vals[0] and "kept" in vals[0]
+
+
+def test_read_index_linearizable():
+    c = SimCluster(3, seed=7)
+    lead = c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    lead = c.leader()
+    effects = lead.core.read_index("r1", c.now)
+    c._process_effects(lead, effects)
+    c.run(0.5)
+    assert lead.read_ready and lead.read_ready[0][0] == "r1"
+    assert lead.read_ready[0][1] >= 1  # at least the committed entry
+    # Follower must refuse ReadIndex.
+    follower = next(n for n in c.nodes.values() if n.core.role == Role.FOLLOWER)
+    with pytest.raises(NotLeaderError):
+        follower.core.read_index("r2", c.now)
+
+
+def test_read_index_blocked_by_partition():
+    """A leader cut off from the quorum must NOT serve reads (stale-read
+    prevention — the scenario ReadIndex exists for)."""
+    c = SimCluster(3, seed=8)
+    lead = c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    lead = c.leader()
+    others = [nid for nid in c.ids if nid != lead.node_id]
+    c.partition([lead.node_id], others)
+    effects = lead.core.read_index("stale-read", c.now)
+    c._process_effects(lead, effects)
+    c.run(1.0)
+    assert lead.read_ready == []  # never confirmed
+
+
+def test_snapshot_compaction_and_follower_catchup():
+    c = SimCluster(3, seed=9)
+    c.wait_for_leader()
+    lead = c.leader()
+    others = [nid for nid in c.ids if nid != lead.node_id]
+    c.partition([lead.node_id, others[0]], [others[1]])
+    # Exceed the snapshot threshold (20 in FAST timings).
+    for i in range(30):
+        c.propose_and_commit({"i": i})
+    c.run(1.0)
+    assert c.leader().core.snapshot is not None, "log should have compacted"
+    # The lagging follower catches up via InstallSnapshot.
+    c.heal()
+    c.run(3.0)
+    lagger = c.nodes[others[1]]
+    assert len([x for x in c.committed_commands(others[1])
+                if isinstance(x, dict) and "i" in x]) == 30
+    assert lagger.core.last_index == c.leader().core.last_index
+
+
+def test_restart_recovers_from_durable_state():
+    c = SimCluster(3, seed=10)
+    c.propose_and_commit({"v": "persisted"})
+    victim = c.leader().node_id
+    c.crash(victim)
+    c.run(1.0)
+    c.restart(victim)
+    c.run(3.0)
+    vals = [x["v"] for x in c.committed_commands(victim)
+            if isinstance(x, dict) and "v" in x]
+    assert vals == ["persisted"]
+    assert c.nodes[victim].core.term >= 1
+
+
+def test_membership_add_server_joint_consensus():
+    c = SimCluster(3, seed=11)
+    lead = c.wait_for_leader()
+    c.run(0.5)
+    lead = c.leader()
+    # Spin up a fresh node n3 as a learner target.
+    from tests.raft_sim import SimNode
+
+    c.ids.append("n3")
+    c.nodes["n3"] = SimNode("n3", Config(voters=frozenset()), 999, c.now)
+    c._process_effects(lead, lead.core.add_server("n3", c.now))
+    c.run(3.0)
+    final = c.leader()
+    assert final is not None
+    cfg = final.core.config
+    assert not cfg.joint
+    assert cfg.voters == frozenset({"n0", "n1", "n2", "n3"})
+    # New voter participates: commit an entry, n3 applies it.
+    c.propose_and_commit({"v": "with-n3"})
+    c.run(1.0)
+    assert any(
+        isinstance(x, dict) and x.get("v") == "with-n3"
+        for x in c.committed_commands("n3")
+    )
+
+
+def test_membership_remove_server():
+    c = SimCluster(3, seed=12)
+    lead = c.wait_for_leader()
+    victim = next(nid for nid in c.ids if nid != lead.node_id)
+    c._process_effects(lead, lead.core.remove_server(victim, c.now))
+    c.run(3.0)
+    final = c.leader()
+    cfg = final.core.config
+    assert not cfg.joint and victim not in cfg.voters
+    assert len(cfg.voters) == 2
+    # Cluster still commits with the remaining pair.
+    c.propose_and_commit({"v": "post-removal"})
+
+
+def test_joint_quorum_requires_both_majorities():
+    cfg = Config(
+        voters=frozenset({"a", "b", "c", "d", "e"}),
+        voters_old=frozenset({"a", "b", "c"}),
+    )
+    # Majority of new but not old: no quorum.
+    assert not cfg.has_quorum({"c", "d", "e"})
+    # Majority of old but not new: no quorum.
+    assert not cfg.has_quorum({"a", "b"})
+    # Majority of both.
+    assert cfg.has_quorum({"a", "b", "c", "d"})
+    assert cfg.has_quorum({"a", "b", "d"})
+
+
+def test_leader_transfer():
+    c = SimCluster(3, seed=13)
+    lead = c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    lead = c.leader()
+    target = next(nid for nid in c.ids if nid != lead.node_id)
+    c._process_effects(lead, lead.core.transfer_leadership(target, c.now))
+    c.run(2.0)
+    new_lead = c.leader()
+    assert new_lead is not None and new_lead.node_id == target
+    # Proposals rejected mid-transfer point at the target.
+    with pytest.raises(NotLeaderError):
+        lead.core.propose({"v": 2}, c.now)
+
+
+def test_quorum_intersection_property():
+    """Any two quorums of any (possibly joint) config intersect — proptest
+    analogue of property_based_tests.rs:27-89."""
+    import itertools
+    import random as _r
+
+    rng = _r.Random(0)
+    for _ in range(200):
+        n = rng.randint(1, 7)
+        nodes = [f"x{i}" for i in range(n)]
+        old = frozenset(rng.sample(nodes, rng.randint(1, n)))
+        cfg = Config(voters=frozenset(nodes), voters_old=old if rng.random() < 0.5 else None)
+        subsets = [
+            set(s)
+            for r in range(n + 1)
+            for s in itertools.combinations(nodes, r)
+        ]
+        quorums = [s for s in subsets if cfg.has_quorum(s)]
+        for q1 in quorums[:30]:
+            for q2 in quorums[:30]:
+                assert q1 & q2, f"disjoint quorums {q1} {q2} for {cfg}"

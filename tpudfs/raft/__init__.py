@@ -1,0 +1,1 @@
+"""Raft consensus: sans-io core, file-backed storage, gRPC transport shell."""

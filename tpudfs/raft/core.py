@@ -1,0 +1,965 @@
+"""Sans-io Raft core.
+
+Feature parity with the reference's dfs/metaserver/src/simple_raft.rs:
+- leader election with randomized 1.5-3 s timeouts (simple_raft.rs:758,1288),
+- log replication with conflict back-off,
+- snapshot compaction beyond a log-length threshold (simple_raft.rs:1210-1213)
+  and InstallSnapshot catch-up for lagging followers (simple_raft.rs:1455-1533),
+- ReadIndex linearizable reads confirmed by heartbeat quorum acks
+  (simple_raft.rs:1863-1887,993-1011),
+- joint-consensus membership change with a non-voting catch-up stage
+  (10 rounds, simple_raft.rs:72-106,241-243,2458-2512) and joint-majority
+  commit advancement (simple_raft.rs:2246-2277),
+- leader transfer via TimeoutNow (simple_raft.rs:2740-2813).
+
+Architecturally this is NOT a port: the reference interleaves consensus with
+tokio channels, reqwest HTTP and RocksDB in one 3.8k-line loop. Here the core
+is a pure deterministic state machine — time comes in via ``tick(now)``,
+messages via ``handle_message``, randomness via an injected ``random.Random``
+— and all I/O is returned as effect objects for a shell (tpudfs/raft/node.py)
+to execute. That makes the whole consensus layer simulable in-process, which
+is how the model-level test tiers (tests/test_raft_core.py,
+test_raft_partitions.py, test_raft_jepsen.py) drive it.
+
+On a TPU pod this control plane runs host-side over DCN (SURVEY.md §2.6 P4);
+consensus never touches the accelerator.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field, replace
+from enum import Enum
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# Data model
+# ---------------------------------------------------------------------------
+
+
+class Role(str, Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    index: int
+    term: int
+    command: Any  # opaque msgpack-able value; dicts with "_config" are internal
+
+    def to_dict(self) -> dict:
+        return {"index": self.index, "term": self.term, "command": self.command}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogEntry":
+        return cls(int(d["index"]), int(d["term"]), d["command"])
+
+
+@dataclass(frozen=True)
+class Config:
+    """Cluster membership. ``voters_old`` is set only during joint consensus:
+    decisions then require a majority of BOTH voter sets."""
+
+    voters: frozenset[str]
+    voters_old: frozenset[str] | None = None
+    learners: frozenset[str] = frozenset()
+
+    @property
+    def joint(self) -> bool:
+        return self.voters_old is not None
+
+    def all_nodes(self) -> frozenset[str]:
+        nodes = self.voters | self.learners
+        if self.voters_old:
+            nodes = nodes | self.voters_old
+        return nodes
+
+    def has_quorum(self, acks: set[str]) -> bool:
+        def maj(group: frozenset[str]) -> bool:
+            return len(acks & group) * 2 > len(group)
+
+        if not self.voters:
+            return False
+        ok = maj(self.voters)
+        if self.voters_old is not None:
+            ok = ok and maj(self.voters_old)
+        return ok
+
+    def to_dict(self) -> dict:
+        return {
+            "voters": sorted(self.voters),
+            "voters_old": sorted(self.voters_old) if self.voters_old is not None else None,
+            "learners": sorted(self.learners),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        old = d.get("voters_old")
+        return cls(
+            voters=frozenset(d.get("voters") or []),
+            voters_old=frozenset(old) if old is not None else None,
+            learners=frozenset(d.get("learners") or []),
+        )
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    last_index: int
+    last_term: int
+    config: Config
+    data: bytes
+
+    def to_dict(self) -> dict:
+        return {
+            "last_index": self.last_index,
+            "last_term": self.last_term,
+            "config": self.config.to_dict(),
+            "data": self.data,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Snapshot":
+        return cls(
+            int(d["last_index"]), int(d["last_term"]),
+            Config.from_dict(d["config"]), d["data"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Effects (what the shell must do after each core call)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Send:
+    to: str
+    msg: dict
+
+
+@dataclass(frozen=True)
+class PersistHardState:
+    term: int
+    voted_for: str | None
+
+
+@dataclass(frozen=True)
+class AppendLog:
+    entries: tuple[LogEntry, ...]
+
+
+@dataclass(frozen=True)
+class TruncateLog:
+    """Drop every entry with index >= from_index."""
+
+    from_index: int
+
+
+@dataclass(frozen=True)
+class Apply:
+    entries: tuple[LogEntry, ...]
+
+
+@dataclass(frozen=True)
+class SaveSnapshot:
+    snapshot: Snapshot
+
+
+@dataclass(frozen=True)
+class RestoreFromSnapshot:
+    """State machine must reset itself from snapshot.data."""
+
+    snapshot: Snapshot
+
+
+@dataclass(frozen=True)
+class ReadReady:
+    request_id: Any
+    read_index: int
+
+
+@dataclass(frozen=True)
+class SteppedDown:
+    """Leadership lost — shell fails pending proposals with Not Leader."""
+
+    term: int
+
+
+@dataclass(frozen=True)
+class BecameLeader:
+    term: int
+
+
+@dataclass(frozen=True)
+class SnapshotNeeded:
+    """Log exceeded the compaction threshold; shell should serialize the state
+    machine and call ``compact(snapshot_data)``."""
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_hint: str | None):
+        super().__init__(f"Not Leader|{leader_hint or ''}")
+        self.leader_hint = leader_hint
+
+
+# ---------------------------------------------------------------------------
+# Timings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Timings:
+    """Reference values: 1.5-3 s election (simple_raft.rs:758), 100 ms tick
+    loop (simple_raft.rs:1190), snapshot at >100 entries
+    (simple_raft.rs:1211), 10 catch-up rounds (simple_raft.rs:241-243)."""
+
+    election_min: float = 1.5
+    election_max: float = 3.0
+    heartbeat: float = 0.5
+    snapshot_threshold: int = 100
+    catchup_rounds: int = 10
+
+
+# ---------------------------------------------------------------------------
+# Core
+# ---------------------------------------------------------------------------
+
+
+class RaftCore:
+    def __init__(
+        self,
+        node_id: str,
+        config: Config,
+        *,
+        term: int = 0,
+        voted_for: str | None = None,
+        log: list[LogEntry] | None = None,
+        snapshot: Snapshot | None = None,
+        timings: Timings | None = None,
+        rng: random.Random | None = None,
+        now: float = 0.0,
+    ):
+        self.node_id = node_id
+        self.timings = timings or Timings()
+        self.rng = rng or random.Random()
+
+        # Persistent state (the shell re-creates the core from storage).
+        self.term = term
+        self.voted_for = voted_for
+        self.snapshot = snapshot
+        self.log: list[LogEntry] = list(log or [])
+
+        # Config: latest config entry in the log wins; else snapshot's; else boot.
+        self._boot_config = config
+        self.config = config
+        if snapshot is not None:
+            self.config = snapshot.config
+        for e in self.log:
+            cfg = self._config_of(e)
+            if cfg is not None:
+                self.config = cfg
+
+        # Volatile state.
+        self.role = Role.FOLLOWER
+        self.leader_id: str | None = None
+        self.commit_index = snapshot.last_index if snapshot else 0
+        self.last_applied = self.commit_index
+        self.votes: set[str] = set()
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        # ReadIndex machinery: monotonically increasing heartbeat probe seq,
+        # per-peer highest acked seq, pending reads.
+        self._probe_seq = 0
+        self._peer_ack_seq: dict[str, int] = {}
+        self._pending_reads: list[dict] = []  # {id, index, seq}
+        # Membership-change machinery.
+        self._catchup: dict | None = None  # {node, rounds_left, last_match}
+        self._transfer_target: str | None = None
+        self._transfer_deadline = 0.0
+
+        self._election_deadline = now + self._election_timeout()
+        self._heartbeat_due = now
+
+    # ------------------------------------------------------------ log helpers
+
+    @property
+    def log_start(self) -> int:
+        """Index of the first entry held in memory (1 if no snapshot)."""
+        return (self.snapshot.last_index + 1) if self.snapshot else 1
+
+    @property
+    def last_index(self) -> int:
+        if self.log:
+            return self.log[-1].index
+        return self.snapshot.last_index if self.snapshot else 0
+
+    @property
+    def last_term(self) -> int:
+        if self.log:
+            return self.log[-1].term
+        return self.snapshot.last_term if self.snapshot else 0
+
+    def entry(self, index: int) -> LogEntry | None:
+        pos = index - self.log_start
+        if 0 <= pos < len(self.log):
+            return self.log[pos]
+        return None
+
+    def term_at(self, index: int) -> int | None:
+        if index == 0:
+            return 0
+        if self.snapshot and index == self.snapshot.last_index:
+            return self.snapshot.last_term
+        e = self.entry(index)
+        return e.term if e else None
+
+    def entries_from(self, index: int, limit: int = 512) -> list[LogEntry]:
+        pos = max(index - self.log_start, 0)
+        return self.log[pos : pos + limit]
+
+    @staticmethod
+    def _config_of(entry: LogEntry) -> Config | None:
+        cmd = entry.command
+        if isinstance(cmd, dict) and "_config" in cmd:
+            return Config.from_dict(cmd["_config"])
+        return None
+
+    def _recompute_config(self) -> None:
+        """Re-derive membership from snapshot + surviving log entries (needed
+        after truncation drops an uncommitted config entry)."""
+        cfg = self.snapshot.config if self.snapshot else self._boot_config
+        for e in self.log:
+            c = self._config_of(e)
+            if c is not None:
+                cfg = c
+        self.config = cfg
+
+    def _election_timeout(self) -> float:
+        return self.rng.uniform(self.timings.election_min, self.timings.election_max)
+
+    @property
+    def is_voter(self) -> bool:
+        cfg = self.config
+        return self.node_id in cfg.voters or (
+            cfg.voters_old is not None and self.node_id in cfg.voters_old
+        )
+
+    # ------------------------------------------------------------------- tick
+
+    def tick(self, now: float) -> list:
+        effects: list = []
+        if self.role == Role.LEADER:
+            if self._transfer_target and now >= self._transfer_deadline:
+                self._transfer_target = None  # transfer timed out; resume
+            if now >= self._heartbeat_due:
+                self._heartbeat_due = now + self.timings.heartbeat
+                effects += self._broadcast_append()
+            if len(self.log) > self.timings.snapshot_threshold and \
+                    self.last_applied >= self.log_start:
+                effects.append(SnapshotNeeded())
+        elif self.is_voter and now >= self._election_deadline:
+            effects += self._start_election(now)
+        return effects
+
+    # -------------------------------------------------------------- elections
+
+    def _start_election(self, now: float) -> list:
+        self.role = Role.CANDIDATE
+        self.term += 1
+        self.voted_for = self.node_id
+        self.leader_id = None
+        self.votes = {self.node_id}
+        self._election_deadline = now + self._election_timeout()
+        effects: list = [PersistHardState(self.term, self.voted_for)]
+        voters = self.config.voters | (self.config.voters_old or frozenset())
+        for peer in voters - {self.node_id}:
+            effects.append(
+                Send(peer, {
+                    "type": "request_vote",
+                    "term": self.term,
+                    "candidate_id": self.node_id,
+                    "last_log_index": self.last_index,
+                    "last_log_term": self.last_term,
+                })
+            )
+        if self.config.has_quorum(self.votes):  # single-node cluster
+            effects += self._become_leader(now)
+        return effects
+
+    def _become_leader(self, now: float) -> list:
+        self.role = Role.LEADER
+        self.leader_id = self.node_id
+        self.votes = set()
+        self._transfer_target = None
+        self.next_index = {p: self.last_index + 1 for p in self.config.all_nodes()}
+        self.match_index = {p: 0 for p in self.config.all_nodes()}
+        self._peer_ack_seq = {p: 0 for p in self.config.all_nodes()}
+        self._pending_reads = []
+        self._heartbeat_due = now + self.timings.heartbeat
+        effects: list = [BecameLeader(self.term)]
+        # Commit-barrier no-op so this term can commit prior-term entries
+        # and ReadIndex is immediately safe once it commits.
+        effects += self._append_local({"_noop": True})
+        effects += self._broadcast_append()
+        return effects
+
+    def _step_down(self, term: int, now: float) -> list:
+        effects: list = []
+        was_leader = self.role == Role.LEADER
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            effects.append(PersistHardState(self.term, self.voted_for))
+        self.role = Role.FOLLOWER
+        self.votes = set()
+        self._pending_reads = []
+        self._catchup = None
+        self._transfer_target = None
+        self._election_deadline = now + self._election_timeout()
+        if was_leader:
+            effects.append(SteppedDown(self.term))
+        return effects
+
+    # ------------------------------------------------------------ proposals
+
+    def propose(self, command: Any, now: float) -> tuple[int, list]:
+        """Append a command; returns (log index, effects). Raises NotLeaderError
+        with the last-known leader hint when not leader (the client-visible
+        ``Not Leader|<hint>`` convention, reference mod.rs:1442-1467)."""
+        if self.role != Role.LEADER or self._transfer_target:
+            raise NotLeaderError(self._transfer_target or self.leader_id)
+        effects = self._append_local(command)
+        effects += self._broadcast_append()
+        self._heartbeat_due = now + self.timings.heartbeat
+        return self.last_index, effects
+
+    def _append_local(self, command: Any) -> list:
+        entry = LogEntry(self.last_index + 1, self.term, command)
+        self.log.append(entry)
+        cfg = self._config_of(entry)
+        if cfg is not None:
+            self.config = cfg
+        effects: list = [AppendLog((entry,))]
+        # Single-node: may commit immediately.
+        effects += self._advance_commit()
+        return effects
+
+    # ------------------------------------------------------------- ReadIndex
+
+    def read_index(self, request_id: Any, now: float) -> list:
+        """Linearizable read barrier (reference simple_raft.rs:1863-1887):
+        capture commit_index, then confirm leadership with a heartbeat quorum;
+        ReadReady fires once confirmed AND last_applied has caught up.
+
+        A fresh leader must first commit an entry of its own term (Raft §8 /
+        §6.4): until then its commit_index may lag the true cluster commit
+        point, so the read index is left unassigned (None) and filled in by
+        ``_check_reads`` once the current-term no-op commits."""
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        index = (
+            self.commit_index
+            if self.term_at(self.commit_index) == self.term
+            else None
+        )
+        self._probe_seq += 1
+        read = {"id": request_id, "index": index, "seq": self._probe_seq}
+        self._pending_reads.append(read)
+        effects = self._broadcast_append()
+        self._heartbeat_due = now + self.timings.heartbeat
+        # Single-node quorum satisfies immediately.
+        effects += self._check_reads()
+        return effects
+
+    def _check_reads(self) -> list:
+        if self.role != Role.LEADER or not self._pending_reads:
+            return []
+        own_term_committed = self.term_at(self.commit_index) == self.term
+        effects: list = []
+        remaining: list[dict] = []
+        for read in self._pending_reads:
+            if read["index"] is None:
+                if not own_term_committed:
+                    remaining.append(read)
+                    continue
+                # commit_index now covers everything committed before this
+                # leader's term, so it is a safe (conservative) read index.
+                read["index"] = self.commit_index
+            acks = {self.node_id} | {
+                p for p, s in self._peer_ack_seq.items() if s >= read["seq"]
+            }
+            if self.config.has_quorum(acks) and self.last_applied >= read["index"]:
+                effects.append(ReadReady(read["id"], read["index"]))
+            else:
+                remaining.append(read)
+        self._pending_reads = remaining
+        return effects
+
+    # ----------------------------------------------------------- replication
+
+    def _broadcast_append(self) -> list:
+        effects: list = []
+        for peer in self.config.all_nodes() - {self.node_id}:
+            effects += self._send_append(peer)
+        return effects
+
+    def _send_append(self, peer: str) -> list:
+        next_idx = self.next_index.get(peer, self.last_index + 1)
+        if next_idx < self.log_start:
+            assert self.snapshot is not None
+            return [Send(peer, {
+                "type": "install_snapshot",
+                "term": self.term,
+                "leader_id": self.node_id,
+                "snapshot": self.snapshot.to_dict(),
+                "seq": self._probe_seq,
+            })]
+        prev_index = next_idx - 1
+        prev_term = self.term_at(prev_index)
+        if prev_term is None:  # compacted concurrently; retry via snapshot
+            return self._send_append_snapshot_fallback(peer)
+        entries = self.entries_from(next_idx)
+        return [Send(peer, {
+            "type": "append_entries",
+            "term": self.term,
+            "leader_id": self.node_id,
+            "prev_log_index": prev_index,
+            "prev_log_term": prev_term,
+            "entries": [e.to_dict() for e in entries],
+            "leader_commit": self.commit_index,
+            "seq": self._probe_seq,
+        })]
+
+    def _send_append_snapshot_fallback(self, peer: str) -> list:
+        if self.snapshot is None:
+            return []
+        return [Send(peer, {
+            "type": "install_snapshot",
+            "term": self.term,
+            "leader_id": self.node_id,
+            "snapshot": self.snapshot.to_dict(),
+            "seq": self._probe_seq,
+        })]
+
+    def _advance_commit(self) -> list:
+        """Joint-majority commit rule (reference simple_raft.rs:2246-2277) with
+        the current-term restriction (Raft §5.4.2)."""
+        if self.role != Role.LEADER:
+            return []
+        for n in range(self.last_index, self.commit_index, -1):
+            if self.term_at(n) != self.term:
+                break
+            acks = {self.node_id} | {
+                p for p, m in self.match_index.items() if m >= n
+            }
+            if self.config.has_quorum(acks):
+                return self._commit_to(n)
+        return []
+
+    def _commit_to(self, n: int) -> list:
+        self.commit_index = n
+        effects = self._apply_committed()
+        effects += self._check_reads()
+        effects += self._maybe_advance_membership()
+        # A leader removed by a committed final config steps down
+        # (joint-consensus exit, Raft §6).
+        if (
+            self.role == Role.LEADER
+            and not self.config.joint
+            and self.node_id not in self.config.voters
+        ):
+            effects += self._step_down(self.term, 0.0)
+        return effects
+
+    def _apply_committed(self) -> list:
+        if self.last_applied >= self.commit_index:
+            return []
+        entries = [
+            e for e in self.entries_from(self.last_applied + 1,
+                                         self.commit_index - self.last_applied)
+            if e.index <= self.commit_index
+        ]
+        if not entries:
+            return []
+        self.last_applied = entries[-1].index
+        return [Apply(tuple(entries))]
+
+    # -------------------------------------------------------- message intake
+
+    def handle_message(self, msg: dict, now: float) -> list:
+        mtype = msg["type"]
+        term = int(msg.get("term", 0))
+        effects: list = []
+        if term > self.term:
+            effects += self._step_down(term, now)
+        handler = {
+            "request_vote": self._on_request_vote,
+            "request_vote_response": self._on_vote_response,
+            "append_entries": self._on_append_entries,
+            "append_entries_response": self._on_append_response,
+            "install_snapshot": self._on_install_snapshot,
+            "install_snapshot_response": self._on_install_snapshot_response,
+            "timeout_now": self._on_timeout_now,
+        }.get(mtype)
+        if handler is None:
+            return effects
+        return effects + handler(msg, now)
+
+    def _on_request_vote(self, msg: dict, now: float) -> list:
+        granted = False
+        if int(msg["term"]) >= self.term:
+            up_to_date = (
+                int(msg["last_log_term"]) > self.last_term
+                or (
+                    int(msg["last_log_term"]) == self.last_term
+                    and int(msg["last_log_index"]) >= self.last_index
+                )
+            )
+            if up_to_date and self.voted_for in (None, msg["candidate_id"]) \
+                    and self.role != Role.LEADER:
+                granted = True
+                self.voted_for = msg["candidate_id"]
+                self._election_deadline = now + self._election_timeout()
+        effects: list = []
+        if granted:
+            effects.append(PersistHardState(self.term, self.voted_for))
+        effects.append(Send(msg["candidate_id"], {
+            "type": "request_vote_response",
+            "term": self.term,
+            "from": self.node_id,
+            "vote_granted": granted,
+        }))
+        return effects
+
+    def _on_vote_response(self, msg: dict, now: float) -> list:
+        if self.role != Role.CANDIDATE or int(msg["term"]) != self.term:
+            return []
+        if msg["vote_granted"]:
+            self.votes.add(msg["from"])
+            if self.config.has_quorum(self.votes):
+                return self._become_leader(now)
+        return []
+
+    def _on_append_entries(self, msg: dict, now: float) -> list:
+        effects: list = []
+        leader = msg["leader_id"]
+        if int(msg["term"]) < self.term:
+            return [Send(leader, self._append_response(False, msg))]
+        # Valid leader for this term.
+        if self.role != Role.FOLLOWER:
+            effects += self._step_down(int(msg["term"]), now)
+        self.leader_id = leader
+        self._election_deadline = now + self._election_timeout()
+
+        prev_index = int(msg["prev_log_index"])
+        prev_term = int(msg["prev_log_term"])
+        local_prev_term = self.term_at(prev_index)
+        if prev_index > 0 and local_prev_term != prev_term:
+            if local_prev_term is None and self.snapshot \
+                    and prev_index < self.snapshot.last_index:
+                # Already covered by our snapshot; ask from snapshot end.
+                conflict = self.snapshot.last_index + 1
+            elif local_prev_term is None:
+                conflict = self.last_index + 1
+            else:
+                # First index of the conflicting term (accelerated back-off).
+                conflict = prev_index
+                while conflict > self.log_start and \
+                        self.term_at(conflict - 1) == local_prev_term:
+                    conflict -= 1
+            resp = self._append_response(False, msg)
+            resp["conflict_index"] = conflict
+            return effects + [Send(leader, resp)]
+
+        entries = [LogEntry.from_dict(e) for e in msg.get("entries") or []]
+        new_entries: list[LogEntry] = []
+        truncated_from: int | None = None
+        for e in entries:
+            local = self.entry(e.index)
+            if local is not None and local.term != e.term:
+                # Conflict: drop this and everything after (and forget any
+                # config that lived only in the truncated suffix).
+                pos = e.index - self.log_start
+                del self.log[pos:]
+                truncated_from = e.index
+                local = None
+            if local is None and e.index == self.last_index + 1:
+                self.log.append(e)
+                new_entries.append(e)
+                cfg = self._config_of(e)
+                if cfg is not None:
+                    self.config = cfg
+        if truncated_from is not None:
+            effects.append(TruncateLog(truncated_from))
+            self._recompute_config()
+        if new_entries:
+            effects.append(AppendLog(tuple(new_entries)))
+
+        # The follower may hold a divergent tail past the leader's entries, so
+        # only prev_log_index + len(entries) is CONFIRMED matched — reporting
+        # last_index here would let the leader count unheld entries toward
+        # quorum and commit without a real majority.
+        confirmed = prev_index + len(entries)
+        leader_commit = int(msg["leader_commit"])
+        if leader_commit > self.commit_index:
+            self.commit_index = min(leader_commit, confirmed, self.last_index)
+            effects += self._apply_committed()
+
+        effects.append(Send(leader, self._append_response(True, msg, confirmed)))
+        return effects
+
+    def _append_response(self, success: bool, msg: dict, match: int = 0) -> dict:
+        return {
+            "type": "append_entries_response",
+            "term": self.term,
+            "from": self.node_id,
+            "success": success,
+            "match_index": match if success else 0,
+            "seq": int(msg.get("seq", 0)),
+        }
+
+    def _on_append_response(self, msg: dict, now: float) -> list:
+        if self.role != Role.LEADER or int(msg["term"]) != self.term:
+            return []
+        peer = msg["from"]
+        seq = int(msg.get("seq", 0))
+        if seq > self._peer_ack_seq.get(peer, 0):
+            self._peer_ack_seq[peer] = seq
+        effects: list = []
+        if msg["success"]:
+            match = int(msg["match_index"])
+            if match > self.match_index.get(peer, 0):
+                self.match_index[peer] = match
+            self.next_index[peer] = max(self.next_index.get(peer, 1), match + 1)
+            effects += self._advance_commit()
+            effects += self._check_reads()
+            effects += self._tick_catchup(peer)
+            # Leader transfer: fire TimeoutNow once the target caught up
+            # (reference initiate_leader_transfer, simple_raft.rs:2740-2813).
+            if self._transfer_target == peer and match >= self.last_index:
+                effects.append(Send(peer, {"type": "timeout_now", "term": self.term}))
+            # Keep streaming if the follower is still behind.
+            if self.next_index[peer] <= self.last_index:
+                effects += self._send_append(peer)
+        else:
+            conflict = int(msg.get("conflict_index", 0))
+            self.next_index[peer] = max(
+                1, conflict if conflict else self.next_index.get(peer, 2) - 1
+            )
+            effects += self._send_append(peer)
+        return effects
+
+    def _on_install_snapshot(self, msg: dict, now: float) -> list:
+        effects: list = []
+        if int(msg["term"]) < self.term:
+            return []
+        if self.role != Role.FOLLOWER:
+            effects += self._step_down(int(msg["term"]), now)
+        self.leader_id = msg["leader_id"]
+        self._election_deadline = now + self._election_timeout()
+        snap = Snapshot.from_dict(msg["snapshot"])
+        if self.snapshot is None or snap.last_index > self.snapshot.last_index:
+            # Keep any log suffix that extends past the snapshot and matches.
+            if self.term_at(snap.last_index) == snap.last_term:
+                self.log = [e for e in self.log if e.index > snap.last_index]
+            else:
+                self.log = []
+            self.snapshot = snap
+            self.config = snap.config
+            for e in self.log:
+                cfg = self._config_of(e)
+                if cfg is not None:
+                    self.config = cfg
+            self.commit_index = max(self.commit_index, snap.last_index)
+            self.last_applied = max(self.last_applied, snap.last_index)
+            effects.append(SaveSnapshot(snap))
+            effects.append(RestoreFromSnapshot(snap))
+        effects.append(Send(msg["leader_id"], {
+            "type": "install_snapshot_response",
+            "term": self.term,
+            "from": self.node_id,
+            "last_index": self.snapshot.last_index if self.snapshot else 0,
+            "seq": int(msg.get("seq", 0)),
+        }))
+        return effects
+
+    def _on_install_snapshot_response(self, msg: dict, now: float) -> list:
+        if self.role != Role.LEADER or int(msg["term"]) != self.term:
+            return []
+        peer = msg["from"]
+        last = int(msg["last_index"])
+        seq = int(msg.get("seq", 0))
+        if seq > self._peer_ack_seq.get(peer, 0):
+            self._peer_ack_seq[peer] = seq
+        self.match_index[peer] = max(self.match_index.get(peer, 0), last)
+        self.next_index[peer] = last + 1
+        effects = self._advance_commit()
+        effects += self._check_reads()
+        if self.next_index[peer] <= self.last_index:
+            effects += self._send_append(peer)
+        return effects
+
+    def _on_timeout_now(self, msg: dict, now: float) -> list:
+        """Immediate election for leader transfer (reference TimeoutNow route,
+        bin/master.rs:163-171). Stale-term transfers are ignored so a delayed
+        TimeoutNow can't depose a healthy later-term leader."""
+        if int(msg.get("term", 0)) < self.term:
+            return []
+        if not self.is_voter or self.role == Role.LEADER:
+            return []
+        return self._start_election(now)
+
+    # ------------------------------------------------------------ membership
+
+    def add_server(self, node: str, now: float) -> list:
+        """Begin adding a voter: the node first replicates as a non-voting
+        learner; once caught up (or after N catch-up rounds) the joint config
+        is proposed (reference BeginJointConsensus + CatchUpProgress,
+        simple_raft.rs:72-106,241-243)."""
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        if self.config.joint or self._catchup is not None:
+            raise ValueError("membership change already in progress")
+        if node in self.config.voters:
+            raise ValueError(f"{node} is already a voter")
+        self._catchup = {
+            "node": node,
+            "rounds_left": self.timings.catchup_rounds,
+            "target": self.last_index,
+        }
+        new_cfg = replace(self.config, learners=self.config.learners | {node})
+        _, effects = self.propose({"_config": new_cfg.to_dict()}, now)
+        self.next_index.setdefault(node, 1)
+        self.match_index.setdefault(node, 0)
+        self._peer_ack_seq.setdefault(node, 0)
+        return effects
+
+    def remove_server(self, node: str, now: float) -> list:
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        if self.config.joint or self._catchup is not None:
+            raise ValueError("membership change already in progress")
+        if node not in self.config.voters:
+            raise ValueError(f"{node} is not a voter")
+        if len(self.config.voters) == 1:
+            raise ValueError("cannot remove the last voter")
+        joint = Config(
+            voters=self.config.voters - {node},
+            voters_old=self.config.voters,
+            learners=self.config.learners,
+        )
+        _, effects = self.propose({"_config": joint.to_dict()}, now)
+        return effects
+
+    def _tick_catchup(self, peer: str) -> list:
+        """Promote a caught-up learner into joint consensus."""
+        cu = self._catchup
+        if cu is None or cu["node"] != peer or self.config.joint:
+            return []
+        if self.match_index.get(peer, 0) >= cu["target"]:
+            self._catchup = None
+            joint = Config(
+                voters=self.config.voters | {peer},
+                voters_old=self.config.voters,
+                learners=self.config.learners - {peer},
+            )
+            _, effects = self.propose({"_config": joint.to_dict()}, 0.0)
+            return effects
+        cu["rounds_left"] -= 1
+        cu["target"] = self.last_index
+        if cu["rounds_left"] <= 0:
+            self._catchup = None  # abandon: learner too slow
+        return []
+
+    def _maybe_advance_membership(self) -> list:
+        """Once the joint config commits, propose the final config
+        (reference FinalizeConfiguration, simple_raft.rs:2458-2512)."""
+        if self.role != Role.LEADER or not self.config.joint:
+            return []
+        # Find the latest config entry still in the log.
+        for e in reversed(self.log):
+            cfg = self._config_of(e)
+            if cfg is None:
+                continue
+            if not cfg.joint:
+                return []  # final already proposed
+            if e.index <= self.commit_index:
+                final = Config(voters=cfg.voters, learners=cfg.learners)
+                _, effects = self.propose({"_config": final.to_dict()}, 0.0)
+                return effects
+            return []
+        # No config entry in the log: the joint config came from the snapshot,
+        # hence is committed — propose the final config so the cluster doesn't
+        # stay in joint consensus forever after compaction.
+        cfg = self.config
+        final = Config(voters=cfg.voters, learners=cfg.learners)
+        _, effects = self.propose({"_config": final.to_dict()}, 0.0)
+        return effects
+
+    def transfer_leadership(self, target: str, now: float,
+                            timeout: float = 5.0) -> list:
+        """Stop accepting proposals, catch the target up, then TimeoutNow
+        (reference simple_raft.rs:2740-2813)."""
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        if target not in self.config.voters:
+            raise ValueError(f"{target} is not a voter")
+        if target == self.node_id:
+            return []
+        self._transfer_target = target
+        self._transfer_deadline = now + timeout
+        if self.match_index.get(target, 0) >= self.last_index:
+            return [Send(target, {"type": "timeout_now", "term": self.term})]
+        return self._send_append(target)
+
+    # -------------------------------------------------------------- snapshot
+
+    def compact(self, state_machine_data: bytes) -> list:
+        """Install a local snapshot at last_applied and drop covered entries
+        (reference create_snapshot, simple_raft.rs:1033-1097)."""
+        if self.last_applied < self.log_start:
+            return []
+        last_term = self.term_at(self.last_applied)
+        assert last_term is not None
+        snap = Snapshot(
+            last_index=self.last_applied,
+            last_term=last_term,
+            config=self._config_at(self.last_applied),
+            data=state_machine_data,
+        )
+        self.log = [e for e in self.log if e.index > self.last_applied]
+        self.snapshot = snap
+        return [SaveSnapshot(snap)]
+
+    def _config_at(self, index: int) -> Config:
+        cfg = self.snapshot.config if self.snapshot else self.config
+        latest = None
+        for e in self.log:
+            if e.index > index:
+                break
+            c = self._config_of(e)
+            if c is not None:
+                latest = c
+        if latest is not None:
+            return latest
+        # No config entry at/below index in the in-memory log.
+        if self.snapshot:
+            return self.snapshot.config
+        return cfg
+
+    # ------------------------------------------------------------- inspection
+
+    def status(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "role": self.role.value,
+            "term": self.term,
+            "leader_id": self.leader_id,
+            "commit_index": self.commit_index,
+            "last_applied": self.last_applied,
+            "last_index": self.last_index,
+            "log_len": len(self.log),
+            "config": self.config.to_dict(),
+            "snapshot_index": self.snapshot.last_index if self.snapshot else 0,
+        }

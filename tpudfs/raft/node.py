@@ -1,0 +1,282 @@
+"""Asyncio shell driving a RaftCore over the gRPC substrate.
+
+This is the runtime half of the reference's RaftNode (simple_raft.rs:568-653):
+the event loop ticking at 100 ms (simple_raft.rs:1160,1190), commit-wait
+replies keyed by log index (pending_replies, simple_raft.rs:627,2452-2454),
+peer RPC with a 1.5 s timeout (simple_raft.rs:690), and snapshot compaction
+via the state machine's serializer. Where the reference interleaves all of
+this with consensus logic in one task, here every decision lives in the pure
+core and this shell only executes effects.
+
+State machine contract: ``apply(command) -> result`` (synchronous, fast),
+``snapshot() -> bytes``, ``restore(bytes)``. Commands are opaque msgpack-able
+values.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Callable
+
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer, ServerTls
+from tpudfs.raft.core import (
+    Apply,
+    AppendLog,
+    BecameLeader,
+    Config,
+    NotLeaderError,
+    PersistHardState,
+    RaftCore,
+    ReadReady,
+    RestoreFromSnapshot,
+    SaveSnapshot,
+    Send,
+    SnapshotNeeded,
+    SteppedDown,
+    Timings,
+    TruncateLog,
+)
+from tpudfs.raft.storage import RaftStorage
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "RaftService"
+PEER_RPC_TIMEOUT = 1.5  # reference simple_raft.rs:690
+TICK_INTERVAL = 0.1  # reference simple_raft.rs:1190
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: str,
+        peers: list[str],
+        data_dir: str,
+        *,
+        apply: Callable[[Any], Any],
+        snapshot: Callable[[], bytes],
+        restore: Callable[[bytes], None],
+        timings: Timings | None = None,
+        rpc_client: RpcClient | None = None,
+    ):
+        self.node_id = node_id
+        self.storage = RaftStorage(data_dir)
+        term, voted_for, log, snap = self.storage.load()
+        self.core = RaftCore(
+            node_id,
+            Config(voters=frozenset(peers) | {node_id}),
+            term=term,
+            voted_for=voted_for,
+            log=log,
+            snapshot=snap,
+            timings=timings,
+            now=time.monotonic(),
+        )
+        self._apply_fn = apply
+        self._snapshot_fn = snapshot
+        self._restore_fn = restore
+        if snap is not None:
+            self._restore_fn(snap.data)
+        # Replay committed-but-unsnapshotted state: the core re-applies from
+        # snapshot.last_index as commits re-advance after election.
+        self._owns_client = rpc_client is None
+        self.client = rpc_client or RpcClient()
+        self._pending: dict[int, tuple[int, asyncio.Future]] = {}
+        self._pending_reads: dict[int, asyncio.Future] = {}
+        self._read_seq = 0
+        self._lock = asyncio.Lock()
+        self._tick_task: asyncio.Task | None = None
+        self._send_tasks: set[asyncio.Task] = set()
+        self._snapshotting = False
+
+    # ---------------------------------------------------------------- server
+
+    def handlers(self) -> dict:
+        return {"Message": self.rpc_message, "Status": self.rpc_status}
+
+    def attach(self, server: RpcServer) -> None:
+        server.add_service(SERVICE, self.handlers())
+
+    async def start(self) -> None:
+        self._tick_task = asyncio.create_task(self._tick_loop())
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+            self._tick_task = None
+        for t in list(self._send_tasks):
+            t.cancel()
+        self.storage.close()
+        if self._owns_client:
+            await self.client.close()
+
+    # ----------------------------------------------------------------- RPCs
+
+    async def rpc_message(self, req: dict) -> dict:
+        async with self._lock:
+            effects = self.core.handle_message(req["msg"], self._now())
+            await self._execute(effects)
+        return {}
+
+    async def rpc_status(self, _req: dict) -> dict:
+        return self.status()
+
+    def status(self) -> dict:
+        """Introspection (the reference's /raft/state, bin/master.rs:261-278)."""
+        return self.core.status()
+
+    # ------------------------------------------------------------ public API
+
+    @property
+    def is_leader(self) -> bool:
+        return self.core.role.value == "leader"
+
+    @property
+    def leader_hint(self) -> str | None:
+        return self.core.leader_id
+
+    async def propose(self, command: Any, timeout: float = 10.0) -> Any:
+        """Replicate ``command``; resolves with the state machine's apply
+        result once committed (commit-wait, reference simple_raft.rs:2452)."""
+        async with self._lock:
+            index, effects = self.core.propose(command, self._now())
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[index] = (self.core.term, fut)
+            await self._execute(effects)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(index, None)
+            raise NotLeaderError(self.core.leader_id) from None
+
+    async def read_index(self, timeout: float = 10.0) -> int:
+        """Linearizable read barrier; resolves once this node has confirmed
+        leadership and applied up to the read index."""
+        async with self._lock:
+            self._read_seq += 1
+            rid = self._read_seq
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending_reads[rid] = fut
+            await self._execute(self.core.read_index(rid, self._now()))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending_reads.pop(rid, None)
+            raise NotLeaderError(self.core.leader_id) from None
+
+    async def add_server(self, node: str) -> None:
+        async with self._lock:
+            await self._execute(self.core.add_server(node, self._now()))
+
+    async def remove_server(self, node: str) -> None:
+        async with self._lock:
+            await self._execute(self.core.remove_server(node, self._now()))
+
+    async def transfer_leadership(self, target: str) -> None:
+        async with self._lock:
+            await self._execute(self.core.transfer_leadership(target, self._now()))
+
+    # -------------------------------------------------------------- internals
+
+    @staticmethod
+    def _now() -> float:
+        return time.monotonic()
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(TICK_INTERVAL)
+            async with self._lock:
+                try:
+                    await self._execute(self.core.tick(self._now()))
+                except Exception:
+                    logger.exception("tick failed")
+
+    async def _execute(self, effects: list) -> None:
+        sends: list[Send] = []
+        for eff in effects:
+            if isinstance(eff, Send):
+                sends.append(eff)
+            elif isinstance(eff, PersistHardState):
+                await asyncio.to_thread(
+                    self.storage.save_hard_state, eff.term, eff.voted_for
+                )
+            elif isinstance(eff, AppendLog):
+                await asyncio.to_thread(
+                    self.storage.append_entries, list(eff.entries)
+                )
+            elif isinstance(eff, TruncateLog):
+                await asyncio.to_thread(self.storage.truncate_from, eff.from_index)
+                self._fail_pending_from(eff.from_index)
+            elif isinstance(eff, Apply):
+                for entry in eff.entries:
+                    result = None
+                    if not (isinstance(entry.command, dict)
+                            and ("_noop" in entry.command or "_config" in entry.command)):
+                        try:
+                            result = self._apply_fn(entry.command)
+                        except Exception as e:
+                            logger.exception("state machine apply failed")
+                            result = e
+                    pending = self._pending.pop(entry.index, None)
+                    if pending is not None:
+                        term, fut = pending
+                        if not fut.done():
+                            if term != entry.term:
+                                fut.set_exception(
+                                    NotLeaderError(self.core.leader_id)
+                                )
+                            elif isinstance(result, Exception):
+                                fut.set_exception(result)
+                            else:
+                                fut.set_result(result)
+            elif isinstance(eff, SaveSnapshot):
+                await asyncio.to_thread(
+                    self.storage.save_snapshot, eff.snapshot, list(self.core.log)
+                )
+            elif isinstance(eff, RestoreFromSnapshot):
+                self._restore_fn(eff.snapshot.data)
+            elif isinstance(eff, ReadReady):
+                fut = self._pending_reads.pop(eff.request_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(eff.read_index)
+            elif isinstance(eff, SteppedDown):
+                self._fail_all_pending()
+            elif isinstance(eff, BecameLeader):
+                logger.info("%s became leader for term %d", self.node_id, eff.term)
+            elif isinstance(eff, SnapshotNeeded):
+                if not self._snapshotting:
+                    self._snapshotting = True
+                    try:
+                        data = self._snapshot_fn()
+                        await self._execute(self.core.compact(data))
+                    finally:
+                        self._snapshotting = False
+        for s in sends:
+            task = asyncio.create_task(self._send(s.to, s.msg))
+            self._send_tasks.add(task)
+            task.add_done_callback(self._send_tasks.discard)
+
+    def _fail_pending_from(self, index: int) -> None:
+        for idx in [i for i in self._pending if i >= index]:
+            _, fut = self._pending.pop(idx)
+            if not fut.done():
+                fut.set_exception(NotLeaderError(self.core.leader_id))
+
+    def _fail_all_pending(self) -> None:
+        for idx in list(self._pending):
+            _, fut = self._pending.pop(idx)
+            if not fut.done():
+                fut.set_exception(NotLeaderError(self.core.leader_id))
+        for rid in list(self._pending_reads):
+            fut = self._pending_reads.pop(rid)
+            if not fut.done():
+                fut.set_exception(NotLeaderError(self.core.leader_id))
+
+    async def _send(self, peer: str, msg: dict) -> None:
+        try:
+            await self.client.call(
+                peer, SERVICE, "Message", {"msg": msg}, timeout=PEER_RPC_TIMEOUT
+            )
+        except RpcError as e:
+            logger.debug("raft send to %s failed: %s", peer, e.message)

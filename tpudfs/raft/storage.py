@@ -1,0 +1,137 @@
+"""Durable Raft state on the local filesystem.
+
+The reference persists term/vote/log under RocksDB keys with batched writes
+(simple_raft.rs:683,908-952) and snapshots as serialized state
+(simple_raft.rs:1033-1097). RocksDB isn't available in this image, so this
+module uses the equivalent primitives directly:
+
+- ``hard_state`` file — atomic replace, fsync'd (term + voted_for);
+- ``wal.bin`` — append-only length-prefixed msgpack records (append / truncate
+  markers), one fsync per batch (the save_log_entries_batch analogue);
+- ``snapshot.bin`` — atomic replace; saving a snapshot rewrites the WAL with
+  only the entries past the snapshot (compaction, simple_raft.rs:1210-1213).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from pathlib import Path
+
+import msgpack
+
+from tpudfs.raft.core import LogEntry, Snapshot
+
+_LEN = struct.Struct("<I")
+
+
+def _write_all(fd: int, data: bytes) -> None:
+    """os.write may be partial (signals, ENOSPC-adjacent paths); loop."""
+    view = memoryview(data)
+    while view:
+        n = os.write(fd, view)
+        view = view[n:]
+
+
+def _atomic_write(path: Path, data: bytes) -> None:
+    tmp = path.with_name(path.name + ".tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        _write_all(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+
+
+class RaftStorage:
+    def __init__(self, data_dir: str | Path):
+        self.dir = Path(data_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._hard = self.dir / "hard_state"
+        self._wal = self.dir / "wal.bin"
+        self._snap = self.dir / "snapshot.bin"
+        self._wal_fd: int | None = None
+
+    # ------------------------------------------------------------------ load
+
+    def load(self) -> tuple[int, str | None, list[LogEntry], Snapshot | None]:
+        term, voted_for = 0, None
+        if self._hard.exists():
+            d = msgpack.unpackb(self._hard.read_bytes(), raw=False)
+            term, voted_for = int(d["term"]), d["voted_for"]
+        snapshot = None
+        if self._snap.exists():
+            snapshot = Snapshot.from_dict(
+                msgpack.unpackb(self._snap.read_bytes(), raw=False)
+            )
+        log: list[LogEntry] = []
+        if self._wal.exists():
+            log = self._replay_wal()
+        if snapshot is not None:
+            log = [e for e in log if e.index > snapshot.last_index]
+        return term, voted_for, log, snapshot
+
+    def _replay_wal(self) -> list[LogEntry]:
+        log: list[LogEntry] = []
+        raw = self._wal.read_bytes()
+        pos = 0
+        while pos + _LEN.size <= len(raw):
+            (n,) = _LEN.unpack_from(raw, pos)
+            pos += _LEN.size
+            if pos + n > len(raw):
+                break  # torn tail record from a crash — ignore
+            rec = msgpack.unpackb(raw[pos : pos + n], raw=False)
+            pos += n
+            if rec["t"] == "a":
+                entries = [LogEntry.from_dict(e) for e in rec["e"]]
+                if entries:
+                    log = [x for x in log if x.index < entries[0].index]
+                    log.extend(entries)
+            elif rec["t"] == "t":
+                log = [x for x in log if x.index < rec["i"]]
+        return log
+
+    # ----------------------------------------------------------------- write
+
+    def save_hard_state(self, term: int, voted_for: str | None) -> None:
+        _atomic_write(
+            self._hard, msgpack.packb({"term": term, "voted_for": voted_for})
+        )
+
+    def _wal_handle(self) -> int:
+        if self._wal_fd is None:
+            self._wal_fd = os.open(
+                self._wal, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+        return self._wal_fd
+
+    def _wal_append(self, rec: dict) -> None:
+        payload = msgpack.packb(rec)
+        fd = self._wal_handle()
+        _write_all(fd, _LEN.pack(len(payload)) + payload)
+        os.fsync(fd)
+
+    def append_entries(self, entries: list[LogEntry]) -> None:
+        if entries:
+            self._wal_append({"t": "a", "e": [e.to_dict() for e in entries]})
+
+    def truncate_from(self, index: int) -> None:
+        self._wal_append({"t": "t", "i": index})
+
+    def save_snapshot(self, snapshot: Snapshot, remaining: list[LogEntry]) -> None:
+        """Persist snapshot and compact the WAL down to ``remaining``."""
+        _atomic_write(self._snap, msgpack.packb(snapshot.to_dict()))
+        if self._wal_fd is not None:
+            os.close(self._wal_fd)
+            self._wal_fd = None
+        buf = b""
+        if remaining:
+            payload = msgpack.packb({"t": "a", "e": [e.to_dict() for e in remaining]})
+            buf = _LEN.pack(len(payload)) + payload
+        _atomic_write(self._wal, buf)
+
+    def close(self) -> None:
+        if self._wal_fd is not None:
+            os.close(self._wal_fd)
+            self._wal_fd = None
