@@ -1,0 +1,172 @@
+"""MasterState apply logic + placement/healing pure functions
+(coverage model: reference master.rs:3823-4483 pure-function tests)."""
+
+import pytest
+
+from tpudfs.master import placement
+from tpudfs.master.state import ChunkServerStatus, MasterState
+
+
+def _mk_state(servers=None):
+    st = MasterState()
+    st.exit_safe_mode()
+    for addr, rack, space in servers or []:
+        st.chunk_servers[addr] = ChunkServerStatus(
+            last_heartbeat_ms=10**15, available_space=space, rack_id=rack
+        )
+    return st
+
+
+def _create_complete(st, path, blocks):
+    st.apply({"op": "create_file", "path": path, "created_at_ms": 1})
+    for bid, locs in blocks:
+        st.apply({"op": "allocate_block", "path": path, "block_id": bid,
+                  "locations": locs})
+    st.apply({"op": "complete_file", "path": path, "size": 10,
+              "block_checksums": [], "etag_md5": "x"})
+
+
+def test_file_lifecycle():
+    st = _mk_state()
+    st.apply({"op": "create_file", "path": "/a", "created_at_ms": 5})
+    assert st.get_file("/a") is None  # pending until complete
+    st.apply({"op": "allocate_block", "path": "/a", "block_id": "b1",
+              "locations": ["cs1", "cs2", "cs3"]})
+    st.apply({"op": "complete_file", "path": "/a", "size": 100,
+              "etag_md5": "etag",
+              "block_checksums": [{"block_id": "b1", "checksum_crc32c": 7,
+                                   "actual_size": 100}]})
+    f = st.get_file("/a")
+    assert f.size == 100 and f.blocks[0].checksum_crc32c == 7
+    with pytest.raises(ValueError):
+        st.apply({"op": "create_file", "path": "/a", "created_at_ms": 6})
+    st.apply({"op": "rename_file", "src": "/a", "dst": "/b"})
+    assert st.get_file("/a") is None and st.get_file("/b").path == "/b"
+    st.apply({"op": "delete_file", "path": "/b"})
+    assert st.get_file("/b") is None
+    # Deletion queued block cleanup on every holder.
+    assert {"type": "DELETE", "block_id": "b1"} in st.pending_commands["cs1"]
+
+
+def test_access_stats_and_tiering_commands():
+    st = _mk_state()
+    _create_complete(st, "/f", [("b1", ["cs1"])])
+    st.apply({"op": "update_access_stats", "path": "/f", "at_ms": 123})
+    assert st.files["/f"].last_access_ms == 123
+    assert st.files["/f"].access_count == 1
+    st.apply({"op": "move_to_cold", "path": "/f", "at_ms": 456})
+    assert st.files["/f"].moved_to_cold_at_ms == 456
+    assert {"type": "MOVE_TO_COLD", "block_id": "b1"} in st.pending_commands["cs1"]
+    st.apply({"op": "convert_to_ec", "path": "/f", "ec_data_shards": 6,
+              "ec_parity_shards": 3})
+    assert st.files["/f"].ec_data_shards == 6
+
+
+def test_snapshot_roundtrip():
+    st = _mk_state()
+    _create_complete(st, "/f", [("b1", ["cs1", "cs2"])])
+    st2 = MasterState()
+    st2.restore(st.snapshot())
+    assert st2.get_file("/f").blocks[0].locations == ["cs1", "cs2"]
+
+
+def test_safe_mode_exit_conditions():
+    st = MasterState()
+    st.enter_safe_mode(at_ms=1000)
+    _create_complete(st, "/f", [("b1", ["cs1"]), ("b2", ["cs1"])])
+    st.safe_mode = True  # _create_complete is for block bookkeeping only
+    # No chunkservers yet: stays in safe mode.
+    assert not st.should_exit_safe_mode(at_ms=2000)
+    # One CS reporting 99%+ of blocks: exits.
+    st.record_heartbeat("cs1", used_space=0, available_space=10,
+                        chunk_count=2, rack_id="r", at_ms=2000)
+    assert not st.safe_mode
+    # Timeout path.
+    st.enter_safe_mode(at_ms=1000)
+    assert st.should_exit_safe_mode(at_ms=1000 + 61_000)
+
+
+def test_rack_aware_selection_spreads_racks():
+    servers = [
+        ("a1", ChunkServerStatus(available_space=100, rack_id="r1")),
+        ("a2", ChunkServerStatus(available_space=90, rack_id="r1")),
+        ("b1", ChunkServerStatus(available_space=80, rack_id="r2")),
+        ("c1", ChunkServerStatus(available_space=70, rack_id="r3")),
+    ]
+    sel = placement.select_servers_rack_aware(servers, 3)
+    assert sel == ["a1", "b1", "c1"]  # one per rack, by free space
+    sel = placement.select_servers_rack_aware(servers, 4)
+    assert sel == ["a1", "b1", "c1", "a2"]
+    # Empty rack ids don't clump into one bucket.
+    servers = [
+        ("x", ChunkServerStatus(available_space=5, rack_id="")),
+        ("y", ChunkServerStatus(available_space=9, rack_id="")),
+    ]
+    assert placement.select_servers_rack_aware(servers, 2) == ["y", "x"]
+
+
+def test_healer_replicated_block():
+    st = _mk_state([("cs1", "r1", 10), ("cs2", "r2", 20), ("cs3", "r3", 30)])
+    _create_complete(st, "/f", [("b1", ["cs1", "dead1", "dead2"])])
+    plan = placement.heal_under_replicated(st)
+    targets = {cmd["target_chunk_server_address"] for _, cmd in plan.queues}
+    sources = {src for src, _ in plan.queues}
+    assert sources == {"cs1"} and targets == {"cs2", "cs3"}
+
+
+def test_healer_respects_bad_blocks():
+    st = _mk_state([("cs1", "r1", 10), ("cs2", "r2", 20), ("cs3", "r3", 30)])
+    _create_complete(st, "/f", [("b1", ["cs1", "cs2", "cs3"])])
+    st.report_bad_blocks("cs1", ["b1"])
+    plan = placement.heal_under_replicated(st)
+    # cs1's copy is bad: needs one more replica but no free server exists.
+    assert plan.queues == []
+    st.chunk_servers["cs4"] = ChunkServerStatus(available_space=5, rack_id="r4",
+                                                last_heartbeat_ms=10**15)
+    plan = placement.heal_under_replicated(st)
+    assert plan.queues[0][1]["target_chunk_server_address"] == "cs4"
+    assert plan.queues[0][0] in ("cs2", "cs3")  # healthy source only
+
+
+def test_healer_ec_block():
+    st = _mk_state([(f"cs{i}", f"r{i}", 10 + i) for i in range(6)])
+    st.apply({"op": "create_file", "path": "/e", "created_at_ms": 1,
+              "ec_data_shards": 4, "ec_parity_shards": 2})
+    locs = ["cs0", "cs1", "dead", "cs3", "cs4", "cs5"]
+    st.apply({"op": "allocate_block", "path": "/e", "block_id": "e1",
+              "locations": locs, "ec_data_shards": 4, "ec_parity_shards": 2})
+    st.apply({"op": "complete_file", "path": "/e", "size": 10,
+              "block_checksums": []})
+    plan = placement.heal_under_replicated(st)
+    (target, cmd), = plan.queues
+    assert cmd["type"] == "RECONSTRUCT_EC_SHARD"
+    assert cmd["shard_index"] == 2
+    assert target == "cs2"  # only live CS not already holding a shard
+    assert cmd["ec_shard_sources"][2] == ""  # dead slot marked unavailable
+
+
+def test_healer_ec_unrecoverable():
+    st = _mk_state([("cs0", "r0", 10)])
+    st.apply({"op": "create_file", "path": "/e", "created_at_ms": 1,
+              "ec_data_shards": 4, "ec_parity_shards": 2})
+    st.apply({"op": "allocate_block", "path": "/e", "block_id": "e1",
+              "locations": ["cs0", "d1", "d2", "d3", "d4", "d5"],
+              "ec_data_shards": 4, "ec_parity_shards": 2})
+    st.apply({"op": "complete_file", "path": "/e", "size": 10,
+              "block_checksums": []})
+    plan = placement.heal_under_replicated(st)
+    assert plan.queues == []  # only 1 of 4 needed shards live
+
+
+def test_balancer():
+    st = _mk_state([("big", "r1", 10), ("small", "r2", 10)])
+    st.chunk_servers["big"].used_space = 500 * 1024 * 1024
+    st.chunk_servers["small"].used_space = 0
+    _create_complete(st, "/f", [("b1", ["big"])])
+    plan = placement.plan_balancing(st)
+    assert plan.queues[0][1]["target_chunk_server_address"] == "small"
+    assert plan.queues[0][1]["balance_delete_source"]
+    assert len(plan.queues) == 1  # no DELETE until the copy is acked
+    # Under threshold: no action.
+    st.chunk_servers["big"].used_space = 10
+    assert placement.plan_balancing(st).queues == []

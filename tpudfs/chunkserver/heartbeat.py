@@ -35,6 +35,9 @@ class HeartbeatLoop:
         self.config_addrs = list(config_addrs or [])
         self.interval = interval
         self._task: asyncio.Task | None = None
+        #: Executed-command outcomes awaiting delivery to a leader master;
+        #: the master commits location metadata only on these acks.
+        self.pending_results: list[dict] = []
 
     def start(self) -> None:
         self._task = asyncio.create_task(self.run())
@@ -83,6 +86,7 @@ class HeartbeatLoop:
         # Snapshot (don't drain) bad blocks: they are only cleared once at
         # least one master has actually received the report.
         bad_blocks = sorted(self.cs.pending_bad_blocks)
+        results_snapshot = list(self.pending_results)
         req = {
             "chunk_server_address": self.cs.address,
             "used_space": stats["used_space"],
@@ -90,9 +94,11 @@ class HeartbeatLoop:
             "chunk_count": stats["chunk_count"],
             "bad_blocks": bad_blocks,
             "rack_id": self.cs.rack_id,
+            "command_results": results_snapshot,
         }
         executed: list[dict] = []
         reported = False
+        results_delivered = False
         for master in masters:
             try:
                 resp = await self.cs.client.call(
@@ -102,19 +108,27 @@ class HeartbeatLoop:
                 logger.warning("heartbeat to %s failed: %s", master, e.message)
                 continue
             reported = True
+            if resp.get("results_processed"):
+                results_delivered = True
             self.cs.observe_term(int(resp.get("master_term", 0)))
             for cmd in resp.get("commands") or []:
                 try:
-                    await self.execute_command(cmd)
+                    err = await self.execute_command(cmd)
                 except Exception:
                     logger.exception("command %s failed", cmd.get("type"))
+                    err = "exception"
+                self.pending_results.append({**cmd, "success": err is None})
                 executed.append(cmd)
         if reported:
             self.cs.pending_bad_blocks.difference_update(bad_blocks)
+        if results_delivered:
+            # A leader consumed the snapshot; keep only results added since.
+            self.pending_results = self.pending_results[len(results_snapshot):]
         return executed
 
-    async def execute_command(self, cmd: dict) -> None:
-        """Dispatch a master command (reference bin/chunkserver.rs:271-338)."""
+    async def execute_command(self, cmd: dict) -> str | None:
+        """Dispatch a master command (reference bin/chunkserver.rs:271-338).
+        Returns an error string, or None on success."""
         ctype = cmd.get("type")
         block_id = cmd.get("block_id", "")
         self.cs.observe_term(int(cmd.get("master_term", 0)))
@@ -141,3 +155,4 @@ class HeartbeatLoop:
             err = f"unknown command type {ctype!r}"
         if err:
             logger.error("command %s for block %s failed: %s", ctype, block_id, err)
+        return err

@@ -316,7 +316,7 @@ class ChunkServer:
             try:
                 resp = await self.client.call(
                     master, "MasterService", "GetBlockLocations",
-                    {"block_id": block_id}, timeout=5.0,
+                    {"block_id": block_id, "allow_stale": True}, timeout=5.0,
                 )
                 if resp.get("found"):
                     locations = list(resp.get("locations") or [])

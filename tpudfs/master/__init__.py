@@ -1,0 +1,1 @@
+"""Metadata plane: namespace master (Raft-replicated state machine)."""

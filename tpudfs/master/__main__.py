@@ -1,0 +1,47 @@
+"""Master process entrypoint (reference dfs/metaserver/src/bin/master.rs).
+
+Run: python -m tpudfs.master --port 50051 --data-dir /data/m1 \
+         --peers 127.0.0.1:50052,127.0.0.1:50053 [--shard-id shard-a]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from tpudfs.common.rpc import RpcServer
+from tpudfs.common.telemetry import setup_logging
+from tpudfs.master.service import Master
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("tpudfs-master")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=50051)
+    p.add_argument("--advertise", default="", help="address peers/clients use")
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--peers", default="", help="comma-separated peer master addresses")
+    p.add_argument("--shard-id", default="shard-0")
+    p.add_argument("--config-servers", default="")
+    return p.parse_args(argv)
+
+
+async def amain(args) -> None:
+    address = args.advertise or f"{args.host}:{args.port}"
+    peers = [x for x in args.peers.split(",") if x]
+    master = Master(address, peers, args.data_dir, shard_id=args.shard_id)
+    server = RpcServer(args.host, args.port)
+    master.attach(server)
+    await server.start()
+    await master.start()
+    print(f"READY {address}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    asyncio.run(amain(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Replica placement, healing, and balancing (pure functions over MasterState).
+
+Model: reference master.rs —
+- ``select_servers_rack_aware`` (master.rs:378-432): candidates sorted by free
+  space, bucketed per rack (empty rack id → its own bucket), racks ordered by
+  their best server, round-robin one pick per rack per round;
+- ``heal_under_replicated_blocks`` (master.rs:436-602): replicated blocks with
+  fewer than RF live, non-bad replicas get REPLICATE commands queued on a live
+  source; EC blocks with dead shard hosts (and >= k live shards) get
+  RECONSTRUCT_EC_SHARD on a fresh target with a per-slot source list;
+- block balancer (master.rs:777-845): move one block from the most- to the
+  least-loaded CS when imbalance exceeds 100 MB.
+
+Deviation from the reference (improvement): block locations are updated in
+metadata once the chunkserver ACKS the command via its next heartbeat
+(``command_results``, see Master.rpc_heartbeat) — the reference leaves
+``block.locations`` stale after heals. Plans here only queue commands; no
+metadata is touched until the data actually moved.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpudfs.master.state import MasterState, REPLICATION_FACTOR
+
+BALANCE_THRESHOLD_BYTES = 100 * 1024 * 1024  # reference master.rs:777-845
+
+
+@dataclass
+class HealPlan:
+    """Commands to queue on chunkservers (metadata follows on CS ack)."""
+
+    queues: list[tuple[str, dict]] = field(default_factory=list)
+
+
+def select_servers_rack_aware(
+    servers: list[tuple[str, object]], n: int
+) -> list[str]:
+    """servers: [(addr, ChunkServerStatus)]."""
+    if n == 0 or not servers:
+        return []
+    candidates = sorted(servers, key=lambda s: -s[1].available_space)
+    buckets: dict[str, list[tuple[str, object]]] = {}
+    for addr, st in candidates:
+        key = st.rack_id if st.rack_id else f"__addr__{addr}"
+        buckets.setdefault(key, []).append((addr, st))
+    racks = sorted(buckets.values(), key=lambda r: -r[0][1].available_space)
+    selected: list[str] = []
+    positions = [0] * len(racks)
+    while len(selected) < n:
+        picked = False
+        for i, rack in enumerate(racks):
+            if len(selected) >= n:
+                break
+            if positions[i] < len(rack):
+                selected.append(rack[positions[i]][0])
+                positions[i] += 1
+                picked = True
+        if not picked:
+            break
+    return selected
+
+
+def heal_under_replicated(state: MasterState) -> HealPlan:
+    plan = HealPlan()
+    live = state.live_servers()
+    if not live:
+        return plan
+    for f in state.files.values():
+        for block in f.blocks:
+            if block.is_ec:
+                _heal_ec_block(state, block, live, plan)
+            else:
+                _heal_replicated_block(state, block, live, plan)
+    return plan
+
+
+def _heal_replicated_block(state, block, live, plan: HealPlan) -> None:
+    bad_on = state.bad_block_locations.get(block.block_id, set())
+    live_locs = [
+        loc for loc in block.locations
+        if loc in state.chunk_servers and loc not in bad_on
+    ]
+    needed = REPLICATION_FACTOR - len(live_locs)
+    if needed <= 0:
+        return
+    if not live_locs:
+        return  # no live replica: unrecoverable here (scrub/recovery may help)
+    source = live_locs[0]
+    eligible = [
+        (s, state.chunk_servers[s]) for s in live if s not in block.locations
+    ]
+    targets = select_servers_rack_aware(eligible, needed)
+    for target in targets:
+        plan.queues.append((source, {
+            "type": "REPLICATE",
+            "block_id": block.block_id,
+            "target_chunk_server_address": target,
+        }))
+
+
+def _heal_ec_block(state, block, live, plan: HealPlan) -> None:
+    k = block.ec_data_shards
+    total = k + block.ec_parity_shards
+    if len(block.locations) != total:
+        return
+    live_count = sum(1 for loc in block.locations if loc in state.chunk_servers)
+    if live_count == total:
+        return
+    if live_count < k:
+        return  # unrecoverable
+    taken = set(block.locations)
+    for shard_idx, loc in enumerate(block.locations):
+        if loc in state.chunk_servers:
+            continue
+        eligible = [
+            (s, state.chunk_servers[s]) for s in live if s not in taken
+        ]
+        picked = select_servers_rack_aware(eligible, 1)
+        if not picked:
+            continue
+        target = picked[0]
+        taken.add(target)
+        sources = [
+            l if l in state.chunk_servers else "" for l in block.locations
+        ]
+        plan.queues.append((target, {
+            "type": "RECONSTRUCT_EC_SHARD",
+            "block_id": block.block_id,
+            "target_chunk_server_address": target,
+            "shard_index": shard_idx,
+            "ec_data_shards": block.ec_data_shards,
+            "ec_parity_shards": block.ec_parity_shards,
+            "ec_shard_sources": sources,
+            "original_block_size": block.original_size,
+        }))
+
+
+def plan_balancing(state: MasterState) -> HealPlan:
+    """One block from the most-loaded to the least-loaded CS when the spread
+    exceeds BALANCE_THRESHOLD_BYTES."""
+    plan = HealPlan()
+    if len(state.chunk_servers) < 2:
+        return plan
+    by_used = sorted(state.chunk_servers.items(), key=lambda kv: kv[1].used_space)
+    least, most = by_used[0], by_used[-1]
+    if most[1].used_space - least[1].used_space < BALANCE_THRESHOLD_BYTES:
+        return plan
+    # Find a replicated block on `most` that `least` doesn't hold. Only the
+    # copy is scheduled here; the source copy is deleted by the master AFTER
+    # the REPLICATE is acked (balance intent recorded on the command), so a
+    # failed copy can never lose the last replica.
+    for f in state.files.values():
+        for block in f.blocks:
+            if block.is_ec:
+                continue
+            if most[0] in block.locations and least[0] not in block.locations:
+                plan.queues.append((most[0], {
+                    "type": "REPLICATE",
+                    "block_id": block.block_id,
+                    "target_chunk_server_address": least[0],
+                    "balance_delete_source": True,
+                }))
+                return plan
+    return plan

@@ -1,0 +1,504 @@
+"""Master service: the namespace gRPC front + background maintenance loops.
+
+Model: reference dfs/metaserver/src/master.rs MyMaster (RPC handlers
+master.rs:2179-3660) and its background tasks (master.rs:712-1427 +
+bin/master.rs:230-238):
+
+- namespace RPCs gated by safe mode (master.rs:2163-2173) and, once sharding
+  lands, shard ownership (REDIRECT, master.rs:2141-2159);
+- linearizable reads via the Raft ReadIndex barrier (ensure_linearizable_read,
+  master.rs:1911);
+- AllocateBlock picks replicas rack-aware from live chunkservers and returns
+  the allocating master's Raft term for epoch fencing (master.rs:2351);
+- Heartbeat updates soft state, reports bad blocks, drains the per-CS command
+  queue stamped with the current term (master.rs:2596-2723);
+- liveness checker drops silent CSes after 15 s and heals (master.rs:729-760);
+  periodic healer (master.rs:762-775); block balancer (master.rs:777-845);
+- tiering scanner marks cold files and schedules EC policy conversion
+  (scan_tiering / scan_ec_conversion, master.rs:1933-2138).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import uuid
+
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
+from tpudfs.master import placement
+from tpudfs.master.state import (
+    MasterState,
+    REPLICATION_FACTOR,
+    now_ms,
+)
+from tpudfs.raft.core import NotLeaderError, Timings
+from tpudfs.raft.node import RaftNode
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "MasterService"
+
+LIVENESS_CUTOFF_MS = 15_000  # reference master.rs:740-757
+LIVENESS_INTERVAL = 5.0
+HEALER_INTERVAL = 300.0
+BALANCER_INTERVAL = 30.0
+TIERING_INTERVAL = 60.0
+DEFAULT_COLD_THRESHOLD_SECS = 7 * 24 * 3600  # reference: COLD_THRESHOLD_SECS
+DEFAULT_EC_THRESHOLD_SECS = 30 * 24 * 3600  # reference: EC_THRESHOLD_SECS
+EC_CONVERSION_SHAPE = (6, 3)  # reference RS(6,3), master.rs:2016-2138
+
+
+class Master:
+    def __init__(
+        self,
+        address: str,
+        peers: list[str],
+        data_dir: str,
+        *,
+        shard_id: str = "shard-0",
+        raft_timings: Timings | None = None,
+        rpc_client: RpcClient | None = None,
+        cold_threshold_secs: int | None = None,
+        ec_threshold_secs: int | None = None,
+        liveness_cutoff_ms: int = LIVENESS_CUTOFF_MS,
+        intervals: dict | None = None,
+    ):
+        self.address = address
+        self.state = MasterState(shard_id)
+        self.state.enter_safe_mode()
+        self._owns_client = rpc_client is None
+        self.client = rpc_client or RpcClient()
+        self.raft = RaftNode(
+            address, peers, data_dir,
+            apply=self.state.apply,
+            snapshot=self.state.snapshot,
+            restore=self.state.restore,
+            timings=raft_timings,
+            rpc_client=self.client,
+        )
+        self.cold_threshold_ms = 1000 * (
+            cold_threshold_secs
+            if cold_threshold_secs is not None
+            else int(os.environ.get("COLD_THRESHOLD_SECS", DEFAULT_COLD_THRESHOLD_SECS))
+        )
+        self.ec_threshold_ms = 1000 * (
+            ec_threshold_secs
+            if ec_threshold_secs is not None
+            else int(os.environ.get("EC_THRESHOLD_SECS", DEFAULT_EC_THRESHOLD_SECS))
+        )
+        self.liveness_cutoff_ms = liveness_cutoff_ms
+        iv = intervals or {}
+        self._intervals = {
+            "liveness": iv.get("liveness", LIVENESS_INTERVAL),
+            "healer": iv.get("healer", HEALER_INTERVAL),
+            "balancer": iv.get("balancer", BALANCER_INTERVAL),
+            "tiering": iv.get("tiering", TIERING_INTERVAL),
+        }
+        self._tasks: set[asyncio.Task] = set()
+
+    # --------------------------------------------------------------- wiring
+
+    def handlers(self) -> dict:
+        return {
+            "GetFileInfo": self.rpc_get_file_info,
+            "CreateFile": self.rpc_create_file,
+            "DeleteFile": self.rpc_delete_file,
+            "AllocateBlock": self.rpc_allocate_block,
+            "CompleteFile": self.rpc_complete_file,
+            "ListFiles": self.rpc_list_files,
+            "GetBlockLocations": self.rpc_get_block_locations,
+            "Heartbeat": self.rpc_heartbeat,
+            "RegisterChunkServer": self.rpc_register_chunk_server,
+            "Rename": self.rpc_rename,
+            "SafeModeStatus": self.rpc_safe_mode_status,
+            "EnterSafeMode": self.rpc_enter_safe_mode,
+            "ExitSafeMode": self.rpc_exit_safe_mode,
+            "AddRaftNode": self.rpc_add_raft_node,
+            "RemoveRaftNode": self.rpc_remove_raft_node,
+            "TransferLeadership": self.rpc_transfer_leadership,
+            "RaftState": self.rpc_raft_state,
+        }
+
+    def attach(self, server: RpcServer) -> None:
+        server.add_service(SERVICE, self.handlers())
+        self.raft.attach(server)
+
+    async def start(self, background_tasks: bool = True) -> None:
+        await self.raft.start()
+        if background_tasks:
+            self._spawn(self._loop(self._intervals["liveness"], self.run_liveness_check))
+            self._spawn(self._loop(self._intervals["healer"], self.run_healer))
+            self._spawn(self._loop(self._intervals["balancer"], self.run_balancer))
+            self._spawn(self._loop(self._intervals["tiering"], self.run_tiering_scan))
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _loop(self, interval: float, fn) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await fn()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("background task %s failed", fn.__name__)
+
+    async def stop(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        self._tasks.clear()
+        await self.raft.stop()
+        if self._owns_client:
+            await self.client.close()
+
+    # -------------------------------------------------------------- helpers
+
+    async def _propose(self, cmd: dict):
+        try:
+            return await self.raft.propose(cmd)
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+        except ValueError as e:
+            raise RpcError.invalid(str(e)) from None
+
+    async def _linearizable_read(self) -> None:
+        """ReadIndex barrier before serving metadata reads
+        (reference master.rs:1911)."""
+        try:
+            await self.raft.read_index()
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+
+    def _check_safe_mode(self) -> None:
+        if self.state.safe_mode and self.state.should_exit_safe_mode():
+            self.state.exit_safe_mode()
+        if self.state.safe_mode:
+            raise RpcError.unavailable(
+                "Master is in safe mode; writes are temporarily disabled"
+            )
+
+    @staticmethod
+    def _new_block_id() -> str:
+        return f"blk-{uuid.uuid4().hex}"
+
+    # ------------------------------------------------------- namespace RPCs
+
+    async def rpc_create_file(self, req: dict) -> dict:
+        self._check_safe_mode()
+        await self._propose({
+            "op": "create_file",
+            "path": req["path"],
+            "ec_data_shards": int(req.get("ec_data_shards") or 0),
+            "ec_parity_shards": int(req.get("ec_parity_shards") or 0),
+            "created_at_ms": now_ms(),
+        })
+        return {"success": True}
+
+    async def rpc_allocate_block(self, req: dict) -> dict:
+        self._check_safe_mode()
+        # Leadership first: a follower's local state may lag, and the client
+        # must get a redirect rather than a spurious not_found.
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
+        path = req["path"]
+        f = self.state.files.get(path)
+        if f is None:
+            raise RpcError.not_found(f"file not found: {path}")
+        k, m = f.ec_data_shards, f.ec_parity_shards
+        count = (k + m) if k > 0 else REPLICATION_FACTOR
+        servers = placement.select_servers_rack_aware(
+            list(self.state.chunk_servers.items()), count
+        )
+        if k > 0 and len(servers) < count:
+            raise RpcError.unavailable(
+                f"EC({k},{m}) needs {count} chunkservers, have {len(servers)}"
+            )
+        if not servers:
+            raise RpcError.unavailable("no chunkservers available")
+        block_id = self._new_block_id()
+        result = await self._propose({
+            "op": "allocate_block",
+            "path": path,
+            "block_id": block_id,
+            "locations": servers,
+            "ec_data_shards": k,
+            "ec_parity_shards": m,
+        })
+        return {
+            "block": result["block"],
+            "chunk_server_addresses": servers,
+            "ec_data_shards": k,
+            "ec_parity_shards": m,
+            "master_term": self.raft.core.term,
+        }
+
+    async def rpc_complete_file(self, req: dict) -> dict:
+        self._check_safe_mode()
+        await self._propose({
+            "op": "complete_file",
+            "path": req["path"],
+            "size": int(req["size"]),
+            "etag_md5": req.get("etag_md5", ""),
+            "created_at_ms": int(req.get("created_at_ms") or now_ms()),
+            "block_checksums": req.get("block_checksums") or [],
+        })
+        return {"success": True}
+
+    async def rpc_get_file_info(self, req: dict) -> dict:
+        await self._linearizable_read()
+        f = self.state.get_file(req["path"])
+        if f is None:
+            return {"found": False, "metadata": None}
+        # Fire-and-forget access-stats update for tiering
+        # (reference master.rs:2190-2209).
+        self._spawn(self._update_access_stats(req["path"]))
+        return {"found": True, "metadata": f.to_dict()}
+
+    async def _update_access_stats(self, path: str) -> None:
+        try:
+            await self.raft.propose(
+                {"op": "update_access_stats", "path": path, "at_ms": now_ms()}
+            )
+        except (NotLeaderError, ValueError):
+            pass
+
+    async def rpc_delete_file(self, req: dict) -> dict:
+        self._check_safe_mode()
+        await self._propose({"op": "delete_file", "path": req["path"]})
+        return {"success": True}
+
+    async def rpc_rename(self, req: dict) -> dict:
+        self._check_safe_mode()
+        await self._propose({
+            "op": "rename_file", "src": req["src"], "dst": req["dst"],
+        })
+        return {"success": True}
+
+    async def rpc_list_files(self, req: dict) -> dict:
+        await self._linearizable_read()
+        prefix = req.get("path", "")
+        files = sorted(
+            p for p, f in self.state.files.items()
+            if f.complete and p.startswith(prefix)
+        )
+        return {"files": files}
+
+    async def rpc_get_block_locations(self, req: dict) -> dict:
+        # Linearizable by default; chunkserver recovery passes allow_stale
+        # because it sweeps all masters and any copy of the location set
+        # helps (reference recover_block queries every master).
+        if not req.get("allow_stale"):
+            await self._linearizable_read()
+        found = self.state.find_block(req["block_id"])
+        if found is None:
+            return {"found": False, "locations": []}
+        f, block = found
+        return {
+            "found": True,
+            "locations": block.locations,
+            "ec_data_shards": block.ec_data_shards,
+            "ec_parity_shards": block.ec_parity_shards,
+        }
+
+    # ----------------------------------------------------- chunkserver RPCs
+
+    async def rpc_register_chunk_server(self, req: dict) -> dict:
+        self.state.record_heartbeat(
+            req["address"],
+            used_space=0,
+            available_space=int(req.get("capacity") or 0),
+            chunk_count=0,
+            rack_id=req.get("rack_id", ""),
+        )
+        return {"success": True}
+
+    async def rpc_heartbeat(self, req: dict) -> dict:
+        addr = req["chunk_server_address"]
+        self.state.record_heartbeat(
+            addr,
+            used_space=int(req.get("used_space") or 0),
+            available_space=int(req.get("available_space") or 0),
+            chunk_count=int(req.get("chunk_count") or 0),
+            rack_id=req.get("rack_id", ""),
+        )
+        bad = list(req.get("bad_blocks") or [])
+        if bad:
+            logger.warning("heartbeat: %d bad block(s) reported by %s", len(bad), addr)
+        self.state.report_bad_blocks(addr, bad)
+        if bad:
+            self._spawn(self.run_healer())
+        results_processed = await self._process_command_results(
+            addr, req.get("command_results") or []
+        )
+        term = self.raft.core.term
+        commands = self.state.drain_commands(addr)
+        for c in commands:
+            c["master_term"] = term
+        return {
+            "success": True,
+            "commands": commands,
+            "master_term": term,
+            "results_processed": results_processed,
+        }
+
+    async def _process_command_results(self, reporter: str, results: list[dict]) -> bool:
+        """Commit metadata changes only after the chunkserver ACKED the data
+        movement (prevents phantom locations from failed commands). Returns
+        False when this master can't process them (not leader) so the CS
+        retains and re-reports them."""
+        if not results:
+            return True
+        if not self.raft.is_leader:
+            return False
+        for res in results:
+            if not res.get("success"):
+                continue
+            found = self.state.find_block(res.get("block_id", ""))
+            if found is None:
+                continue
+            _, block = found
+            rtype = res.get("type")
+            new_locs = None
+            if rtype == "REPLICATE":
+                target = res.get("target_chunk_server_address")
+                if target and target not in block.locations:
+                    new_locs = block.locations + [target]
+                if res.get("balance_delete_source"):
+                    # Copy confirmed: now safe to drop the source replica.
+                    self.state.queue_command(reporter, {
+                        "type": "DELETE",
+                        "block_id": res["block_id"],
+                        "balance_remove_location": True,
+                    })
+            elif rtype == "RECONSTRUCT_EC_SHARD":
+                idx = int(res.get("shard_index", -1))
+                if 0 <= idx < len(block.locations):
+                    new_locs = list(block.locations)
+                    new_locs[idx] = reporter
+            elif rtype == "DELETE" and res.get("balance_remove_location"):
+                new_locs = [l for l in block.locations if l != reporter]
+            if new_locs is not None and new_locs != block.locations:
+                try:
+                    await self.raft.propose({
+                        "op": "mark_block_locations",
+                        "block_id": res["block_id"],
+                        "locations": new_locs,
+                    })
+                except (NotLeaderError, ValueError) as e:
+                    logger.warning("location update failed: %s", e)
+                    return False
+        return True
+
+    # ------------------------------------------------------- admin RPCs
+
+    async def rpc_safe_mode_status(self, _req: dict) -> dict:
+        return {
+            "safe_mode": self.state.safe_mode,
+            "reported_blocks": self.state.safe_mode_reported_blocks,
+            "total_blocks": self.state.total_known_blocks(),
+        }
+
+    async def rpc_enter_safe_mode(self, _req: dict) -> dict:
+        self.state.enter_safe_mode()
+        return {"success": True}
+
+    async def rpc_exit_safe_mode(self, _req: dict) -> dict:
+        self.state.exit_safe_mode()
+        return {"success": True}
+
+    async def rpc_add_raft_node(self, req: dict) -> dict:
+        try:
+            await self.raft.add_server(req["address"])
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+        except ValueError as e:
+            raise RpcError.invalid(str(e)) from None
+        return {"success": True}
+
+    async def rpc_remove_raft_node(self, req: dict) -> dict:
+        try:
+            await self.raft.remove_server(req["address"])
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+        except ValueError as e:
+            raise RpcError.invalid(str(e)) from None
+        return {"success": True}
+
+    async def rpc_transfer_leadership(self, req: dict) -> dict:
+        try:
+            await self.raft.transfer_leadership(req["target"])
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+        except ValueError as e:
+            raise RpcError.invalid(str(e)) from None
+        return {"success": True}
+
+    async def rpc_raft_state(self, _req: dict) -> dict:
+        return self.raft.status()
+
+    # ------------------------------------------------------ background tasks
+
+    async def run_liveness_check(self) -> None:
+        """Drop CSes silent for >15 s, then heal (reference master.rs:729-760)."""
+        cutoff = now_ms() - self.liveness_cutoff_ms
+        dead = [
+            addr for addr, st in self.state.chunk_servers.items()
+            if st.last_heartbeat_ms < cutoff
+        ]
+        for addr in dead:
+            logger.warning("chunkserver %s considered dead; removing", addr)
+            self.state.remove_chunk_server(addr)
+        if dead:
+            await self.run_healer()
+
+    async def run_healer(self) -> None:
+        if not self.raft.is_leader:
+            return
+        plan = placement.heal_under_replicated(self.state)
+        await self._execute_plan(plan)
+
+    async def run_balancer(self) -> None:
+        if not self.raft.is_leader:
+            return
+        plan = placement.plan_balancing(self.state)
+        await self._execute_plan(plan)
+
+    async def _execute_plan(self, plan: placement.HealPlan) -> None:
+        for addr, cmd in plan.queues:
+            self.state.queue_command(addr, cmd)
+
+    async def run_tiering_scan(self) -> None:
+        """Mark cold files + schedule EC policy conversion
+        (reference scan_tiering master.rs:1933-2013, scan_ec_conversion
+        master.rs:2016-2138)."""
+        if not self.raft.is_leader:
+            return
+        at = now_ms()
+        for path, f in list(self.state.files.items()):
+            if not f.complete:
+                continue
+            reference_ms = f.last_access_ms or f.created_at_ms
+            if not f.moved_to_cold_at_ms and reference_ms and \
+                    at - reference_ms >= self.cold_threshold_ms:
+                try:
+                    await self.raft.propose(
+                        {"op": "move_to_cold", "path": path, "at_ms": at}
+                    )
+                    logger.info("tiering: moved %s to cold", path)
+                except (NotLeaderError, ValueError) as e:
+                    logger.warning("tiering move failed for %s: %s", path, e)
+            elif f.moved_to_cold_at_ms and not f.ec_data_shards and \
+                    at - f.moved_to_cold_at_ms >= self.ec_threshold_ms:
+                k, m = EC_CONVERSION_SHAPE
+                try:
+                    await self.raft.propose({
+                        "op": "convert_to_ec", "path": path,
+                        "ec_data_shards": k, "ec_parity_shards": m,
+                    })
+                    logger.info("tiering: EC policy conversion for %s", path)
+                except (NotLeaderError, ValueError) as e:
+                    logger.warning("EC conversion failed for %s: %s", path, e)

@@ -1,0 +1,400 @@
+"""Master namespace state machine.
+
+Model: reference dfs/metaserver/src/master.rs MasterState + the MasterCommand
+apply logic in simple_raft.rs:2995-3398. Two kinds of state live here, exactly
+as in the reference:
+
+- **Replicated** (mutated only by Raft-applied commands, identical on every
+  replica): the file namespace, block metadata, transaction records, access
+  stats.
+- **Soft** (mutated directly by heartbeats on whichever master receives them;
+  rebuilt from heartbeats after restart): the ChunkServer registry, per-CS
+  pending command queues, bad-block locations, safe-mode progress
+  (master.rs:2596-2667 mutates these without consensus).
+
+Commands are dicts ``{"op": ..., ...}`` carrying their own timestamps so apply
+is deterministic across replicas. Apply raising ValueError reports the error
+to the proposing client without mutating state.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import msgpack
+
+REPLICATION_FACTOR = 3  # reference master.rs:27
+SAFE_MODE_BLOCK_RATIO = 0.99  # reference master.rs:260-366
+SAFE_MODE_TIMEOUT_MS = 60_000
+SAFE_MODE_MIN_CHUNKSERVERS = 1
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+@dataclass
+class BlockInfo:
+    """proto/dfs.proto:226-236 BlockInfo."""
+
+    block_id: str
+    size: int = 0
+    locations: list[str] = field(default_factory=list)
+    checksum_crc32c: int = 0
+    ec_data_shards: int = 0
+    ec_parity_shards: int = 0
+    original_size: int = 0
+
+    @property
+    def is_ec(self) -> bool:
+        return self.ec_data_shards > 0
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BlockInfo":
+        return cls(**d)
+
+
+@dataclass
+class FileMetadata:
+    """proto/dfs.proto:198-214 FileMetadata incl. tiering fields."""
+
+    path: str
+    size: int = 0
+    blocks: list[BlockInfo] = field(default_factory=list)
+    etag_md5: str = ""
+    created_at_ms: int = 0
+    ec_data_shards: int = 0
+    ec_parity_shards: int = 0
+    last_access_ms: int = 0
+    access_count: int = 0
+    moved_to_cold_at_ms: int = 0
+    complete: bool = False
+
+    def to_dict(self) -> dict:
+        d = self.__dict__.copy()
+        d["blocks"] = [b.to_dict() for b in self.blocks]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileMetadata":
+        d = dict(d)
+        d["blocks"] = [BlockInfo.from_dict(b) for b in d.get("blocks", [])]
+        return cls(**d)
+
+
+@dataclass
+class ChunkServerStatus:
+    """Soft state per CS (reference simple_raft.rs:206-222)."""
+
+    last_heartbeat_ms: int = 0
+    used_space: int = 0
+    available_space: int = 0
+    chunk_count: int = 0
+    rack_id: str = "default"
+
+
+class MasterState:
+    def __init__(self, shard_id: str = "shard-0"):
+        self.shard_id = shard_id
+        # Replicated.
+        self.files: dict[str, FileMetadata] = {}
+        self.transactions: dict[str, dict] = {}
+        # Soft.
+        self.chunk_servers: dict[str, ChunkServerStatus] = {}
+        self.pending_commands: dict[str, list[dict]] = {}
+        self.bad_block_locations: dict[str, set[str]] = {}
+        self.safe_mode = True
+        self.safe_mode_entered_ms = 0
+
+    # ------------------------------------------------------------- safe mode
+
+    def enter_safe_mode(self, at_ms: int | None = None) -> None:
+        """Block writes until enough CS blocks are reported (reference
+        master.rs:260-366; entered at boot, bin/master.rs:120-121)."""
+        self.safe_mode = True
+        self.safe_mode_entered_ms = at_ms if at_ms is not None else now_ms()
+
+    @property
+    def safe_mode_reported_blocks(self) -> int:
+        """Recomputed from current heartbeats each time (self-correcting —
+        a CS registering with chunk_count=0 and reporting real counts later
+        is credited as soon as its heartbeat carries them)."""
+        return sum(st.chunk_count for st in self.chunk_servers.values())
+
+    def total_known_blocks(self) -> int:
+        total = 0
+        for f in self.files.values():
+            total += len(f.blocks)
+        return total
+
+    def should_exit_safe_mode(self, at_ms: int | None = None) -> bool:
+        if not self.safe_mode:
+            return True
+        at = at_ms if at_ms is not None else now_ms()
+        if at - self.safe_mode_entered_ms >= SAFE_MODE_TIMEOUT_MS:
+            return True
+        if len(self.chunk_servers) < SAFE_MODE_MIN_CHUNKSERVERS:
+            return False
+        total = self.total_known_blocks()
+        if total == 0:
+            return True
+        return self.safe_mode_reported_blocks >= total * SAFE_MODE_BLOCK_RATIO
+
+    def exit_safe_mode(self) -> None:
+        self.safe_mode = False
+
+    # ------------------------------------------------------- soft-state ops
+
+    def record_heartbeat(self, addr: str, *, used_space: int, available_space: int,
+                         chunk_count: int, rack_id: str, at_ms: int | None = None) -> bool:
+        """Returns True when the CS is newly registered."""
+        at = at_ms if at_ms is not None else now_ms()
+        is_new = addr not in self.chunk_servers
+        prev_rack = self.chunk_servers[addr].rack_id if not is_new else "default"
+        self.chunk_servers[addr] = ChunkServerStatus(
+            last_heartbeat_ms=at,
+            used_space=used_space,
+            available_space=available_space,
+            chunk_count=chunk_count,
+            rack_id=rack_id or prev_rack,
+        )
+        if self.safe_mode and self.should_exit_safe_mode(at):
+            self.exit_safe_mode()
+        return is_new
+
+    def report_bad_blocks(self, addr: str, block_ids: list[str]) -> None:
+        """Replace this CS's bad markers with its current report: a CS keeps
+        reporting a block until it self-recovers, so absence = recovered
+        (keeps the map from poisoning (block, CS) pairs forever)."""
+        for bids in self.bad_block_locations.values():
+            bids.discard(addr)
+        for bid in block_ids:
+            self.bad_block_locations.setdefault(bid, set()).add(addr)
+        for bid in [b for b, s in self.bad_block_locations.items() if not s]:
+            del self.bad_block_locations[bid]
+
+    def queue_command(self, addr: str, command: dict) -> None:
+        queue = self.pending_commands.setdefault(addr, [])
+        if command not in queue:
+            queue.append(command)
+
+    def drain_commands(self, addr: str) -> list[dict]:
+        return self.pending_commands.pop(addr, [])
+
+    def remove_chunk_server(self, addr: str) -> None:
+        self.chunk_servers.pop(addr, None)
+        self.pending_commands.pop(addr, None)
+        for bids in self.bad_block_locations.values():
+            bids.discard(addr)
+        for bid in [b for b, s in self.bad_block_locations.items() if not s]:
+            del self.bad_block_locations[bid]
+
+    def live_servers(self) -> list[str]:
+        return sorted(self.chunk_servers)
+
+    # --------------------------------------------------------------- lookups
+
+    def get_file(self, path: str) -> FileMetadata | None:
+        f = self.files.get(path)
+        return f if f is not None and f.complete else None
+
+    def find_block(self, block_id: str) -> tuple[FileMetadata, BlockInfo] | None:
+        for f in self.files.values():
+            for b in f.blocks:
+                if b.block_id == block_id:
+                    return f, b
+        return None
+
+    # ------------------------------------------------------------- commands
+
+    def apply(self, cmd: dict):
+        op = cmd.get("op")
+        handler = getattr(self, f"_apply_{op}", None)
+        if handler is None:
+            raise ValueError(f"unknown master command {op!r}")
+        return handler(cmd)
+
+    def _apply_create_file(self, cmd: dict):
+        path = cmd["path"]
+        existing = self.files.get(path)
+        if existing is not None and existing.complete:
+            raise ValueError(f"file already exists: {path}")
+        self.files[path] = FileMetadata(
+            path=path,
+            created_at_ms=int(cmd.get("created_at_ms") or 0),
+            ec_data_shards=int(cmd.get("ec_data_shards") or 0),
+            ec_parity_shards=int(cmd.get("ec_parity_shards") or 0),
+        )
+        return {"success": True}
+
+    def _apply_allocate_block(self, cmd: dict):
+        path = cmd["path"]
+        f = self.files.get(path)
+        if f is None:
+            raise ValueError(f"file not found: {path}")
+        block = BlockInfo(
+            block_id=cmd["block_id"],
+            locations=list(cmd["locations"]),
+            ec_data_shards=int(cmd.get("ec_data_shards") or 0),
+            ec_parity_shards=int(cmd.get("ec_parity_shards") or 0),
+        )
+        f.blocks.append(block)
+        return {"success": True, "block": block.to_dict()}
+
+    def _apply_complete_file(self, cmd: dict):
+        path = cmd["path"]
+        f = self.files.get(path)
+        if f is None:
+            raise ValueError(f"file not found: {path}")
+        f.size = int(cmd["size"])
+        f.etag_md5 = cmd.get("etag_md5", "")
+        if cmd.get("created_at_ms"):
+            f.created_at_ms = int(cmd["created_at_ms"])
+        by_id = {b.block_id: b for b in f.blocks}
+        for info in cmd.get("block_checksums") or []:
+            b = by_id.get(info["block_id"])
+            if b is not None:
+                b.checksum_crc32c = int(info.get("checksum_crc32c") or 0)
+                b.size = int(info.get("actual_size") or 0)
+                if info.get("original_size"):
+                    b.original_size = int(info["original_size"])
+        f.complete = True
+        return {"success": True}
+
+    def _apply_delete_file(self, cmd: dict):
+        path = cmd["path"]
+        f = self.files.pop(path, None)
+        if f is None:
+            raise ValueError(f"file not found: {path}")
+        # Queue best-effort block deletion on every holder (idempotent; the
+        # reference leaves orphans — proto DELETE is marked "future use").
+        for b in f.blocks:
+            for loc in b.locations:
+                self.queue_command(loc, {"type": "DELETE", "block_id": b.block_id})
+        return {"success": True}
+
+    def _apply_rename_file(self, cmd: dict):
+        src, dst = cmd["src"], cmd["dst"]
+        f = self.files.get(src)
+        if f is None or not f.complete:
+            raise ValueError(f"file not found: {src}")
+        if dst in self.files and self.files[dst].complete:
+            raise ValueError(f"destination exists: {dst}")
+        self.files.pop(src)
+        f.path = dst
+        self.files[dst] = f
+        return {"success": True}
+
+    def _apply_update_access_stats(self, cmd: dict):
+        f = self.files.get(cmd["path"])
+        if f is not None:
+            f.last_access_ms = int(cmd["at_ms"])
+            f.access_count += 1
+        return {"success": True}
+
+    def _apply_move_to_cold(self, cmd: dict):
+        f = self.files.get(cmd["path"])
+        if f is None:
+            raise ValueError(f"file not found: {cmd['path']}")
+        f.moved_to_cold_at_ms = int(cmd["at_ms"])
+        for b in f.blocks:
+            for loc in b.locations:
+                self.queue_command(
+                    loc, {"type": "MOVE_TO_COLD", "block_id": b.block_id}
+                )
+        return {"success": True}
+
+    def _apply_convert_to_ec(self, cmd: dict):
+        """Metadata-level EC policy conversion; data migration is not part of
+        the reference either (master.rs:2108-2118 leaves it TODO)."""
+        f = self.files.get(cmd["path"])
+        if f is None:
+            raise ValueError(f"file not found: {cmd['path']}")
+        f.ec_data_shards = int(cmd["ec_data_shards"])
+        f.ec_parity_shards = int(cmd["ec_parity_shards"])
+        return {"success": True}
+
+    def _apply_mark_block_locations(self, cmd: dict):
+        """Healer/balancer result: replace a block's location set."""
+        found = self.find_block(cmd["block_id"])
+        if found is None:
+            raise ValueError(f"block not found: {cmd['block_id']}")
+        _, block = found
+        block.locations = list(cmd["locations"])
+        return {"success": True}
+
+    # Transaction + sharding commands land with the 2PC/sharding layer
+    # (tpudfs/master/transactions.py); registered here so apply() dispatch
+    # stays in one place.
+
+    def _apply_tx_create(self, cmd: dict):
+        tx = cmd["tx"]
+        self.transactions[tx["txid"]] = dict(tx)
+        return {"success": True}
+
+    def _apply_tx_set_state(self, cmd: dict):
+        tx = self.transactions.get(cmd["txid"])
+        if tx is None:
+            raise ValueError(f"unknown transaction {cmd['txid']}")
+        tx["state"] = cmd["state"]
+        tx["updated_at_ms"] = int(cmd["at_ms"])
+        return {"success": True}
+
+    def _apply_tx_apply_op(self, cmd: dict):
+        op = cmd["operation"]
+        if op["kind"] == "create":
+            meta = FileMetadata.from_dict(op["metadata"])
+            meta.path = op["path"]
+            self.files[op["path"]] = meta
+        elif op["kind"] == "delete":
+            self.files.pop(op["path"], None)
+        else:
+            raise ValueError(f"unknown tx operation {op['kind']}")
+        return {"success": True}
+
+    def _apply_tx_set_participant_acked(self, cmd: dict):
+        tx = self.transactions.get(cmd["txid"])
+        if tx is None:
+            raise ValueError(f"unknown transaction {cmd['txid']}")
+        tx["participant_acked"] = True
+        return {"success": True}
+
+    def _apply_tx_delete(self, cmd: dict):
+        self.transactions.pop(cmd["txid"], None)
+        return {"success": True}
+
+    def _apply_ingest_metadata(self, cmd: dict):
+        for path, fd in cmd["files"].items():
+            self.files[path] = FileMetadata.from_dict(fd)
+        return {"success": True, "count": len(cmd["files"])}
+
+    def _apply_remove_metadata(self, cmd: dict):
+        removed = 0
+        for path in list(self.files):
+            if cmd["start"] <= path < cmd["end"]:
+                del self.files[path]
+                removed += 1
+        return {"success": True, "count": removed}
+
+    # ---------------------------------------------------------- persistence
+
+    def snapshot(self) -> bytes:
+        return msgpack.packb({
+            "shard_id": self.shard_id,
+            "files": {p: f.to_dict() for p, f in self.files.items()},
+            "transactions": self.transactions,
+        })
+
+    def restore(self, data: bytes) -> None:
+        if not data:
+            return
+        d = msgpack.unpackb(data, raw=False)
+        self.shard_id = d.get("shard_id", self.shard_id)
+        self.files = {
+            p: FileMetadata.from_dict(fd) for p, fd in d.get("files", {}).items()
+        }
+        self.transactions = dict(d.get("transactions", {}))
