@@ -1,0 +1,105 @@
+"""WGL checker self-tests (coverage model: reference checker.rs:774,853-996)."""
+
+from tpudfs.client.checker import check_linearizability
+
+
+def _op(i, kind, key, t0, t1, value=None, dst=None, result=None):
+    return {
+        "id": i, "client": f"c{i}",
+        "op": {"type": kind, "key": key, "value": value, "dst": dst},
+        "invoke_ts": t0, "return_ts": t1, "result": result,
+    }
+
+
+def test_sequential_history_linearizable():
+    h = [
+        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+        _op(1, "get", "k", 2, 3, result="a"),
+        _op(2, "delete", "k", 4, 5, result={"ok": True}),
+        _op(3, "get", "k", 6, 7, result=None),
+    ]
+    r = check_linearizability(h)
+    assert r.linearizable, r.message
+
+
+def test_stale_read_detected():
+    h = [
+        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+        _op(1, "put", "k", 2, 3, value="b", result={"ok": True}),
+        _op(2, "get", "k", 4, 5, result="a"),  # stale: b already returned
+    ]
+    r = check_linearizability(h)
+    assert not r.linearizable
+
+
+def test_concurrent_ops_either_order():
+    # put(b) concurrent with get: get may see "a" or "b".
+    base = [
+        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+        _op(1, "put", "k", 2, 6, value="b", result={"ok": True}),
+    ]
+    for observed in ("a", "b"):
+        h = base + [_op(2, "get", "k", 3, 5, result=observed)]
+        assert check_linearizability(h).linearizable, observed
+
+
+def test_phantom_value_detected():
+    h = [
+        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+        _op(1, "get", "k", 2, 3, result="z"),  # never written
+    ]
+    r = check_linearizability(h)
+    assert not r.linearizable
+    assert "no put ever wrote" in r.message
+
+
+def test_crashed_put_maybe_applied():
+    # A crashed put may or may not have taken effect: both observations OK.
+    for observed in ("a", "b"):
+        h = [
+            _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+            _op(1, "put", "k", 2, None, value="b"),  # crash: no return
+            _op(2, "get", "k", 10, 11, result=observed),
+        ]
+        assert check_linearizability(h).linearizable, observed
+
+
+def test_failed_mutator_must_not_apply():
+    h = [
+        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+        _op(1, "put", "k", 2, 3, value="b", result={"ok": False}),  # failed
+        _op(2, "get", "k", 4, 5, result="b"),
+    ]
+    assert not check_linearizability(h).linearizable
+
+
+def test_rename_moves_value():
+    h = [
+        _op(0, "put", "x", 0, 1, value="v", result={"ok": True}),
+        _op(1, "rename", "x", 2, 3, dst="y", result={"ok": True}),
+        _op(2, "get", "y", 4, 5, result="v"),
+        _op(3, "get", "x", 6, 7, result=None),
+    ]
+    assert check_linearizability(h).linearizable
+
+
+def test_rename_violation():
+    h = [
+        _op(0, "put", "x", 0, 1, value="v", result={"ok": True}),
+        _op(1, "rename", "x", 2, 3, dst="y", result={"ok": True}),
+        _op(2, "get", "x", 4, 5, result="v"),  # should be gone
+    ]
+    assert not check_linearizability(h).linearizable
+
+
+def test_real_time_order_enforced():
+    # get returned before put was invoked: cannot observe the later value.
+    h = [
+        _op(0, "get", "k", 0, 1, result="late"),
+        _op(1, "put", "k", 2, 3, value="late", result={"ok": True}),
+    ]
+    assert not check_linearizability(h).linearizable
+
+
+def test_empty_history():
+    assert check_linearizability([]).linearizable

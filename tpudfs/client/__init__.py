@@ -1,0 +1,1 @@
+"""Client library: write/read paths, retry/redirect, hedged reads, EC, CLI."""

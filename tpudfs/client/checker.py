@@ -1,0 +1,182 @@
+"""Wing-Gong-Linearizability checker for DFS operation histories.
+
+Model: reference dfs/client/src/checker.rs — a WGL-style search over
+invoke/return histories of a multi-register store (one register per path)
+with put/get/delete and linked rename operations; crash ops (no return
+record) are treated as *maybe applied*: the search may either linearize them
+at any point after their invocation or drop them entirely
+(checker.rs:186,452).
+
+History entries are dicts (JSONL on disk):
+  {"id": int, "client": str, "op": {"type": "put|get|delete|rename",
+   "key": str, "value": str|None, "dst": str|None},
+   "invoke_ts": float, "return_ts": float|None, "result": Any}
+
+For ``get``, ``result`` is the observed value or None (not found). For
+mutators, ``result`` is {"ok": bool}; a failed mutator (ok=False) is treated
+as not applied. A crashed mutator (return_ts None) is maybe-applied.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any
+
+INF = float("inf")
+
+
+@dataclass(frozen=True)
+class Op:
+    op_id: int
+    kind: str  # put | get | delete | rename
+    key: str
+    value: str | None
+    dst: str | None
+    invoke: float
+    ret: float  # INF for crashed ops
+    result: Any
+    crashed: bool
+
+    @classmethod
+    def from_entry(cls, e: dict) -> "Op":
+        op = e["op"]
+        ret = e.get("return_ts")
+        return cls(
+            op_id=int(e["id"]),
+            kind=op["type"],
+            key=op["key"],
+            value=op.get("value"),
+            dst=op.get("dst"),
+            invoke=float(e["invoke_ts"]),
+            ret=INF if ret is None else float(ret),
+            result=e.get("result"),
+            crashed=ret is None,
+        )
+
+
+def load_history(path: str) -> list[dict]:
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    return entries
+
+
+@dataclass
+class CheckResult:
+    linearizable: bool
+    message: str
+    witness: list[int] | None = None  # linearization order (op ids)
+    #: True when the search budget ran out before proving either way —
+    #: the history is UNKNOWN, not proven non-linearizable.
+    exhausted: bool = False
+
+
+def check_linearizability(entries: list[dict],
+                          max_states: int = 2_000_000) -> CheckResult:
+    """WGL search: find a total order of ops consistent with real time in
+    which every get sees the model state (reference check_linearizability
+    checker.rs:186, try_linearize checker.rs:452)."""
+    ops = [Op.from_entry(e) for e in entries]
+    # A failed mutator is known not to have applied; drop it from the search.
+    ops = [
+        o for o in ops
+        if not (
+            o.kind in ("put", "delete", "rename")
+            and not o.crashed
+            and isinstance(o.result, dict)
+            and o.result.get("ok") is False
+        )
+    ]
+    ops.sort(key=lambda o: o.invoke)
+    n = len(ops)
+    if n == 0:
+        return CheckResult(True, "empty history")
+
+    # State = immutable dict of key -> value.
+    seen: set[tuple[frozenset, frozenset]] = set()
+    budget = [max_states]
+
+    def apply(state: dict, op: Op) -> dict | None:
+        """Returns the next state, or None if op's observation contradicts."""
+        if op.kind == "put":
+            new = dict(state)
+            new[op.key] = op.value
+            return new
+        if op.kind == "delete":
+            new = dict(state)
+            new.pop(op.key, None)
+            return new
+        if op.kind == "rename":
+            if op.key not in state:
+                return dict(state)  # no-op rename of missing key
+            new = dict(state)
+            new[op.dst] = new.pop(op.key)
+            return new
+        if op.kind == "get":
+            observed = op.result
+            actual = state.get(op.key)
+            if observed != actual:
+                return None
+            return state
+        return None
+
+    def search(remaining: frozenset, state: dict) -> list[int] | None:
+        if not remaining:
+            return []
+        key = (remaining, frozenset(state.items()))
+        if key in seen:
+            return None
+        if budget[0] <= 0:
+            return None
+        budget[0] -= 1
+        seen.add(key)
+        rem_ops = [o for o in ops if o.op_id in remaining]
+        # An op may linearize first only if no other remaining op RETURNED
+        # before it was invoked (real-time order).
+        min_ret = min(o.ret for o in rem_ops)
+        candidates = [o for o in rem_ops if o.invoke <= min_ret]
+        for op in candidates:
+            nxt = apply(state, op)
+            if nxt is not None:
+                rest = search(remaining - {op.op_id}, nxt)
+                if rest is not None:
+                    return [op.op_id] + rest
+            if op.crashed:
+                # Maybe-applied: also try dropping it entirely.
+                rest = search(remaining - {op.op_id}, state)
+                if rest is not None:
+                    return rest
+        return None
+
+    witness = search(frozenset(o.op_id for o in ops), {})
+    if witness is not None:
+        return CheckResult(True, f"linearizable ({n} ops)", witness)
+    if budget[0] <= 0:
+        return CheckResult(
+            False,
+            f"UNKNOWN: search budget exhausted after {max_states} states",
+            exhausted=True,
+        )
+    return CheckResult(False, _diagnose(ops))
+
+
+def _diagnose(ops: list[Op]) -> str:
+    """Best-effort diagnosis of the violation (reference checker.rs diagnosis
+    output): find a get whose value was never concurrently writable."""
+    for o in ops:
+        if o.kind != "get":
+            continue
+        writers = [
+            w for w in ops
+            if w.kind == "put" and w.key == o.key and w.value == o.result
+        ]
+        if o.result is not None and not writers:
+            return (
+                f"not linearizable: get(id={o.op_id}, key={o.key!r}) observed "
+                f"{o.result!r}, which no put ever wrote"
+            )
+    return "not linearizable: no valid linearization order exists"

@@ -1,0 +1,501 @@
+"""DFS client library.
+
+Model: reference dfs/client/src/mod.rs —
+- master RPC executor with shard-keyed target selection, exponential backoff
+  (500 ms doubling to a 5 s cap, 5 retries; mod.rs:23-24,1346-1488),
+  ``Not Leader|<hint>`` and ``REDIRECT:<hint>`` handling with shard-map
+  refresh (mod.rs:1442-1467);
+- write path: CreateFile → AllocateBlock sticky to the creating master for
+  read-your-writes (mod.rs:256-266) → CRC32C+MD5 → pipeline WriteBlock →
+  CompleteFile with per-block checksums (mod.rs:225-494); EC files encode k+m
+  shards and write one shard per chunkserver in parallel (mod.rs:308-412);
+- read path: concurrent per-block fan-out with reorder (mod.rs:856-917), byte
+  ranges mapped to per-block offset/length (mod.rs:731-844), hedged reads
+  (primary + delayed hedge to the second replica, first success wins,
+  sequential fallback; mod.rs:948-1107), EC degraded read with concat fast
+  path (mod.rs:1110-1165).
+
+Superset of the reference: writes split into multiple blocks at
+``block_size`` (the reference writes single-block files but reads multi-block
+ones). On TPU hosts the same read path feeds tpudfs.tpu.hbm_reader, which
+lands blocks directly in device memory as sharded jax.Arrays.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+
+from tpudfs.common.checksum import crc32c
+from tpudfs.common.erasure import decode as ec_decode
+from tpudfs.common.erasure import encode as ec_encode
+from tpudfs.common.erasure import shard_len
+from tpudfs.common.rpc import ClientTls, RpcClient, RpcError
+from tpudfs.common.sharding import ShardMap
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BLOCK_SIZE = 64 * 1024 * 1024
+MAX_RETRIES = 5  # reference mod.rs:23
+INITIAL_BACKOFF = 0.5  # reference mod.rs:24
+BACKOFF_CAP = 5.0
+
+MASTER = "MasterService"
+CS = "ChunkServerService"
+
+
+class DfsError(Exception):
+    pass
+
+
+class IndeterminateError(DfsError):
+    """The operation failed in a way where it MAY still have applied (retries
+    exhausted on transport errors). Callers recording histories must treat
+    this as a crash op, not a definite failure."""
+
+
+class Client:
+    def __init__(
+        self,
+        master_addrs: list[str] | None = None,
+        config_addrs: list[str] | None = None,
+        *,
+        block_size: int = DEFAULT_BLOCK_SIZE,
+        hedge_delay: float | None = None,
+        max_retries: int = MAX_RETRIES,
+        initial_backoff: float = INITIAL_BACKOFF,
+        rpc_client: RpcClient | None = None,
+        tls: ClientTls | None = None,
+        rpc_timeout: float = 30.0,
+    ):
+        if not master_addrs and not config_addrs:
+            raise ValueError("need master_addrs or config_addrs")
+        self.master_addrs = list(master_addrs or [])
+        self.config_addrs = list(config_addrs or [])
+        self.block_size = block_size
+        #: Opt-in tail-latency hedging (reference with_hedge_delay mod.rs:76-79).
+        self.hedge_delay = hedge_delay
+        self.max_retries = max_retries
+        self.initial_backoff = initial_backoff
+        self.rpc_timeout = rpc_timeout
+        self._owns_rpc = rpc_client is None
+        self.rpc = rpc_client or RpcClient(tls=tls)
+        self.shard_map: ShardMap | None = None
+        self._refreshing = False
+
+    async def close(self) -> None:
+        if self._owns_rpc:
+            await self.rpc.close()
+
+    # ----------------------------------------------------------- shard map
+
+    async def refresh_shard_map(self) -> None:
+        """Fetch the ShardMap from a Config Server (reference mod.rs:1493-1534)."""
+        for cfg in self.config_addrs:
+            try:
+                resp = await self.rpc.call(
+                    cfg, "ConfigService", "FetchShardMap", {}, timeout=5.0
+                )
+                self.shard_map = ShardMap.from_dict(resp["shard_map"])
+                return
+            except RpcError as e:
+                logger.warning("shard map fetch from %s failed: %s", cfg, e.message)
+
+    def _masters_for(self, path: str | None) -> list[str]:
+        """Shard-keyed master targets; static list when unsharded."""
+        if path is not None and self.shard_map is not None:
+            shard = self.shard_map.get_shard(path)
+            if shard is not None:
+                peers = self.shard_map.get_peers(shard)
+                if peers:
+                    return peers
+        if self.master_addrs:
+            return list(self.master_addrs)
+        if self.shard_map is not None:
+            return self.shard_map.get_all_masters()
+        return []
+
+    def _masters_for_shard_hint(self, hint: str) -> list[str] | None:
+        if self.shard_map is not None and self.shard_map.has_shard(hint):
+            return self.shard_map.get_peers(hint)
+        return None
+
+    # --------------------------------------------------------- RPC executor
+
+    async def _execute(self, method: str, req: dict, *, path: str | None = None,
+                       masters: list[str] | None = None,
+                       retry_benign: tuple[str, ...] = ()) -> tuple[dict, str]:
+        """Retry/redirect loop (reference execute_rpc_internal mod.rs:1346-1488).
+        Returns (response, master_that_answered).
+
+        ``retry_benign``: status codes that, on a RETRY following an
+        indeterminate failure, indicate the previous attempt actually applied
+        (e.g. ALREADY_EXISTS after resending CreateFile) — treated as success.
+        """
+        targets = list(masters) if masters else self._masters_for(path)
+        if not targets:
+            await self.refresh_shard_map()
+            targets = self._masters_for(path)
+        if not targets:
+            raise DfsError("no master addresses known")
+        backoff = self.initial_backoff
+        last_err: RpcError | None = None
+        indeterminate = False  # a previous attempt may have applied
+        idx = 0
+        for attempt in range(self.max_retries + 1):
+            target = targets[idx % len(targets)]
+            try:
+                resp = await self.rpc.call(
+                    target, MASTER, method, req, timeout=self.rpc_timeout
+                )
+                return resp, target
+            except RpcError as e:
+                last_err = e
+                hint = e.not_leader_hint
+                redirect = e.redirect_hint
+                if hint:
+                    # Leader hint: try it next, immediately.
+                    if hint in targets:
+                        idx = targets.index(hint)
+                    else:
+                        targets.insert(0, hint)
+                        idx = 0
+                    continue
+                if redirect is not None:
+                    # Wrong shard: refresh the map FIRST, fall back to the
+                    # stale map's peers only if the refresh fails
+                    # (mod.rs:1442-1467).
+                    stale_peers = self._masters_for_shard_hint(redirect)
+                    await self.refresh_shard_map()
+                    peers = self._masters_for_shard_hint(redirect) or \
+                        stale_peers or []
+                    if peers:
+                        targets = peers
+                        idx = 0
+                    continue
+                logger.debug("rpc %s to %s failed: %s", method, target, e.message)
+                if e.code.name in ("INVALID_ARGUMENT", "NOT_FOUND",
+                                   "ALREADY_EXISTS", "DATA_LOSS", "OUT_OF_RANGE"):
+                    if indeterminate and e.code.name in retry_benign:
+                        # The op we resent already applied on a prior attempt.
+                        return {"success": True, "retry_resolved": True}, target
+                    raise DfsError(e.message) from None
+                indeterminate = True
+                idx += 1
+            if attempt < self.max_retries:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, BACKOFF_CAP)
+        raise IndeterminateError(
+            f"{method} failed after {self.max_retries + 1} attempts: "
+            f"{last_err.message if last_err else 'unknown'}"
+        )
+
+    # ------------------------------------------------------------ write path
+
+    async def create_file(self, path: str, data: bytes,
+                          ec: tuple[int, int] | None = None) -> None:
+        """Write ``data`` to ``path`` (reference create_file_from_buffer
+        mod.rs:225-494; EC variant mod.rs:496-677)."""
+        k, m = ec or (0, 0)
+        _, master = await self._execute("CreateFile", {
+            "path": path, "ec_data_shards": k, "ec_parity_shards": m,
+        }, path=path, retry_benign=("ALREADY_EXISTS",))
+        # Stick to the creating master for read-your-writes (mod.rs:256-266).
+        sticky = [master] + [a for a in self._masters_for(path) if a != master]
+        block_checksums = []
+        offset = 0
+        while offset < len(data) or offset == 0:
+            piece = data[offset : offset + self.block_size]
+            if not piece and offset > 0:
+                break
+            alloc, _ = await self._execute(
+                "AllocateBlock", {"path": path}, masters=sticky
+            )
+            block = alloc["block"]
+            servers = alloc["chunk_server_addresses"]
+            term = int(alloc.get("master_term") or 0)
+            if not servers:
+                raise DfsError("no chunk servers available")
+            if k > 0:
+                await self._write_ec_block(block["block_id"], piece, servers,
+                                           k, m, term)
+            else:
+                await self._write_replicated_block(
+                    block["block_id"], piece, servers, term
+                )
+            block_checksums.append({
+                "block_id": block["block_id"],
+                "checksum_crc32c": crc32c(piece),
+                "actual_size": len(piece),
+                "original_size": len(piece) if k > 0 else 0,
+            })
+            offset += len(piece) if piece else 1
+            if not piece:
+                break
+        await self._execute("CompleteFile", {
+            "path": path,
+            "size": len(data),
+            "etag_md5": hashlib.md5(data).hexdigest(),
+            "block_checksums": block_checksums,
+        }, masters=sticky)
+
+    async def _write_replicated_block(self, block_id: str, data: bytes,
+                                      servers: list[str], term: int) -> None:
+        resp = await self.rpc.call(servers[0], CS, "WriteBlock", {
+            "block_id": block_id,
+            "data": data,
+            "next_servers": servers[1:],
+            "expected_crc32c": crc32c(data),
+            "master_term": term,
+        }, timeout=max(self.rpc_timeout, 60.0))
+        if not resp.get("success"):
+            raise DfsError(f"write failed: {resp.get('error_message')}")
+        written = int(resp.get("replicas_written") or 0)
+        if written < 1:
+            raise DfsError("no replicas written")
+        if written < len(servers):
+            logger.warning(
+                "block %s: only %d/%d replicas written (healer will repair)",
+                block_id, written, len(servers),
+            )
+
+    async def _write_ec_block(self, block_id: str, data: bytes,
+                              servers: list[str], k: int, m: int,
+                              term: int) -> None:
+        """One shard per chunkserver, written in parallel with per-shard CRCs
+        (reference mod.rs:308-412)."""
+        if len(servers) < k + m:
+            raise DfsError(f"EC({k},{m}) needs {k + m} servers, got {len(servers)}")
+        shards = ec_encode(data, k, m)
+
+        async def write_shard(i: int) -> None:
+            resp = await self.rpc.call(servers[i], CS, "WriteBlock", {
+                "block_id": block_id,
+                "data": shards[i],
+                "next_servers": [],
+                "expected_crc32c": crc32c(shards[i]),
+                "master_term": term,
+            }, timeout=max(self.rpc_timeout, 60.0))
+            if not resp.get("success"):
+                raise DfsError(
+                    f"EC shard {i} write failed: {resp.get('error_message')}"
+                )
+
+        await asyncio.gather(*(write_shard(i) for i in range(k + m)))
+
+    # ------------------------------------------------------------- read path
+
+    async def get_file_info(self, path: str) -> dict | None:
+        resp, _ = await self._execute("GetFileInfo", {"path": path}, path=path)
+        return resp["metadata"] if resp.get("found") else None
+
+    async def get_file(self, path: str) -> bytes:
+        """Concurrent block fan-out + reorder (reference mod.rs:856-917)."""
+        meta = await self.get_file_info(path)
+        if meta is None:
+            raise DfsError(f"file not found: {path}")
+        blocks = meta["blocks"]
+        results: list[bytes | None] = [None] * len(blocks)
+
+        async def fetch(i: int) -> None:
+            results[i] = await self._read_block(blocks[i])
+
+        await asyncio.gather(*(fetch(i) for i in range(len(blocks))))
+        data = b"".join(results)  # type: ignore[arg-type]
+        if len(data) != meta["size"]:
+            data = data[: meta["size"]]
+        return data
+
+    async def read_file_range(self, path: str, offset: int, length: int) -> bytes:
+        """Byte range → per-block (offset, length) reads (reference
+        mod.rs:731-844)."""
+        meta = await self.get_file_info(path)
+        if meta is None:
+            raise DfsError(f"file not found: {path}")
+        if offset >= meta["size"] or length <= 0:
+            return b""
+        length = min(length, meta["size"] - offset)
+        out: list[tuple[int, bytes]] = []
+        pos = 0  # byte offset of current block start
+        coros = []
+        for i, block in enumerate(meta["blocks"]):
+            bsize = block["size"]
+            bstart, bend = pos, pos + bsize
+            pos = bend
+            lo = max(offset, bstart)
+            hi = min(offset + length, bend)
+            if lo >= hi:
+                continue
+            coros.append((lo, block, lo - bstart, hi - lo))
+
+        async def fetch(entry):
+            lo, block, boff, blen = entry
+            if block.get("ec_data_shards"):
+                whole = await self._read_ec_block(block)
+                return lo, whole[boff : boff + blen]
+            return lo, await self._read_block_range(block, boff, blen)
+
+        parts = await asyncio.gather(*(fetch(e) for e in coros))
+        for lo, chunk in parts:
+            out.append((lo, chunk))
+        out.sort()
+        return b"".join(chunk for _, chunk in out)
+
+    async def _read_block(self, block: dict) -> bytes:
+        if block.get("ec_data_shards"):
+            data = await self._read_ec_block(block)
+        else:
+            data = await self._read_block_range(block, 0, 0)
+        expected = int(block.get("checksum_crc32c") or 0)
+        if expected and crc32c(data) != expected:
+            raise DfsError(
+                f"end-to-end checksum mismatch for block {block['block_id']}"
+            )
+        return data
+
+    async def _read_block_range(self, block: dict, offset: int,
+                                length: int) -> bytes:
+        """Replica read with optional hedging (reference read_block_range
+        mod.rs:948-1107): fire the primary, start a delayed hedge at the
+        second replica, first success wins; then sequential fallback."""
+        locations = [l for l in block["locations"] if l]
+        if not locations:
+            raise DfsError(f"no locations for block {block['block_id']}")
+        req = {"block_id": block["block_id"], "offset": offset, "length": length}
+
+        async def read_from(addr: str) -> bytes:
+            resp = await self.rpc.call(addr, CS, "ReadBlock", req,
+                                       timeout=max(self.rpc_timeout, 60.0))
+            return resp["data"]
+
+        errors: list[str] = []
+        if self.hedge_delay is not None and len(locations) > 1:
+            primary = asyncio.create_task(read_from(locations[0]))
+            try:
+                return await asyncio.wait_for(
+                    asyncio.shield(primary), self.hedge_delay
+                )
+            except asyncio.TimeoutError:
+                hedge = asyncio.create_task(read_from(locations[1]))
+                done, pending = await asyncio.wait(
+                    {primary, hedge}, return_when=asyncio.FIRST_COMPLETED
+                )
+                # Prefer any successful completion; cancel the loser.
+                winner: bytes | None = None
+                for t in done:
+                    if t.exception() is None:
+                        winner = t.result()
+                if winner is None and pending:
+                    t2 = await asyncio.wait(pending)
+                    for t in t2[0]:
+                        if t.exception() is None:
+                            winner = t.result()
+                    pending = set()
+                for t in pending:
+                    t.cancel()
+                if winner is not None:
+                    return winner
+                errors.append("hedged reads failed")
+                rest = locations[2:]
+            except RpcError as e:
+                errors.append(f"{locations[0]}: {e.message}")
+                rest = locations[1:]
+            else:  # pragma: no cover
+                rest = []
+        else:
+            rest = locations
+
+        for addr in rest:
+            try:
+                return await read_from(addr)
+            except RpcError as e:
+                errors.append(f"{addr}: {e.message}")
+        raise DfsError(
+            f"all replicas failed for block {block['block_id']}: {errors}"
+        )
+
+    async def _read_ec_block(self, block: dict) -> bytes:
+        """Concurrent shard fetch; concat fast path when all data shards
+        arrive, RS decode otherwise (reference read_ec_block mod.rs:1110-1165)."""
+        k = int(block["ec_data_shards"])
+        m = int(block["ec_parity_shards"])
+        locations = block["locations"]
+        original = int(block.get("original_size") or block.get("size") or 0)
+
+        async def fetch(i: int) -> bytes | None:
+            addr = locations[i] if i < len(locations) else ""
+            if not addr:
+                return None
+            try:
+                resp = await self.rpc.call(
+                    addr, CS, "ReadBlock",
+                    {"block_id": block["block_id"], "offset": 0, "length": 0},
+                    timeout=max(self.rpc_timeout, 60.0),
+                )
+                return resp["data"]
+            except RpcError as e:
+                logger.warning("EC shard %d fetch failed: %s", i, e.message)
+                return None
+
+        shards = list(await asyncio.gather(*(fetch(i) for i in range(k + m))))
+        if all(s is not None for s in shards[:k]):
+            return b"".join(shards[:k])[:original]  # type: ignore[arg-type]
+        try:
+            return ec_decode(shards, k, m, original)
+        except Exception as e:
+            raise DfsError(
+                f"EC decode failed for block {block['block_id']}: {e}"
+            ) from None
+
+    # -------------------------------------------------------- namespace ops
+
+    async def delete_file(self, path: str) -> None:
+        await self._execute("DeleteFile", {"path": path}, path=path,
+                            retry_benign=("NOT_FOUND",))
+
+    async def rename_file(self, src: str, dst: str) -> None:
+        await self._execute("Rename", {"src": src, "dst": dst}, path=src,
+                            retry_benign=("NOT_FOUND",))
+
+    async def list_files(self, prefix: str = "") -> list[str]:
+        """Per-shard fan-out union (reference mod.rs:125-200)."""
+        if self.shard_map is None and self.config_addrs:
+            await self.refresh_shard_map()
+        if self.shard_map is None:
+            resp, _ = await self._execute("ListFiles", {"path": prefix})
+            return list(resp["files"])
+        out: set[str] = set()
+        for shard in self.shard_map.get_all_shards():
+            peers = self.shard_map.get_peers(shard) or []
+            if not peers:
+                continue
+            try:
+                resp, _ = await self._execute(
+                    "ListFiles", {"path": prefix}, masters=peers
+                )
+                out.update(resp["files"])
+            except DfsError as e:
+                logger.warning("list on shard %s failed: %s", shard, e)
+        return sorted(out)
+
+    # ------------------------------------------------------------ admin ops
+
+    async def safe_mode_status(self) -> dict:
+        resp, _ = await self._execute("SafeModeStatus", {})
+        return resp
+
+    async def set_safe_mode(self, enter: bool) -> None:
+        await self._execute("EnterSafeMode" if enter else "ExitSafeMode", {})
+
+    async def cluster_add_server(self, address: str) -> None:
+        await self._execute("AddRaftNode", {"address": address})
+
+    async def cluster_remove_server(self, address: str) -> None:
+        await self._execute("RemoveRaftNode", {"address": address})
+
+    async def cluster_transfer_leadership(self, target: str) -> None:
+        await self._execute("TransferLeadership", {"target": target})
+
+    async def raft_state(self, master: str) -> dict:
+        return await self.rpc.call(master, MASTER, "RaftState", {}, timeout=5.0)

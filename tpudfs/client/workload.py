@@ -1,0 +1,149 @@
+"""Concurrent workload generator producing checkable histories.
+
+Model: reference dfs/client/src/workload.rs — N concurrent virtual clients
+doing random put/get/delete/rename over a keyspace spanning multiple shards
+(the ``/a/`` and ``/z/`` prefixes, workload.rs:43-49), recording a JSONL
+invoke/return history for the linearizability checker.
+
+File contents are tiny unique tokens so a get's observation maps back to
+exactly one put.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+
+from tpudfs.client.client import Client, DfsError, IndeterminateError
+
+
+@dataclass
+class WorkloadConfig:
+    clients: int = 4
+    ops_per_client: int = 20
+    keys: int = 5
+    prefixes: tuple[str, ...] = ("/a/", "/z/")  # spans both bootstrap shards
+    seed: int = 0
+    op_weights: dict = field(default_factory=lambda: {
+        "put": 0.5, "get": 0.3, "delete": 0.1, "rename": 0.1,
+    })
+
+
+class HistoryRecorder:
+    def __init__(self):
+        self.entries: list[dict] = []
+        self._next_id = 0
+        self._lock = asyncio.Lock()
+
+    async def record_invoke(self, client: str, op: dict) -> dict:
+        async with self._lock:
+            entry = {
+                "id": self._next_id,
+                "client": client,
+                "op": op,
+                "invoke_ts": time.monotonic(),
+                "return_ts": None,
+                "result": None,
+            }
+            self._next_id += 1
+            self.entries.append(entry)
+            return entry
+
+    @staticmethod
+    def record_return(entry: dict, result) -> None:
+        entry["return_ts"] = time.monotonic()
+        entry["result"] = result
+
+
+def dump_history(entries: list[dict], path: str) -> None:
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e) + "\n")
+
+
+async def run_workload(client: Client, cfg: WorkloadConfig) -> list[dict]:
+    rec = HistoryRecorder()
+    rng = random.Random(cfg.seed)
+    keyspace = [
+        f"{cfg.prefixes[i % len(cfg.prefixes)]}wl-{i}" for i in range(cfg.keys)
+    ]
+
+    async def run_client(name: str, seed: int) -> None:
+        crng = random.Random(seed)
+        for i in range(cfg.ops_per_client):
+            kinds, weights = zip(*cfg.op_weights.items())
+            kind = crng.choices(kinds, weights)[0]
+            key = crng.choice(keyspace)
+            op: dict = {"type": kind, "key": key, "value": None, "dst": None}
+            if kind == "put":
+                op["value"] = f"{name}-{i}"
+            elif kind == "rename":
+                op["dst"] = crng.choice([k for k in keyspace if k != key])
+            if kind == "put":
+                # The DFS has create-once semantics, so a put is issued as a
+                # RECORDED delete followed by a RECORDED create — both appear
+                # in the history so the checker can explain the intermediate
+                # not-found window.
+                dentry = await rec.record_invoke(
+                    name, {"type": "delete", "key": key, "value": None, "dst": None}
+                )
+                try:
+                    await client.delete_file(key)
+                    rec.record_return(dentry, {"ok": True})
+                except IndeterminateError:
+                    pass  # crash op: maybe-applied
+                except DfsError:
+                    rec.record_return(dentry, {"ok": False})
+                except Exception:
+                    pass  # crash op
+            entry = await rec.record_invoke(name, op)
+            # IndeterminateError (retries exhausted on transport failures)
+            # means the op MAY have applied: leave return_ts None so the
+            # checker treats it as maybe-applied, never as a definite outcome.
+            try:
+                if kind == "put":
+                    try:
+                        await client.create_file(key, op["value"].encode())
+                        rec.record_return(entry, {"ok": True})
+                    except IndeterminateError:
+                        pass
+                    except DfsError:
+                        rec.record_return(entry, {"ok": False})
+                elif kind == "get":
+                    try:
+                        data = await client.get_file(key)
+                        rec.record_return(entry, data.decode())
+                    except IndeterminateError:
+                        pass
+                    except DfsError as e:
+                        if "not found" in str(e):
+                            rec.record_return(entry, None)
+                        # Other read failures (replicas down) are
+                        # indeterminate observations: crash op.
+                elif kind == "delete":
+                    try:
+                        await client.delete_file(key)
+                        rec.record_return(entry, {"ok": True})
+                    except IndeterminateError:
+                        pass
+                    except DfsError:
+                        rec.record_return(entry, {"ok": False})
+                elif kind == "rename":
+                    try:
+                        await client.rename_file(key, op["dst"])
+                        rec.record_return(entry, {"ok": True})
+                    except IndeterminateError:
+                        pass
+                    except DfsError:
+                        rec.record_return(entry, {"ok": False})
+            except Exception:
+                # Left as a crash op: return_ts stays None (maybe-applied).
+                pass
+
+    await asyncio.gather(*(
+        run_client(f"c{i}", rng.randrange(1 << 30)) for i in range(cfg.clients)
+    ))
+    return rec.entries

@@ -163,7 +163,12 @@ class Master:
         except NotLeaderError as e:
             raise RpcError.not_leader(e.leader_hint) from None
         except ValueError as e:
-            raise RpcError.invalid(str(e)) from None
+            msg = str(e)
+            if "not found" in msg:
+                raise RpcError.not_found(msg) from None
+            if "exists" in msg:
+                raise RpcError.already_exists(msg) from None
+            raise RpcError.invalid(msg) from None
 
     async def _linearizable_read(self) -> None:
         """ReadIndex barrier before serving metadata reads
