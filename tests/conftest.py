@@ -10,12 +10,21 @@ import asyncio
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon environment exports JAX_PLATFORMS=axon and its sitecustomize hook
+# imports jax at interpreter start, so env vars set here are too late — but
+# backends initialize lazily, so jax.config.update BEFORE the first
+# jax.devices() call still wins. XLA_FLAGS is also read at backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_pyfunc_call(pyfuncitem):

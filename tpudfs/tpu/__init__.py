@@ -1,0 +1,11 @@
+"""TPU-native data plane: Pallas kernels, HBM reader, ICI replication, infeed."""
+
+from __future__ import annotations
+
+
+def on_tpu() -> bool:
+    """True when the default JAX backend is a real TPU (Pallas compiles to
+    Mosaic); off-TPU callers get interpret-mode kernels or jnp fallbacks."""
+    import jax
+
+    return jax.devices()[0].platform == "tpu"

@@ -1,0 +1,172 @@
+"""DFS → TPU HBM reader: chunk fetches land as device arrays, verified on-device.
+
+The reference's read path concatenates fetched blocks into one host Vec
+(mod.rs:898-917) that a consumer then copies again. Here each block's bytes go
+straight from the fetch buffer into its target device's memory (one
+``jax.device_put`` per block, round-robin across devices), the per-512B-chunk
+CRC32C runs ON the device (Pallas kernel), and the chunk CRCs are folded with
+the GF(2)-matrix combine into the whole-block checksum recorded at
+CompleteFile — end-to-end verification without a host checksum pass. Uniform
+blocks then assemble into a single sharded ``jax.Array`` via
+``jax.make_array_from_single_device_arrays`` (no host concat at any point) —
+the "chunk read into TPU HBM" path of BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudfs.client.client import Client, DfsError
+from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE, crc32c_combine
+from tpudfs.tpu.crc32c_pallas import (
+    WORDS_PER_CHUNK,
+    bytes_to_words,
+    crc32c_chunks_device,
+)
+
+
+@dataclass
+class DeviceBlock:
+    block_id: str
+    array: jax.Array  # (chunks, 128) uint32 words on one device
+    size: int  # unpadded byte length
+    verified: bool
+
+
+class HbmReader:
+    def __init__(self, client: Client, devices: list | None = None):
+        self.client = client
+        self.devices = list(devices) if devices is not None else jax.devices()
+
+    # ------------------------------------------------------------ per block
+
+    async def read_block_to_device(self, block: dict, device,
+                                   verify: bool = True) -> DeviceBlock:
+        data = await self.client._read_block_range(block, 0, 0) \
+            if not block.get("ec_data_shards") else \
+            await self.client._read_ec_block(block)
+        words = jax.device_put(bytes_to_words(data), device)
+        verified = True
+        if verify and block.get("checksum_crc32c"):
+            verified = await asyncio.to_thread(
+                self._verify_device_block, words, len(data),
+                int(block["checksum_crc32c"]),
+            )
+            if not verified:
+                raise DfsError(
+                    f"on-device checksum mismatch for block {block['block_id']}"
+                )
+        return DeviceBlock(block["block_id"], words, len(data), verified)
+
+    def _verify_device_block(self, words: jax.Array, size: int,
+                             expected_crc: int) -> bool:
+        """Device chunk CRCs + host GF(2) combine == stored whole-block CRC."""
+        chunk_crcs = np.asarray(crc32c_chunks_device(words))
+        crc = 0
+        remaining = size
+        for c in chunk_crcs:
+            if remaining <= 0:
+                break
+            clen = min(CHECKSUM_CHUNK_SIZE, remaining)
+            if clen < CHECKSUM_CHUNK_SIZE:
+                # Tail chunk was zero-padded on device; unwind the padding:
+                # crc(data+zeros) relates by the combine operator, so compute
+                # the tail directly instead (tiny).
+                return self._verify_with_host_tail(
+                    words, size, expected_crc, chunk_crcs
+                )
+            crc = crc32c_combine(crc, int(c), clen)
+            remaining -= clen
+        return crc == expected_crc
+
+    def _verify_with_host_tail(self, words, size, expected_crc, chunk_crcs):
+        full_chunks = size // CHECKSUM_CHUNK_SIZE
+        crc = 0
+        for c in chunk_crcs[:full_chunks]:
+            crc = crc32c_combine(crc, int(c), CHECKSUM_CHUNK_SIZE)
+        tail_len = size - full_chunks * CHECKSUM_CHUNK_SIZE
+        if tail_len:
+            from tpudfs.common.checksum import crc32c
+
+            tail_words = np.asarray(words[full_chunks:])
+            tail = tail_words.astype("<u4").tobytes()[:tail_len]
+            crc = crc32c_combine(crc, crc32c(tail), tail_len)
+        return crc == expected_crc
+
+    # ------------------------------------------------------------- per file
+
+    async def read_file_to_device_blocks(
+        self, path: str, verify: bool = True,
+        placement: str = "round_robin",
+    ) -> list[DeviceBlock]:
+        """Fetch every block concurrently with per-block device placement
+        (the fan-out of mod.rs:880-916 with DMA placement instead of host
+        concat). ``round_robin``: block i → device i % n (spreads a stream of
+        blocks). ``contiguous``: block i → device i // ceil(blocks/n) (keeps
+        file order within each device — required for read_file_sharded)."""
+        meta = await self.client.get_file_info(path)
+        if meta is None:
+            raise DfsError(f"file not found: {path}")
+        blocks = meta["blocks"]
+        n = len(self.devices)
+        if placement == "contiguous":
+            per = -(-len(blocks) // n) if blocks else 1
+            device_of = lambda i: self.devices[i // per]  # noqa: E731
+        else:
+            device_of = lambda i: self.devices[i % n]  # noqa: E731
+        coros = [
+            self.read_block_to_device(block, device_of(i), verify=verify)
+            for i, block in enumerate(blocks)
+        ]
+        return list(await asyncio.gather(*coros))
+
+    async def read_file_sharded(self, path: str, mesh: Mesh | None = None,
+                                verify: bool = True) -> jax.Array:
+        """Whole file as ONE sharded jax.Array ((total_chunks, 128) uint32
+        words, sharded over the device axis IN FILE ORDER). Blocks are
+        assigned contiguously (block i → device i // per_group) and
+        concatenated ON their device (never on the host); the tail pads with
+        zero chunks so every shard has equal shape."""
+        dblocks = await self.read_file_to_device_blocks(
+            path, verify=verify, placement="contiguous"
+        )
+        if not dblocks:
+            raise DfsError(f"file has no blocks: {path}")
+        ndev = len(self.devices)
+        max_chunks = max(b.array.shape[0] for b in dblocks)
+        per = -(-len(dblocks) // ndev)
+        groups: list[list[jax.Array]] = [[] for _ in range(ndev)]
+        for i, b in enumerate(dblocks):
+            short = max_chunks - b.array.shape[0]
+            arr = b.array if short == 0 else jnp.pad(b.array, ((0, short), (0, 0)))
+            groups[i // per].append(arr)
+        per_group = max(len(g) for g in groups)
+        shards = []
+        for d, group in enumerate(groups):
+            device = self.devices[d]
+            while len(group) < per_group:
+                group.append(
+                    jax.device_put(
+                        jnp.zeros((max_chunks, WORDS_PER_CHUNK), jnp.uint32),
+                        device,
+                    )
+                )
+            shard = group[0] if len(group) == 1 else jnp.concatenate(group)
+            shards.append(jax.device_put(shard, device))
+        if mesh is None:
+            mesh = Mesh(np.array(self.devices), ("blocks",))
+        sharding = NamedSharding(mesh, P("blocks"))
+        return jax.make_array_from_single_device_arrays(
+            (ndev * per_group * max_chunks, WORDS_PER_CHUNK), sharding, shards
+        )
+
+
+def device_array_to_bytes(arr: jax.Array, size: int) -> bytes:
+    """Host copy-out (for tests / CLI): unpad the device words."""
+    return np.asarray(arr).astype("<u4").tobytes()[:size]

@@ -1,0 +1,142 @@
+"""Pipeline replication as XLA collectives over ICI.
+
+The reference replicates every block over a sequential gRPC chain
+client → CS1 → CS2 → CS3 (chunkserver.rs:777-825,1039-1077) — three full
+traversals of the NIC per block. When ChunkServers are colocated on the TPU
+hosts of a pod (the BASELINE.json north star), the same 3× chain can ride the
+ICI fabric instead: each host's pending chunk writes are batched into a
+"collective write group" (SURVEY.md §7 hard parts), expressed as a sharded
+jax.Array, and the chain hop becomes ``jax.lax.ppermute`` ring shifts under
+``shard_map`` — after R-1 shifts device i holds the shards of hosts
+i, i-1, ..., i-R+1, exactly the chain-replication layout, with the transfers
+scheduled by XLA on ICI links rather than TCP.
+
+Acks: the per-hop ``replicas_written`` aggregation becomes an on-device
+``psum`` of per-device verify results; CRC verification of the received
+replicas runs on-device via the Pallas CRC kernel (jnp fallback off-TPU).
+
+Works identically on the virtual CPU mesh used in tests (the driver's
+``dryrun_multichip`` path) and a real multi-chip mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpudfs.tpu.crc32c_pallas import WORDS_PER_CHUNK, crc32c_chunks_device
+
+
+def make_mesh(devices=None, axis: str = "hosts") -> Mesh:
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+class IciReplicator:
+    """R-way chain replication of per-host chunk groups over the mesh."""
+
+    def __init__(self, mesh: Mesh, replication: int = 3, axis: str | None = None):
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.replication = replication
+        n = mesh.devices.size
+        if replication > n:
+            raise ValueError(f"replication {replication} > mesh size {n}")
+        self._fn = self._build()
+
+    def _build(self):
+        axis = self.axis
+        R = self.replication
+        mesh = self.mesh
+        n = mesh.devices.size
+
+        def step(local_words: jnp.ndarray, local_crcs: jnp.ndarray):
+            # local_words: (C, 128) uint32 — this host's pending chunk batch.
+            # local_crcs:  (C,) uint32 — expected per-chunk CRCs.
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            replicas = [local_words]
+            crcs = [local_crcs]
+            cur_w, cur_c = local_words, local_crcs
+            for _ in range(R - 1):
+                # Chain hop over ICI: everyone forwards to its right neighbor.
+                cur_w = jax.lax.ppermute(cur_w, axis, perm)
+                cur_c = jax.lax.ppermute(cur_c, axis, perm)
+                replicas.append(cur_w)
+                crcs.append(cur_c)
+            stacked = jnp.stack(replicas)  # (R, C, 128)
+            expected = jnp.stack(crcs)  # (R, C)
+            # On-device end-to-end verify of every replica we now hold.
+            actual = jax.vmap(
+                lambda w: crc32c_chunks_device(w, use_pallas=None)
+            )(stacked)
+            ok = jnp.all(actual == expected)
+            # replicas_written analogue: how many hosts verified every replica.
+            acks = jax.lax.psum(ok.astype(jnp.int32), axis)
+            # ok gets a singleton axis: rank-0 outputs can't vary over a mesh.
+            return stacked, ok[None], acks
+
+        spec_in = P(self.axis)
+        # check_vma=False: pallas_call outputs don't carry vma metadata yet
+        # (JAX 0.9), so the varying-across-mesh check can't see through them.
+        return jax.jit(shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec_in, spec_in),
+            out_specs=(spec_in, spec_in, P()),
+            check_vma=False,
+        ))
+
+    def replicate(self, words: jax.Array, crcs: jax.Array):
+        """words: (n*C, 128) uint32 sharded over the mesh axis (C chunks per
+        host); crcs: (n*C,) uint32. Returns (replicas, ok, acks):
+        replicas (n*R, C, 128) — R replica groups per host, ok per-host
+        verify bit, acks = number of hosts whose replicas all verified."""
+        return self._fn(words, crcs)
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis))
+
+
+@partial(jax.jit, static_argnames=("k", "m"))
+def _parity_of_words(words: jnp.ndarray, k: int, m: int) -> jnp.ndarray:
+    from tpudfs.tpu.rs_pallas import rs_encode_device
+
+    flat = jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(1, -1)
+    C = words.shape[0]
+    total = C * WORDS_PER_CHUNK * 4
+    shard = total // k
+    return rs_encode_device(flat.reshape(k, shard), k, m)
+
+
+def replicated_write_step(mesh: Mesh, replication: int = 3,
+                          ec: tuple[int, int] | None = None):
+    """The full distributed data-plane step used by ``dryrun_multichip``:
+    chain-replicate each host's chunk batch over ICI, verify every received
+    replica on-device, optionally RS-encode local parity shards, and psum the
+    ack count — the TPU-native equivalent of one pipeline-replicated
+    WriteBlock round."""
+    replicator = IciReplicator(mesh, replication)
+
+    def step(words: jax.Array, crcs: jax.Array):
+        replicas, ok, acks = replicator.replicate(words, crcs)
+        out = {"replicas": replicas, "ok": ok, "acks": acks}
+        if ec is not None:
+            k, m = ec
+            out["parity"] = jax.jit(
+                shard_map(
+                    lambda w: _parity_of_words(w, k, m),
+                    mesh=mesh,
+                    in_specs=P(mesh.axis_names[0]),
+                    out_specs=P(mesh.axis_names[0]),
+                    check_vma=False,
+                )
+            )(words)
+        return out
+
+    return step

@@ -1,0 +1,161 @@
+"""Reed-Solomon GF(2^8) encode as a TPU Pallas kernel (device twin of
+native/gf256.cc and tpudfs.common.erasure).
+
+The reference encodes RS(k,m) shards on the host CPU with table lookups
+(erasure.rs:7-29). Table gathers are hostile to the VPU, but GF(2^8)
+multiplication by a CONSTANT is linear over GF(2):
+
+    c * x = XOR_{j<8} [bit j of x] * (c * 2^j)
+
+so each parity byte is an XOR of masked constants — 8 shift/mask/select passes
+per (parity, data-shard) pair, fully vectorized across the shard length. For
+RS(6,3) that is 6*3*8 = 144 VPU ops per byte lane, no gathers, no MXU needed.
+This is the "GF(2^8) RS-encode as a Pallas kernel" item from SURVEY.md §7
+step 1.
+
+The c*2^j constants are derived from the same systematic Vandermonde matrix as
+the host encoder (so device parities are bit-exact with ``erasure.encode``)
+and are baked into the kernel as compile-time scalars — the generator matrix
+is static per (k, m), and scalar immediates lower cleanly in Mosaic where
+small-table gathers do not. Shards are uint8 with length padded to the
+128-lane tile.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpudfs.common.erasure import encode_matrix, gf_mul
+from tpudfs.tpu import on_tpu
+
+_LANE = 128
+_TILE = 8 * 1024  # bytes of shard length per grid step
+
+
+@lru_cache(maxsize=16)
+def coef_bits(k: int, m: int) -> tuple:
+    """Nested tuple [m][k][8]: coef_bits[p][d][j] = G[k+p, d] * 2^j in GF(2^8)."""
+    gen = encode_matrix(k, m)[k:]  # parity rows
+    return tuple(
+        tuple(
+            tuple(gf_mul(int(gen[p, d]), 1 << j) for j in range(8))
+            for d in range(k)
+        )
+        for p in range(m)
+    )
+
+
+def pad_shard_len(n: int) -> int:
+    return -(-n // _LANE) * _LANE
+
+
+_BYTE_LSB = 0x01010101  # bit 0 of each packed byte
+
+
+def _parity_rows(words: jnp.ndarray, coefs: tuple) -> jnp.ndarray:
+    """(k, W) uint32 data shards (4 packed bytes per word) -> (m, W) uint32
+    parity; coefs are Python constants baked into the compiled kernel.
+
+    This Mosaic version legalizes only shift/and/or/xor on integer vectors
+    (no int8 mul/sub, no i1 relayout), so GF(2^8) runs on uint32-packed
+    bytes: extract bit j of every byte ((x >> j) & 0x01010101), expand each
+    set bit to a full 0xFF byte with three shift-or doublings (bits never
+    cross byte boundaries), AND with the constant replicated into all four
+    byte lanes. Byte order inside the word is irrelevant — every byte gets
+    identical treatment."""
+    k, W = words.shape
+    m = len(coefs)
+    parities = []
+    for p in range(m):
+        acc = jnp.zeros((1, W), dtype=jnp.uint32)
+        for d in range(k):
+            x = words[d : d + 1, :]
+            for j in range(8):
+                c = coefs[p][d][j]
+                if c == 0:
+                    continue
+                bits = (x >> jnp.uint32(j)) & jnp.uint32(_BYTE_LSB)
+                mask = bits | (bits << jnp.uint32(1))
+                mask = mask | (mask << jnp.uint32(2))
+                mask = mask | (mask << jnp.uint32(4))
+                acc = acc ^ (mask & jnp.uint32(c * _BYTE_LSB))
+        parities.append(acc)
+    return jnp.concatenate(parities, axis=0)
+
+
+@lru_cache(maxsize=16)
+def _rs_pallas_fn(k: int, m: int, interpret: bool):
+    coefs = coef_bits(k, m)
+
+    def kernel(words_ref, out_ref):
+        out_ref[:] = _parity_rows(words_ref[:], coefs)
+
+    @jax.jit
+    def run(words: jnp.ndarray) -> jnp.ndarray:
+        W = words.shape[1]
+        tile = min(_TILE // 4, W)
+        grid = pl.cdiv(W, tile)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((m, W), jnp.uint32),
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((k, tile), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(words)
+
+    return run
+
+
+def _pack_words(data_shards: jax.Array) -> jax.Array:
+    k, L = data_shards.shape
+    return jax.lax.bitcast_convert_type(
+        data_shards.reshape(k, L // 4, 4), jnp.uint32
+    )
+
+
+def _unpack_words(words: jax.Array) -> jax.Array:
+    m, W = words.shape
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(m, W * 4)
+
+
+def rs_encode_device(data_shards: jax.Array, k: int, m: int, *,
+                     use_pallas: bool | None = None) -> jax.Array:
+    """Parity shards for on-device data ((k, L) uint8 -> (m, L) uint8).
+    Jittable; L must be a multiple of 128 (pad_shard_len)."""
+    if use_pallas is None:
+        use_pallas = on_tpu()
+    words = _pack_words(data_shards)
+    if use_pallas:
+        out = _rs_pallas_fn(k, m, not on_tpu())(words)
+    else:
+        out = _parity_rows(words, coef_bits(k, m))
+    return _unpack_words(out)
+
+
+def rs_encode_jax(data: bytes, k: int, m: int, **kw) -> list[bytes]:
+    """Host convenience mirroring erasure.encode: returns k+m shard byte
+    strings (shard length = ceil(len/k), zero padded; parity computed over
+    128-aligned device layout then truncated — parity is bytewise independent
+    so the truncation is exact)."""
+    shard = -(-len(data) // k)
+    padded = pad_shard_len(shard)
+    buf = np.zeros((k, padded), dtype=np.uint8)
+    flat = np.frombuffer(data, dtype=np.uint8)
+    for i in range(k):
+        piece = flat[i * shard : (i + 1) * shard]
+        buf[i, : len(piece)] = piece
+    parity = np.asarray(rs_encode_device(jnp.asarray(buf), k, m, **kw))
+    return [buf[i, :shard].tobytes() for i in range(k)] + [
+        parity[i, :shard].tobytes() for i in range(m)
+    ]
