@@ -166,12 +166,20 @@ async def test_safe_mode_blocks_writes(tmp_path):
     try:
         await c.start()
         leader = await c.leader()
+        # Pause heartbeats so one can't re-register the CS (and exit safe
+        # mode, total blocks being 0) between enter_safe_mode and the call;
+        # the sleep lets any already-received Heartbeat handler finish.
+        for hb in c.heartbeats:
+            hb.stop()
+        await asyncio.sleep(0.2)
         leader.state.enter_safe_mode()
         leader.state.chunk_servers.clear()  # force: no CS registered
         with pytest.raises(RpcError) as ei:
             await c.call(leader.address, "CreateFile", {"path": "/x"})
         assert "safe mode" in ei.value.message.lower()
         # CS heartbeats bring it out (total blocks 0 → exit on first report).
+        for hb in c.heartbeats:
+            hb.start()
         await c.wait_out_of_safe_mode(leader)
         await c.call(leader.address, "CreateFile", {"path": "/x"})
     finally:

@@ -52,7 +52,9 @@ class HbmReader:
             if not block.get("ec_data_shards") else \
             await self.client._read_ec_block(block)
         words = jax.device_put(bytes_to_words(data), device)
-        verified = True
+        # verified means "an on-device CRC check ran and passed" — a block
+        # with no recorded checksum was NOT verified.
+        verified = False
         if verify and block.get("checksum_crc32c"):
             verified = await asyncio.to_thread(
                 self._verify_device_block, words, len(data),

@@ -105,12 +105,16 @@ class IciReplicator:
 
 @partial(jax.jit, static_argnames=("k", "m"))
 def _parity_of_words(words: jnp.ndarray, k: int, m: int) -> jnp.ndarray:
-    from tpudfs.tpu.rs_pallas import rs_encode_device
+    from tpudfs.tpu.rs_pallas import pad_shard_len, rs_encode_device
 
-    flat = jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(1, -1)
     C = words.shape[0]
     total = C * WORDS_PER_CHUNK * 4
-    shard = total // k
+    # Shards are zero-padded to equal 128-lane-aligned length, matching the
+    # reference's padded-shard layout (dfs/common/src/erasure.rs:7-28) and
+    # rs_encode_device's lane requirement.
+    shard = pad_shard_len(-(-total // k))
+    flat = jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(-1)
+    flat = jnp.pad(flat, (0, k * shard - total))
     return rs_encode_device(flat.reshape(k, shard), k, m)
 
 
@@ -122,21 +126,26 @@ def replicated_write_step(mesh: Mesh, replication: int = 3,
     ack count — the TPU-native equivalent of one pipeline-replicated
     WriteBlock round."""
     replicator = IciReplicator(mesh, replication)
+    parity_fn = None
+    if ec is not None:
+        k, m = ec
+        # Built (and jitted) once — rebuilding inside step() would miss the
+        # jit cache and recompile the RS-parity shard_map on every call.
+        parity_fn = jax.jit(
+            shard_map(
+                lambda w: _parity_of_words(w, k, m),
+                mesh=mesh,
+                in_specs=P(mesh.axis_names[0]),
+                out_specs=P(mesh.axis_names[0]),
+                check_vma=False,
+            )
+        )
 
     def step(words: jax.Array, crcs: jax.Array):
         replicas, ok, acks = replicator.replicate(words, crcs)
         out = {"replicas": replicas, "ok": ok, "acks": acks}
-        if ec is not None:
-            k, m = ec
-            out["parity"] = jax.jit(
-                shard_map(
-                    lambda w: _parity_of_words(w, k, m),
-                    mesh=mesh,
-                    in_specs=P(mesh.axis_names[0]),
-                    out_specs=P(mesh.axis_names[0]),
-                    check_vma=False,
-                )
-            )(words)
+        if parity_fn is not None:
+            out["parity"] = parity_fn(words)
         return out
 
     return step

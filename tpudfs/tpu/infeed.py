@@ -44,6 +44,11 @@ class DfsInfeed:
                     )
                     await pending.put((path, blocks))
                 await pending.put(None)
+            except asyncio.CancelledError:
+                # Consumer gone (early exit cancelled us) — nobody will drain
+                # the queue, so a blocking put here would pin this task and
+                # its prefetched device blocks forever. Just unwind.
+                raise
             except BaseException as e:
                 # A failed prefetch must surface to the consumer, not hang it.
                 await pending.put(e)
