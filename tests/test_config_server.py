@@ -1,0 +1,182 @@
+"""Config Server: replicated ShardMap + master registry.
+
+Exercises the reference's config-server surface (SURVEY.md §2.1 "Config
+Server", config_server.rs): linearizable FetchShardMap, shard CRUD through
+Raft, auto-allocation of the healthiest registered masters, split/merge/
+rebalance, registry heartbeats, and snapshot/restore of the config state.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
+from tpudfs.common.sharding import RANGE_MAX, ShardMap
+from tpudfs.configserver.service import ConfigServer, wait_for_leader
+from tpudfs.configserver.state import ConfigState
+from tpudfs.raft.core import Timings
+
+FAST_RAFT = Timings(election_min=0.3, election_max=0.6, heartbeat=0.1,
+                    snapshot_threshold=200)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ConfigCluster:
+    def __init__(self, tmp_path, n=1):
+        self.tmp = tmp_path
+        self.n = n
+        self.nodes: dict[str, ConfigServer] = {}
+        self.servers: dict[str, RpcServer] = {}
+        self.client = RpcClient()
+
+    async def start(self):
+        addrs = [f"127.0.0.1:{_free_port()}" for _ in range(self.n)]
+        for i, addr in enumerate(addrs):
+            peers = [a for a in addrs if a != addr]
+            node = ConfigServer(addr, peers, str(self.tmp / f"cfg{i}"),
+                                raft_timings=FAST_RAFT, rpc_client=self.client)
+            server = RpcServer(port=int(addr.rsplit(":", 1)[1]))
+            node.attach(server)
+            await server.start()
+            await node.start()
+            self.nodes[addr] = node
+            self.servers[addr] = server
+        self.leader_addr = await wait_for_leader(addrs, self.client)
+        return self
+
+    async def stop(self):
+        for node in self.nodes.values():
+            await node.stop()
+        for server in self.servers.values():
+            await server.stop()
+        await self.client.close()
+
+    async def call(self, method, req, addr=None, timeout=10.0):
+        return await self.client.call(addr or self.leader_addr, "ConfigService",
+                                      method, req, timeout=timeout)
+
+
+async def test_shard_crud_and_fetch(tmp_path):
+    c = ConfigCluster(tmp_path)
+    try:
+        await c.start()
+        r = await c.call("AddShard", {"shard_id": "shard-a",
+                                      "peers": ["127.0.0.1:1", "127.0.0.1:2"]})
+        assert r["success"] and r["peers"] == ["127.0.0.1:1", "127.0.0.1:2"]
+        r = await c.call("AddShard", {"shard_id": "shard-z",
+                                      "peers": ["127.0.0.1:3"]})
+        sm = ShardMap.from_dict((await c.call("FetchShardMap", {}))["shard_map"])
+        assert sm.shards == {"shard-a", "shard-z"}
+        # Second shard split the keyspace at "/m" (bootstrap heuristic).
+        assert sm.get_shard("/a/x") == "shard-z"
+        assert sm.get_shard("/z/x") == "shard-a"
+        r = await c.call("RemoveShard", {"shard_id": "shard-z"})
+        sm = ShardMap.from_dict((await c.call("FetchShardMap", {}))["shard_map"])
+        assert sm.shards == {"shard-a"}
+        with pytest.raises(RpcError):
+            await c.call("RemoveShard", {"shard_id": "nope"})
+    finally:
+        await c.stop()
+
+
+async def test_split_merge_rebalance(tmp_path):
+    c = ConfigCluster(tmp_path)
+    try:
+        await c.start()
+        await c.call("AddShard", {"shard_id": "s1", "peers": ["127.0.0.1:1"]})
+        r = await c.call("SplitShard", {"split_key": "/h", "new_shard_id": "s2",
+                                        "peers": ["127.0.0.1:2"]})
+        assert r["success"]
+        sm = ShardMap.from_dict((await c.call("FetchShardMap", {}))["shard_map"])
+        assert sm.get_shard("/a") == "s2" and sm.get_shard("/q") == "s1"
+        # Rebalance the boundary: move it from /h to /j.
+        await c.call("RebalanceShard", {"old_key": "/h", "new_key": "/j"})
+        sm = ShardMap.from_dict((await c.call("FetchShardMap", {}))["shard_map"])
+        assert sm.get_shard("/i") == "s2"
+        # Merge s2 back into s1.
+        await c.call("MergeShards", {"victim_shard_id": "s2",
+                                     "retained_shard_id": "s1"})
+        sm = ShardMap.from_dict((await c.call("FetchShardMap", {}))["shard_map"])
+        assert sm.shards == {"s1"} and sm.get_shard("/a") == "s1"
+    finally:
+        await c.stop()
+
+
+async def test_auto_allocation_from_registry(tmp_path):
+    c = ConfigCluster(tmp_path)
+    try:
+        await c.start()
+        for i in range(4):
+            await c.call("RegisterMaster", {"address": f"127.0.0.1:60{i}"})
+        r = await c.call("AddShard", {"shard_id": "auto"})
+        assert len(r["peers"]) == 3  # healthiest 3 of 4
+        # Allocated masters are now assigned; the next auto shard gets the
+        # remaining unassigned one (falls back to assigned if none free).
+        r2 = await c.call("AddShard", {"shard_id": "auto2"})
+        assert len(r2["peers"]) >= 1
+        assert set(r2["peers"]) != set(r["peers"])
+        masters = (await c.call("ListMasters", {}))["masters"]
+        assert sum(1 for m in masters.values() if m["shard_id"] == "auto") == 3
+    finally:
+        await c.stop()
+
+
+async def test_shard_heartbeat_updates_registry(tmp_path):
+    c = ConfigCluster(tmp_path)
+    try:
+        await c.start()
+        await c.call("RegisterMaster",
+                     {"address": "127.0.0.1:700", "shard_id": "s1"})
+        await c.call("AddShard", {"shard_id": "s1", "peers": ["127.0.0.1:700"]})
+        r = await c.call("ShardHeartbeat",
+                         {"shard_id": "s1", "address": "127.0.0.1:700"})
+        assert r["success"] and r["shard_map_version"] >= 1
+        leader = c.nodes[c.leader_addr]
+        assert "s1" in leader.state.shard_health
+    finally:
+        await c.stop()
+
+
+async def test_three_node_replication_and_failover(tmp_path):
+    c = ConfigCluster(tmp_path, n=3)
+    try:
+        await c.start()
+        await c.call("AddShard", {"shard_id": "r1", "peers": ["127.0.0.1:1"]})
+        # All three replicas converge on the same map.
+        for _ in range(100):
+            if all(n.state.shard_map.has_shard("r1") for n in c.nodes.values()):
+                break
+            await asyncio.sleep(0.05)
+        assert all(n.state.shard_map.has_shard("r1") for n in c.nodes.values())
+        # Kill the leader; a follower takes over and still serves the map.
+        old = c.leader_addr
+        await c.nodes[old].stop()
+        await c.servers[old].stop()
+        rest = [a for a in c.nodes if a != old]
+        c.leader_addr = await wait_for_leader(rest, c.client, timeout=15.0)
+        sm = ShardMap.from_dict((await c.call("FetchShardMap", {}))["shard_map"])
+        assert sm.has_shard("r1")
+        del c.nodes[old], c.servers[old]
+    finally:
+        await c.stop()
+
+
+def test_config_state_snapshot_roundtrip():
+    st = ConfigState()
+    st.apply({"op": "register_master", "address": "m1", "at_ms": 5})
+    st.apply({"op": "add_shard", "shard_id": "s1", "peers": ["m1"]})
+    st.apply({"op": "shard_heartbeat", "shard_id": "s1", "address": "m1",
+              "at_ms": 9})
+    blob = st.snapshot()
+    st2 = ConfigState()
+    st2.restore(blob)
+    assert st2.shard_map.to_dict() == st.shard_map.to_dict()
+    assert st2.masters == st.masters
+    assert st2.shard_health == st.shard_health
+    assert st2.shard_map.range_of("s1") == ("", RANGE_MAX)

@@ -1,0 +1,4 @@
+from tpudfs.configserver.service import ConfigServer
+from tpudfs.configserver.state import ConfigState
+
+__all__ = ["ConfigServer", "ConfigState"]

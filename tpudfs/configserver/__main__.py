@@ -1,7 +1,8 @@
-"""Master process entrypoint (reference dfs/metaserver/src/bin/master.rs).
+"""Config Server process entrypoint (reference
+dfs/metaserver/src/bin/config_server.rs).
 
-Run: python -m tpudfs.master --port 50051 --data-dir /data/m1 \
-         --peers 127.0.0.1:50052,127.0.0.1:50053 [--shard-id shard-a]
+Run: python -m tpudfs.configserver --port 50200 --data-dir /data/cfg1 \
+         --peers 127.0.0.1:50201,127.0.0.1:50202
 """
 
 from __future__ import annotations
@@ -11,31 +12,27 @@ import asyncio
 
 from tpudfs.common.rpc import RpcServer
 from tpudfs.common.telemetry import setup_logging
-from tpudfs.master.service import Master
+from tpudfs.configserver.service import ConfigServer
 
 
 def parse_args(argv=None):
-    p = argparse.ArgumentParser("tpudfs-master")
+    p = argparse.ArgumentParser("tpudfs-config-server")
     p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=50051)
+    p.add_argument("--port", type=int, default=50200)
     p.add_argument("--advertise", default="", help="address peers/clients use")
     p.add_argument("--data-dir", required=True)
-    p.add_argument("--peers", default="", help="comma-separated peer master addresses")
-    p.add_argument("--shard-id", default="shard-0")
-    p.add_argument("--config-servers", default="")
+    p.add_argument("--peers", default="", help="comma-separated peer addresses")
     return p.parse_args(argv)
 
 
 async def amain(args) -> None:
     address = args.advertise or f"{args.host}:{args.port}"
     peers = [x for x in args.peers.split(",") if x]
-    configs = [x for x in args.config_servers.split(",") if x]
-    master = Master(address, peers, args.data_dir, shard_id=args.shard_id,
-                    config_servers=configs)
+    cfg = ConfigServer(address, peers, args.data_dir)
     server = RpcServer(args.host, args.port)
-    master.attach(server)
+    cfg.attach(server)
     await server.start()
-    await master.start()
+    await cfg.start()
     print(f"READY {address}", flush=True)
     await asyncio.Event().wait()
 

@@ -1,0 +1,225 @@
+"""Config Server service: the meta-shard Raft group's RPC front.
+
+Model: reference dfs/metaserver/src/config_server.rs ``MyConfigServer`` —
+FetchShardMap is a linearizable read (config_server.rs:43-61); shard
+mutations (Add/Remove/Split/Merge/Rebalance) go through Raft
+(config_server.rs:63-273) with auto-allocation of the healthiest registered
+masters when the caller names no peers (config_server.rs:143-156);
+RegisterMaster/ShardHeartbeat maintain the allocatable-master registry
+(config_server.rs:275-339).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
+from tpudfs.configserver.state import ConfigState
+from tpudfs.master.state import now_ms
+from tpudfs.raft.core import NotLeaderError, Timings
+from tpudfs.raft.node import RaftNode
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "ConfigService"
+
+#: Masters allocated per new shard when the caller doesn't name peers
+#: (reference config_server.rs:143-156 picks 3).
+AUTO_ALLOC_MASTERS = 3
+
+
+class ConfigServer:
+    def __init__(
+        self,
+        address: str,
+        peers: list[str],
+        data_dir: str,
+        *,
+        raft_timings: Timings | None = None,
+        rpc_client: RpcClient | None = None,
+        auto_alloc_masters: int = AUTO_ALLOC_MASTERS,
+    ):
+        self.address = address
+        self.state = ConfigState()
+        self._owns_client = rpc_client is None
+        self.client = rpc_client or RpcClient()
+        self.auto_alloc_masters = auto_alloc_masters
+        self.raft = RaftNode(
+            address, peers, data_dir,
+            apply=self.state.apply,
+            snapshot=self.state.snapshot,
+            restore=self.state.restore,
+            timings=raft_timings,
+            rpc_client=self.client,
+        )
+
+    # --------------------------------------------------------------- wiring
+
+    def handlers(self) -> dict:
+        return {
+            "FetchShardMap": self.rpc_fetch_shard_map,
+            "AddShard": self.rpc_add_shard,
+            "RemoveShard": self.rpc_remove_shard,
+            "SplitShard": self.rpc_split_shard,
+            "MergeShards": self.rpc_merge_shards,
+            "RebalanceShard": self.rpc_rebalance_shard,
+            "RegisterMaster": self.rpc_register_master,
+            "ShardHeartbeat": self.rpc_shard_heartbeat,
+            "ListMasters": self.rpc_list_masters,
+            "AddRaftNode": self.rpc_add_raft_node,
+            "RemoveRaftNode": self.rpc_remove_raft_node,
+            "RaftState": self.rpc_raft_state,
+        }
+
+    def attach(self, server: RpcServer) -> None:
+        server.add_service(SERVICE, self.handlers())
+        self.raft.attach(server)
+
+    async def start(self) -> None:
+        await self.raft.start()
+
+    async def stop(self) -> None:
+        await self.raft.stop()
+        if self._owns_client:
+            await self.client.close()
+
+    # -------------------------------------------------------------- helpers
+
+    async def _propose(self, cmd: dict):
+        try:
+            return await self.raft.propose(cmd)
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+        except ValueError as e:
+            raise RpcError.invalid(str(e)) from None
+
+    def _allocate_peers(self, requested: list[str] | None) -> list[str]:
+        """Caller-named peers, or the healthiest unassigned registered
+        masters (falling back to assigned ones — the reference shares masters
+        across shards when the registry is small)."""
+        if requested:
+            return list(requested)
+        at = now_ms()
+        peers = self.state.healthy_masters(at)[: self.auto_alloc_masters]
+        if not peers:
+            peers = self.state.healthy_masters(at, unassigned_only=False)[
+                : self.auto_alloc_masters
+            ]
+        if not peers:
+            raise RpcError.unavailable(
+                "no healthy registered masters to allocate for the shard"
+            )
+        return peers
+
+    # ----------------------------------------------------------------- RPCs
+
+    async def rpc_fetch_shard_map(self, req: dict) -> dict:
+        """Linearizable by default (reference config_server.rs:43-61);
+        ``allow_stale`` serves the local copy (used by polling loops)."""
+        if not req.get("allow_stale"):
+            try:
+                await self.raft.read_index()
+            except NotLeaderError as e:
+                raise RpcError.not_leader(e.leader_hint) from None
+        return {"shard_map": self.state.shard_map.to_dict()}
+
+    async def rpc_add_shard(self, req: dict) -> dict:
+        peers = self._allocate_peers(req.get("peers"))
+        result = await self._propose({
+            "op": "add_shard", "shard_id": req["shard_id"], "peers": peers,
+        })
+        return {"success": True, "peers": peers, "version": result["version"]}
+
+    async def rpc_remove_shard(self, req: dict) -> dict:
+        result = await self._propose({
+            "op": "remove_shard", "shard_id": req["shard_id"],
+        })
+        return {"success": True, "version": result["version"]}
+
+    async def rpc_split_shard(self, req: dict) -> dict:
+        peers = self._allocate_peers(req.get("peers"))
+        result = await self._propose({
+            "op": "split_shard",
+            "split_key": req["split_key"],
+            "new_shard_id": req["new_shard_id"],
+            "peers": peers,
+        })
+        return {"success": True, "peers": peers, "version": result["version"]}
+
+    async def rpc_merge_shards(self, req: dict) -> dict:
+        result = await self._propose({
+            "op": "merge_shards",
+            "victim_shard_id": req["victim_shard_id"],
+            "retained_shard_id": req["retained_shard_id"],
+        })
+        return {"success": True, "version": result["version"]}
+
+    async def rpc_rebalance_shard(self, req: dict) -> dict:
+        result = await self._propose({
+            "op": "rebalance_shard",
+            "old_key": req["old_key"],
+            "new_key": req["new_key"],
+        })
+        return {"success": True, "version": result["version"]}
+
+    async def rpc_register_master(self, req: dict) -> dict:
+        await self._propose({
+            "op": "register_master",
+            "address": req["address"],
+            "shard_id": req.get("shard_id"),
+            "at_ms": now_ms(),
+        })
+        return {"success": True}
+
+    async def rpc_shard_heartbeat(self, req: dict) -> dict:
+        await self._propose({
+            "op": "shard_heartbeat",
+            "shard_id": req["shard_id"],
+            "address": req.get("address", ""),
+            "at_ms": now_ms(),
+        })
+        return {"success": True, "shard_map_version": self.state.shard_map.version}
+
+    async def rpc_list_masters(self, _req: dict) -> dict:
+        return {"masters": self.state.masters}
+
+    # ------------------------------------------------------- raft admin RPCs
+
+    async def rpc_add_raft_node(self, req: dict) -> dict:
+        try:
+            await self.raft.add_server(req["address"])
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+        except ValueError as e:
+            raise RpcError.invalid(str(e)) from None
+        return {"success": True}
+
+    async def rpc_remove_raft_node(self, req: dict) -> dict:
+        try:
+            await self.raft.remove_server(req["address"])
+        except NotLeaderError as e:
+            raise RpcError.not_leader(e.leader_hint) from None
+        except ValueError as e:
+            raise RpcError.invalid(str(e)) from None
+        return {"success": True}
+
+    async def rpc_raft_state(self, _req: dict) -> dict:
+        return self.raft.status()
+
+
+async def wait_for_leader(addrs: list[str], client: RpcClient,
+                          timeout: float = 15.0) -> str:
+    """Poll ``RaftState`` until some config server reports leadership
+    (the pattern test scripts use against /raft/state in the reference)."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        for addr in addrs:
+            try:
+                st = await client.call(addr, SERVICE, "RaftState", {}, timeout=2.0)
+                if st.get("role") == "leader":
+                    return addr
+            except RpcError:
+                continue
+        await asyncio.sleep(0.1)
+    raise TimeoutError("no config server leader")

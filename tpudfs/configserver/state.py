@@ -1,0 +1,141 @@
+"""Config Server replicated state: the ShardMap + master registry.
+
+Model: the reference's Config variant of the Raft state machine
+(dfs/metaserver/src/simple_raft.rs:359-403 ``ConfigCommand``/``ConfigStateInner``
+applied at simple_raft.rs:3317-3398) — a meta-shard Raft group owning the
+authoritative range ShardMap plus a registry of master servers available for
+shard allocation (dfs/metaserver/src/config_server.rs:275-339).
+
+All mutations arrive as Raft commands so every replica applies the identical
+deterministic change; timestamps ride inside the command (``at_ms``), never
+read from the local clock during apply.
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+from tpudfs.common.sharding import ShardMap
+
+#: A registered master is "healthy" (allocatable) while its last heartbeat is
+#: newer than this (reference config_server.rs:143-156 picks healthiest).
+MASTER_HEALTH_CUTOFF_MS = 30_000
+
+
+class ConfigState:
+    def __init__(self):
+        self.shard_map = ShardMap(strategy="range")
+        #: master address -> {"shard_id": str|None, "last_heartbeat_ms": int}
+        self.masters: dict[str, dict] = {}
+        #: shard id -> {"last_heartbeat_ms": int, "from": str}
+        self.shard_health: dict[str, dict] = {}
+
+    # ------------------------------------------------------------- queries
+
+    def healthy_masters(self, at_ms: int, *, unassigned_only: bool = True) -> list[str]:
+        """Masters eligible for new-shard allocation, most recently seen
+        first (reference auto-allocates the 3 healthiest,
+        config_server.rs:143-156)."""
+        out = [
+            (info["last_heartbeat_ms"], addr)
+            for addr, info in self.masters.items()
+            if at_ms - info["last_heartbeat_ms"] <= MASTER_HEALTH_CUTOFF_MS
+            and (not unassigned_only or not info.get("shard_id"))
+        ]
+        return [addr for _, addr in sorted(out, reverse=True)]
+
+    # --------------------------------------------------------------- apply
+
+    def apply(self, cmd: dict):
+        op = cmd.get("op")
+        handler = getattr(self, f"_apply_{op}", None)
+        if handler is None:
+            raise ValueError(f"unknown config command {op!r}")
+        return handler(cmd)
+
+    def _apply_add_shard(self, cmd: dict):
+        shard_id, peers = cmd["shard_id"], list(cmd["peers"])
+        self.shard_map.add_shard(shard_id, peers)
+        self._assign(peers, shard_id)
+        return {"success": True, "version": self.shard_map.version}
+
+    def _apply_remove_shard(self, cmd: dict):
+        shard_id = cmd["shard_id"]
+        if not self.shard_map.has_shard(shard_id):
+            raise ValueError(f"no such shard: {shard_id}")
+        self._assign(self.shard_map.get_peers(shard_id) or [], None)
+        self.shard_map.remove_shard(shard_id)
+        self.shard_health.pop(shard_id, None)
+        return {"success": True, "version": self.shard_map.version}
+
+    def _apply_split_shard(self, cmd: dict):
+        ok = self.shard_map.split_shard(
+            cmd["split_key"], cmd["new_shard_id"], list(cmd["peers"])
+        )
+        if not ok:
+            raise ValueError(
+                f"cannot split at {cmd['split_key']!r} into {cmd['new_shard_id']!r}"
+            )
+        self._assign(list(cmd["peers"]), cmd["new_shard_id"])
+        return {"success": True, "version": self.shard_map.version}
+
+    def _apply_merge_shards(self, cmd: dict):
+        victim = cmd["victim_shard_id"]
+        peers = self.shard_map.get_peers(victim) or []
+        ok = self.shard_map.merge_shards(victim, cmd["retained_shard_id"])
+        if not ok:
+            raise ValueError(
+                f"cannot merge {victim!r} into {cmd['retained_shard_id']!r}"
+            )
+        self._assign(peers, None)
+        self.shard_health.pop(victim, None)
+        return {"success": True, "version": self.shard_map.version}
+
+    def _apply_rebalance_shard(self, cmd: dict):
+        ok = self.shard_map.rebalance_boundary(cmd["old_key"], cmd["new_key"])
+        if not ok:
+            raise ValueError(f"no boundary at {cmd['old_key']!r}")
+        return {"success": True, "version": self.shard_map.version}
+
+    def _apply_register_master(self, cmd: dict):
+        addr = cmd["address"]
+        prev = self.masters.get(addr, {})
+        self.masters[addr] = {
+            "shard_id": cmd.get("shard_id") or prev.get("shard_id"),
+            "last_heartbeat_ms": int(cmd["at_ms"]),
+        }
+        return {"success": True}
+
+    def _apply_shard_heartbeat(self, cmd: dict):
+        at = int(cmd["at_ms"])
+        self.shard_health[cmd["shard_id"]] = {
+            "last_heartbeat_ms": at,
+            "from": cmd.get("address", ""),
+        }
+        if cmd.get("address") in self.masters:
+            self.masters[cmd["address"]]["last_heartbeat_ms"] = at
+        return {"success": True}
+
+    def _assign(self, peers: list[str], shard_id: str | None) -> None:
+        for p in peers:
+            if p in self.masters:
+                self.masters[p]["shard_id"] = shard_id
+
+    # ---------------------------------------------------------- persistence
+
+    def snapshot(self) -> bytes:
+        return msgpack.packb({
+            "shard_map": self.shard_map.to_dict(),
+            "masters": self.masters,
+            "shard_health": self.shard_health,
+        })
+
+    def restore(self, data: bytes) -> None:
+        if not data:
+            return
+        d = msgpack.unpackb(data, raw=False)
+        self.shard_map = ShardMap.from_dict(d["shard_map"])
+        self.masters = {k: dict(v) for k, v in d.get("masters", {}).items()}
+        self.shard_health = {
+            k: dict(v) for k, v in d.get("shard_health", {}).items()
+        }

@@ -26,24 +26,30 @@ import os
 import uuid
 
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
+from tpudfs.common.sharding import ShardMap
 from tpudfs.master import placement
 from tpudfs.master.state import (
     MasterState,
     REPLICATION_FACTOR,
     now_ms,
 )
+from tpudfs.master.transactions import TransactionManager
 from tpudfs.raft.core import NotLeaderError, Timings
 from tpudfs.raft.node import RaftNode
 
 logger = logging.getLogger(__name__)
 
 SERVICE = "MasterService"
+CONFIG_SERVICE = "ConfigService"
 
 LIVENESS_CUTOFF_MS = 15_000  # reference master.rs:740-757
 LIVENESS_INTERVAL = 5.0
 HEALER_INTERVAL = 300.0
 BALANCER_INTERVAL = 30.0
 TIERING_INTERVAL = 60.0
+SHARD_REFRESH_INTERVAL = 5.0  # reference master.rs:1429
+TX_CLEANUP_INTERVAL = 5.0  # reference master.rs:968
+TX_RECOVERY_INTERVAL = 30.0  # reference master.rs:1171
 DEFAULT_COLD_THRESHOLD_SECS = 7 * 24 * 3600  # reference: COLD_THRESHOLD_SECS
 DEFAULT_EC_THRESHOLD_SECS = 30 * 24 * 3600  # reference: EC_THRESHOLD_SECS
 EC_CONVERSION_SHAPE = (6, 3)  # reference RS(6,3), master.rs:2016-2138
@@ -57,6 +63,7 @@ class Master:
         data_dir: str,
         *,
         shard_id: str = "shard-0",
+        config_servers: list[str] | None = None,
         raft_timings: Timings | None = None,
         rpc_client: RpcClient | None = None,
         cold_threshold_secs: int | None = None,
@@ -65,6 +72,8 @@ class Master:
         intervals: dict | None = None,
     ):
         self.address = address
+        self.config_servers = list(config_servers or [])
+        self.shard_map: ShardMap | None = None
         self.state = MasterState(shard_id)
         self.state.enter_safe_mode()
         self._owns_client = rpc_client is None
@@ -94,7 +103,11 @@ class Master:
             "healer": iv.get("healer", HEALER_INTERVAL),
             "balancer": iv.get("balancer", BALANCER_INTERVAL),
             "tiering": iv.get("tiering", TIERING_INTERVAL),
+            "shard_refresh": iv.get("shard_refresh", SHARD_REFRESH_INTERVAL),
+            "tx_cleanup": iv.get("tx_cleanup", TX_CLEANUP_INTERVAL),
+            "tx_recovery": iv.get("tx_recovery", TX_RECOVERY_INTERVAL),
         }
+        self.tx = TransactionManager(self)
         self._tasks: set[asyncio.Task] = set()
 
     # --------------------------------------------------------------- wiring
@@ -118,6 +131,11 @@ class Master:
             "RemoveRaftNode": self.rpc_remove_raft_node,
             "TransferLeadership": self.rpc_transfer_leadership,
             "RaftState": self.rpc_raft_state,
+            "PrepareTransaction": self.tx.rpc_prepare,
+            "CommitTransaction": self.tx.rpc_commit,
+            "AbortTransaction": self.tx.rpc_abort,
+            "InquireTransaction": self.tx.rpc_inquire,
+            "IngestMetadata": self.rpc_ingest_metadata,
         }
 
     def attach(self, server: RpcServer) -> None:
@@ -131,6 +149,24 @@ class Master:
             self._spawn(self._loop(self._intervals["healer"], self.run_healer))
             self._spawn(self._loop(self._intervals["balancer"], self.run_balancer))
             self._spawn(self._loop(self._intervals["tiering"], self.run_tiering_scan))
+            self._spawn(self._loop(self._intervals["tx_cleanup"], self.tx.run_cleanup))
+            self._spawn(self._loop(self._intervals["tx_recovery"], self.tx.run_recovery))
+            if self.config_servers:
+                # Prime the map BEFORE serving: without it a sharded master
+                # can't tell its keys from a peer's and could e.g. apply a
+                # cross-shard rename as a local one. Retries cover config
+                # Raft still electing at boot, bounded by wall-clock (each
+                # attempt can itself burn several RPC timeouts against
+                # blackholed config servers); _check_shard_ownership fails
+                # closed if this deadline passes without a map.
+                deadline = asyncio.get_event_loop().time() + 30.0
+                while asyncio.get_event_loop().time() < deadline:
+                    await self.run_shard_refresh()
+                    if self.shard_map is not None:
+                        break
+                    await asyncio.sleep(0.5)
+                self._spawn(self._loop(self._intervals["shard_refresh"],
+                                       self.run_shard_refresh))
 
     def _spawn(self, coro) -> None:
         task = asyncio.create_task(coro)
@@ -186,6 +222,103 @@ class Master:
                 "Master is in safe mode; writes are temporarily disabled"
             )
 
+    def _check_tx_lock(self, *paths: str) -> None:
+        """Reject namespace ops on paths reserved by an in-flight 2PC tx
+        (prepared-window isolation — without it a concurrent CreateFile on a
+        rename destination is clobbered at commit, and a DeleteFile of the
+        source frees blocks the committed destination still references)."""
+        locked = self.state.tx_locked_paths()
+        for p in paths:
+            if p in locked:
+                raise RpcError.failed_precondition(
+                    f"path {p!r} is locked by an in-flight transaction"
+                )
+
+    def _owner_shard(self, path: str) -> str | None:
+        if self.shard_map is None:
+            return None
+        return self.shard_map.get_shard(path)
+
+    def _check_shard_ownership(self, path: str) -> None:
+        """REDIRECT:<owning-shard> for keys outside our range (reference
+        check_shard_ownership master.rs:2141-2159). A sharded master whose
+        map hasn't loaded yet fails CLOSED (it can't tell its keys from a
+        peer's); an unsharded one (no config servers) skips the check, as
+        does one whose shard isn't in the map yet (bootstrap)."""
+        if self.shard_map is None:
+            if self.config_servers:
+                raise RpcError.unavailable(
+                    "shard map not yet loaded; retry shortly"
+                )
+            return
+        if not self.shard_map.has_shard(self.state.shard_id):
+            return
+        owner = self.shard_map.get_shard(path)
+        if owner is not None and owner != self.state.shard_id:
+            raise RpcError.redirect(owner)
+
+    async def call_shard(self, shard_id: str, method: str, req: dict,
+                         attempts: int = 4) -> dict:
+        """RPC to another shard's master group, following Not-Leader hints
+        (the master-to-master path of the 2PC/sharding flows)."""
+        peers = (self.shard_map.get_peers(shard_id) or []) \
+            if self.shard_map else []
+        if not peers:
+            raise RpcError.unavailable(f"no peers known for shard {shard_id}")
+        last: RpcError | None = None
+        idx = 0
+        for _ in range(attempts):
+            target = peers[idx % len(peers)]
+            try:
+                return await self.client.call(target, SERVICE, method, req,
+                                              timeout=10.0)
+            except RpcError as e:
+                last = e
+                if e.is_not_leader:
+                    hint = e.not_leader_hint
+                    if hint:
+                        if hint in peers:
+                            idx = peers.index(hint)
+                        else:
+                            peers.insert(0, hint)
+                            idx = 0
+                    else:
+                        # Mid-election, no hint yet: try the next peer
+                        # rather than failing the whole cross-shard op.
+                        idx += 1
+                        await asyncio.sleep(0.2)
+                    continue
+                if e.code.name in ("INVALID_ARGUMENT", "NOT_FOUND",
+                                   "ALREADY_EXISTS", "FAILED_PRECONDITION"):
+                    raise
+                idx += 1
+                await asyncio.sleep(0.2)
+        raise last if last is not None else RpcError.unavailable(
+            f"shard {shard_id} unreachable"
+        )
+
+    async def call_config(self, method: str, req: dict) -> dict:
+        """RPC to the Config Server group, following Not-Leader hints."""
+        targets = list(self.config_servers)
+        if not targets:
+            raise RpcError.unavailable("no config servers configured")
+        last: RpcError | None = None
+        for _ in range(len(targets) + 2):
+            target = targets[0]
+            try:
+                return await self.client.call(target, CONFIG_SERVICE, method,
+                                              req, timeout=10.0)
+            except RpcError as e:
+                last = e
+                hint = e.not_leader_hint
+                if hint and hint != target:
+                    targets = [hint] + [t for t in targets if t != hint]
+                    continue
+                targets = targets[1:] + targets[:1]
+        raise last if last is not None else RpcError.unavailable(
+            "config servers unreachable"
+        )
+
     @staticmethod
     def _new_block_id() -> str:
         return f"blk-{uuid.uuid4().hex}"
@@ -194,6 +327,8 @@ class Master:
 
     async def rpc_create_file(self, req: dict) -> dict:
         self._check_safe_mode()
+        self._check_shard_ownership(req["path"])
+        self._check_tx_lock(req["path"])
         await self._propose({
             "op": "create_file",
             "path": req["path"],
@@ -205,6 +340,7 @@ class Master:
 
     async def rpc_allocate_block(self, req: dict) -> dict:
         self._check_safe_mode()
+        self._check_shard_ownership(req["path"])
         # Leadership first: a follower's local state may lag, and the client
         # must get a redirect rather than a spurious not_found.
         if not self.raft.is_leader:
@@ -243,6 +379,8 @@ class Master:
 
     async def rpc_complete_file(self, req: dict) -> dict:
         self._check_safe_mode()
+        self._check_shard_ownership(req["path"])
+        self._check_tx_lock(req["path"])
         await self._propose({
             "op": "complete_file",
             "path": req["path"],
@@ -254,6 +392,7 @@ class Master:
         return {"success": True}
 
     async def rpc_get_file_info(self, req: dict) -> dict:
+        self._check_shard_ownership(req["path"])
         await self._linearizable_read()
         f = self.state.get_file(req["path"])
         if f is None:
@@ -273,15 +412,38 @@ class Master:
 
     async def rpc_delete_file(self, req: dict) -> dict:
         self._check_safe_mode()
+        self._check_shard_ownership(req["path"])
+        self._check_tx_lock(req["path"])
         await self._propose({"op": "delete_file", "path": req["path"]})
         return {"success": True}
 
     async def rpc_rename(self, req: dict) -> dict:
+        """Rename: same-shard fast path through one Raft command
+        (master.rs:2777-2808), cross-shard via the 2PC coordinator
+        (master.rs:2809-3021)."""
         self._check_safe_mode()
-        await self._propose({
-            "op": "rename_file", "src": req["src"], "dst": req["dst"],
-        })
-        return {"success": True}
+        src, dst = req["src"], req["dst"]
+        # Rename is the one op where a stale shard map corrupts the
+        # namespace (a cross-shard rename mistaken for same-shard creates
+        # the destination in a keyspace this shard doesn't own), so fetch a
+        # fresh map before deciding; renames are rare enough to afford it.
+        if self.config_servers:
+            try:
+                resp = await self.call_config("FetchShardMap", {})
+                self.shard_map = ShardMap.from_dict(resp["shard_map"])
+            except RpcError as e:
+                logger.warning("rename: shard map refresh failed (%s); "
+                               "using cached map", e.message)
+        self._check_shard_ownership(src)
+        self._check_tx_lock(src, dst)
+        dest_shard = self._owner_shard(dst)
+        if dest_shard is None or dest_shard == self.state.shard_id:
+            await self._propose({"op": "rename_file", "src": src, "dst": dst})
+            return {"success": True}
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
+        await self.tx.run_cross_shard_rename(src, dst, dest_shard)
+        return {"success": True, "cross_shard": True}
 
     async def rpc_list_files(self, req: dict) -> dict:
         await self._linearizable_read()
@@ -397,6 +559,51 @@ class Master:
                     logger.warning("location update failed: %s", e)
                     return False
         return True
+
+    # ------------------------------------------------------- sharding RPCs
+
+    async def rpc_ingest_metadata(self, req: dict) -> dict:
+        """Bulk-import file metadata pushed by a peer shard during split
+        migration (reference IngestMetadata master.rs:3558-3620). Gated like
+        every other namespace write; a misdirected ingest (range has since
+        moved on) is rejected wholesale rather than overwriting metadata for
+        keys this shard doesn't own. Duplicate ingests of the same migration
+        are idempotent overwrites."""
+        self._check_safe_mode()
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
+        files = dict(req["files"])
+        if self.shard_map is not None and \
+                self.shard_map.has_shard(self.state.shard_id):
+            foreign = [p for p in files
+                       if (self.shard_map.get_shard(p) or self.state.shard_id)
+                       != self.state.shard_id]
+            if foreign:
+                raise RpcError.failed_precondition(
+                    f"ingest rejected: {len(foreign)} path(s) outside this "
+                    f"shard's range (e.g. {foreign[0]!r})"
+                )
+        result = await self._propose({"op": "ingest_metadata", "files": files})
+        return {"success": True, "count": result["count"]}
+
+    async def run_shard_refresh(self) -> None:
+        """Refresh the shard map from the Config Server, register this
+        master, and (leader only) report shard liveness (reference
+        master.rs:1429-1481 + RegisterMaster/ShardHeartbeat)."""
+        try:
+            resp = await self.call_config(
+                "FetchShardMap", {"allow_stale": True}
+            )
+            self.shard_map = ShardMap.from_dict(resp["shard_map"])
+            await self.call_config("RegisterMaster", {
+                "address": self.address, "shard_id": self.state.shard_id,
+            })
+            if self.raft.is_leader:
+                await self.call_config("ShardHeartbeat", {
+                    "shard_id": self.state.shard_id, "address": self.address,
+                })
+        except RpcError as e:
+            logger.warning("shard refresh failed: %s", e.message)
 
     # ------------------------------------------------------- admin RPCs
 

@@ -198,6 +198,19 @@ class MasterState:
 
     # --------------------------------------------------------------- lookups
 
+    def tx_locked_paths(self) -> set[str]:
+        """Paths reserved by in-flight (pending/prepared) transactions.
+        Namespace ops on these must be rejected until the tx resolves —
+        otherwise e.g. a client CreateFile on a prepared rename's destination
+        is silently clobbered at commit, or a DeleteFile of the source frees
+        blocks the committed destination still references."""
+        locked: set[str] = set()
+        for tx in self.transactions.values():
+            if tx.get("state") in ("pending", "prepared"):
+                for op in tx.get("operations", []):
+                    locked.add(op["path"])
+        return locked
+
     def get_file(self, path: str) -> FileMetadata | None:
         f = self.files.get(path)
         return f if f is not None and f.complete else None
@@ -354,6 +367,16 @@ class MasterState:
             self.files.pop(op["path"], None)
         else:
             raise ValueError(f"unknown tx operation {op['kind']}")
+        return {"success": True}
+
+    def _apply_tx_mark_commit_sent(self, cmd: dict):
+        """Coordinator marker: a CommitTransaction RPC is (about to be) in
+        flight — from here on the participant may have committed, so the
+        coordinator must never presume abort for this tx."""
+        tx = self.transactions.get(cmd["txid"])
+        if tx is None:
+            raise ValueError(f"unknown transaction {cmd['txid']}")
+        tx["commit_sent"] = True
         return {"success": True}
 
     def _apply_tx_set_participant_acked(self, cmd: dict):

@@ -1,0 +1,337 @@
+"""Cross-shard 2PC transactions (Spanner-style rename across Raft groups).
+
+Model: the reference's transaction machinery in dfs/metaserver/src/master.rs —
+``TransactionRecord`` with states Pending → Prepared → Committed/Aborted
+(master.rs:34-101), the cross-shard rename coordinator (master.rs:2809-3021),
+participant Prepare/Commit/Abort/Inquire handlers (master.rs:3026-3306),
+presumed-abort inquiry with a retry cap (run_transaction_cleanup
+master.rs:968-1165), coordinator commit-retry recovery
+(run_transaction_recovery master.rs:1171-1322), and the participant-ack GC
+guard (master.rs:1142-1150).
+
+Transaction records are Raft-replicated dict state (MasterState.transactions,
+applied by the ``_apply_tx_*`` commands); only inquiry attempt counters are
+soft state.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import TYPE_CHECKING
+
+from tpudfs.common.rpc import RpcError
+from tpudfs.master.state import now_ms
+from tpudfs.raft.core import NotLeaderError
+
+if TYPE_CHECKING:
+    from tpudfs.master.service import Master
+
+logger = logging.getLogger(__name__)
+
+TX_TIMEOUT_MS = 10_000  # reference master.rs:173-178
+TX_STALE_MS = 3_600_000  # reference master.rs:179-188 (1 h)
+INQUIRY_MAX_RETRIES = 60  # reference master.rs:1034-1137
+
+
+class TransactionManager:
+    def __init__(self, master: "Master"):
+        self.m = master
+        #: Soft per-tx inquiry counters (participant side); reset on restart,
+        #: which only delays — never skips — presumed abort.
+        self.inquiry_attempts: dict[str, int] = {}
+
+    # ------------------------------------------------------------ coordinator
+
+    async def run_cross_shard_rename(self, src: str, dst: str,
+                                     dest_shard: str) -> None:
+        """Coordinator flow (reference master.rs:2809-3021, call stack
+        SURVEY.md §3.4)."""
+        m = self.m
+        meta = m.state.files.get(src)
+        if meta is None or not meta.complete:
+            raise RpcError.not_found(f"file not found: {src}")
+        txid = f"tx-{uuid.uuid4().hex}"
+        at = now_ms()
+        operations = [
+            {"kind": "create", "path": dst, "metadata": meta.to_dict()},
+            {"kind": "delete", "path": src},
+        ]
+        # 1-2. Local quorum: record the tx, advance to Prepared.
+        await m._propose({"op": "tx_create", "tx": {
+            "txid": txid, "state": "pending", "coordinator": True,
+            "coordinator_shard": m.state.shard_id, "dest_shard": dest_shard,
+            "operations": operations, "participant_acked": False,
+            "created_at_ms": at, "updated_at_ms": at,
+        }})
+        await m._propose({"op": "tx_set_state", "txid": txid,
+                          "state": "prepared", "at_ms": now_ms()})
+        # 3. Prepare on the destination shard.
+        try:
+            await self._call_dest(dest_shard, "PrepareTransaction", {
+                "txid": txid,
+                "coordinator_shard": m.state.shard_id,
+                "operations": [operations[0]],
+            })
+        except RpcError as e:
+            # Prepare failed: abort both sides (reference master.rs:2907-2932).
+            await self._abort_local(txid)
+            await self._abort_dest(dest_shard, txid)
+            # Deterministic rejections keep their code so clients don't retry
+            # an abort that would repeat identically (e.g. dest exists).
+            code = e.code.name
+            if code in ("ALREADY_EXISTS", "NOT_FOUND", "INVALID_ARGUMENT"):
+                raise RpcError(e.code,
+                               f"cross-shard rename aborted: {e.message}") \
+                    from None
+            raise RpcError.failed_precondition(
+                f"cross-shard rename aborted: {e.message}"
+            ) from None
+        # 4. Commit on the destination shard. The replicated commit_sent
+        # marker lands FIRST: once a commit RPC may have been delivered the
+        # coordinator must never presume abort (only retry forward). A
+        # failure here leaves the tx Prepared; run_transaction_recovery
+        # retries the commit (the rename outcome is then indeterminate to
+        # this caller).
+        await m._propose({"op": "tx_mark_commit_sent", "txid": txid})
+        try:
+            await self._call_dest(dest_shard, "CommitTransaction", {"txid": txid})
+        except RpcError as e:
+            logger.warning("tx %s: commit RPC to %s failed (%s); left "
+                           "Prepared for recovery", txid, dest_shard, e.message)
+            raise RpcError.unavailable(
+                f"rename commit pending recovery: {e.message}"
+            ) from None
+        await self._finish_commit(txid)
+
+    async def _finish_commit(self, txid: str) -> None:
+        """Steps 5-7: delete source, mark Committed, record participant ack
+        (reference master.rs:2952-3008)."""
+        m = self.m
+        tx = m.state.transactions.get(txid)
+        if tx is None:
+            return
+        delete_ops = [o for o in tx["operations"] if o["kind"] == "delete"]
+        for op in delete_ops:
+            await m._propose({"op": "tx_apply_op", "txid": txid,
+                              "operation": op})
+        await m._propose({"op": "tx_set_state", "txid": txid,
+                          "state": "committed", "at_ms": now_ms()})
+        await m._propose({"op": "tx_set_participant_acked", "txid": txid})
+
+    async def _abort_local(self, txid: str) -> None:
+        try:
+            await self.m._propose({"op": "tx_set_state", "txid": txid,
+                                   "state": "aborted", "at_ms": now_ms()})
+        except RpcError as e:
+            logger.warning("tx %s: local abort failed: %s", txid, e.message)
+
+    async def _abort_dest(self, dest_shard: str, txid: str) -> None:
+        try:
+            await self._call_dest(dest_shard, "AbortTransaction", {"txid": txid})
+        except RpcError:
+            pass  # participant cleanup will presumed-abort
+
+    async def _call_dest(self, shard_id: str, method: str, req: dict,
+                         attempts: int = 4) -> dict:
+        return await self.m.call_shard(shard_id, method, req, attempts=attempts)
+
+    # ------------------------------------------------------------ participant
+
+    async def rpc_prepare(self, req: dict) -> dict:
+        """Participant Prepare (reference master.rs:3026-3129): idempotent on
+        resend, validates the destination doesn't already exist."""
+        m = self.m
+        txid = req["txid"]
+        existing = m.state.transactions.get(txid)
+        if existing is not None:
+            if existing["state"] in ("prepared", "committed"):
+                return {"success": True, "already": existing["state"]}
+            raise RpcError.failed_precondition(
+                f"transaction {txid} already {existing['state']}"
+            )
+        m._check_tx_lock(*(op["path"] for op in req["operations"]))
+        for op in req["operations"]:
+            if op["kind"] == "create":
+                cur = m.state.files.get(op["path"])
+                if cur is not None and cur.complete:
+                    raise RpcError.already_exists(
+                        f"destination exists: {op['path']}"
+                    )
+        at = now_ms()
+        await m._propose({"op": "tx_create", "tx": {
+            "txid": txid, "state": "prepared", "coordinator": False,
+            "coordinator_shard": req.get("coordinator_shard", ""),
+            "dest_shard": m.state.shard_id,
+            "operations": list(req["operations"]),
+            "participant_acked": False,
+            "created_at_ms": at, "updated_at_ms": at,
+        }})
+        return {"success": True}
+
+    async def rpc_commit(self, req: dict) -> dict:
+        """Participant Commit (reference master.rs:3131-3229): apply the
+        prepared operations, mark Committed; idempotent."""
+        m = self.m
+        txid = req["txid"]
+        tx = m.state.transactions.get(txid)
+        if tx is None:
+            raise RpcError.not_found(f"unknown transaction {txid}")
+        if tx["state"] == "committed":
+            return {"success": True, "already": "committed"}
+        if tx["state"] == "aborted":
+            raise RpcError.failed_precondition(f"transaction {txid} aborted")
+        for op in tx["operations"]:
+            await m._propose({"op": "tx_apply_op", "txid": txid,
+                              "operation": op})
+        await m._propose({"op": "tx_set_state", "txid": txid,
+                          "state": "committed", "at_ms": now_ms()})
+        return {"success": True}
+
+    async def rpc_abort(self, req: dict) -> dict:
+        """Participant Abort (reference master.rs:3231-3274); idempotent,
+        refuses only after commit."""
+        m = self.m
+        txid = req["txid"]
+        tx = m.state.transactions.get(txid)
+        if tx is None or tx["state"] == "aborted":
+            return {"success": True}
+        if tx["state"] == "committed":
+            raise RpcError.failed_precondition(
+                f"transaction {txid} already committed"
+            )
+        await m._propose({"op": "tx_set_state", "txid": txid,
+                          "state": "aborted", "at_ms": now_ms()})
+        return {"success": True}
+
+    async def rpc_inquire(self, req: dict) -> dict:
+        """Coordinator-side inquiry endpoint (reference master.rs:3276-3306).
+        Linearizable: answered through the ReadIndex barrier so a lagging
+        follower can't feed a false ``unknown`` into the participant's
+        presumed-abort countdown. ``unknown`` (e.g. GC'd record) → caller
+        presumes abort; the participant-ack guard keeps committed records
+        alive until the participant stopped asking."""
+        await self.m._linearizable_read()
+        tx = self.m.state.transactions.get(req["txid"])
+        return {"state": tx["state"] if tx else "unknown"}
+
+    # -------------------------------------------------------- background work
+
+    async def run_cleanup(self) -> None:
+        """Reference run_transaction_cleanup (master.rs:968-1165): abort
+        timed-out Pending txs, resolve participant txs stuck Prepared via
+        coordinator inquiry (presumed abort after the retry cap), GC stale
+        finished records."""
+        m = self.m
+        if not m.raft.is_leader:
+            return
+        at = now_ms()
+        for txid, tx in list(m.state.transactions.items()):
+            age = at - int(tx.get("updated_at_ms") or 0)
+            state = tx["state"]
+            if state == "pending" and age > TX_TIMEOUT_MS:
+                logger.warning("tx %s: pending timed out; aborting", txid)
+                await self._abort_local(txid)
+            elif state == "prepared" and not tx.get("coordinator") \
+                    and age > TX_TIMEOUT_MS:
+                await self._resolve_participant(txid, tx)
+            elif self._gc_eligible(tx) and age > TX_STALE_MS:
+                await m._propose({"op": "tx_delete", "txid": txid})
+                self.inquiry_attempts.pop(txid, None)
+
+    @staticmethod
+    def _gc_eligible(tx: dict) -> bool:
+        if tx["state"] == "aborted":
+            return True
+        if tx["state"] != "committed":
+            return False
+        # Coordinator keeps committed records until the participant acked
+        # (reference master.rs:1142-1150); participants GC freely.
+        return (not tx.get("coordinator")) or bool(tx.get("participant_acked"))
+
+    async def _resolve_participant(self, txid: str, tx: dict) -> None:
+        """Inquire the coordinator about a stuck-Prepared participant tx."""
+        m = self.m
+        attempts = self.inquiry_attempts.get(txid, 0)
+        state = "unknown"
+        try:
+            resp = await m.call_shard(
+                tx.get("coordinator_shard", ""), "InquireTransaction",
+                {"txid": txid}, attempts=2,
+            )
+            state = resp.get("state", "unknown")
+        except RpcError as e:
+            logger.warning("tx %s: inquiry failed: %s", txid, e.message)
+        if state == "committed":
+            try:
+                await self.rpc_commit({"txid": txid})
+            except RpcError as e:
+                logger.warning("tx %s: self-commit failed: %s", txid, e.message)
+            return
+        if state == "aborted" or (state in ("unknown", "pending")
+                                  and attempts >= INQUIRY_MAX_RETRIES):
+            # Presumed abort: coordinator said aborted, or it has forgotten
+            # the tx / never progressed it and we exhausted the retry cap.
+            if state not in ("aborted",):
+                logger.warning("tx %s: presumed abort after %d inquiries",
+                               txid, attempts)
+            await self._abort_local(txid)
+            self.inquiry_attempts.pop(txid, None)
+            return
+        self.inquiry_attempts[txid] = attempts + 1
+
+    async def run_recovery(self) -> None:
+        """Reference run_transaction_recovery (master.rs:1171-1322): the
+        coordinator re-drives Prepared txs — re-sends (idempotent) Prepare
+        then Commit to the destination shard, then finishes locally; stale
+        Prepared txs are aborted on both sides."""
+        m = self.m
+        if not m.raft.is_leader:
+            return
+        at = now_ms()
+        for txid, tx in list(m.state.transactions.items()):
+            if not tx.get("coordinator"):
+                continue
+            if tx["state"] == "committed" and not tx.get("participant_acked"):
+                # Reached Committed (so the participant's commit succeeded)
+                # but leadership was lost before the ack marker landed; retry
+                # it so the record becomes GC-eligible.
+                try:
+                    await m._propose({"op": "tx_set_participant_acked",
+                                      "txid": txid})
+                except RpcError as e:
+                    logger.warning("tx %s: ack retry failed: %s",
+                                   txid, e.message)
+                continue
+            if tx["state"] != "prepared":
+                continue
+            dest = tx.get("dest_shard", "")
+            if at - int(tx.get("updated_at_ms") or 0) > TX_STALE_MS \
+                    and not tx.get("commit_sent"):
+                # Safe only while no commit was ever sent: the participant
+                # cannot have committed, so presumed abort preserves
+                # atomicity. With commit_sent we retry forward indefinitely.
+                logger.warning("tx %s: stale Prepared; aborting", txid)
+                await self._abort_local(txid)
+                await self._abort_dest(dest, txid)
+                continue
+            try:
+                create_ops = [o for o in tx["operations"]
+                              if o["kind"] == "create"]
+                await self._call_dest(dest, "PrepareTransaction", {
+                    "txid": txid,
+                    "coordinator_shard": m.state.shard_id,
+                    "operations": create_ops,
+                }, attempts=2)
+                await self._call_dest(dest, "CommitTransaction",
+                                      {"txid": txid}, attempts=2)
+            except RpcError as e:
+                logger.warning("tx %s: recovery attempt failed: %s",
+                               txid, e.message)
+                continue
+            try:
+                await self._finish_commit(txid)
+                logger.info("tx %s: recovered to Committed", txid)
+            except (RpcError, NotLeaderError) as e:
+                logger.warning("tx %s: finish after recovery failed: %s",
+                               txid, e)
