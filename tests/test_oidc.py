@@ -1,0 +1,99 @@
+"""OIDC JWT validation tests with a locally generated RSA key
+(reference test model: mock_oidc.py fake provider, SURVEY.md §4 tier 3)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+
+import pytest
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+from tpudfs.auth.errors import AuthError
+from tpudfs.auth.oidc import JwksCache, OidcValidator
+
+ISSUER = "https://issuer.test"
+AUDIENCE = "tpudfs"
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode().rstrip("=")
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    numbers = key.public_key().public_numbers()
+    jwk = {
+        "kty": "RSA",
+        "kid": "test-key",
+        "alg": "RS256",
+        "n": _b64url(numbers.n.to_bytes((numbers.n.bit_length() + 7) // 8, "big")),
+        "e": _b64url(numbers.e.to_bytes(3, "big").lstrip(b"\0")),
+    }
+    return key, {"keys": [jwk]}
+
+
+def make_token(key, claims: dict, kid: str = "test-key", alg: str = "RS256") -> str:
+    header = _b64url(json.dumps({"alg": alg, "kid": kid}).encode())
+    payload = _b64url(json.dumps(claims).encode())
+    sig = key.sign(f"{header}.{payload}".encode(), padding.PKCS1v15(), hashes.SHA256())
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+def base_claims() -> dict:
+    return {"iss": ISSUER, "aud": AUDIENCE, "sub": "repo:org/project",
+            "exp": time.time() + 600}
+
+
+@pytest.fixture
+def validator(keypair):
+    _, jwks = keypair
+    return OidcValidator(ISSUER, AUDIENCE, JwksCache(static_jwks=jwks))
+
+
+async def test_valid_token(keypair, validator):
+    key, _ = keypair
+    tok = await validator.validate(make_token(key, base_claims()))
+    assert tok.subject == "repo:org/project" and tok.issuer == ISSUER
+
+
+async def test_audience_list(keypair, validator):
+    key, _ = keypair
+    claims = base_claims()
+    claims["aud"] = ["other", AUDIENCE]
+    assert (await validator.validate(make_token(key, claims))).audience == AUDIENCE
+
+
+@pytest.mark.parametrize("mutate,expected", [
+    (lambda c: c.update(iss="https://evil.test"), "InvalidToken"),
+    (lambda c: c.update(aud="other"), "InvalidToken"),
+    (lambda c: c.update(exp=time.time() - 5), "ExpiredToken"),
+    (lambda c: c.pop("exp"), "ExpiredToken"),
+])
+async def test_bad_claims(keypair, validator, mutate, expected):
+    key, _ = keypair
+    claims = base_claims()
+    mutate(claims)
+    with pytest.raises(AuthError) as err:
+        await validator.validate(make_token(key, claims))
+    assert err.value.code == expected
+
+
+async def test_bad_signature_and_alg(keypair, validator):
+    key, _ = keypair
+    good = make_token(key, base_claims())
+    h, p, s = good.split(".")
+    with pytest.raises(AuthError):
+        await validator.validate(f"{h}.{p}.{'A' * len(s)}")
+    # alg none / HS256 downgrade rejected
+    with pytest.raises(AuthError):
+        await validator.validate(make_token(key, base_claims(), alg="none"))
+    # unknown kid rejected (static JWKS: no refetch)
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    with pytest.raises(AuthError):
+        await validator.validate(make_token(other, base_claims(), kid="other-key"))
+    with pytest.raises(AuthError):
+        await validator.validate("not-a-jwt")

@@ -1,0 +1,24 @@
+"""S3-compatible auth stack (SURVEY.md §2.4, reference dfs/common/src/auth/).
+
+Pure-Python host-side code: auth is control-plane work and never touches the
+TPU. Submodules mirror the reference's capability set:
+
+- :mod:`signing`       — SigV4 canonical request / string-to-sign / key
+                         derivation / constant-time verification
+                         (reference auth/signing.rs:9-123)
+- :mod:`encoding`      — S3-flavor percent encoding (auth/encoding.rs:7)
+- :mod:`credentials`   — CredentialProvider + env provider
+                         (auth/credentials.rs:2-37)
+- :mod:`cache`         — LRU signing-key cache (auth/cache.rs:14-47)
+- :mod:`presign`       — SigV4 query-string presigned URLs (auth/presign.rs:20)
+- :mod:`chunked`       — STREAMING-AWS4-HMAC-SHA256-PAYLOAD chunk verification
+                         (auth/chunked.rs:5-28)
+- :mod:`errors`        — typed AuthError → S3 XML error mapping
+                         (auth/mod.rs:39-110)
+- :mod:`policy`        — IAM identity-policy engine (auth/policy.rs:5-128)
+- :mod:`bucket_policy` — resource-based bucket policies
+                         (auth/bucket_policy.rs:14-127)
+- :mod:`oidc`          — JWKS cache + RS256 JWT validation (auth/oidc.rs:38-81)
+- :mod:`sts`           — AES-GCM stateless session tokens (auth/sts.rs:21-60)
+- :mod:`sse`           — SSE-S3 envelope encryption (auth/sse.rs:10-64)
+"""

@@ -1,0 +1,27 @@
+"""Shared audit record type (reference auth/audit.rs:4)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class AuditRecord:
+    timestamp: float           # unix seconds
+    request_id: str
+    principal: str             # access key or "role:<name>"; "-" if anonymous
+    action: str                # e.g. "s3:GetObject"
+    resource: str              # e.g. "arn:aws:s3:::bucket/key"
+    outcome: str               # "Allow" | "Deny" | "Error"
+    http_status: int = 0
+    source_ip: str = ""
+    detail: str = ""
+    extra: dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, data: str | bytes) -> "AuditRecord":
+        return cls(**json.loads(data))

@@ -1,0 +1,88 @@
+"""Resource-based bucket policies (reference auth/bucket_policy.rs:14-127).
+
+A bucket policy is a JSON document attached to a bucket (stored by the gateway
+as a hidden object under the bucket root) whose statements name a
+``Principal`` in addition to Action/Resource. Combined decision with the
+identity policy follows S3 semantics:
+
+- explicit Deny in either policy → denied,
+- Allow in either (bucket policy can grant to principals the identity policy
+  doesn't) → allowed,
+- otherwise denied.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any
+
+from tpudfs.auth.policy import wildcard_match
+
+
+@dataclass(frozen=True)
+class BucketStatement:
+    effect: str
+    principals: tuple[str, ...]  # access keys / "role:name" / "*"
+    actions: tuple[str, ...]
+    resources: tuple[str, ...]
+
+    def matches(self, principal: str, action: str, resource: str) -> bool:
+        return (
+            any(wildcard_match(p, principal) for p in self.principals)
+            and any(wildcard_match(p, action) for p in self.actions)
+            and any(wildcard_match(p, resource) for p in self.resources)
+        )
+
+
+class BucketPolicy:
+    def __init__(self, statements: list[BucketStatement], raw: dict[str, Any]):
+        self.statements = statements
+        self.raw = raw
+
+    @classmethod
+    def from_json(cls, doc: dict[str, Any] | str | bytes) -> "BucketPolicy":
+        if isinstance(doc, (str, bytes)):
+            doc = json.loads(doc)
+        statements = []
+        for s in doc.get("Statement", []):
+            def as_tuple(v: Any) -> tuple[str, ...]:
+                if v is None:
+                    return ()
+                if isinstance(v, str):
+                    return (v,)
+                return tuple(v)
+
+            principal = s.get("Principal", ())
+            if isinstance(principal, dict):  # {"AWS": [...]} form
+                principal = principal.get("AWS", ())
+            statements.append(
+                BucketStatement(
+                    effect=s.get("Effect", "Deny"),
+                    principals=as_tuple(principal),
+                    actions=as_tuple(s.get("Action")),
+                    resources=as_tuple(s.get("Resource")),
+                )
+            )
+        return cls(statements, doc if isinstance(doc, dict) else {})
+
+    def evaluate(self, principal: str, action: str, resource: str) -> str:
+        """Returns "Deny", "Allow", or "Neutral"."""
+        allowed = False
+        for stmt in self.statements:
+            if not stmt.matches(principal, action, resource):
+                continue
+            if stmt.effect == "Deny":
+                return "Deny"
+            if stmt.effect == "Allow":
+                allowed = True
+        return "Allow" if allowed else "Neutral"
+
+
+def combined_decision(
+    identity_allowed: bool, bucket_verdict: str
+) -> bool:
+    """S3 union semantics: bucket Deny vetoes; either Allow grants."""
+    if bucket_verdict == "Deny":
+        return False
+    return identity_allowed or bucket_verdict == "Allow"

@@ -1,0 +1,76 @@
+"""aws-chunked streaming upload verification (reference auth/chunked.rs:5-28).
+
+Clients that sign with ``x-amz-content-sha256: STREAMING-AWS4-HMAC-SHA256-
+PAYLOAD`` send the body as framed chunks::
+
+    <hex-size>;chunk-signature=<sig>\r\n<data>\r\n ... 0;chunk-signature=<sig>\r\n\r\n
+
+Each chunk signature chains off the previous one (seed = the request's own
+signature)::
+
+    sig_n = HMAC(signing_key, "AWS4-HMAC-SHA256-PAYLOAD" \n amz_date \n scope
+                 \n sig_{n-1} \n sha256("") \n sha256(chunk_data))
+
+:func:`decode_chunked_body` verifies every chunk and returns the decoded
+payload; any broken frame or signature raises :class:`AuthError`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+from tpudfs.auth.errors import AuthError
+from tpudfs.auth.signing import EMPTY_SHA256, sha256_hex
+
+CHUNK_STRING_TO_SIGN_PREFIX = "AWS4-HMAC-SHA256-PAYLOAD"
+
+
+def chunk_signature(
+    signing_key: bytes, amz_date: str, scope: str, previous_signature: str, chunk_data: bytes
+) -> str:
+    string_to_sign = "\n".join(
+        [
+            CHUNK_STRING_TO_SIGN_PREFIX,
+            amz_date,
+            scope,
+            previous_signature,
+            EMPTY_SHA256,
+            sha256_hex(chunk_data),
+        ]
+    )
+    return hmac.new(signing_key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+
+
+def decode_chunked_body(
+    body: bytes, signing_key: bytes, amz_date: str, scope: str, seed_signature: str
+) -> bytes:
+    """Parse + verify an aws-chunked body; returns the raw payload bytes."""
+    out = bytearray()
+    prev_sig = seed_signature
+    pos = 0
+    while True:
+        header_end = body.find(b"\r\n", pos)
+        if header_end < 0:
+            raise AuthError.malformed("truncated chunk header")
+        header = body[pos:header_end].decode("ascii", errors="replace")
+        size_part, sep, sig_part = header.partition(";chunk-signature=")
+        if not sep:
+            raise AuthError.malformed("chunk header missing chunk-signature")
+        try:
+            size = int(size_part, 16)
+        except ValueError as exc:
+            raise AuthError.malformed(f"bad chunk size: {size_part}") from exc
+        data_start = header_end + 2
+        data_end = data_start + size
+        if body[data_end : data_end + 2] != b"\r\n":
+            raise AuthError.malformed("chunk data not CRLF-terminated")
+        data = bytes(body[data_start:data_end])
+        expected = chunk_signature(signing_key, amz_date, scope, prev_sig, data)
+        if not hmac.compare_digest(expected, sig_part):
+            raise AuthError.signature_mismatch()
+        prev_sig = expected
+        if size == 0:
+            return bytes(out)
+        out.extend(data)
+        pos = data_end + 2

@@ -1,0 +1,73 @@
+"""Credential providers + signing-key LRU cache
+(reference auth/credentials.rs:2-37, auth/cache.rs:14-47).
+
+``CredentialProvider`` resolves an access-key id to its secret. The env
+provider reads ``S3_ACCESS_KEY`` / ``S3_SECRET_KEY`` (single static identity),
+and ``StaticCredentialProvider`` holds a map for multi-user test clusters.
+Derived SigV4 signing keys are cached keyed by ``(access_key, date, region,
+service)`` so the 4-round HMAC chain runs once per key per day.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+from tpudfs.auth.signing import derive_signing_key
+
+
+class CredentialProvider:
+    """Resolve access-key id → secret key, or None if unknown."""
+
+    def secret_for(self, access_key: str) -> str | None:
+        raise NotImplementedError
+
+
+class EnvCredentialProvider(CredentialProvider):
+    """Single identity from environment (reference auth/credentials.rs:2-37)."""
+
+    def __init__(self, access_env: str = "S3_ACCESS_KEY", secret_env: str = "S3_SECRET_KEY"):
+        self._access = os.environ.get(access_env, "")
+        self._secret = os.environ.get(secret_env, "")
+
+    def secret_for(self, access_key: str) -> str | None:
+        if self._access and access_key == self._access:
+            return self._secret
+        return None
+
+
+class StaticCredentialProvider(CredentialProvider):
+    def __init__(self, users: dict[str, str]):
+        self._users = dict(users)
+
+    def secret_for(self, access_key: str) -> str | None:
+        return self._users.get(access_key)
+
+
+class SigningKeyCache:
+    """Thread-safe LRU of derived signing keys (reference auth/cache.rs:14-47)."""
+
+    def __init__(self, capacity: int = 128):
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple[str, str, str, str], bytes] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, access_key: str, secret_key: str, date: str, region: str, service: str) -> bytes:
+        key = (access_key, date, region, service)
+        with self._lock:
+            cached = self._entries.get(key)
+            if cached is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return cached
+            self.misses += 1
+        derived = derive_signing_key(secret_key, date, region, service)
+        with self._lock:
+            self._entries[key] = derived
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+        return derived

@@ -1,0 +1,33 @@
+"""S3-flavor URI percent-encoding (reference auth/encoding.rs:7).
+
+AWS SigV4 for S3 uses a stricter encoding than RFC 3986 defaults: every byte
+outside the unreserved set ``A-Z a-z 0-9 - . _ ~`` is percent-encoded with
+uppercase hex. For the canonical *path* the forward slash is kept literal and
+the path is NOT normalized (S3 semantics — dot segments are significant
+object-key bytes); for query strings the slash is encoded too.
+"""
+
+from __future__ import annotations
+
+_UNRESERVED = frozenset(
+    b"ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-._~"
+)
+
+
+def uri_encode(value: str, *, encode_slash: bool = True) -> str:
+    """Percent-encode ``value`` the way SigV4-for-S3 requires."""
+    out: list[str] = []
+    for byte in value.encode("utf-8"):
+        if byte in _UNRESERVED or (byte == 0x2F and not encode_slash):
+            out.append(chr(byte))
+        else:
+            out.append(f"%{byte:02X}")
+    return "".join(out)
+
+
+def canonical_query_string(params: list[tuple[str, str]]) -> str:
+    """Sorted, fully-encoded query string (signature param excluded upstream)."""
+    encoded = sorted(
+        (uri_encode(k), uri_encode(v)) for k, v in params
+    )
+    return "&".join(f"{k}={v}" for k, v in encoded)

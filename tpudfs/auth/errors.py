@@ -1,0 +1,90 @@
+"""Typed auth failures with S3 XML error payloads (reference auth/mod.rs:39-110).
+
+Every authentication/authorization failure carries the S3 error ``code`` (the
+``<Code>`` element AWS clients switch on), an HTTP status, and a message. The
+gateway's middleware renders :meth:`AuthError.to_xml` verbatim so boto3 /
+aws-cli raise the same typed exceptions they would against real S3.
+"""
+
+from __future__ import annotations
+
+from xml.sax.saxutils import escape
+
+
+class AuthError(Exception):
+    """Auth failure mapping onto an S3 error response."""
+
+    def __init__(self, code: str, message: str, http_status: int):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+        self.http_status = http_status
+
+    # -- constructors for the reference's variants (auth/mod.rs:39-110) -------
+
+    @classmethod
+    def missing_authentication(cls) -> "AuthError":
+        return cls("MissingSecurityHeader", "Request is missing authentication information.", 403)
+
+    @classmethod
+    def malformed(cls, detail: str) -> "AuthError":
+        return cls("AuthorizationHeaderMalformed", detail, 400)
+
+    @classmethod
+    def invalid_access_key(cls, access_key: str) -> "AuthError":
+        return cls(
+            "InvalidAccessKeyId",
+            f"The AWS Access Key Id you provided does not exist in our records: {access_key}",
+            403,
+        )
+
+    @classmethod
+    def signature_mismatch(cls) -> "AuthError":
+        return cls(
+            "SignatureDoesNotMatch",
+            "The request signature we calculated does not match the signature you provided.",
+            403,
+        )
+
+    @classmethod
+    def clock_skew(cls) -> "AuthError":
+        return cls(
+            "RequestTimeTooSkewed",
+            "The difference between the request time and the server's time is too large.",
+            403,
+        )
+
+    @classmethod
+    def expired(cls) -> "AuthError":
+        return cls("AccessDenied", "Request has expired", 403)
+
+    @classmethod
+    def expired_token(cls) -> "AuthError":
+        return cls("ExpiredToken", "The provided token has expired.", 400)
+
+    @classmethod
+    def invalid_token(cls) -> "AuthError":
+        return cls("InvalidToken", "The provided token is malformed or otherwise invalid.", 400)
+
+    @classmethod
+    def access_denied(cls, detail: str = "Access Denied") -> "AuthError":
+        return cls("AccessDenied", detail, 403)
+
+    @classmethod
+    def insecure_transport(cls) -> "AuthError":
+        return cls("AccessDenied", "Requests must be made over HTTPS.", 403)
+
+    @classmethod
+    def internal(cls, detail: str) -> "AuthError":
+        return cls("InternalError", detail, 500)
+
+    def to_xml(self, resource: str = "", request_id: str = "") -> str:
+        return (
+            '<?xml version="1.0" encoding="UTF-8"?>\n'
+            "<Error>"
+            f"<Code>{escape(self.code)}</Code>"
+            f"<Message>{escape(self.message)}</Message>"
+            f"<Resource>{escape(resource)}</Resource>"
+            f"<RequestId>{escape(request_id)}</RequestId>"
+            "</Error>"
+        )

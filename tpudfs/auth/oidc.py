@@ -1,0 +1,129 @@
+"""OIDC: JWKS cache + RS256 JWT validation (reference auth/oidc.rs:38-81).
+
+Validates web-identity tokens for ``AssumeRoleWithWebIdentity``: fetches the
+issuer's JWKS (``/.well-known`` discovery or a direct ``jwks_uri``), caches
+keys by ``kid``, verifies the RS256 signature with ``cryptography``, and
+checks ``iss`` / ``aud`` / ``exp``. A static JWKS can be injected for
+air-gapped clusters and tests.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+from tpudfs.auth.errors import AuthError
+
+
+def _b64url_decode(data: str) -> bytes:
+    padding_needed = -len(data) % 4
+    return base64.urlsafe_b64decode(data + "=" * padding_needed)
+
+
+def _b64url_uint(data: str) -> int:
+    return int.from_bytes(_b64url_decode(data), "big")
+
+
+def public_key_from_jwk(jwk: dict[str, Any]) -> rsa.RSAPublicKey:
+    if jwk.get("kty") != "RSA":
+        raise AuthError.invalid_token()
+    numbers = rsa.RSAPublicNumbers(_b64url_uint(jwk["e"]), _b64url_uint(jwk["n"]))
+    return numbers.public_key()
+
+
+@dataclass
+class ValidatedToken:
+    issuer: str
+    subject: str
+    audience: str
+    claims: dict[str, Any]
+
+
+class JwksCache:
+    """kid → JWK map with TTL refresh (reference hourly JWKS task main.rs:109-137)."""
+
+    def __init__(self, jwks_uri: str | None = None, *, ttl_seconds: float = 3600.0,
+                 static_jwks: dict[str, Any] | None = None):
+        self._uri = jwks_uri
+        self._ttl = ttl_seconds
+        self._keys: dict[str, dict[str, Any]] = {}
+        self._fetched_at = 0.0
+        self.fetch_count = 0
+        if static_jwks is not None:
+            self.load(static_jwks)
+            self._fetched_at = float("inf")  # never refresh a static set
+
+    def load(self, jwks: dict[str, Any]) -> None:
+        self._keys = {k.get("kid", ""): k for k in jwks.get("keys", [])}
+
+    async def refresh(self) -> None:
+        if self._uri is None:
+            return
+        import aiohttp
+
+        self.fetch_count += 1
+        async with aiohttp.ClientSession() as session:
+            async with session.get(self._uri, timeout=aiohttp.ClientTimeout(total=10)) as resp:
+                resp.raise_for_status()
+                self.load(await resp.json(content_type=None))
+        self._fetched_at = time.monotonic()
+
+    async def key_for(self, kid: str) -> dict[str, Any]:
+        if time.monotonic() - self._fetched_at > self._ttl or (
+            kid not in self._keys and self._uri is not None and self._fetched_at != float("inf")
+        ):
+            await self.refresh()
+        jwk = self._keys.get(kid)
+        if jwk is None:
+            raise AuthError.invalid_token()
+        return jwk
+
+
+class OidcValidator:
+    def __init__(self, issuer: str, audience: str, jwks: JwksCache):
+        self.issuer = issuer
+        self.audience = audience
+        self.jwks = jwks
+
+    async def validate(self, token: str, *, now: float | None = None) -> ValidatedToken:
+        try:
+            header_b64, payload_b64, sig_b64 = token.split(".")
+            header = json.loads(_b64url_decode(header_b64))
+            payload = json.loads(_b64url_decode(payload_b64))
+            signature = _b64url_decode(sig_b64)
+        except (ValueError, json.JSONDecodeError) as exc:
+            raise AuthError.invalid_token() from exc
+
+        if header.get("alg") != "RS256":
+            raise AuthError.invalid_token()
+        jwk = await self.jwks.key_for(header.get("kid", ""))
+        key = public_key_from_jwk(jwk)
+        signing_input = f"{header_b64}.{payload_b64}".encode("ascii")
+        try:
+            key.verify(signature, signing_input, padding.PKCS1v15(), hashes.SHA256())
+        except InvalidSignature as exc:
+            raise AuthError.invalid_token() from exc
+
+        now = time.time() if now is None else now
+        if payload.get("iss") != self.issuer:
+            raise AuthError.invalid_token()
+        aud = payload.get("aud")
+        aud_list = aud if isinstance(aud, list) else [aud]
+        if self.audience not in aud_list:
+            raise AuthError.invalid_token()
+        exp = payload.get("exp")
+        if not isinstance(exp, (int, float)) or exp < now:
+            raise AuthError.expired_token()
+        return ValidatedToken(
+            issuer=payload["iss"],
+            subject=str(payload.get("sub", "")),
+            audience=self.audience,
+            claims=payload,
+        )

@@ -1,0 +1,128 @@
+"""IAM identity-policy engine (reference auth/policy.rs:5-128).
+
+Statically configured from ``iam_config.json``: users (access keys) attach
+managed policies and inline statements; roles carry their own policies plus an
+assume-role trust list used by STS. Evaluation is standard IAM:
+
+1. explicit ``Deny`` anywhere → denied,
+2. else any matching ``Allow`` → allowed,
+3. else implicit deny.
+
+``Action``/``Resource`` support ``*`` and ``?`` wildcards (glob-style,
+matched segment-free over the whole string, as in the reference's matcher).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def wildcard_match(pattern: str, value: str) -> bool:
+    """Case-sensitive glob match where ``*`` crosses ``/`` boundaries."""
+    return fnmatch.fnmatchcase(value, pattern)
+
+
+@dataclass(frozen=True)
+class Statement:
+    effect: str  # "Allow" | "Deny"
+    actions: tuple[str, ...]
+    resources: tuple[str, ...]
+
+    @classmethod
+    def from_json(cls, doc: dict[str, Any]) -> "Statement":
+        def as_tuple(v: Any) -> tuple[str, ...]:
+            if isinstance(v, str):
+                return (v,)
+            return tuple(v or ())
+
+        return cls(
+            effect=doc.get("Effect", "Deny"),
+            actions=as_tuple(doc.get("Action")),
+            resources=as_tuple(doc.get("Resource")),
+        )
+
+    def matches(self, action: str, resource: str) -> bool:
+        return any(wildcard_match(p, action) for p in self.actions) and any(
+            wildcard_match(p, resource) for p in self.resources
+        )
+
+
+@dataclass
+class Role:
+    name: str
+    statements: list[Statement] = field(default_factory=list)
+    #: OIDC subjects (``sub`` claims) trusted to assume this role; wildcards ok.
+    trusted_subjects: list[str] = field(default_factory=list)
+
+
+class PolicyEngine:
+    """Holds users/roles/managed policies; answers is_allowed / can_assume_role."""
+
+    def __init__(self) -> None:
+        self._managed: dict[str, list[Statement]] = {}
+        self._user_statements: dict[str, list[Statement]] = {}
+        self._roles: dict[str, Role] = {}
+
+    @classmethod
+    def from_file(cls, path: str) -> "PolicyEngine":
+        with open(path, encoding="utf-8") as fh:
+            return cls.from_json(json.load(fh))
+
+    @classmethod
+    def from_json(cls, doc: dict[str, Any]) -> "PolicyEngine":
+        engine = cls()
+        for name, policy in (doc.get("managed_policies") or {}).items():
+            engine._managed[name] = [
+                Statement.from_json(s) for s in policy.get("Statement", [])
+            ]
+        for access_key, user in (doc.get("users") or {}).items():
+            stmts: list[Statement] = []
+            for policy_name in user.get("policies", []):
+                stmts.extend(engine._managed.get(policy_name, []))
+            stmts.extend(Statement.from_json(s) for s in user.get("inline", []))
+            engine._user_statements[access_key] = stmts
+        for name, role in (doc.get("roles") or {}).items():
+            r = Role(name=name, trusted_subjects=list(role.get("trusted_subjects", [])))
+            for policy_name in role.get("policies", []):
+                r.statements.extend(engine._managed.get(policy_name, []))
+            r.statements.extend(Statement.from_json(s) for s in role.get("inline", []))
+            engine._roles[name] = r
+        return engine
+
+    @staticmethod
+    def evaluate(statements: list[Statement], action: str, resource: str) -> bool:
+        allowed = False
+        for stmt in statements:
+            if not stmt.matches(action, resource):
+                continue
+            if stmt.effect == "Deny":
+                return False  # explicit deny wins immediately
+            if stmt.effect == "Allow":
+                allowed = True
+        return allowed
+
+    def is_allowed(self, principal: str, action: str, resource: str) -> bool:
+        """``principal`` is an access-key id or ``role:<name>`` for STS creds."""
+        if principal.startswith("role:"):
+            role = self._roles.get(principal[len("role:"):])
+            statements = role.statements if role else []
+        else:
+            statements = self._user_statements.get(principal, [])
+        return self.evaluate(statements, action, resource)
+
+    def knows_principal(self, principal: str) -> bool:
+        if principal.startswith("role:"):
+            return principal[len("role:"):] in self._roles
+        return principal in self._user_statements
+
+    def can_assume_role(self, role_name: str, subject: str) -> bool:
+        role = self._roles.get(role_name)
+        if role is None:
+            return False
+        return any(wildcard_match(p, subject) for p in role.trusted_subjects)
+
+    def role(self, name: str) -> Role | None:
+        return self._roles.get(name)

@@ -1,0 +1,68 @@
+"""SigV4 query-string presigned URLs (reference auth/presign.rs:20).
+
+Generation side of presigned GET/PUT: the signature covers the method, path,
+all ``X-Amz-*`` query parameters, and the ``host`` header, with payload hash
+``UNSIGNED-PAYLOAD`` (S3 presign semantics). Verification happens in the
+gateway's auth middleware via the same canonical-request builder, so both
+directions share one SigV4 implementation.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+from tpudfs.auth.encoding import uri_encode
+from tpudfs.auth.signing import (
+    ALGORITHM,
+    UNSIGNED_PAYLOAD,
+    build_canonical_request,
+    build_string_to_sign,
+    derive_signing_key,
+    sign,
+)
+
+MAX_EXPIRY_SECONDS = 7 * 24 * 3600  # S3 cap, enforced again at verify time
+
+
+def presign_url(
+    method: str,
+    endpoint: str,
+    path: str,
+    access_key: str,
+    secret_key: str,
+    *,
+    region: str = "us-east-1",
+    service: str = "s3",
+    expires_seconds: int = 3600,
+    now: datetime.datetime | None = None,
+    extra_query: list[tuple[str, str]] | None = None,
+) -> str:
+    """Build a presigned URL for ``method`` on ``endpoint``+``path``."""
+    if not 1 <= expires_seconds <= MAX_EXPIRY_SECONDS:
+        raise ValueError(f"expires_seconds out of range: {expires_seconds}")
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = now.strftime("%Y%m%d")
+    scope = f"{date}/{region}/{service}/aws4_request"
+
+    host = endpoint.split("://", 1)[-1]
+    params: list[tuple[str, str]] = [
+        ("X-Amz-Algorithm", ALGORITHM),
+        ("X-Amz-Credential", f"{access_key}/{scope}"),
+        ("X-Amz-Date", amz_date),
+        ("X-Amz-Expires", str(expires_seconds)),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    params.extend(extra_query or [])
+
+    canonical = build_canonical_request(
+        method, path, params, {"host": host}, ["host"], UNSIGNED_PAYLOAD
+    )
+    string_to_sign = build_string_to_sign(amz_date, scope, canonical)
+    key = derive_signing_key(secret_key, date, region, service)
+    signature = sign(key, string_to_sign)
+
+    query = "&".join(
+        f"{uri_encode(k)}={uri_encode(v)}" for k, v in params
+    )
+    return f"{endpoint}{uri_encode(path, encode_slash=False)}?{query}&X-Amz-Signature={signature}"

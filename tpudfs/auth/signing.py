@@ -1,0 +1,136 @@
+"""AWS SigV4 core: canonical request, string-to-sign, key derivation, verify
+(reference auth/signing.rs:9-123).
+
+Implements the public AWS Signature Version 4 algorithm for the S3 service.
+Signature comparison is constant-time (:func:`hmac.compare_digest`) to close
+the timing side channel the reference guards with the ``subtle`` crate
+(auth/signing.rs:92-123).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass
+
+from tpudfs.auth.encoding import canonical_query_string, uri_encode
+from tpudfs.auth.errors import AuthError
+
+ALGORITHM = "AWS4-HMAC-SHA256"
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode("utf-8"), hashlib.sha256).digest()
+
+
+def derive_signing_key(secret_key: str, date: str, region: str, service: str) -> bytes:
+    """kSecret → kDate → kRegion → kService → kSigning chain."""
+    k_date = _hmac(("AWS4" + secret_key).encode("utf-8"), date)
+    k_region = _hmac(k_date, region)
+    k_service = _hmac(k_region, service)
+    return _hmac(k_service, "aws4_request")
+
+
+def canonical_headers(headers: dict[str, str], signed_headers: list[str]) -> str:
+    """Lowercased, sorted, whitespace-trimmed header lines for signing."""
+    lowered = {k.lower(): v for k, v in headers.items()}
+    lines = []
+    for name in signed_headers:
+        value = lowered.get(name, "")
+        lines.append(f"{name}:{' '.join(value.split())}\n")
+    return "".join(lines)
+
+
+def build_canonical_request(
+    method: str,
+    path: str,
+    query_params: list[tuple[str, str]],
+    headers: dict[str, str],
+    signed_headers: list[str],
+    payload_hash: str,
+) -> str:
+    return "\n".join(
+        [
+            method.upper(),
+            uri_encode(path, encode_slash=False) or "/",
+            canonical_query_string(query_params),
+            canonical_headers(headers, signed_headers),
+            ";".join(signed_headers),
+            payload_hash,
+        ]
+    )
+
+
+def build_string_to_sign(amz_date: str, scope: str, canonical_request: str) -> str:
+    return "\n".join(
+        [ALGORITHM, amz_date, scope, sha256_hex(canonical_request.encode("utf-8"))]
+    )
+
+
+def sign(signing_key: bytes, string_to_sign: str) -> str:
+    return hmac.new(signing_key, string_to_sign.encode("utf-8"), hashlib.sha256).hexdigest()
+
+
+def verify_signature(expected_hex: str, provided_hex: str) -> None:
+    """Constant-time comparison (reference auth/signing.rs:92-123)."""
+    if not hmac.compare_digest(expected_hex.encode(), provided_hex.encode()):
+        raise AuthError.signature_mismatch()
+
+
+@dataclass(frozen=True)
+class Credential:
+    """Parsed SigV4 credential scope: AK/date/region/service/aws4_request."""
+
+    access_key: str
+    date: str
+    region: str
+    service: str
+
+    @property
+    def scope(self) -> str:
+        return f"{self.date}/{self.region}/{self.service}/aws4_request"
+
+    @classmethod
+    def parse(cls, credential: str) -> "Credential":
+        parts = credential.split("/")
+        if len(parts) != 5 or parts[4] != "aws4_request":
+            raise AuthError.malformed(f"invalid Credential: {credential}")
+        return cls(parts[0], parts[1], parts[2], parts[3])
+
+
+@dataclass(frozen=True)
+class ParsedAuthorization:
+    """Decomposed ``Authorization: AWS4-HMAC-SHA256 ...`` header
+    (reference credential parsing auth/mod.rs:112)."""
+
+    credential: Credential
+    signed_headers: list[str]
+    signature: str
+
+    @classmethod
+    def parse(cls, header: str) -> "ParsedAuthorization":
+        if not header.startswith(ALGORITHM):
+            raise AuthError.malformed("unsupported signing algorithm")
+        fields: dict[str, str] = {}
+        for part in header[len(ALGORITHM):].split(","):
+            part = part.strip()
+            if "=" not in part:
+                raise AuthError.malformed(f"bad Authorization component: {part}")
+            key, value = part.split("=", 1)
+            fields[key.strip()] = value.strip()
+        try:
+            credential = Credential.parse(fields["Credential"])
+            signed = fields["SignedHeaders"].split(";")
+            signature = fields["Signature"]
+        except KeyError as exc:
+            raise AuthError.malformed(f"missing Authorization field {exc}") from exc
+        if not signed or any(not h for h in signed):
+            raise AuthError.malformed("empty SignedHeaders")
+        return cls(credential, signed, signature)

@@ -1,0 +1,65 @@
+"""SSE-S3 envelope encryption (reference auth/sse.rs:10-64).
+
+Per-object data-encryption key (DEK): each PutObject draws a fresh 32-byte
+DEK, encrypts the object body with AES-256-GCM under the DEK, then wraps the
+DEK with the server's master key-encryption key (KEK), also AES-256-GCM. Only
+the sealed blob is stored in the DFS; the KEK never leaves the gateway.
+
+Stored blob layout (all lengths fixed)::
+
+    b"SSE1" | kek_nonce(12) | wrapped_dek(48 = 32 + 16 tag) |
+    data_nonce(12) | ciphertext(len + 16 tag)
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+MAGIC = b"SSE1"
+_HEADER_LEN = len(MAGIC) + 12 + 48 + 12
+
+
+class SseError(Exception):
+    pass
+
+
+class SseEngine:
+    def __init__(self, master_key: bytes):
+        if len(master_key) != 32:
+            raise ValueError("SSE master key must be 32 bytes")
+        self._kek = AESGCM(master_key)
+
+    @classmethod
+    def from_base64(cls, encoded: str) -> "SseEngine":
+        import base64
+
+        return cls(base64.b64decode(encoded))
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        dek = os.urandom(32)
+        kek_nonce = os.urandom(12)
+        wrapped = self._kek.encrypt(kek_nonce, dek, MAGIC)
+        data_nonce = os.urandom(12)
+        ciphertext = AESGCM(dek).encrypt(data_nonce, plaintext, None)
+        return MAGIC + kek_nonce + wrapped + data_nonce + ciphertext
+
+    def decrypt(self, blob: bytes) -> bytes:
+        if len(blob) < _HEADER_LEN + 16 or not blob.startswith(MAGIC):
+            raise SseError("not an SSE-S3 envelope")
+        offset = len(MAGIC)
+        kek_nonce = blob[offset : offset + 12]
+        wrapped = blob[offset + 12 : offset + 60]
+        data_nonce = blob[offset + 60 : offset + 72]
+        ciphertext = blob[offset + 72 :]
+        try:
+            dek = self._kek.decrypt(kek_nonce, wrapped, MAGIC)
+            return AESGCM(dek).decrypt(data_nonce, ciphertext, None)
+        except InvalidTag as exc:
+            raise SseError("SSE envelope authentication failed") from exc
+
+    @staticmethod
+    def is_envelope(blob: bytes) -> bool:
+        return blob.startswith(MAGIC)

@@ -1,0 +1,127 @@
+"""STS: stateless AES-GCM session tokens (reference auth/sts.rs:21-60).
+
+``AssumeRoleWithWebIdentity`` mints temporary credentials. No server-side
+session store: the session token IS the state — an AES-256-GCM box over the
+session JSON, sealed with one of the server's signing keys. A key-id prefix
+enables zero-downtime key rotation (old tokens keep decrypting under the
+retired key while new tokens seal under the active one).
+
+Token layout: ``v1.<key_id>.<b64url(nonce || ciphertext)>``. The temp secret
+key is derived from the token server-side, so only the token needs to travel.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from tpudfs.auth.errors import AuthError
+
+DEFAULT_SESSION_SECONDS = 3600
+MAX_SESSION_SECONDS = 12 * 3600
+
+
+@dataclass(frozen=True)
+class Session:
+    access_key: str       # temp "ASIA..."-style id
+    role: str             # assumed role name
+    subject: str          # OIDC sub
+    expires_at: float     # unix seconds
+    key_id: str           # sealing key id — temp secret derives from this key
+
+    @property
+    def principal(self) -> str:
+        return f"role:{self.role}"
+
+
+@dataclass(frozen=True)
+class TempCredentials:
+    access_key: str
+    secret_key: str
+    session_token: str
+    expires_at: float
+
+
+class StsTokenService:
+    """Seal/unseal sessions with rotating AES-256-GCM keys."""
+
+    def __init__(self, keys: dict[str, bytes], active_key_id: str):
+        if active_key_id not in keys:
+            raise ValueError(f"active key id {active_key_id!r} not in key set")
+        for key_id, key in keys.items():
+            if len(key) != 32:
+                raise ValueError(f"key {key_id!r} must be 32 bytes")
+        self._keys = dict(keys)
+        self._active = active_key_id
+
+    @classmethod
+    def from_hex(cls, keys_hex: dict[str, str], active_key_id: str) -> "StsTokenService":
+        return cls({k: bytes.fromhex(v) for k, v in keys_hex.items()}, active_key_id)
+
+    def _temp_secret(self, key_id: str, access_key: str, expires_at: float) -> str:
+        mac = hmac.new(
+            self._keys[key_id], f"{access_key}:{expires_at}".encode(), hashlib.sha256
+        )
+        return base64.urlsafe_b64encode(mac.digest()).decode().rstrip("=")
+
+    def issue(
+        self, role: str, subject: str, *, duration_seconds: int = DEFAULT_SESSION_SECONDS,
+        now: float | None = None,
+    ) -> TempCredentials:
+        duration_seconds = max(900, min(duration_seconds, MAX_SESSION_SECONDS))
+        now = time.time() if now is None else now
+        expires_at = now + duration_seconds
+        access_key = "ASIA" + base64.b32encode(os.urandom(10)).decode().rstrip("=")
+
+        nonce = os.urandom(12)
+        plaintext = json.dumps(
+            {"ak": access_key, "role": role, "sub": subject, "exp": expires_at}
+        ).encode()
+        sealed = AESGCM(self._keys[self._active]).encrypt(nonce, plaintext, None)
+        token = (
+            f"v1.{self._active}."
+            + base64.urlsafe_b64encode(nonce + sealed).decode().rstrip("=")
+        )
+        return TempCredentials(
+            access_key=access_key,
+            secret_key=self._temp_secret(self._active, access_key, expires_at),
+            session_token=token,
+            expires_at=expires_at,
+        )
+
+    def decrypt(self, token: str, *, now: float | None = None) -> Session:
+        try:
+            version, key_id, blob_b64 = token.split(".", 2)
+            if version != "v1":
+                raise ValueError("unknown token version")
+            blob = base64.urlsafe_b64decode(blob_b64 + "=" * (-len(blob_b64) % 4))
+            nonce, ciphertext = blob[:12], blob[12:]
+            key = self._keys.get(key_id)
+            if key is None:
+                raise ValueError("unknown key id")
+            plaintext = AESGCM(key).decrypt(nonce, ciphertext, None)
+            doc = json.loads(plaintext)
+            session = Session(doc["ak"], doc["role"], doc["sub"], float(doc["exp"]), key_id)
+        except (ValueError, KeyError, InvalidTag, json.JSONDecodeError) as exc:
+            raise AuthError.invalid_token() from exc
+        now = time.time() if now is None else now
+        if session.expires_at < now:
+            raise AuthError.expired_token()
+        return session
+
+    def secret_for_session(self, session: Session) -> str:
+        """Re-derive the temp secret for SigV4 verification of STS requests.
+
+        Derives from the key that sealed the token (``session.key_id``), so
+        sessions issued before a rotation keep verifying while their retired
+        key id remains in the key set.
+        """
+        return self._temp_secret(session.key_id, session.access_key, session.expires_at)
