@@ -1,0 +1,227 @@
+"""Regression tests for 2PC/sharding races found in review.
+
+Each test pins one of the fixes:
+- apply-level tx conflict validation (TOCTOU between RPC check and Raft apply)
+- participant Prepare rejecting in-flight (incomplete) destination uploads
+- inquiry network failures not counting toward presumed abort
+- coordinator converging to abort when the participant authoritatively aborted
+- AddShard peer-set replacement releasing old registry assignments
+- shard-map refresh never regressing to an older version
+"""
+
+import asyncio
+
+import pytest
+
+from tpudfs.common.rpc import RpcError
+from tpudfs.common.sharding import ShardMap
+from tpudfs.configserver.state import ConfigState
+from tpudfs.master.state import MasterState
+from tpudfs.master.transactions import TX_STALE_MS
+
+from tests.test_cross_shard import ShardedCluster
+
+
+def _mktx(txid, ops, *, coordinator, state="pending", **extra):
+    return {
+        "txid": txid, "state": state, "coordinator": coordinator,
+        "coordinator_shard": "shard-a", "dest_shard": "shard-z",
+        "operations": ops, "participant_acked": False,
+        "created_at_ms": 1, "updated_at_ms": 1, **extra,
+    }
+
+
+META = {"path": "", "size": 0, "complete": True, "blocks": []}
+
+
+def test_apply_tx_create_rejects_conflicts():
+    """Authoritative validation inside the replicated apply: duplicate txids,
+    locked paths, existing destinations, and missing sources all reject."""
+    s = MasterState(shard_id="shard-a")
+    ops1 = [{"kind": "create", "path": "/z/d1", "metadata": META},
+            {"kind": "delete", "path": "/a/src"}]
+    s.apply({"op": "create_file", "path": "/a/src", "ec_data_shards": 0,
+             "ec_parity_shards": 0, "created_at_ms": 1})
+    s.apply({"op": "complete_file", "path": "/a/src", "size": 0,
+             "etag_md5": "", "created_at_ms": 1, "block_checksums": []})
+    s.apply({"op": "tx_create", "tx": _mktx("t1", ops1, coordinator=True)})
+
+    # Second concurrent rename of the SAME source: locked-path conflict.
+    ops2 = [{"kind": "create", "path": "/z/d2", "metadata": META},
+            {"kind": "delete", "path": "/a/src"}]
+    with pytest.raises(ValueError, match="locked"):
+        s.apply({"op": "tx_create", "tx": _mktx("t2", ops2, coordinator=True)})
+    # Duplicate txid.
+    with pytest.raises(ValueError, match="exists"):
+        s.apply({"op": "tx_create", "tx": _mktx("t1", ops1, coordinator=True)})
+    # Coordinator rename of a nonexistent source.
+    ops3 = [{"kind": "create", "path": "/z/d3", "metadata": META},
+            {"kind": "delete", "path": "/a/ghost"}]
+    with pytest.raises(ValueError, match="not found"):
+        s.apply({"op": "tx_create", "tx": _mktx("t3", ops3, coordinator=True)})
+
+    # Participant: destination with ANY metadata (even incomplete) rejects.
+    p = MasterState(shard_id="shard-z")
+    p.apply({"op": "create_file", "path": "/z/partial", "ec_data_shards": 0,
+             "ec_parity_shards": 0, "created_at_ms": 1})  # complete=False
+    with pytest.raises(ValueError, match="exists"):
+        p.apply({"op": "tx_create", "tx": _mktx(
+            "t4", [{"kind": "create", "path": "/z/partial", "metadata": META}],
+            coordinator=False, state="prepared")})
+
+
+async def test_concurrent_same_source_renames_one_wins(tmp_path):
+    """Two racing cross-shard renames of one source: exactly one commits."""
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        await c.client.create_file("/a/race", b"v")
+        src_m = c.master_of("/a/race")
+        results = await asyncio.gather(
+            c.rpc.call(src_m.address, "MasterService", "Rename",
+                       {"src": "/a/race", "dst": "/z/r1"}),
+            c.rpc.call(src_m.address, "MasterService", "Rename",
+                       {"src": "/a/race", "dst": "/z/r2"}),
+            return_exceptions=True,
+        )
+        oks = [r for r in results if isinstance(r, dict)]
+        errs = [r for r in results if isinstance(r, RpcError)]
+        assert len(oks) == 1 and len(errs) == 1, results
+        dst_m = c.master_of("/z/r1")
+        created = [p for p in ("/z/r1", "/z/r2") if p in dst_m.state.files]
+        assert len(created) == 1
+        assert "/a/race" not in src_m.state.files
+    finally:
+        await c.stop()
+
+
+async def test_prepare_rejects_inflight_upload(tmp_path):
+    """A destination path with an incomplete (in-flight) upload blocks
+    Prepare instead of being clobbered at commit."""
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        dst_m = c.master_of("/z/up")
+        await c.rpc.call(dst_m.address, "MasterService", "CreateFile",
+                         {"path": "/z/up"})  # no CompleteFile: in-flight
+        with pytest.raises(RpcError) as ei:
+            await dst_m.tx.rpc_prepare({
+                "txid": "tx-in", "coordinator_shard": "shard-a",
+                "operations": [{"kind": "create", "path": "/z/up",
+                                "metadata": META}],
+            })
+        assert "exists" in ei.value.message
+        assert not dst_m.state.transactions
+    finally:
+        await c.stop()
+
+
+async def test_inquiry_network_failure_not_counted(tmp_path):
+    """Unreachable coordinator ≠ abort evidence: the presumed-abort counter
+    must not advance on RPC failures, and the tx stays prepared."""
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        dst_m = c.master_of("/z/n")
+        tx = _mktx("tx-net", [{"kind": "create", "path": "/z/n",
+                               "metadata": META}],
+                   coordinator=False, state="prepared",
+                   coordinator_shard="shard-gone")
+        await dst_m._propose({"op": "tx_create", "tx": tx})
+        dst_m.tx.inquiry_attempts["tx-net"] = 10**6  # over the cap already
+        await dst_m.tx._resolve_participant(
+            "tx-net", dst_m.state.transactions["tx-net"])
+        assert dst_m.state.transactions["tx-net"]["state"] == "prepared"
+        assert dst_m.tx.inquiry_attempts["tx-net"] == 10**6  # unchanged
+    finally:
+        await c.stop()
+
+
+async def test_inquiry_prepared_answer_not_counted(tmp_path):
+    """An authoritative 'prepared' answer leaves the decision with the
+    coordinator — no presumed-abort countdown."""
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        src_m, dst_m = c.masters["shard-a"], c.masters["shard-z"]
+        shared = _mktx("tx-prep", [{"kind": "create", "path": "/z/p",
+                                    "metadata": META}],
+                       coordinator=False, state="prepared",
+                       coordinator_shard=src_m.state.shard_id)
+        await dst_m._propose({"op": "tx_create", "tx": shared})
+        coord = dict(shared, coordinator=True, state="prepared",
+                     operations=[{"kind": "delete", "path": "/a/p"}])
+        src_m.state.transactions["tx-prep"] = coord  # direct: test-only
+        dst_m.tx.inquiry_attempts["tx-prep"] = 10**6
+        await dst_m.tx._resolve_participant(
+            "tx-prep", dst_m.state.transactions["tx-prep"])
+        assert dst_m.state.transactions["tx-prep"]["state"] == "prepared"
+    finally:
+        await c.stop()
+
+
+async def test_coordinator_aborts_after_participant_presumed_abort(tmp_path):
+    """Participant authoritatively aborted (presumed abort) → coordinator
+    recovery must converge to abort instead of retrying commit forever
+    (which would hold the path locks eternally)."""
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        await c.client.create_file("/a/w", b"v")
+        src_m, dst_m = c.master_of("/a/w"), c.master_of("/z/w2")
+        ops = [{"kind": "create", "path": "/z/w2",
+                "metadata": src_m.state.files["/a/w"].to_dict()},
+               {"kind": "delete", "path": "/a/w"}]
+        await src_m._propose({"op": "tx_create", "tx": _mktx(
+            "tx-div", ops, coordinator=True, state="prepared",
+            coordinator_shard=src_m.state.shard_id,
+            dest_shard=dst_m.state.shard_id, commit_sent=True)})
+        # Participant saw the prepare, then presumed-aborted.
+        await dst_m._propose({"op": "tx_create", "tx": _mktx(
+            "tx-div", [ops[0]], coordinator=False, state="aborted",
+            coordinator_shard=src_m.state.shard_id,
+            dest_shard=dst_m.state.shard_id)})
+        await src_m.tx.run_recovery()
+        assert src_m.state.transactions["tx-div"]["state"] == "aborted"
+        # Locks released: the source is usable again.
+        assert "/a/w" not in src_m.state.tx_locked_paths()
+        await c.client.delete_file("/a/w")
+    finally:
+        await c.stop()
+
+
+def test_add_shard_reissue_releases_old_peers():
+    s = ConfigState()
+    s.apply({"op": "register_master", "address": "m1", "shard_id": "",
+             "at_ms": 0})
+    s.apply({"op": "register_master", "address": "m2", "shard_id": "",
+             "at_ms": 0})
+    s.apply({"op": "add_shard", "shard_id": "s1", "peers": ["m1"]})
+    assert s.masters["m1"]["shard_id"] == "s1"
+    s.apply({"op": "add_shard", "shard_id": "s1", "peers": ["m2"]})
+    assert s.masters["m2"]["shard_id"] == "s1"
+    # m1 released → available for auto-allocation again.
+    assert not s.masters["m1"].get("shard_id")
+    assert "m1" in s.healthy_masters(at_ms=0, unassigned_only=True)
+
+
+async def test_shard_refresh_version_monotonic(tmp_path):
+    """A lagging config follower's older map must not regress boundaries."""
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        m = c.masters["shard-a"]
+        current = m.shard_map
+        assert current is not None
+        stale = ShardMap.from_dict(current.to_dict())
+        stale.version = current.version - 1
+
+        async def lagging_call(method, req):
+            if method == "FetchShardMap":
+                return {"shard_map": stale.to_dict()}
+            return {"success": True}
+
+        m.call_config = lagging_call
+        await m.run_shard_refresh()
+        assert m.shard_map.version == current.version  # not regressed
+        newer = ShardMap.from_dict(current.to_dict())
+        newer.version = current.version + 5
+        stale = newer
+        await m.run_shard_refresh()
+        assert m.shard_map.version == current.version + 5
+    finally:
+        await c.stop()

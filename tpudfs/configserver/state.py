@@ -55,6 +55,13 @@ class ConfigState:
 
     def _apply_add_shard(self, cmd: dict):
         shard_id, peers = cmd["shard_id"], list(cmd["peers"])
+        if self.shard_map.has_shard(shard_id):
+            # Re-issued AddShard replaces the peer set: release the old
+            # peers' registry assignment or they stay excluded from
+            # auto-allocation forever.
+            old = [p for p in (self.shard_map.get_peers(shard_id) or [])
+                   if p not in peers]
+            self._assign(old, None)
         self.shard_map.add_shard(shard_id, peers)
         self._assign(peers, shard_id)
         return {"success": True, "version": self.shard_map.version}

@@ -423,6 +423,11 @@ class Master:
         (master.rs:2809-3021)."""
         self._check_safe_mode()
         src, dst = req["src"], req["dst"]
+        # Leadership first: only the leader's map decides the rename, and
+        # bouncing off followers must not each pay a linearizable
+        # cross-group FetchShardMap round trip.
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
         # Rename is the one op where a stale shard map corrupts the
         # namespace (a cross-shard rename mistaken for same-shard creates
         # the destination in a keyspace this shard doesn't own), so fetch a
@@ -440,8 +445,6 @@ class Master:
         if dest_shard is None or dest_shard == self.state.shard_id:
             await self._propose({"op": "rename_file", "src": src, "dst": dst})
             return {"success": True}
-        if not self.raft.is_leader:
-            raise RpcError.not_leader(self.raft.leader_hint)
         await self.tx.run_cross_shard_rename(src, dst, dest_shard)
         return {"success": True, "cross_shard": True}
 
@@ -594,7 +597,12 @@ class Master:
             resp = await self.call_config(
                 "FetchShardMap", {"allow_stale": True}
             )
-            self.shard_map = ShardMap.from_dict(resp["shard_map"])
+            fetched = ShardMap.from_dict(resp["shard_map"])
+            # allow_stale may answer from a lagging config follower; a map
+            # older than the one we hold would regress shard boundaries and
+            # let two shards accept the same key. Install monotonically.
+            if self.shard_map is None or fetched.version >= self.shard_map.version:
+                self.shard_map = fetched
             await self.call_config("RegisterMaster", {
                 "address": self.address, "shard_id": self.state.shard_id,
             })

@@ -345,7 +345,32 @@ class MasterState:
     # stays in one place.
 
     def _apply_tx_create(self, cmd: dict):
+        """Authoritative conflict validation lives HERE, not in the RPC
+        handler: the handler's checks run before the Raft proposal and two
+        concurrent renames of one path can both pass them (the await between
+        check and apply is a TOCTOU window). Apply is serialized by the log,
+        so re-checking against replicated state closes the race
+        deterministically on every replica."""
         tx = cmd["tx"]
+        if tx["txid"] in self.transactions:
+            raise ValueError(f"transaction exists: {tx['txid']}")
+        paths = {op["path"] for op in tx.get("operations", [])}
+        conflict = paths & self.tx_locked_paths()
+        if conflict:
+            raise ValueError(
+                f"path {sorted(conflict)[0]!r} is locked by an in-flight "
+                "transaction"
+            )
+        for op in tx.get("operations", []):
+            if op["kind"] == "create" and not tx.get("coordinator") \
+                    and op["path"] in self.files:
+                # ANY metadata blocks a participant create — an in-flight
+                # upload (complete=False) would otherwise be clobbered at
+                # commit with its allocated blocks orphaned.
+                raise ValueError(f"destination exists: {op['path']}")
+            if op["kind"] == "delete" and tx.get("coordinator") \
+                    and op["path"] not in self.files:
+                raise ValueError(f"source not found: {op['path']}")
         self.transactions[tx["txid"]] = dict(tx)
         return {"success": True}
 

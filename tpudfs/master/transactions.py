@@ -150,14 +150,16 @@ class TransactionManager:
             raise RpcError.failed_precondition(
                 f"transaction {txid} already {existing['state']}"
             )
+        # Fast-fail advisory checks; the authoritative (race-free) versions
+        # re-run inside the replicated _apply_tx_create.
         m._check_tx_lock(*(op["path"] for op in req["operations"]))
         for op in req["operations"]:
-            if op["kind"] == "create":
-                cur = m.state.files.get(op["path"])
-                if cur is not None and cur.complete:
-                    raise RpcError.already_exists(
-                        f"destination exists: {op['path']}"
-                    )
+            if op["kind"] == "create" and m.state.files.get(op["path"]) is not None:
+                # ANY metadata — including an in-flight incomplete upload —
+                # blocks the prepare, else commit clobbers it.
+                raise RpcError.already_exists(
+                    f"destination exists: {op['path']}"
+                )
         at = now_ms()
         await m._propose({"op": "tx_create", "tx": {
             "txid": txid, "state": "prepared", "coordinator": False,
@@ -253,7 +255,6 @@ class TransactionManager:
         """Inquire the coordinator about a stuck-Prepared participant tx."""
         m = self.m
         attempts = self.inquiry_attempts.get(txid, 0)
-        state = "unknown"
         try:
             resp = await m.call_shard(
                 tx.get("coordinator_shard", ""), "InquireTransaction",
@@ -261,24 +262,48 @@ class TransactionManager:
             )
             state = resp.get("state", "unknown")
         except RpcError as e:
-            logger.warning("tx %s: inquiry failed: %s", txid, e.message)
+            # No ANSWER is not evidence of abort: the coordinator may be
+            # partitioned away mid-commit (commit_sent, retrying forward).
+            # Counting network failures toward the presumed-abort cap would
+            # let the participant abort a tx the coordinator still intends
+            # to commit — divergence. Wait for an authoritative answer.
+            logger.warning("tx %s: inquiry failed (not counted): %s",
+                           txid, e.message)
+            return
         if state == "committed":
             try:
                 await self.rpc_commit({"txid": txid})
             except RpcError as e:
                 logger.warning("tx %s: self-commit failed: %s", txid, e.message)
             return
-        if state == "aborted" or (state in ("unknown", "pending")
-                                  and attempts >= INQUIRY_MAX_RETRIES):
-            # Presumed abort: coordinator said aborted, or it has forgotten
-            # the tx / never progressed it and we exhausted the retry cap.
-            if state not in ("aborted",):
-                logger.warning("tx %s: presumed abort after %d inquiries",
-                               txid, attempts)
+        if state == "aborted":
+            await self._abort_local(txid)
+            self.inquiry_attempts.pop(txid, None)
+            return
+        if state == "prepared":
+            # Coordinator still owns the decision (it may be mid-commit);
+            # its recovery/staleness logic will drive the outcome — don't
+            # count toward presumed abort.
+            return
+        # "unknown" (record GC'd or never created) / "pending" (coordinator
+        # will time it out): authoritative non-progress — count toward the
+        # presumed-abort cap.
+        if attempts >= INQUIRY_MAX_RETRIES:
+            logger.warning("tx %s: presumed abort after %d inquiries",
+                           txid, attempts)
             await self._abort_local(txid)
             self.inquiry_attempts.pop(txid, None)
             return
         self.inquiry_attempts[txid] = attempts + 1
+
+    @staticmethod
+    def _participant_reports_aborted(e: RpcError) -> bool:
+        """True when a Prepare/Commit rejection means the participant's tx
+        record is authoritatively in state aborted (rpc_prepare/rpc_commit
+        raise FAILED_PRECONDITION with the state named in the message)."""
+        return (e.code.name == "FAILED_PRECONDITION"
+                and not e.is_not_leader
+                and "aborted" in e.message)
 
     async def run_recovery(self) -> None:
         """Reference run_transaction_recovery (master.rs:1171-1322): the
@@ -326,6 +351,16 @@ class TransactionManager:
                 await self._call_dest(dest, "CommitTransaction",
                                       {"txid": txid}, attempts=2)
             except RpcError as e:
+                if self._participant_reports_aborted(e):
+                    # The participant AUTHORITATIVELY aborted (presumed abort
+                    # after our silence, or an operator abort) — it can never
+                    # have committed, so retrying forward forever would wedge
+                    # this tx Prepared and hold its path locks eternally.
+                    # Converge by aborting locally instead.
+                    logger.warning("tx %s: participant aborted; aborting "
+                                   "coordinator side", txid)
+                    await self._abort_local(txid)
+                    continue
                 logger.warning("tx %s: recovery attempt failed: %s",
                                txid, e.message)
                 continue
