@@ -1,0 +1,108 @@
+"""Audit log: hash chain, batching, restart recovery, tamper detection,
+retention pruning, reader CLI (reference s3_server/audit.rs + audit_reader)."""
+
+import asyncio
+import sqlite3
+import time
+
+from tpudfs.auth.audit import AuditRecord
+from tpudfs.s3.audit import AuditLog
+from tpudfs.s3 import audit_reader
+
+
+def _rec(i, principal="AK", resource="arn:aws:s3:::b/k"):
+    return AuditRecord(timestamp=time.time(), request_id=f"r{i}",
+                       principal=principal, action="s3:GetObject",
+                       resource=resource, outcome="Allow", http_status=200)
+
+
+async def test_chain_write_verify_and_restart(tmp_path):
+    db = str(tmp_path / "audit.db")
+    log = AuditLog(db, b"key", flush_interval=0.05)
+    log.start()
+    for i in range(10):
+        log.log(_rec(i))
+    await asyncio.sleep(0.3)
+    assert log.written_count == 10
+    intact, n = log.verify_chain()
+    assert intact and n == 10
+    await log.stop()
+
+    # Restart resumes the chain from the stored tip.
+    log2 = AuditLog(db, b"key", flush_interval=0.05)
+    log2.start()
+    for i in range(10, 15):
+        log2.log(_rec(i))
+    await asyncio.sleep(0.3)
+    intact, n = log2.verify_chain()
+    assert intact and n == 15
+    # Query by principal / resource filters.
+    assert len(log2.query(principal="AK")) == 15
+    assert len(log2.query(principal="OTHER")) == 0
+    assert len(log2.query(resource="arn:aws:s3:::b")) == 15
+    await log2.stop()
+
+
+async def test_tamper_detection(tmp_path):
+    db = str(tmp_path / "audit.db")
+    log = AuditLog(db, b"key", flush_interval=0.05)
+    log.start()
+    for i in range(5):
+        log.log(_rec(i))
+    await asyncio.sleep(0.3)
+    await log.stop()
+
+    # Edit a committed record behind the log's back.
+    conn = sqlite3.connect(db)
+    with conn:
+        conn.execute(
+            "UPDATE logs SET record = replace(record, 'Allow', 'Deny')"
+            " WHERE seq = 3")
+    conn.close()
+    tampered = AuditLog(db, b"key")
+    intact, checked = tampered.verify_chain()
+    assert not intact and checked == 2  # chain breaks at the edited row
+    await tampered.stop()
+
+
+async def test_retention_pruning_keeps_chain_valid(tmp_path):
+    db = str(tmp_path / "audit.db")
+    log = AuditLog(db, b"key", flush_interval=0.05, retention_days=1.0)
+    log.start()
+    for i in range(6):
+        log.log(_rec(i))
+    await asyncio.sleep(0.3)
+    # Age the first 3 rows past retention, then force a prune.
+    with log._db:
+        log._db.execute(
+            "UPDATE logs SET ts = ts - 200000 WHERE seq <= 3")
+    log._prune()
+    intact, n = log.verify_chain()
+    assert intact and n == 3  # surviving suffix verifies from the anchor
+    await log.stop()
+
+
+async def test_queue_overflow_drops_counted(tmp_path):
+    log = AuditLog(str(tmp_path / "a.db"), b"key", queue_max=3)
+    for i in range(10):
+        log.log(_rec(i))
+    assert log.dropped_count == 7
+    await log.stop()
+
+
+async def test_reader_cli(tmp_path, capsys):
+    db = str(tmp_path / "audit.db")
+    log = AuditLog(db, b"key", flush_interval=0.05)
+    log.start()
+    log.log(_rec(0, principal="U1"))
+    log.log(_rec(1, principal="U2"))
+    await asyncio.sleep(0.3)
+    await log.stop()
+
+    assert audit_reader.main(["--db", db, "--hmac-key", "key",
+                              "--verify"]) == 0
+    out = capsys.readouterr().out
+    assert '"intact": true' in out
+    audit_reader.main(["--db", db, "--hmac-key", "key", "--principal", "U1"])
+    out = capsys.readouterr().out
+    assert '"U1"' in out and '"U2"' not in out

@@ -1,0 +1,186 @@
+"""Regression tests for the S3 gateway hardening round: reserved-key
+blocklist, atomic PUT-overwrite publish, MPU key binding, STS TLS
+enforcement, input validation, and XML escaping."""
+
+import hashlib
+import json
+
+import pytest
+
+from tests.test_cross_shard import ShardedCluster
+from tests.test_s3_gateway import _gateway, _sign_request, req, AK, SK, IAM
+from tpudfs.auth.credentials import StaticCredentialProvider
+from tpudfs.auth.errors import AuthError
+from tpudfs.auth.policy import PolicyEngine
+from tpudfs.s3.handlers import is_reserved_key
+from tpudfs.s3.middleware import S3Request
+
+
+def test_reserved_key_detection():
+    for key in (".policy", ".bucket", ".s3_mpu/u1/00001", ".s3_tmp/x",
+                ".s3_mpu"):
+        assert is_reserved_key(key), key
+    for key in ("normal.txt", "dir/.policy", ".policyish", "a/.s3_tmp/x",
+                ".bucket2"):
+        assert not is_reserved_key(key), key
+
+
+async def test_reserved_keys_unreachable_via_object_api(tmp_path):
+    """A PutObject-only principal must not be able to inject a bucket
+    policy (or read/delete internal state) through the object routes."""
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b"))
+        evil_policy = json.dumps({"Statement": [
+            {"Effect": "Allow", "Principal": "*", "Action": "s3:*",
+             "Resource": "*"}]}).encode()
+        r = await gw.handle(req("PUT", "/b/.policy", body=evil_policy))
+        assert r.status == 400 and b"reserved" in r.body
+        assert (await gw.handle(req("GET", "/b/.policy"))).status == 400
+        assert (await gw.handle(req("DELETE", "/b/.bucket"))).status == 400
+        assert (await gw.handle(req("GET", "/b/.s3_mpu/x/00001"))).status == 400
+        # …and the policy endpoints themselves still work.
+        r = await gw.handle(req("PUT", "/b", query=[("policy", "")],
+                                body=evil_policy))
+        assert r.status == 204
+        # Nested occurrences are ordinary keys.
+        assert (await gw.handle(
+            req("PUT", "/b/dir/.policy", body=b"ok"))).status == 200
+    finally:
+        await c.stop()
+
+
+async def test_put_overwrite_preserves_old_until_publish(tmp_path):
+    """PUT over an existing object publishes atomically: a failed upload
+    leaves the old object intact and readable."""
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b"))
+        await gw.handle(req("PUT", "/b/o", body=b"version-1"))
+
+        # Inject a failure INTO the publish rename: the temp upload lands but
+        # the swap never happens.
+        original = gw.client.rename_file
+
+        async def broken_rename(src, dst, replace=False):
+            from tpudfs.client.client import DfsError
+            raise DfsError("injected publish failure")
+
+        gw.client.rename_file = broken_rename
+        from tpudfs.client.client import DfsError
+        with pytest.raises(DfsError):
+            await gw.handle(req("PUT", "/b/o", body=b"version-2"))
+        gw.client.rename_file = original
+
+        r = await gw.handle(req("GET", "/b/o"))
+        assert r.status == 200 and r.body == b"version-1"  # old survives
+        # No temp junk visible in listings.
+        body = (await gw.handle(req("GET", "/b"))).body.decode()
+        assert body.count("<Key>") == 1
+
+        # Successful overwrite replaces and frees the old blocks via the
+        # replicated command (no delete-then-create gap).
+        await gw.handle(req("PUT", "/b/o", body=b"version-2"))
+        assert (await gw.handle(req("GET", "/b/o"))).body == b"version-2"
+    finally:
+        await c.stop()
+
+
+async def test_mpu_upload_id_bound_to_key(tmp_path):
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b"))
+        r = await gw.handle(req("POST", "/b/intended.bin",
+                                query=[("uploads", "")]))
+        uid = r.body.decode().split("<UploadId>")[1].split("<")[0]
+        r = await gw.handle(req("PUT", "/b/intended.bin", query=[
+            ("uploadId", uid), ("partNumber", "1")], body=b"data"))
+        etag = r.headers["ETag"]
+        complete = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+                    f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>")
+        # Completing under a DIFFERENT key is rejected.
+        r = await gw.handle(req("POST", "/b/other.bin",
+                                query=[("uploadId", uid)],
+                                body=complete.encode()))
+        assert r.status == 404 and b"NoSuchUpload" in r.body
+        # The intended key completes fine.
+        r = await gw.handle(req("POST", "/b/intended.bin",
+                                query=[("uploadId", uid)],
+                                body=complete.encode()))
+        assert r.status == 200
+    finally:
+        await c.stop()
+
+
+async def test_sts_requires_tls_when_configured(tmp_path):
+    c, gw = await _gateway(tmp_path, auth_enabled=True,
+                           credentials=StaticCredentialProvider({AK: SK}),
+                           policy=PolicyEngine.from_json(IAM),
+                           require_tls=True)
+    try:
+        with pytest.raises(AuthError) as ei:
+            await gw.handle(req("POST", "/", body=b"Action=AssumeRoleWithWebIdentity"))
+        assert "HTTPS" in ei.value.message
+        # Secure request proceeds past the TLS gate (fails later on missing
+        # STS config, not on transport).
+        secure = S3Request(method="POST", path="/", query=[], headers={},
+                           body=b"Action=AssumeRoleWithWebIdentity",
+                           secure=True)
+        with pytest.raises(AuthError) as ei:
+            await gw.handle(secure)
+        assert "STS is not configured" in ei.value.message
+    finally:
+        await c.stop()
+
+
+async def test_bad_numeric_params_are_400(tmp_path):
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b"))
+        r = await gw.handle(req("GET", "/b", query=[("max-keys", "abc")]))
+        assert r.status == 400 and b"InvalidArgument" in r.body
+        r = await gw.handle(req("PUT", "/b/k", query=[
+            ("uploadId", "u"), ("partNumber", "abc")], body=b"x"))
+        assert r.status == 400 and b"InvalidArgument" in r.body
+    finally:
+        await c.stop()
+
+
+async def test_error_xml_escapes_special_chars(tmp_path):
+    import xml.etree.ElementTree as ET
+
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b"))
+        r = await gw.handle(req("GET", "/b/a&b<c>.txt"))
+        assert r.status == 404
+        root = ET.fromstring(r.body)  # parses iff properly escaped
+        assert root.find("Code").text == "NoSuchKey"
+        assert "a&b<c>.txt" in root.find("Resource").text
+    finally:
+        await c.stop()
+
+
+async def test_cross_shard_replace_rename(tmp_path):
+    """replace-mode rename across shards: existing destination atomically
+    swapped via the 2PC path (the gateway publish when temp and final keys
+    land on different shards)."""
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        await c.client.create_file("/z/dst", b"old")
+        await c.client.create_file("/a/src", b"new")
+        src_m, dst_m = c.master_of("/a/src"), c.master_of("/z/dst")
+        assert src_m is not dst_m
+        # Non-replace still refuses.
+        from tpudfs.client.client import DfsError
+        with pytest.raises(DfsError):
+            await c.client.rename_file("/a/src", "/z/dst")
+        await c.client.rename_file("/a/src", "/z/dst", replace=True)
+        assert await c.client.get_file("/z/dst") == b"new"
+        assert "/a/src" not in src_m.state.files
+        # The refused non-replace attempt left an aborted record; the
+        # replace rename committed.
+        states = sorted(t["state"] for t in src_m.state.transactions.values())
+        assert "committed" in states and "prepared" not in states
+    finally:
+        await c.stop()

@@ -194,12 +194,18 @@ class Client:
     # ------------------------------------------------------------ write path
 
     async def create_file(self, path: str, data: bytes,
-                          ec: tuple[int, int] | None = None) -> None:
+                          ec: tuple[int, int] | None = None,
+                          etag: str | None = None,
+                          overwrite: bool = False) -> None:
         """Write ``data`` to ``path`` (reference create_file_from_buffer
-        mod.rs:225-494; EC variant mod.rs:496-677)."""
+        mod.rs:225-494; EC variant mod.rs:496-677). ``etag`` overrides the
+        stored ETag (the S3 gateway stores plaintext/multipart ETags that
+        differ from the md5 of the stored bytes); ``overwrite`` atomically
+        replaces an existing file in the CreateFile command itself."""
         k, m = ec or (0, 0)
         _, master = await self._execute("CreateFile", {
             "path": path, "ec_data_shards": k, "ec_parity_shards": m,
+            "overwrite": overwrite,
         }, path=path, retry_benign=("ALREADY_EXISTS",))
         # Stick to the creating master for read-your-writes (mod.rs:256-266).
         sticky = [master] + [a for a in self._masters_for(path) if a != master]
@@ -236,7 +242,8 @@ class Client:
         await self._execute("CompleteFile", {
             "path": path,
             "size": len(data),
-            "etag_md5": hashlib.md5(data).hexdigest(),
+            "etag_md5": etag if etag is not None
+            else hashlib.md5(data).hexdigest(),
             "block_checksums": block_checksums,
         }, masters=sticky)
 
@@ -454,30 +461,48 @@ class Client:
         await self._execute("DeleteFile", {"path": path}, path=path,
                             retry_benign=("NOT_FOUND",))
 
-    async def rename_file(self, src: str, dst: str) -> None:
-        await self._execute("Rename", {"src": src, "dst": dst}, path=src,
+    async def rename_file(self, src: str, dst: str,
+                          replace: bool = False) -> None:
+        """``replace=True`` atomically swaps out an existing destination
+        (the S3 gateway's PUT-overwrite publish step)."""
+        await self._execute("Rename", {"src": src, "dst": dst,
+                                       "replace": replace}, path=src,
                             retry_benign=("NOT_FOUND",))
 
     async def list_files(self, prefix: str = "") -> list[str]:
         """Per-shard fan-out union (reference mod.rs:125-200)."""
+        return [p for p, _ in await self.list_files_with_meta(prefix, meta=False)]
+
+    async def list_files_with_meta(
+        self, prefix: str = "", *, meta: bool = True,
+        basename: str | None = None,
+    ) -> list[tuple[str, dict | None]]:
+        """Listing with per-key metadata for the S3 gateway's ListObjects
+        (Size/ETag/LastModified without per-key GetFileInfo round trips).
+        ``basename`` filters server-side to paths ending in that segment."""
+        req = {"path": prefix, "with_meta": meta, "basename": basename}
         if self.shard_map is None and self.config_addrs:
             await self.refresh_shard_map()
+        out: dict[str, dict | None] = {}
+
+        def merge(resp: dict) -> None:
+            metas = resp.get("metas") or [None] * len(resp["files"])
+            out.update(zip(resp["files"], metas))
+
         if self.shard_map is None:
-            resp, _ = await self._execute("ListFiles", {"path": prefix})
-            return list(resp["files"])
-        out: set[str] = set()
+            resp, _ = await self._execute("ListFiles", req)
+            merge(resp)
+            return sorted(out.items())
         for shard in self.shard_map.get_all_shards():
             peers = self.shard_map.get_peers(shard) or []
             if not peers:
                 continue
             try:
-                resp, _ = await self._execute(
-                    "ListFiles", {"path": prefix}, masters=peers
-                )
-                out.update(resp["files"])
+                resp, _ = await self._execute("ListFiles", req, masters=peers)
+                merge(resp)
             except DfsError as e:
                 logger.warning("list on shard %s failed: %s", shard, e)
-        return sorted(out)
+        return sorted(out.items())
 
     # ------------------------------------------------------------ admin ops
 

@@ -335,6 +335,7 @@ class Master:
             "ec_data_shards": int(req.get("ec_data_shards") or 0),
             "ec_parity_shards": int(req.get("ec_parity_shards") or 0),
             "created_at_ms": now_ms(),
+            "overwrite": bool(req.get("overwrite")),
         })
         return {"success": True}
 
@@ -441,21 +442,39 @@ class Master:
                                "using cached map", e.message)
         self._check_shard_ownership(src)
         self._check_tx_lock(src, dst)
+        replace = bool(req.get("replace"))
         dest_shard = self._owner_shard(dst)
         if dest_shard is None or dest_shard == self.state.shard_id:
-            await self._propose({"op": "rename_file", "src": src, "dst": dst})
+            await self._propose({"op": "rename_file", "src": src, "dst": dst,
+                                 "replace": replace})
             return {"success": True}
-        await self.tx.run_cross_shard_rename(src, dst, dest_shard)
+        await self.tx.run_cross_shard_rename(src, dst, dest_shard,
+                                             replace=replace)
         return {"success": True, "cross_shard": True}
 
     async def rpc_list_files(self, req: dict) -> dict:
         await self._linearizable_read()
         prefix = req.get("path", "")
-        files = sorted(
-            p for p, f in self.state.files.items()
+        # basename narrows to paths whose final segment matches exactly —
+        # lets the S3 gateway discover bucket markers without shipping the
+        # whole namespace (ListAllMyBuckets would otherwise be O(all files)).
+        basename = req.get("basename")
+        entries = sorted(
+            (p, f) for p, f in self.state.files.items()
             if f.complete and p.startswith(prefix)
+            and (basename is None or p.rsplit("/", 1)[-1] == basename)
         )
-        return {"files": files}
+        resp = {"files": [p for p, _ in entries]}
+        if req.get("with_meta"):
+            # S3 ListObjects needs Size/ETag/LastModified per key without a
+            # GetFileInfo round trip each (reference ListObjects handlers.rs
+            # walk per-shard metadata the same way).
+            resp["metas"] = [
+                {"size": f.size, "etag_md5": f.etag_md5,
+                 "created_at_ms": f.created_at_ms}
+                for _, f in entries
+            ]
+        return resp
 
     async def rpc_get_block_locations(self, req: dict) -> dict:
         # Linearizable by default; chunkserver recovery passes allow_stale

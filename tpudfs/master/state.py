@@ -235,7 +235,16 @@ class MasterState:
         path = cmd["path"]
         existing = self.files.get(path)
         if existing is not None and existing.complete:
-            raise ValueError(f"file already exists: {path}")
+            if not cmd.get("overwrite"):
+                raise ValueError(f"file already exists: {path}")
+            # Atomic S3-style overwrite: replace the metadata and queue the
+            # old blocks for deletion in ONE replicated command — no
+            # delete-then-create window where the object doesn't exist.
+            for b in existing.blocks:
+                for loc in b.locations:
+                    self.queue_command(
+                        loc, {"type": "DELETE", "block_id": b.block_id}
+                    )
         self.files[path] = FileMetadata(
             path=path,
             created_at_ms=int(cmd.get("created_at_ms") or 0),
@@ -295,8 +304,18 @@ class MasterState:
         f = self.files.get(src)
         if f is None or not f.complete:
             raise ValueError(f"file not found: {src}")
-        if dst in self.files and self.files[dst].complete:
-            raise ValueError(f"destination exists: {dst}")
+        existing = self.files.get(dst)
+        if existing is not None and existing.complete:
+            if not cmd.get("replace"):
+                raise ValueError(f"destination exists: {dst}")
+            # Atomic publish (S3 PUT overwrite): swap in the new metadata
+            # and queue the replaced object's blocks for deletion in the
+            # same replicated command — readers see old-or-new, never a gap.
+            for b in existing.blocks:
+                for loc in b.locations:
+                    self.queue_command(
+                        loc, {"type": "DELETE", "block_id": b.block_id}
+                    )
         self.files.pop(src)
         f.path = dst
         self.files[dst] = f
@@ -363,10 +382,11 @@ class MasterState:
             )
         for op in tx.get("operations", []):
             if op["kind"] == "create" and not tx.get("coordinator") \
-                    and op["path"] in self.files:
+                    and op["path"] in self.files and not op.get("replace"):
                 # ANY metadata blocks a participant create — an in-flight
                 # upload (complete=False) would otherwise be clobbered at
-                # commit with its allocated blocks orphaned.
+                # commit with its allocated blocks orphaned. A replace-mode
+                # rename (S3 PUT overwrite) explicitly allows it.
                 raise ValueError(f"destination exists: {op['path']}")
             if op["kind"] == "delete" and tx.get("coordinator") \
                     and op["path"] not in self.files:
@@ -385,6 +405,15 @@ class MasterState:
     def _apply_tx_apply_op(self, cmd: dict):
         op = cmd["operation"]
         if op["kind"] == "create":
+            replaced = self.files.get(op["path"])
+            if replaced is not None and replaced.complete:
+                # replace-mode cross-shard rename: free the old object's
+                # blocks as part of the committed create.
+                for b in replaced.blocks:
+                    for loc in b.locations:
+                        self.queue_command(
+                            loc, {"type": "DELETE", "block_id": b.block_id}
+                        )
             meta = FileMetadata.from_dict(op["metadata"])
             meta.path = op["path"]
             self.files[op["path"]] = meta

@@ -44,9 +44,11 @@ class TransactionManager:
     # ------------------------------------------------------------ coordinator
 
     async def run_cross_shard_rename(self, src: str, dst: str,
-                                     dest_shard: str) -> None:
+                                     dest_shard: str,
+                                     replace: bool = False) -> None:
         """Coordinator flow (reference master.rs:2809-3021, call stack
-        SURVEY.md §3.4)."""
+        SURVEY.md §3.4). ``replace`` allows an existing destination to be
+        atomically swapped out (S3 PUT-overwrite publish)."""
         m = self.m
         meta = m.state.files.get(src)
         if meta is None or not meta.complete:
@@ -54,7 +56,8 @@ class TransactionManager:
         txid = f"tx-{uuid.uuid4().hex}"
         at = now_ms()
         operations = [
-            {"kind": "create", "path": dst, "metadata": meta.to_dict()},
+            {"kind": "create", "path": dst, "metadata": meta.to_dict(),
+             "replace": replace},
             {"kind": "delete", "path": src},
         ]
         # 1-2. Local quorum: record the tx, advance to Prepared.
@@ -154,7 +157,8 @@ class TransactionManager:
         # re-run inside the replicated _apply_tx_create.
         m._check_tx_lock(*(op["path"] for op in req["operations"]))
         for op in req["operations"]:
-            if op["kind"] == "create" and m.state.files.get(op["path"]) is not None:
+            if op["kind"] == "create" and not op.get("replace") \
+                    and m.state.files.get(op["path"]) is not None:
                 # ANY metadata — including an in-flight incomplete upload —
                 # blocks the prepare, else commit clobbers it.
                 raise RpcError.already_exists(

@@ -1,0 +1,10 @@
+"""S3-compatible REST gateway over the DFS client (SURVEY.md §2.5,
+reference dfs/s3_server/).
+
+aiohttp front (the reference uses axum) exposing the S3 REST surface —
+bucket/object CRUD, ListObjects v1/v2, multipart upload, CopyObject,
+DeleteObjects, Range reads, presigned URLs, bucket policies — backed by
+:class:`tpudfs.client.client.Client`, with the full auth pipeline from
+:mod:`tpudfs.auth` (SigV4, OIDC/STS, IAM + bucket policy, SSE-S3) and a
+hash-chained audit log.
+"""

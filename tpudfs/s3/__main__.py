@@ -1,0 +1,3 @@
+from tpudfs.s3.server import main
+
+main()

@@ -1,0 +1,196 @@
+"""Tamper-evident audit log (reference s3_server/audit.rs).
+
+The reference logs to RocksDB (Zstd) with column families ``logs`` /
+``idx_user`` / ``idx_resource``, a batched single-writer task with a 5 s
+flush, and an HMAC-SHA256 hash chain recovered across restarts
+(audit.rs:15-120). Here the store is stdlib sqlite (one table + two indexes
+play the CF roles); everything else is kept:
+
+- **single writer, batched**: records go through an asyncio queue; a flusher
+  task commits batches every ``flush_interval`` or ``batch_size`` records.
+- **hash chain**: ``chain[n] = HMAC(key, chain[n-1] || record_json)``. The
+  chain tip is re-read from the last row on restart so tampering with any
+  committed row (or deleting one mid-chain) breaks verification.
+- **bounded queue**: when the queue is full, records are DROPPED and counted
+  (``dropped_count``) rather than stalling the request path (audit.rs:20-40).
+- **TTL retention**: rows older than ``retention_days`` are pruned; pruning
+  advances a persisted ``chain_anchor`` so verification still passes for the
+  surviving suffix.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import logging
+import sqlite3
+import time
+
+from tpudfs.auth.audit import AuditRecord
+
+logger = logging.getLogger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS logs (
+    seq INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts REAL NOT NULL,
+    principal TEXT NOT NULL,
+    resource TEXT NOT NULL,
+    record TEXT NOT NULL,
+    chain_hash BLOB NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_user ON logs (principal, seq);
+CREATE INDEX IF NOT EXISTS idx_resource ON logs (resource, seq);
+CREATE TABLE IF NOT EXISTS meta (key TEXT PRIMARY KEY, value BLOB);
+"""
+
+
+def _chain(key: bytes, prev: bytes, record_json: str) -> bytes:
+    return hmac.new(key, prev + record_json.encode("utf-8"), hashlib.sha256).digest()
+
+
+GENESIS = b"\x00" * 32
+
+
+class AuditLog:
+    def __init__(self, db_path: str, hmac_key: bytes, *,
+                 flush_interval: float = 5.0, batch_size: int = 256,
+                 queue_max: int = 10_000, retention_days: float = 90.0):
+        self._db = sqlite3.connect(db_path)
+        self._db.executescript(_SCHEMA)
+        self._key = hmac_key
+        self._flush_interval = flush_interval
+        self._batch_size = batch_size
+        self._retention_s = retention_days * 86400
+        self._queue: asyncio.Queue[AuditRecord] = asyncio.Queue(maxsize=queue_max)
+        self._tip = self._recover_tip()
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        self.dropped_count = 0
+        self.flush_error_count = 0
+        self.written_count = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _recover_tip(self) -> bytes:
+        """Resume the hash chain from the last committed row
+        (reference audit.rs:79-120)."""
+        row = self._db.execute(
+            "SELECT chain_hash FROM logs ORDER BY seq DESC LIMIT 1"
+        ).fetchone()
+        if row is not None:
+            return bytes(row[0])
+        anchor = self._db.execute(
+            "SELECT value FROM meta WHERE key='chain_anchor'"
+        ).fetchone()
+        return bytes(anchor[0]) if anchor else GENESIS
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run_flusher())
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        self._flush_pending()
+        self._db.close()
+
+    # --------------------------------------------------------------- logging
+
+    def log(self, record: AuditRecord) -> None:
+        """Non-blocking enqueue; drops (and counts) when the queue is full."""
+        if self._closed:
+            return
+        try:
+            self._queue.put_nowait(record)
+        except asyncio.QueueFull:
+            self.dropped_count += 1
+
+    async def _run_flusher(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self._flush_interval)
+                self._flush_pending()
+                self._prune()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.flush_error_count += 1
+                logger.exception("audit flush failed")
+
+    def _flush_pending(self) -> None:
+        batch: list[AuditRecord] = []
+        while not self._queue.empty() and len(batch) < self._batch_size * 4:
+            batch.append(self._queue.get_nowait())
+        if not batch:
+            return
+        rows = []
+        tip = self._tip
+        for rec in batch:
+            payload = rec.to_json()
+            tip = _chain(self._key, tip, payload)
+            rows.append((rec.timestamp, rec.principal, rec.resource, payload, tip))
+        with self._db:
+            self._db.executemany(
+                "INSERT INTO logs (ts, principal, resource, record, chain_hash)"
+                " VALUES (?, ?, ?, ?, ?)", rows,
+            )
+        self._tip = tip
+        self.written_count += len(rows)
+
+    def _prune(self) -> None:
+        cutoff = time.time() - self._retention_s
+        row = self._db.execute(
+            "SELECT seq, chain_hash FROM logs WHERE ts < ? ORDER BY seq DESC LIMIT 1",
+            (cutoff,),
+        ).fetchone()
+        if row is None:
+            return
+        last_pruned_seq, anchor = row
+        with self._db:
+            self._db.execute("DELETE FROM logs WHERE seq <= ?", (last_pruned_seq,))
+            self._db.execute(
+                "INSERT OR REPLACE INTO meta (key, value) VALUES ('chain_anchor', ?)",
+                (bytes(anchor),),
+            )
+
+    # --------------------------------------------------------------- reading
+
+    def query(self, *, principal: str | None = None, resource: str | None = None,
+              since: float | None = None, limit: int = 1000) -> list[AuditRecord]:
+        sql = "SELECT record FROM logs WHERE 1=1"
+        args: list = []
+        if principal is not None:
+            sql += " AND principal = ?"
+            args.append(principal)
+        if resource is not None:
+            sql += " AND resource LIKE ?"
+            args.append(resource + "%")
+        if since is not None:
+            sql += " AND ts >= ?"
+            args.append(since)
+        sql += " ORDER BY seq LIMIT ?"
+        args.append(limit)
+        return [AuditRecord.from_json(r[0]) for r in self._db.execute(sql, args)]
+
+    def verify_chain(self) -> tuple[bool, int]:
+        """Re-walk the chain from the anchor; returns (intact, rows_checked).
+        Any edited/deleted/reordered committed row breaks the HMAC chain."""
+        anchor_row = self._db.execute(
+            "SELECT value FROM meta WHERE key='chain_anchor'"
+        ).fetchone()
+        tip = bytes(anchor_row[0]) if anchor_row else GENESIS
+        n = 0
+        for record_json, chain_hash in self._db.execute(
+            "SELECT record, chain_hash FROM logs ORDER BY seq"
+        ):
+            tip = _chain(self._key, tip, record_json)
+            if not hmac.compare_digest(tip, bytes(chain_hash)):
+                return False, n
+            n += 1
+        return True, n

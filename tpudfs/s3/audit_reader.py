@@ -1,0 +1,44 @@
+"""Audit reader CLI (reference s3_server/src/bin/audit_reader.rs):
+query/filter/verify the hash-chained audit log.
+
+Usage::
+
+    python -m tpudfs.s3.audit_reader --db audit.db [--hmac-key K] \
+        [--principal AK] [--resource arn:...] [--since EPOCH] [--verify]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import asdict
+
+from tpudfs.s3.audit import AuditLog
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="tpudfs audit log reader")
+    p.add_argument("--db", required=True)
+    p.add_argument("--hmac-key", default="tpudfs-audit")
+    p.add_argument("--principal")
+    p.add_argument("--resource")
+    p.add_argument("--since", type=float)
+    p.add_argument("--limit", type=int, default=1000)
+    p.add_argument("--verify", action="store_true",
+                   help="verify the tamper-evidence hash chain")
+    args = p.parse_args(argv)
+
+    log = AuditLog(args.db, args.hmac_key.encode())
+    if args.verify:
+        intact, n = log.verify_chain()
+        print(json.dumps({"intact": intact, "records_checked": n}))
+        return 0 if intact else 1
+    for rec in log.query(principal=args.principal, resource=args.resource,
+                         since=args.since, limit=args.limit):
+        print(json.dumps(asdict(rec)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,535 @@
+"""S3 REST handlers over the DFS client (reference s3_server/handlers.rs).
+
+Mapping (reference handlers.rs:158-161, 667-721):
+- bucket = top-level DFS directory, existence tracked by a ``/{bucket}/.bucket``
+  marker object;
+- object ``s3://bucket/key`` = DFS path ``/{bucket}/{key}``;
+- bucket policy stored at ``/{bucket}/.policy``;
+- multipart parts at ``/{bucket}/.s3_mpu/{upload_id}/{part:05d}`` (ETags ride
+  the part files' own metadata, replacing the reference's ``.etag`` sidecars).
+
+Handlers are framework-agnostic (return :class:`S3Response`); the aiohttp
+server adapts. SSE-S3, Range reads, ListObjects v1/v2, CopyObject,
+DeleteObjects, and the AWS multipart ``md5(md5(p1)..pN)-N`` ETag
+(handlers.rs:234-447) are implemented; hidden internal keys never appear in
+listings.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import uuid
+from dataclasses import dataclass, field
+from xml.sax.saxutils import escape
+
+from tpudfs.auth.bucket_policy import BucketPolicy
+from tpudfs.auth.sse import SseEngine, SseError
+from tpudfs.client.client import Client, DfsError
+from tpudfs.s3 import xml_types as xt
+
+BUCKET_MARKER = ".bucket"
+POLICY_KEY = ".policy"
+MPU_PREFIX = ".s3_mpu/"
+TMP_PREFIX = ".s3_tmp/"
+#: Internal key namespaces: filtered from listings AND blocked from the
+#: object API — otherwise a PutObject-only principal could write
+#: /{bucket}/.policy and grant itself the bucket (privilege escalation via
+#: policy injection).
+RESERVED_SEGMENTS = frozenset({BUCKET_MARKER, POLICY_KEY,
+                               MPU_PREFIX.rstrip("/"), TMP_PREFIX.rstrip("/")})
+SSE_OVERHEAD = 4 + 12 + 48 + 12 + 16  # SSE1 envelope framing (sse.py layout)
+XML = "application/xml"
+
+
+def is_reserved_key(key: str) -> bool:
+    """True when the key's first segment is an internal namespace."""
+    return key.split("/", 1)[0] in RESERVED_SEGMENTS
+
+
+@dataclass
+class S3Response:
+    status: int = 200
+    body: bytes = b""
+    headers: dict[str, str] = field(default_factory=dict)
+    content_type: str = XML
+
+
+def _err(code: str, message: str, status: int, resource: str = "") -> S3Response:
+    body = (
+        '<?xml version="1.0" encoding="UTF-8"?>\n<Error>'
+        f"<Code>{escape(code)}</Code><Message>{escape(message)}</Message>"
+        f"<Resource>{escape(resource)}</Resource></Error>"
+    ).encode()
+    return S3Response(status=status, body=body)
+
+
+def no_such_bucket(bucket: str) -> S3Response:
+    return _err("NoSuchBucket", "The specified bucket does not exist", 404, bucket)
+
+
+def no_such_key(key: str) -> S3Response:
+    return _err("NoSuchKey", "The specified key does not exist.", 404, key)
+
+
+class S3Handlers:
+    def __init__(self, client: Client, *, sse: SseEngine | None = None,
+                 owner: str = "tpudfs"):
+        self.client = client
+        self.sse = sse
+        self.owner = owner
+        self._policy_cache: dict[str, BucketPolicy | None] = {}
+
+    # ------------------------------------------------------------- helpers
+
+    @staticmethod
+    def obj_path(bucket: str, key: str) -> str:
+        return f"/{bucket}/{key}"
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        info = await self.client.get_file_info(f"/{bucket}/{BUCKET_MARKER}")
+        return info is not None
+
+    def _plain_size(self, meta: dict) -> int:
+        """Content-Length accounting for the fixed SSE envelope overhead."""
+        size = int(meta.get("size") or 0)
+        if self.sse is not None and size >= SSE_OVERHEAD:
+            return size - SSE_OVERHEAD
+        return size
+
+    # ------------------------------------------------------------- buckets
+
+    async def list_buckets(self) -> S3Response:
+        # basename filter: the masters ship only the bucket markers, not the
+        # whole namespace (ListAllMyBuckets stays O(#buckets)).
+        entries = await self.client.list_files_with_meta(
+            "/", basename=BUCKET_MARKER
+        )
+        buckets: dict[str, int] = {}
+        for path, meta in entries:
+            parts = path.strip("/").split("/", 1)
+            if len(parts) == 2 and parts[1] == BUCKET_MARKER:
+                buckets[parts[0]] = int((meta or {}).get("created_at_ms") or 0)
+        doc = xt.list_buckets(self.owner, [
+            {"name": name, "created": xt.iso8601(ms)}
+            for name, ms in sorted(buckets.items())
+        ])
+        return S3Response(body=doc.encode())
+
+    async def create_bucket(self, bucket: str) -> S3Response:
+        await self.client.create_file(f"/{bucket}/{BUCKET_MARKER}", b"")
+        return S3Response(headers={"Location": f"/{bucket}"})
+
+    async def head_bucket(self, bucket: str) -> S3Response:
+        if not await self.bucket_exists(bucket):
+            return S3Response(status=404)
+        return S3Response()
+
+    async def delete_bucket(self, bucket: str) -> S3Response:
+        if not await self.bucket_exists(bucket):
+            return no_such_bucket(bucket)
+        keys = await self._bucket_keys(bucket)
+        if keys:
+            return _err("BucketNotEmpty",
+                        "The bucket you tried to delete is not empty", 409, bucket)
+        # Sweep internal files (policy, temp orphans, stray MPU parts) before
+        # dropping the marker so nothing leaks under a dead bucket.
+        for path in await self.client.list_files(f"/{bucket}/"):
+            try:
+                await self.client.delete_file(path)
+            except DfsError:
+                pass
+        self._policy_cache.pop(bucket, None)
+        return S3Response(status=204)
+
+    async def get_bucket_location(self) -> S3Response:
+        return S3Response(body=xt.location_constraint().encode())
+
+    async def _bucket_keys(self, bucket: str,
+                           prefix: str = "") -> list[tuple[str, dict | None]]:
+        """Visible (key, meta) pairs under a bucket, hidden keys filtered."""
+        root = f"/{bucket}/"
+        entries = await self.client.list_files_with_meta(root + prefix)
+        out = []
+        for path, meta in entries:
+            key = path[len(root):]
+            if is_reserved_key(key):
+                continue
+            out.append((key, meta))
+        return out
+
+    # ------------------------------------------------------------ listings
+
+    async def list_objects(self, bucket: str, q: dict[str, str]) -> S3Response:
+        if not await self.bucket_exists(bucket):
+            return no_such_bucket(bucket)
+        v2 = q.get("list-type") == "2"
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        try:
+            max_keys = max(0, min(int(q.get("max-keys", "1000") or 1000), 1000))
+        except ValueError:
+            return _err("InvalidArgument", "max-keys must be an integer", 400)
+        if v2:
+            token = q.get("continuation-token", "")
+            after = _decode_token(token) if token else q.get("start-after", "")
+        else:
+            after = q.get("marker", "")
+
+        entries = await self._bucket_keys(bucket, prefix)
+        objects: list[dict] = []
+        prefixes: list[str] = []
+        seen_prefixes: set[str] = set()
+        truncated = False
+        last_emitted = ""
+        for key, meta in entries:
+            if delimiter:
+                rest = key[len(prefix):]
+                cut = rest.find(delimiter)
+                if cut >= 0:
+                    common = prefix + rest[: cut + len(delimiter)]
+                    if common <= after or common in seen_prefixes:
+                        continue
+                    if len(objects) + len(seen_prefixes) >= max_keys:
+                        truncated = True
+                        break
+                    seen_prefixes.add(common)
+                    prefixes.append(common)
+                    last_emitted = common
+                    continue
+            if key <= after:
+                continue
+            if len(objects) + len(seen_prefixes) >= max_keys:
+                truncated = True
+                break
+            objects.append({
+                "key": key,
+                "last_modified": xt.iso8601(int((meta or {}).get("created_at_ms") or 0)),
+                "etag": (meta or {}).get("etag_md5", ""),
+                "size": self._plain_size(meta or {}),
+            })
+            last_emitted = key
+        if v2:
+            doc = xt.list_objects_v2(
+                bucket, prefix, delimiter, max_keys, truncated, objects,
+                prefixes,
+                continuation_token=q.get("continuation-token", ""),
+                next_continuation_token=_encode_token(last_emitted) if truncated else "",
+                start_after=q.get("start-after", ""),
+            )
+        else:
+            doc = xt.list_objects_v1(
+                bucket, prefix, q.get("marker", ""), delimiter, max_keys,
+                truncated, objects, prefixes, next_marker=last_emitted,
+            )
+        return S3Response(body=doc.encode())
+
+    # ------------------------------------------------------------- objects
+
+    async def _publish(self, bucket: str, path: str, body: bytes,
+                       etag: str | None) -> None:
+        """Atomic S3 PUT semantics: upload to a hidden temp key, then
+        replace-rename into place in one replicated command. The old object
+        stays readable during the upload and survives an upload failure; a
+        crash leaves only a temp orphan."""
+        tmp = f"/{bucket}/{TMP_PREFIX}{uuid.uuid4().hex}"
+        await self.client.create_file(tmp, body, etag=etag)
+        try:
+            await self.client.rename_file(tmp, path, replace=True)
+        except DfsError:
+            try:
+                await self.client.delete_file(tmp)
+            except DfsError:
+                pass
+            raise
+
+    async def put_object(self, bucket: str, key: str, body: bytes) -> S3Response:
+        if not await self.bucket_exists(bucket):
+            return no_such_bucket(bucket)
+        etag = hashlib.md5(body).hexdigest()
+        if self.sse is not None:
+            body = self.sse.encrypt(body)
+        await self._publish(bucket, self.obj_path(bucket, key), body, etag)
+        headers = {"ETag": f'"{etag}"'}
+        if self.sse is not None:
+            headers["x-amz-server-side-encryption"] = "AES256"
+        return S3Response(headers=headers)
+
+    async def get_object(self, bucket: str, key: str,
+                         range_header: str = "") -> S3Response:
+        path = self.obj_path(bucket, key)
+        meta = await self.client.get_file_info(path)
+        if meta is None:
+            return no_such_key(key)
+        etag = meta.get("etag_md5", "")
+        base_headers = {
+            "ETag": f'"{etag}"',
+            "Last-Modified": xt.iso8601(int(meta.get("created_at_ms") or 0)),
+            "Accept-Ranges": "bytes",
+        }
+        total = self._plain_size(meta)
+        rng = _parse_range(range_header, total)
+        if self.sse is None and rng is not None:
+            # Non-encrypted Range rides read_file_range → 206 without
+            # fetching the full object (reference handlers.rs:1181-1272).
+            start, end = rng
+            data = await self.client.read_file_range(path, start, end - start + 1)
+            base_headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+            return S3Response(status=206, body=data, headers=base_headers,
+                              content_type="application/octet-stream")
+        data = await self.client.get_file(path)
+        if self.sse is not None:
+            try:
+                data = self.sse.decrypt(data)
+            except SseError:
+                return _err("InternalError", "SSE decryption failed", 500, key)
+            base_headers["x-amz-server-side-encryption"] = "AES256"
+        if rng is not None:
+            start, end = rng
+            base_headers["Content-Range"] = f"bytes {start}-{end}/{len(data)}"
+            return S3Response(status=206, body=data[start:end + 1],
+                              headers=base_headers,
+                              content_type="application/octet-stream")
+        return S3Response(body=data, headers=base_headers,
+                          content_type="application/octet-stream")
+
+    async def head_object(self, bucket: str, key: str) -> S3Response:
+        meta = await self.client.get_file_info(self.obj_path(bucket, key))
+        if meta is None:
+            return S3Response(status=404)
+        headers = {
+            "ETag": f'"{meta.get("etag_md5", "")}"',
+            "Content-Length": str(self._plain_size(meta)),
+            "Last-Modified": xt.iso8601(int(meta.get("created_at_ms") or 0)),
+            "Accept-Ranges": "bytes",
+        }
+        return S3Response(headers=headers)
+
+    async def delete_object(self, bucket: str, key: str) -> S3Response:
+        try:
+            await self.client.delete_file(self.obj_path(bucket, key))
+        except DfsError:
+            pass  # S3 delete is idempotent: 204 either way
+        return S3Response(status=204)
+
+    async def delete_objects(self, bucket: str, body: bytes) -> S3Response:
+        try:
+            keys, quiet = xt.parse_delete_objects(body)
+        except Exception:
+            return _err("MalformedXML", "could not parse DeleteObjects body", 400)
+        deleted, errors = [], []
+        for key in keys:
+            try:
+                await self.client.delete_file(self.obj_path(bucket, key))
+                deleted.append(key)
+            except DfsError as e:
+                if "not found" in str(e):
+                    deleted.append(key)  # idempotent
+                else:
+                    errors.append((key, "InternalError", str(e)))
+        return S3Response(body=xt.delete_result(deleted, errors, quiet).encode())
+
+    async def copy_object(self, bucket: str, key: str,
+                          copy_source: str) -> S3Response:
+        src = copy_source.lstrip("/")
+        if "/" not in src:
+            return _err("InvalidArgument", "bad x-amz-copy-source", 400)
+        src_bucket, src_key = src.split("/", 1)
+        src_meta = await self.client.get_file_info(self.obj_path(src_bucket, src_key))
+        if src_meta is None:
+            return no_such_key(src_key)
+        data = await self.client.get_file(self.obj_path(src_bucket, src_key))
+        if self.sse is not None:
+            try:
+                data = self.sse.decrypt(data)
+            except SseError:
+                return _err("InternalError", "SSE decryption failed", 500, src_key)
+        resp = await self.put_object(bucket, key, data)
+        if resp.status != 200:
+            return resp
+        etag = resp.headers.get("ETag", "").strip('"')
+        return S3Response(body=xt.copy_object_result(
+            etag, xt.iso8601(int(src_meta.get("created_at_ms") or 0))
+        ).encode())
+
+    # ----------------------------------------------------------- multipart
+
+    @staticmethod
+    def _part_path(bucket: str, upload_id: str, part_number: int) -> str:
+        return f"/{bucket}/{MPU_PREFIX}{upload_id}/{part_number:05d}"
+
+    async def initiate_multipart(self, bucket: str, key: str) -> S3Response:
+        if not await self.bucket_exists(bucket):
+            return no_such_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        # Record the target key so complete doesn't trust the client's path.
+        await self.client.create_file(
+            f"/{bucket}/{MPU_PREFIX}{upload_id}/key", key.encode()
+        )
+        return S3Response(body=xt.initiate_multipart_upload(
+            bucket, key, upload_id
+        ).encode())
+
+    async def upload_part(self, bucket: str, upload_id: str,
+                          part_number: int, body: bytes) -> S3Response:
+        if not 1 <= part_number <= 10_000:
+            return _err("InvalidArgument", "partNumber out of range", 400)
+        if await self.client.get_file_info(
+            f"/{bucket}/{MPU_PREFIX}{upload_id}/key"
+        ) is None:
+            return _err("NoSuchUpload", "upload does not exist", 404)
+        etag = hashlib.md5(body).hexdigest()
+        path = self._part_path(bucket, upload_id, part_number)
+        await self.client.create_file(path, body, etag=etag, overwrite=True)
+        return S3Response(headers={"ETag": f'"{etag}"'})
+
+    async def list_parts(self, bucket: str, key: str,
+                         upload_id: str) -> S3Response:
+        entries = await self.client.list_files_with_meta(
+            f"/{bucket}/{MPU_PREFIX}{upload_id}/"
+        )
+        parts = []
+        for path, meta in entries:
+            name = path.rsplit("/", 1)[1]
+            if not name.isdigit():
+                continue
+            parts.append({
+                "part_number": int(name),
+                "etag": (meta or {}).get("etag_md5", ""),
+                "size": int((meta or {}).get("size") or 0),
+                "last_modified": xt.iso8601(int((meta or {}).get("created_at_ms") or 0)),
+            })
+        return S3Response(body=xt.list_parts(bucket, key, upload_id, parts).encode())
+
+    async def complete_multipart(self, bucket: str, key: str, upload_id: str,
+                                 body: bytes) -> S3Response:
+        try:
+            requested = xt.parse_complete_multipart_upload(body)
+        except Exception:
+            return _err("MalformedXML", "could not parse CompleteMultipartUpload", 400)
+        if not requested:
+            return _err("InvalidRequest", "no parts in request", 400)
+        try:
+            recorded_key = (await self.client.get_file(
+                f"/{bucket}/{MPU_PREFIX}{upload_id}/key"
+            )).decode("utf-8")
+        except DfsError:
+            return _err("NoSuchUpload", "upload does not exist", 404)
+        if recorded_key != key:
+            # The uploadId is bound to the key it was initiated for.
+            return _err("NoSuchUpload",
+                        "upload was initiated for a different key", 404)
+        chunks: list[bytes] = []
+        digests = b""
+        prev = 0
+        for part_number, claimed_etag in sorted(requested):
+            if part_number <= prev:
+                return _err("InvalidPartOrder", "parts out of order", 400)
+            prev = part_number
+            path = self._part_path(bucket, upload_id, part_number)
+            meta = await self.client.get_file_info(path)
+            if meta is None:
+                return _err("InvalidPart", f"part {part_number} not found", 400)
+            stored_etag = meta.get("etag_md5", "")
+            if claimed_etag and stored_etag and claimed_etag != stored_etag:
+                return _err("InvalidPart", f"part {part_number} ETag mismatch", 400)
+            chunks.append(await self.client.get_file(path))
+            digests += bytes.fromhex(stored_etag)
+        data = b"".join(chunks)
+        # AWS multipart ETag: md5 of the concatenated part digests, -N
+        # (reference handlers.rs:234-447).
+        etag = f"{hashlib.md5(digests).hexdigest()}-{len(requested)}"
+        if self.sse is not None:
+            data = self.sse.encrypt(data)
+        await self._publish(bucket, self.obj_path(bucket, key), data, etag)
+        await self._abort_multipart_files(bucket, upload_id)
+        return S3Response(body=xt.complete_multipart_upload_result(
+            f"/{bucket}/{key}", bucket, key, etag
+        ).encode())
+
+    async def abort_multipart(self, bucket: str, upload_id: str) -> S3Response:
+        await self._abort_multipart_files(bucket, upload_id)
+        return S3Response(status=204)
+
+    async def _abort_multipart_files(self, bucket: str, upload_id: str) -> None:
+        entries = await self.client.list_files(f"/{bucket}/{MPU_PREFIX}{upload_id}/")
+        for path in entries:
+            try:
+                await self.client.delete_file(path)
+            except DfsError:
+                pass
+
+    # -------------------------------------------------------- bucket policy
+
+    async def get_bucket_policy_doc(self, bucket: str) -> BucketPolicy | None:
+        """Cached lookup used by both the ?policy endpoints and the auth
+        middleware (reference evaluates bucket policy in middleware)."""
+        if bucket in self._policy_cache:
+            return self._policy_cache[bucket]
+        try:
+            raw = await self.client.get_file(f"/{bucket}/{POLICY_KEY}")
+            policy = BucketPolicy.from_json(raw)
+        except (DfsError, ValueError):
+            policy = None
+        self._policy_cache[bucket] = policy
+        return policy
+
+    async def get_bucket_policy(self, bucket: str) -> S3Response:
+        policy = await self.get_bucket_policy_doc(bucket)
+        if policy is None:
+            return _err("NoSuchBucketPolicy",
+                        "The bucket policy does not exist", 404, bucket)
+        return S3Response(body=json.dumps(policy.raw).encode(),
+                          content_type="application/json")
+
+    async def put_bucket_policy(self, bucket: str, body: bytes) -> S3Response:
+        if not await self.bucket_exists(bucket):
+            return no_such_bucket(bucket)
+        try:
+            BucketPolicy.from_json(body)
+        except (ValueError, json.JSONDecodeError):
+            return _err("MalformedPolicy", "invalid policy document", 400)
+        await self._publish(bucket, f"/{bucket}/{POLICY_KEY}", body, None)
+        self._policy_cache.pop(bucket, None)
+        return S3Response(status=204)
+
+    async def delete_bucket_policy(self, bucket: str) -> S3Response:
+        try:
+            await self.client.delete_file(f"/{bucket}/{POLICY_KEY}")
+        except DfsError:
+            pass
+        self._policy_cache.pop(bucket, None)
+        return S3Response(status=204)
+
+
+def _parse_range(header: str, total: int) -> tuple[int, int] | None:
+    """``bytes=a-b`` → inclusive (start, end), clamped; None if absent/bad."""
+    if not header.startswith("bytes=") or total <= 0:
+        return None
+    spec = header[len("bytes="):].split(",")[0].strip()
+    start_s, _, end_s = spec.partition("-")
+    try:
+        if start_s == "":          # suffix form: last N bytes
+            n = int(end_s)
+            if n <= 0:
+                return None
+            return max(0, total - n), total - 1
+        start = int(start_s)
+        end = int(end_s) if end_s else total - 1
+    except ValueError:
+        return None
+    if start >= total or start > end:
+        return None
+    return start, min(end, total - 1)
+
+
+def _encode_token(key: str) -> str:
+    return base64.urlsafe_b64encode(key.encode()).decode()
+
+
+def _decode_token(token: str) -> str:
+    try:
+        return base64.urlsafe_b64decode(token.encode()).decode()
+    except Exception:
+        return ""

@@ -1,0 +1,85 @@
+"""Gateway Prometheus metrics (reference s3_server/iam_metrics.rs + the
+request counters in s3_server/main.rs:289-337).
+
+In-process counters/histograms rendered as Prometheus text exposition on
+``/metrics``. No client library dependency — the exposition format is a few
+lines of text.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+
+_LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+
+class Histogram:
+    def __init__(self) -> None:
+        self.bucket_counts = [0] * (len(_LATENCY_BUCKETS) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        for i, bound in enumerate(_LATENCY_BUCKETS):
+            if value <= bound:
+                self.bucket_counts[i] += 1
+                return
+        self.bucket_counts[-1] += 1
+
+    def render(self, name: str, labels: str = "") -> str:
+        out = []
+        cumulative = 0
+        for bound, c in zip(_LATENCY_BUCKETS, self.bucket_counts):
+            cumulative += c
+            sep = "," if labels else ""
+            out.append(f'{name}_bucket{{{labels}{sep}le="{bound}"}} {cumulative}')
+        cumulative += self.bucket_counts[-1]
+        sep = "," if labels else ""
+        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {cumulative}')
+        out.append(f"{name}_sum{{{labels}}} {self.total}")
+        out.append(f"{name}_count{{{labels}}} {self.count}")
+        return "\n".join(out)
+
+
+class S3Metrics:
+    def __init__(self) -> None:
+        self.requests = Counter()        # (method, outcome_class) -> n
+        self.auth_outcomes = Counter()   # "allowed"/"denied"/"error"/"anonymous"
+        self.policy_eval = Histogram()
+        self.request_latency = Histogram()
+        self.sts_issued = 0
+        self.jwks_fetches = 0
+        self.started_at = time.time()
+
+    def render(self, audit=None) -> str:
+        lines = [
+            "# TYPE s3_requests_total counter",
+        ]
+        for (method, outcome), n in sorted(self.requests.items()):
+            lines.append(
+                f's3_requests_total{{method="{method}",outcome="{outcome}"}} {n}'
+            )
+        lines.append("# TYPE s3_auth_outcomes_total counter")
+        for outcome, n in sorted(self.auth_outcomes.items()):
+            lines.append(f's3_auth_outcomes_total{{outcome="{outcome}"}} {n}')
+        lines.append("# TYPE s3_sts_tokens_issued_total counter")
+        lines.append(f"s3_sts_tokens_issued_total {self.sts_issued}")
+        lines.append("# TYPE s3_jwks_fetches_total counter")
+        lines.append(f"s3_jwks_fetches_total {self.jwks_fetches}")
+        lines.append("# TYPE s3_policy_eval_seconds histogram")
+        lines.append(self.policy_eval.render("s3_policy_eval_seconds"))
+        lines.append("# TYPE s3_request_seconds histogram")
+        lines.append(self.request_latency.render("s3_request_seconds"))
+        lines.append("# TYPE s3_uptime_seconds gauge")
+        lines.append(f"s3_uptime_seconds {time.time() - self.started_at:.1f}")
+        if audit is not None:
+            lines.append("# TYPE s3_audit_dropped_total counter")
+            lines.append(f"s3_audit_dropped_total {audit.dropped_count}")
+            lines.append("# TYPE s3_audit_flush_errors_total counter")
+            lines.append(f"s3_audit_flush_errors_total {audit.flush_error_count}")
+            lines.append("# TYPE s3_audit_written_total counter")
+            lines.append(f"s3_audit_written_total {audit.written_count}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,340 @@
+"""aiohttp S3 gateway server (reference s3_server/main.rs).
+
+Env-driven config (reference main.rs:64-241), a single catch-all route (the
+reference's axum ``/{*path}``) behind the auth middleware, Prometheus
+``/metrics``, ``/health``, and an hourly JWKS refresh task
+(main.rs:109-137).
+
+Environment:
+- ``MASTER_ADDRS`` / ``CONFIG_SERVERS`` — DFS endpoints (comma-separated)
+- ``S3_AUTH_ENABLED`` (default true), ``S3_ACCESS_KEY``/``S3_SECRET_KEY``
+- ``S3_USERS_JSON`` — optional ``{access_key: secret}`` map
+- ``IAM_CONFIG_PATH`` — iam_config.json for the policy engine
+- ``OIDC_ISSUER``/``OIDC_AUDIENCE``/``OIDC_JWKS_URI``
+- ``STS_SIGNING_KEYS`` (``{kid: hex32}`` JSON) + ``STS_ACTIVE_KEY``
+- ``SSE_MASTER_KEY`` — base64 32-byte KEK enables SSE-S3
+- ``AUDIT_DB_PATH``/``AUDIT_HMAC_KEY``/``AUDIT_RETENTION_DAYS``
+- ``S3_REQUIRE_TLS``, ``S3_TLS_CERT``/``S3_TLS_KEY``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+
+from aiohttp import web
+
+from tpudfs.auth.credentials import (
+    CredentialProvider,
+    EnvCredentialProvider,
+    StaticCredentialProvider,
+)
+from tpudfs.auth.errors import AuthError
+from tpudfs.auth.oidc import JwksCache, OidcValidator
+from tpudfs.auth.policy import PolicyEngine
+from tpudfs.auth.sse import SseEngine
+from tpudfs.auth.sts import StsTokenService
+from tpudfs.client.client import Client, DfsError
+from tpudfs.s3.audit import AuditLog
+from tpudfs.s3.handlers import S3Handlers, S3Response, _err, is_reserved_key
+from tpudfs.s3.metrics import S3Metrics
+from tpudfs.s3.middleware import AuthMiddleware, S3Request
+from tpudfs.s3.sts_handler import StsHandler
+
+logger = logging.getLogger(__name__)
+
+
+class Gateway:
+    def __init__(
+        self,
+        client: Client,
+        *,
+        credentials: CredentialProvider | None = None,
+        policy: PolicyEngine | None = None,
+        sts: StsTokenService | None = None,
+        oidc: OidcValidator | None = None,
+        sse: SseEngine | None = None,
+        audit: AuditLog | None = None,
+        auth_enabled: bool = True,
+        require_tls: bool = False,
+    ):
+        self.client = client
+        self.handlers = S3Handlers(client, sse=sse)
+        self.metrics = S3Metrics()
+        self.audit = audit
+        self.middleware = AuthMiddleware(
+            credentials or EnvCredentialProvider(),
+            policy, sts,
+            enabled=auth_enabled,
+            require_tls=require_tls,
+            get_bucket_policy=self.handlers.get_bucket_policy_doc,
+            audit_sink=audit.log if audit else None,
+            observe_policy_latency=self.metrics.policy_eval.observe,
+        )
+        self.sts_handler = (
+            StsHandler(oidc, policy, sts)
+            if oidc is not None and policy is not None and sts is not None
+            else None
+        )
+        self._jwks_task: asyncio.Task | None = None
+        self._oidc = oidc
+
+    # --------------------------------------------------------------- app
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024**3)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_route("*", "/{tail:.*}", self._dispatch_http)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, _app) -> None:
+        if self.audit is not None:
+            self.audit.start()
+        if self._oidc is not None:
+            self._jwks_task = asyncio.get_running_loop().create_task(
+                self._jwks_refresher()
+            )
+
+    async def _on_cleanup(self, _app) -> None:
+        if self._jwks_task is not None:
+            self._jwks_task.cancel()
+        if self.audit is not None:
+            await self.audit.stop()
+
+    async def _jwks_refresher(self) -> None:
+        """Hourly JWKS refresh (reference main.rs:109-137)."""
+        while True:
+            try:
+                await self._oidc.jwks.refresh()
+                self.metrics.jwks_fetches += 1
+            except Exception as e:
+                logger.warning("JWKS refresh failed: %s", e)
+            await asyncio.sleep(3600)
+
+    async def _health(self, _req) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _metrics(self, _req) -> web.Response:
+        return web.Response(text=self.metrics.render(self.audit))
+
+    # ---------------------------------------------------------- dispatch
+
+    async def _dispatch_http(self, request: web.Request) -> web.Response:
+        t0 = time.perf_counter()
+        body = await request.read()
+        req = S3Request(
+            method=request.method,
+            path=request.path,  # decoded
+            query=[(k, v) for k, v in request.rel_url.query.items()],
+            headers={k: v for k, v in request.headers.items()},
+            body=body,
+            secure=request.secure,
+            source_ip=request.remote or "",
+        )
+        try:
+            resp = await self.handle(req)
+            outcome = f"{resp.status // 100}xx"
+        except AuthError as e:
+            self.metrics.auth_outcomes["denied" if e.http_status == 403
+                                       else "error"] += 1
+            resp = S3Response(status=e.http_status,
+                              body=e.to_xml(req.path, req.request_id).encode())
+            outcome = "auth"
+        except DfsError as e:
+            logger.warning("DFS error on %s %s: %s", req.method, req.path, e)
+            resp = _err("InternalError", str(e), 500, req.path)
+            outcome = "5xx"
+        except Exception:
+            logger.exception("unhandled error on %s %s", req.method, req.path)
+            resp = _err("InternalError", "internal error", 500, req.path)
+            outcome = "5xx"
+        self.metrics.requests[(req.method, outcome)] += 1
+        self.metrics.request_latency.observe(time.perf_counter() - t0)
+        headers = dict(resp.headers)
+        headers["x-amz-request-id"] = req.request_id
+        return web.Response(status=resp.status, body=resp.body,
+                            headers=headers, content_type=resp.content_type)
+
+    async def handle(self, req: S3Request) -> S3Response:
+        """Route an authenticated S3 request (framework-agnostic; tests call
+        this directly)."""
+        q = req.query_map()
+        # STS rides POST / with Action param (no SigV4 — the web-identity
+        # token IS the credential). It bypasses SigV4 but NOT the TLS
+        # requirement: credential issuance is exactly what must never
+        # travel cleartext.
+        if req.path == "/" and req.method == "POST":
+            if self.middleware.require_tls and not req.secure:
+                raise AuthError.insecure_transport()
+            params = dict(q)
+            if req.body:
+                from urllib.parse import parse_qsl
+                params.update(parse_qsl(req.body.decode("utf-8", "replace")))
+            if params.get("Action") == "AssumeRoleWithWebIdentity":
+                if self.sts_handler is None:
+                    raise AuthError.access_denied("STS is not configured")
+                resp = await self.sts_handler.assume_role_with_web_identity(params)
+                self.metrics.sts_issued += 1
+                return resp
+        auth = await self.middleware.authenticate(req)
+        if self.middleware.enabled:
+            self.metrics.auth_outcomes[
+                "anonymous" if auth.principal == "-" else "allowed"] += 1
+        h = self.handlers
+        parts = [p for p in req.path.split("/") if p]
+        if not parts:
+            if req.method == "GET":
+                return await h.list_buckets()
+            return _err("MethodNotAllowed", "unsupported", 405)
+        bucket = parts[0]
+        if len(parts) == 1:
+            return await self._bucket_route(req, q, auth.body, bucket)
+        key = "/".join(parts[1:])
+        if is_reserved_key(key):
+            # Internal namespaces (.policy, .bucket, .s3_mpu, .s3_tmp) are
+            # unreachable through the object API — writing .policy directly
+            # would be authorized as s3:PutObject yet grant the bucket.
+            return _err("InvalidArgument",
+                        f"key uses a reserved namespace: {key}", 400, key)
+        return await self._object_route(req, q, auth.body, bucket, key)
+
+    async def _bucket_route(self, req: S3Request, q: dict, body: bytes,
+                            bucket: str) -> S3Response:
+        h = self.handlers
+        if "policy" in q:
+            if req.method == "GET":
+                return await h.get_bucket_policy(bucket)
+            if req.method == "PUT":
+                return await h.put_bucket_policy(bucket, body)
+            if req.method == "DELETE":
+                return await h.delete_bucket_policy(bucket)
+        if "location" in q and req.method == "GET":
+            return await h.get_bucket_location()
+        if req.method == "GET":
+            return await h.list_objects(bucket, q)
+        if req.method == "PUT":
+            return await h.create_bucket(bucket)
+        if req.method == "HEAD":
+            return await h.head_bucket(bucket)
+        if req.method == "DELETE":
+            return await h.delete_bucket(bucket)
+        if req.method == "POST" and "delete" in q:
+            return await h.delete_objects(bucket, body)
+        return _err("MethodNotAllowed", "unsupported", 405)
+
+    async def _object_route(self, req: S3Request, q: dict, body: bytes,
+                            bucket: str, key: str) -> S3Response:
+        h = self.handlers
+        if req.method == "POST":
+            if "uploads" in q:
+                return await h.initiate_multipart(bucket, key)
+            if "uploadId" in q:
+                return await h.complete_multipart(bucket, key, q["uploadId"], body)
+        if req.method == "PUT":
+            if "uploadId" in q and "partNumber" in q:
+                try:
+                    part_number = int(q["partNumber"])
+                except ValueError:
+                    return _err("InvalidArgument",
+                                "partNumber must be an integer", 400)
+                return await h.upload_part(bucket, q["uploadId"],
+                                           part_number, body)
+            copy_source = req.header("x-amz-copy-source")
+            if copy_source:
+                return await h.copy_object(bucket, key, copy_source)
+            return await h.put_object(bucket, key, body)
+        if req.method == "GET":
+            if "uploadId" in q:
+                return await h.list_parts(bucket, key, q["uploadId"])
+            return await h.get_object(bucket, key, req.header("Range"))
+        if req.method == "HEAD":
+            return await h.head_object(bucket, key)
+        if req.method == "DELETE":
+            if "uploadId" in q:
+                return await h.abort_multipart(bucket, q["uploadId"])
+            return await h.delete_object(bucket, key)
+        return _err("MethodNotAllowed", "unsupported", 405)
+
+
+def gateway_from_env(client: Client | None = None) -> Gateway:
+    """Build a Gateway from environment config (reference main.rs:64-241)."""
+    env = os.environ
+    if client is None:
+        masters = [a for a in env.get("MASTER_ADDRS", "").split(",") if a]
+        configs = [a for a in env.get("CONFIG_SERVERS", "").split(",") if a]
+        client = Client(masters or None, configs or None)
+
+    users_json = env.get("S3_USERS_JSON", "")
+    credentials: CredentialProvider
+    if users_json:
+        credentials = StaticCredentialProvider(json.loads(users_json))
+    else:
+        credentials = EnvCredentialProvider()
+
+    policy = None
+    if env.get("IAM_CONFIG_PATH"):
+        policy = PolicyEngine.from_file(env["IAM_CONFIG_PATH"])
+
+    sts = None
+    if env.get("STS_SIGNING_KEYS"):
+        keys = json.loads(env["STS_SIGNING_KEYS"])
+        sts = StsTokenService.from_hex(
+            keys, env.get("STS_ACTIVE_KEY") or next(iter(keys))
+        )
+
+    oidc = None
+    if env.get("OIDC_ISSUER"):
+        oidc = OidcValidator(
+            env["OIDC_ISSUER"], env.get("OIDC_AUDIENCE", "tpudfs"),
+            JwksCache(env.get("OIDC_JWKS_URI")),
+        )
+
+    sse = None
+    if env.get("SSE_MASTER_KEY"):
+        sse = SseEngine.from_base64(env["SSE_MASTER_KEY"])
+
+    audit = None
+    if env.get("AUDIT_DB_PATH"):
+        audit = AuditLog(
+            env["AUDIT_DB_PATH"],
+            env.get("AUDIT_HMAC_KEY", "tpudfs-audit").encode(),
+            retention_days=float(env.get("AUDIT_RETENTION_DAYS", "90")),
+        )
+
+    return Gateway(
+        client,
+        credentials=credentials,
+        policy=policy,
+        sts=sts,
+        oidc=oidc,
+        sse=sse,
+        audit=audit,
+        auth_enabled=env.get("S3_AUTH_ENABLED", "true").lower() != "false",
+        require_tls=env.get("S3_REQUIRE_TLS", "").lower() == "true",
+    )
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    gw = gateway_from_env()
+    app = gw.build_app()
+    port = int(os.environ.get("S3_PORT", "9000"))
+    ssl_ctx = None
+    if os.environ.get("S3_TLS_CERT"):
+        import ssl
+
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(os.environ["S3_TLS_CERT"],
+                                os.environ.get("S3_TLS_KEY"))
+    print("READY", flush=True)
+    web.run_app(app, port=port, ssl_context=ssl_ctx)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""STS endpoint: AssumeRoleWithWebIdentity (reference s3_server/sts_handler.rs:65).
+
+POST with ``Action=AssumeRoleWithWebIdentity`` (query or form-encoded):
+validate the OIDC web-identity token, check the role's trust policy
+(``can_assume_role``), mint temp credentials + an encrypted session token,
+and answer with the AWS STS XML document.
+"""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+
+from tpudfs.auth.errors import AuthError
+from tpudfs.auth.oidc import OidcValidator
+from tpudfs.auth.policy import PolicyEngine
+from tpudfs.auth.sts import StsTokenService
+from tpudfs.s3 import xml_types as xt
+from tpudfs.s3.handlers import S3Response
+
+
+class StsHandler:
+    def __init__(self, oidc: OidcValidator, policy: PolicyEngine,
+                 sts: StsTokenService):
+        self.oidc = oidc
+        self.policy = policy
+        self.sts = sts
+
+    async def assume_role_with_web_identity(self, params: dict[str, str]) -> S3Response:
+        token = params.get("WebIdentityToken", "")
+        role_arn = params.get("RoleArn", "")
+        try:
+            duration = int(params.get("DurationSeconds", "3600") or 3600)
+        except ValueError:
+            raise AuthError.malformed("DurationSeconds must be an integer") \
+                from None
+        if not token or not role_arn:
+            raise AuthError.malformed("WebIdentityToken and RoleArn are required")
+        # RoleArn forms accepted: full ARN or bare role name.
+        role = role_arn.rsplit("/", 1)[-1]
+        validated = await self.oidc.validate(token)
+        if not self.policy.can_assume_role(role, validated.subject):
+            raise AuthError.access_denied(
+                f"subject {validated.subject!r} may not assume role {role!r}"
+            )
+        creds = self.sts.issue(role, validated.subject,
+                               duration_seconds=duration)
+        expiration = datetime.datetime.fromtimestamp(
+            creds.expires_at, datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+        doc = xt.assume_role_result(
+            creds.access_key, creds.secret_key, creds.session_token,
+            expiration, role, validated.subject, uuid.uuid4().hex,
+        )
+        return S3Response(body=doc.encode())

@@ -1,0 +1,224 @@
+"""S3 XML request/response documents (reference s3_types.rs:5-218).
+
+The reference uses quick-xml serde types; here the handful of S3 documents
+are rendered/parsed directly with ``xml.etree`` — the schema set is small
+and fixed (list results, MPU, delete batches, copy result, location/policy).
+All renderers escape values and emit the AWS namespace where clients
+(boto3, aws-cli) expect it.
+"""
+
+from __future__ import annotations
+
+import datetime
+import xml.etree.ElementTree as ET
+from xml.sax.saxutils import escape
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+_HEADER = '<?xml version="1.0" encoding="UTF-8"?>\n'
+
+
+def iso8601(ms: int | float) -> str:
+    dt = datetime.datetime.fromtimestamp((ms or 0) / 1000.0, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.000Z")
+
+
+def _tag(name: str, value: str) -> str:
+    return f"<{name}>{escape(str(value))}</{name}>"
+
+
+def list_buckets(owner: str, buckets: list[dict]) -> str:
+    entries = "".join(
+        "<Bucket>" + _tag("Name", b["name"]) + _tag("CreationDate", b["created"]) + "</Bucket>"
+        for b in buckets
+    )
+    return (
+        _HEADER
+        + f'<ListAllMyBucketsResult xmlns="{XMLNS}">'
+        + "<Owner>" + _tag("ID", owner) + _tag("DisplayName", owner) + "</Owner>"
+        + f"<Buckets>{entries}</Buckets></ListAllMyBucketsResult>"
+    )
+
+
+def _contents(objects: list[dict]) -> str:
+    return "".join(
+        "<Contents>"
+        + _tag("Key", o["key"])
+        + _tag("LastModified", o["last_modified"])
+        + _tag("ETag", f'"{o["etag"]}"')
+        + _tag("Size", o["size"])
+        + _tag("StorageClass", o.get("storage_class", "STANDARD"))
+        + "</Contents>"
+        for o in objects
+    )
+
+
+def _common_prefixes(prefixes: list[str]) -> str:
+    return "".join(
+        "<CommonPrefixes>" + _tag("Prefix", p) + "</CommonPrefixes>" for p in prefixes
+    )
+
+
+def list_objects_v1(
+    bucket: str, prefix: str, marker: str, delimiter: str, max_keys: int,
+    is_truncated: bool, objects: list[dict], prefixes: list[str],
+    next_marker: str = "",
+) -> str:
+    doc = (
+        _HEADER
+        + f'<ListBucketResult xmlns="{XMLNS}">'
+        + _tag("Name", bucket) + _tag("Prefix", prefix) + _tag("Marker", marker)
+        + _tag("MaxKeys", max_keys)
+        + (_tag("Delimiter", delimiter) if delimiter else "")
+        + _tag("IsTruncated", "true" if is_truncated else "false")
+        + (_tag("NextMarker", next_marker) if is_truncated and next_marker and delimiter else "")
+        + _contents(objects)
+        + _common_prefixes(prefixes)
+        + "</ListBucketResult>"
+    )
+    return doc
+
+
+def list_objects_v2(
+    bucket: str, prefix: str, delimiter: str, max_keys: int,
+    is_truncated: bool, objects: list[dict], prefixes: list[str],
+    continuation_token: str = "", next_continuation_token: str = "",
+    start_after: str = "",
+) -> str:
+    return (
+        _HEADER
+        + f'<ListBucketResult xmlns="{XMLNS}">'
+        + _tag("Name", bucket) + _tag("Prefix", prefix)
+        + (_tag("Delimiter", delimiter) if delimiter else "")
+        + _tag("MaxKeys", max_keys)
+        + _tag("KeyCount", len(objects) + len(prefixes))
+        + _tag("IsTruncated", "true" if is_truncated else "false")
+        + (_tag("ContinuationToken", continuation_token) if continuation_token else "")
+        + (_tag("NextContinuationToken", next_continuation_token)
+           if next_continuation_token else "")
+        + (_tag("StartAfter", start_after) if start_after else "")
+        + _contents(objects)
+        + _common_prefixes(prefixes)
+        + "</ListBucketResult>"
+    )
+
+
+def initiate_multipart_upload(bucket: str, key: str, upload_id: str) -> str:
+    return (
+        _HEADER
+        + f'<InitiateMultipartUploadResult xmlns="{XMLNS}">'
+        + _tag("Bucket", bucket) + _tag("Key", key) + _tag("UploadId", upload_id)
+        + "</InitiateMultipartUploadResult>"
+    )
+
+
+def complete_multipart_upload_result(location: str, bucket: str, key: str, etag: str) -> str:
+    return (
+        _HEADER
+        + f'<CompleteMultipartUploadResult xmlns="{XMLNS}">'
+        + _tag("Location", location) + _tag("Bucket", bucket)
+        + _tag("Key", key) + _tag("ETag", f'"{etag}"')
+        + "</CompleteMultipartUploadResult>"
+    )
+
+
+def parse_complete_multipart_upload(body: bytes) -> list[tuple[int, str]]:
+    """Returns [(part_number, etag)] from a CompleteMultipartUpload request."""
+    root = ET.fromstring(body)
+    parts: list[tuple[int, str]] = []
+    for part in root.iter():
+        if part.tag.rpartition("}")[2] != "Part":
+            continue
+        num = etag = None
+        for child in part:
+            name = child.tag.rpartition("}")[2]
+            if name == "PartNumber":
+                num = int(child.text or "0")
+            elif name == "ETag":
+                etag = (child.text or "").strip('"')
+        if num is not None and etag is not None:
+            parts.append((num, etag))
+    return parts
+
+
+def list_parts(bucket: str, key: str, upload_id: str,
+               parts: list[dict]) -> str:
+    entries = "".join(
+        "<Part>" + _tag("PartNumber", p["part_number"])
+        + _tag("LastModified", p["last_modified"])
+        + _tag("ETag", f'"{p["etag"]}"') + _tag("Size", p["size"]) + "</Part>"
+        for p in parts
+    )
+    return (
+        _HEADER
+        + f'<ListPartsResult xmlns="{XMLNS}">'
+        + _tag("Bucket", bucket) + _tag("Key", key) + _tag("UploadId", upload_id)
+        + entries + "</ListPartsResult>"
+    )
+
+
+def parse_delete_objects(body: bytes) -> tuple[list[str], bool]:
+    """Returns ([keys], quiet) from a DeleteObjects request body."""
+    root = ET.fromstring(body)
+    keys: list[str] = []
+    quiet = False
+    for el in root.iter():
+        name = el.tag.rpartition("}")[2]
+        if name == "Key" and el.text:
+            keys.append(el.text)
+        elif name == "Quiet" and (el.text or "").strip().lower() == "true":
+            quiet = True
+    return keys, quiet
+
+
+def delete_result(deleted: list[str], errors: list[tuple[str, str, str]],
+                  quiet: bool) -> str:
+    deleted_xml = "" if quiet else "".join(
+        "<Deleted>" + _tag("Key", k) + "</Deleted>" for k in deleted
+    )
+    errors_xml = "".join(
+        "<Error>" + _tag("Key", k) + _tag("Code", code) + _tag("Message", msg) + "</Error>"
+        for k, code, msg in errors
+    )
+    return (
+        _HEADER
+        + f'<DeleteResult xmlns="{XMLNS}">'
+        + deleted_xml + errors_xml + "</DeleteResult>"
+    )
+
+
+def copy_object_result(etag: str, last_modified: str) -> str:
+    return (
+        _HEADER
+        + f'<CopyObjectResult xmlns="{XMLNS}">'
+        + _tag("LastModified", last_modified) + _tag("ETag", f'"{etag}"')
+        + "</CopyObjectResult>"
+    )
+
+
+def location_constraint() -> str:
+    return _HEADER + f'<LocationConstraint xmlns="{XMLNS}"/>'
+
+
+def assume_role_result(access_key: str, secret_key: str, session_token: str,
+                       expiration_iso: str, role: str, subject: str,
+                       request_id: str) -> str:
+    ns = "https://sts.amazonaws.com/doc/2011-06-15/"
+    return (
+        _HEADER
+        + f'<AssumeRoleWithWebIdentityResponse xmlns="{ns}">'
+        + "<AssumeRoleWithWebIdentityResult>"
+        + _tag("SubjectFromWebIdentityToken", subject)
+        + "<Credentials>"
+        + _tag("AccessKeyId", access_key)
+        + _tag("SecretAccessKey", secret_key)
+        + _tag("SessionToken", session_token)
+        + _tag("Expiration", expiration_iso)
+        + "</Credentials>"
+        + "<AssumedRoleUser>"
+        + _tag("Arn", f"arn:aws:sts:::assumed-role/{role}/{subject}")
+        + _tag("AssumedRoleId", f"{role}:{subject}")
+        + "</AssumedRoleUser>"
+        + "</AssumeRoleWithWebIdentityResult>"
+        + "<ResponseMetadata>" + _tag("RequestId", request_id) + "</ResponseMetadata>"
+        + "</AssumeRoleWithWebIdentityResponse>"
+    )
