@@ -1,0 +1,592 @@
+"""Dynamic sharding: throughput monitor, auto split/merge, data shuffler.
+
+Model: the reference's ThroughputMonitor (master.rs:610-675),
+run_split_detector (master.rs:1483-1837) and run_data_shuffler
+(master.rs:1324-1419), with the design deviations documented in
+tpudfs/master/autoshard.py (consistent split key, self-retiring merge,
+crash-resumable migration records).
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from tpudfs.chunkserver.blockstore import BlockStore
+from tpudfs.chunkserver.heartbeat import HeartbeatLoop
+from tpudfs.chunkserver.service import ChunkServer
+from tpudfs.client.client import Client
+from tpudfs.common.rpc import RpcClient, RpcServer
+from tpudfs.configserver.service import ConfigServer
+from tpudfs.master import autoshard
+from tpudfs.master.service import Master
+from tpudfs.master.state import MasterState
+from tpudfs.raft.core import Timings
+
+FAST_RAFT = Timings(election_min=0.3, election_max=0.6, heartbeat=0.1,
+                    snapshot_threshold=500)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# --------------------------------------------------------------- unit: monitor
+
+
+def test_prefix_of():
+    assert autoshard.prefix_of("/a/b/c") == "/a/"
+    assert autoshard.prefix_of("/hot") == "/hot/"
+    assert autoshard.prefix_of("/") == "/"
+    assert autoshard.prefix_of("") == "/"
+
+
+def test_prefix_end_sorts_after_all_keys_under_prefix():
+    end = autoshard.prefix_end("/a/")
+    assert "/a/" < end
+    assert "/a/zzzzzz" < end
+    assert "/a/￿" < end
+    assert "/b" > end[: len("/b")] or "/b/" > end  # keys outside sort after
+
+
+def test_monitor_ema_decay():
+    m = autoshard.ThroughputMonitor(interval_secs=5.0)
+    for _ in range(50):
+        m.record("/a/x", 100)
+    m.decay()
+    # 50 requests / 5 s * 0.7 weight = 7.0
+    assert m.metrics["/a/"].rps == pytest.approx(7.0)
+    assert m.metrics["/a/"].bps == pytest.approx(700.0)
+    m.decay()  # no traffic: decays toward zero
+    assert m.metrics["/a/"].rps == pytest.approx(2.1)
+    assert m.total_rps() == pytest.approx(2.1)
+
+
+def test_monitor_hot_prefix_threshold_and_cooldown():
+    m = autoshard.ThroughputMonitor(split_threshold_rps=5.0,
+                                    split_cooldown_secs=30.0,
+                                    interval_secs=1.0)
+    for _ in range(20):
+        m.record("/hot/k")
+    for _ in range(2):
+        m.record("/cold/k")
+    m.decay()
+    # First check starts the warm-up clock (fresh leaders must not reshard
+    # on empty EMAs); hot only after one full cooldown.
+    assert m.hot_prefix(now=900.0) is None
+    got = m.hot_prefix(now=1000.0)
+    assert got is not None and got[0] == "/hot/"
+    m.mark_resharded(now=1000.0)
+    assert m.hot_prefix(now=1010.0) is None  # cooling down
+    assert m.hot_prefix(now=1031.0) is not None
+
+
+def test_monitor_merge_disabled_by_negative_threshold():
+    m = autoshard.ThroughputMonitor(merge_threshold_rps=-1.0)
+    assert not m.should_merge(now=0.0)
+    m2 = autoshard.ThroughputMonitor(merge_threshold_rps=1.0,
+                                     split_cooldown_secs=0.0)
+    assert m2.should_merge(now=0.0)  # zero traffic < 1.0
+
+
+# ---------------------------------------------------------- unit: state apply
+
+
+def test_state_migration_lifecycle_and_snapshot():
+    st = MasterState("s1")
+    st.apply({"op": "create_file", "path": "/a/f", "created_at_ms": 1,
+              "ec_data_shards": 0, "ec_parity_shards": 0})
+    st.apply({"op": "create_file", "path": "/z/f", "created_at_ms": 1,
+              "ec_data_shards": 0, "ec_parity_shards": 0})
+    st.apply({"op": "begin_migration", "migration_id": "m1", "kind": "split",
+              "target_shard_id": "s2", "start": "",
+              "end": autoshard.prefix_end("/a/"), "prefix": "/a/"})
+    assert "/a/" in st.shuffling_prefixes and "m1" in st.migrations
+    # Duplicate begin is a no-op.
+    st.apply({"op": "begin_migration", "migration_id": "m1", "kind": "split",
+              "target_shard_id": "s2", "start": "", "end": "x",
+              "prefix": "/a/"})
+    # Snapshot/restore carries migrations + shuffle prefixes.
+    st2 = MasterState("s1")
+    st2.restore(st.snapshot())
+    assert st2.migrations["m1"]["target_shard_id"] == "s2"
+    assert st2.shuffling_prefixes == {"/a/"}
+    # Completion removes exactly the migrated range.
+    res = st.apply({"op": "complete_migration", "migration_id": "m1"})
+    assert res["count"] == 1
+    assert "/a/f" not in st.files and "/z/f" in st.files
+    assert st.migrations == {}
+
+
+def test_state_aborted_migration_keeps_files():
+    st = MasterState("s1")
+    st.apply({"op": "create_file", "path": "/a/f", "created_at_ms": 1,
+              "ec_data_shards": 0, "ec_parity_shards": 0})
+    st.apply({"op": "begin_migration", "migration_id": "m1", "kind": "split",
+              "target_shard_id": "s2", "start": "",
+              "end": autoshard.prefix_end("/a/"), "prefix": "/a/"})
+    st.apply({"op": "complete_migration", "migration_id": "m1",
+              "aborted": True})
+    assert "/a/f" in st.files
+    assert st.shuffling_prefixes == set()
+
+
+def test_state_shuffle_and_adopt_ops():
+    st = MasterState("")
+    st.apply({"op": "trigger_shuffle", "prefix": "/p/"})
+    assert st.shuffling_prefixes == {"/p/"}
+    st.apply({"op": "stop_shuffle", "prefix": "/p/"})
+    assert st.shuffling_prefixes == set()
+    st.apply({"op": "adopt_shard", "shard_id": "s9"})
+    assert st.shard_id == "s9"
+
+
+def test_monitor_evicts_dead_prefixes():
+    m = autoshard.ThroughputMonitor(interval_secs=1.0)
+    m.record("/once/x", 10)
+    for _ in range(20):
+        m.decay()
+    assert "/once/" not in m.metrics  # EMA decayed below floor -> evicted
+    m.record("/live/x")
+    m.decay()
+    assert "/live/" in m.metrics
+
+
+def test_state_staged_ingest_lifecycle():
+    """Target-side stage/commit/drop: staged files are held (and survive
+    snapshots) but only published at commit; staged_in() guards the range."""
+    st = MasterState("s2")
+    fd = {"path": "/hot/f", "size": 3, "etag_md5": "", "created_at_ms": 1,
+          "complete": True, "blocks": [], "ec_data_shards": 0,
+          "ec_parity_shards": 0, "last_access_ms": 0,
+          "moved_to_cold_at_ms": 0}
+    st.apply({"op": "stage_ingest", "migration_id": "m1", "start": "/hot/",
+              "end": autoshard.prefix_end("/hot/"), "files": {"/hot/f": fd},
+              "staged_at_ms": 5})
+    assert st.staged_in("/hot/f") and not st.staged_in("/cold/f")
+    assert "/hot/f" not in st.files  # held, not served
+    st2 = MasterState("s2")
+    st2.restore(st.snapshot())
+    assert st2.staged_in("/hot/f")
+    st.apply({"op": "commit_staged_ingest", "migration_id": "m1"})
+    assert not st.staged_in("/hot/f")
+    assert st.files["/hot/f"].size == 3
+    # Duplicate commit is a no-op; drop of unknown id too.
+    st.apply({"op": "commit_staged_ingest", "migration_id": "m1"})
+    st.apply({"op": "drop_staged_ingest", "migration_id": "zzz"})
+    # Drop discards without publishing.
+    st.apply({"op": "stage_ingest", "migration_id": "m2", "start": "/x/",
+              "end": autoshard.prefix_end("/x/"), "files": {"/x/f": fd},
+              "staged_at_ms": 6})
+    st.apply({"op": "drop_staged_ingest", "migration_id": "m2"})
+    assert not st.staged_in("/x/f") and "/x/f" not in st.files
+
+
+def test_state_migrating_out_freeze_interval():
+    st = MasterState("s1")
+    st.apply({"op": "begin_migration", "migration_id": "m1", "kind": "split",
+              "target_shard_id": "s2", "start": "/hot/",
+              "end": autoshard.prefix_end("/hot/"), "prefix": "/hot/"})
+    assert st.migrating_out("/hot/f")
+    assert not st.migrating_out("/cold/f")
+    assert not st.migrating_out("/hot/")  # boundary key stays below
+    st.apply({"op": "complete_migration", "migration_id": "m1"})
+    assert not st.migrating_out("/hot/f")
+
+
+def test_shard_interval():
+    from tpudfs.common.sharding import RANGE_MAX, ShardMap
+    m = ShardMap(strategy="range")
+    m.add_shard("s0", ["a:1"])
+    assert m.shard_interval("s0") == ("", RANGE_MAX)
+    m.carve_shard("/hot/", autoshard.prefix_end("/hot/"), "h1", ["b:1"])
+    assert m.shard_interval("h1") == ("/hot/", autoshard.prefix_end("/hot/"))
+    assert m.shard_interval("s0") is None  # two disjoint runs
+
+
+def test_state_commit_without_stage_fails_but_retry_succeeds():
+    """Regression: a commit for a never-staged migration must fail (success
+    would let the source drop its only copy); a genuine retry after a lost
+    ack is recognized via the tombstone."""
+    st = MasterState("s2")
+    with pytest.raises(ValueError, match="no staged ingest"):
+        st.apply({"op": "commit_staged_ingest", "migration_id": "never",
+                  "at_ms": 10})
+    st.apply({"op": "stage_ingest", "migration_id": "m1", "start": "/a/",
+              "end": autoshard.prefix_end("/a/"), "files": {},
+              "staged_at_ms": 5})
+    st.apply({"op": "commit_staged_ingest", "migration_id": "m1", "at_ms": 6})
+    # Retry: tombstone says already committed.
+    res = st.apply({"op": "commit_staged_ingest", "migration_id": "m1",
+                    "at_ms": 7})
+    assert res.get("duplicate")
+
+
+def test_state_tx_and_migration_mutual_exclusion():
+    """Regression: 2PC prepares bypassed the migration freeze (a tx
+    committed after the stage would be lost), and migrations could begin
+    over a prepared tx's path."""
+    st = MasterState("s1")
+    st.apply({"op": "begin_migration", "migration_id": "m1", "kind": "split",
+              "target_shard_id": "s2", "start": "/hot/",
+              "end": autoshard.prefix_end("/hot/"), "prefix": "/hot/"})
+    with pytest.raises(ValueError, match="migrating"):
+        st.apply({"op": "tx_create", "tx": {
+            "txid": "t1", "state": "prepared", "coordinator": False,
+            "operations": [{"kind": "create", "path": "/hot/dst"}],
+            "created_at_ms": 1, "updated_at_ms": 1,
+        }})
+    st.apply({"op": "complete_migration", "migration_id": "m1"})
+    st.apply({"op": "tx_create", "tx": {
+        "txid": "t2", "state": "prepared", "coordinator": False,
+        "operations": [{"kind": "create", "path": "/cold/dst"}],
+        "created_at_ms": 1, "updated_at_ms": 1,
+    }})
+    with pytest.raises(ValueError, match="in-flight transaction"):
+        st.apply({"op": "begin_migration", "migration_id": "m2",
+                  "kind": "split", "target_shard_id": "s3", "start": "/cold/",
+                  "end": autoshard.prefix_end("/cold/"), "prefix": "/cold/"})
+
+
+def test_config_allocate_group_apply_is_idempotent_and_refreshes():
+    """Regression: select-then-propose allowed two concurrent splits to
+    reserve the same spare group; selection now runs inside apply, retries
+    return the existing reservation and refresh its liveness stamp."""
+    from tpudfs.configserver.state import ConfigState
+    st = ConfigState()
+    st.apply({"op": "register_master", "address": "a:1", "shard_id": None,
+              "group": ["a:1"], "at_ms": 1000})
+    st.apply({"op": "register_master", "address": "b:1", "shard_id": None,
+              "group": ["b:1"], "at_ms": 1000})
+    r1 = st.apply({"op": "allocate_group", "shard_id": "sX", "at_ms": 2000})
+    r2 = st.apply({"op": "allocate_group", "shard_id": "sY", "at_ms": 2000})
+    assert set(r1["peers"]) != set(r2["peers"])  # serialized: no double-grab
+    # Idempotent retry for the same shard, refreshing assigned_at_ms.
+    r1b = st.apply({"op": "allocate_group", "shard_id": "sX", "at_ms": 9000})
+    assert r1b["peers"] == r1["peers"]
+    assert st.masters[r1["peers"][0]]["assigned_at_ms"] == 9000
+    with pytest.raises(ValueError, match="no healthy registered masters"):
+        st.apply({"op": "allocate_group", "shard_id": "sZ", "at_ms": 9000})
+
+
+def test_config_registry_honors_mapped_manual_assignment():
+    """A master reporting a shard id is believed only when the map
+    corroborates it (exists + lists the master as peer)."""
+    from tpudfs.configserver.state import ConfigState
+    st = ConfigState()
+    st.apply({"op": "add_shard", "shard_id": "s0", "peers": ["a:1"]})
+    st.apply({"op": "register_master", "address": "a:1", "shard_id": "s0",
+              "group": ["a:1"], "at_ms": 1000})
+    assert st.masters["a:1"]["shard_id"] == "s0"
+    st.apply({"op": "register_master", "address": "b:1", "shard_id": "s0",
+              "group": ["b:1"], "at_ms": 1000})
+    assert st.masters["b:1"]["shard_id"] is None  # not a peer of s0
+
+
+# --------------------------------------------------- unit: map carve/merge
+
+
+def test_carve_isolates_prefix_and_keeps_flanks():
+    from tpudfs.common.sharding import ShardMap
+    m = ShardMap(strategy="range")
+    m.add_shard("s0", ["a:1"])
+    assert m.carve_shard("/hot/", autoshard.prefix_end("/hot/"),
+                         "hot-shard", ["b:1"])
+    assert m.get_shard("/cold/f") == "s0"
+    assert m.get_shard("/hot/f") == "hot-shard"
+    assert m.get_shard("/zzz/f") == "s0"
+    # The prefix key itself is a boundary: it belongs to the lower flank.
+    assert m.get_shard("/hot/") == "s0"
+
+
+def test_recarve_after_merge_cycle():
+    """The lower-flank boundary survives a carve+merge cycle; a second carve
+    at the same prefix must still succeed (regression: bisect_left on start
+    rejected carves whose start equals an existing boundary)."""
+    from tpudfs.common.sharding import ShardMap
+    m = ShardMap(strategy="range")
+    m.add_shard("s0", ["a:1"])
+    end = autoshard.prefix_end("/hot/")
+    assert m.carve_shard("/hot/", end, "h1", ["b:1"])
+    assert m.merge_shards("h1", "s0")
+    assert m.get_shard("/hot/f") == "s0"
+    assert m.carve_shard("/hot/", end, "h2", ["b:1"])
+    assert m.get_shard("/hot/f") == "h2"
+    assert m.get_shard("/cold/f") == "s0"
+
+
+def test_merge_rejects_self_merge():
+    """Regression: self-merge of a tail-owning shard looped forever inside
+    Raft apply."""
+    from tpudfs.common.sharding import ShardMap
+    m = ShardMap(strategy="range")
+    m.add_shard("s0", ["a:1"])
+    m.add_shard("s1", ["b:1"])
+    assert not m.merge_shards("s1", "s1")
+    assert m.has_shard("s1")
+
+
+def test_merge_target_follows_fold_direction():
+    from tpudfs.common.sharding import ShardMap
+    m = ShardMap(strategy="range")
+    m.add_shard("s0", ["a:1"])
+    assert m.carve_shard("/hot/", autoshard.prefix_end("/hot/"),
+                         "hot-shard", ["b:1"])
+    # The carved shard's keyspace folds into the upper flank (s0).
+    assert m.merge_target("hot-shard") == "s0"
+    # s0 owns several disjoint runs -> ambiguous fold, no auto-merge.
+    assert m.merge_target("s0") is None
+
+
+def test_allocate_group_refuses_cross_group_mix():
+    """Regression: allocating N unassigned addresses from different Raft
+    groups would have each group adopt the new shard (split brain)."""
+    from tpudfs.configserver.state import ConfigState
+    st = ConfigState()
+    for addr, group in [("a:1", ["a:1", "a:2"]), ("a:2", ["a:1", "a:2"]),
+                        ("b:1", ["b:1"])]:
+        st.apply({"op": "register_master", "address": addr, "shard_id": None,
+                  "group": group, "at_ms": 1000})
+    got = st.allocate_group(at_ms=2000)
+    assert got in (["a:1", "a:2"], ["b:1"])  # one whole group, never a mix
+    # A group with any assigned member is skipped entirely. (Assignment
+    # only moves through config ops — a master re-registering with a stale
+    # shard id must not write the registry, so use assign_group here.)
+    st.apply({"op": "assign_group", "shard_id": "s0", "peers": ["a:1"],
+              "at_ms": 3000})
+    assert st.allocate_group(at_ms=3000) == ["b:1"]
+    # Re-registration with a bogus shard id cannot resurrect an assignment.
+    st.apply({"op": "register_master", "address": "b:1", "shard_id": "dead",
+              "group": ["b:1"], "at_ms": 4000})
+    assert st.masters["b:1"]["shard_id"] is None
+    # GC releases a reservation whose shard never reached the map.
+    st.apply({"op": "gc_assignments", "at_ms": 3000 + 200_000,
+              "grace_ms": 120_000})
+    assert st.masters["a:1"]["shard_id"] is None
+
+
+def test_state_merge_completion_retires_shard_id():
+    """Regression: retirement must be atomic with the handoff (a separate
+    adopt command left a crash window claiming the dead shard id)."""
+    st = MasterState("victim")
+    st.apply({"op": "begin_migration", "migration_id": "m1", "kind": "merge",
+              "target_shard_id": "s0", "start": "", "end": "\U0010ffff"})
+    st.apply({"op": "complete_migration", "migration_id": "m1"})
+    assert st.shard_id == ""
+
+
+# ------------------------------------------------------ integration harness
+
+
+class AutoCluster:
+    """Config server + 1 serving master + 1 spare master + chunkservers,
+    with aggressive thresholds/intervals so reshards happen in test time."""
+
+    def __init__(self, tmp_path, n_cs=3, master_kw=None):
+        self.tmp = tmp_path
+        self.n_cs = n_cs
+        self.master_kw = master_kw or {}
+        self.rpc = RpcClient()
+        self.servers = []
+        self.chunkservers = []
+        self.heartbeats = []
+
+    async def _serve(self, addr, svc):
+        server = RpcServer(port=int(addr.rsplit(":", 1)[1]))
+        svc.attach(server)
+        await server.start()
+        self.servers.append(server)
+
+    def _make_master(self, addr, shard_id, **kw) -> Master:
+        defaults = dict(
+            config_servers=[self.cfg_addr], raft_timings=FAST_RAFT,
+            rpc_client=self.rpc,
+            intervals={"shard_refresh": 0.2, "split_detector": 0.3,
+                       "metrics_decay": 0.3, "data_shuffler": 0.3,
+                       "tx_cleanup": 1.0, "tx_recovery": 2.0},
+            split_cooldown_secs=2.0,
+        )
+        defaults.update(self.master_kw)
+        defaults.update(kw)
+        return Master(addr, [], str(self.tmp / f"m-{addr.rsplit(':', 1)[1]}"),
+                      shard_id=shard_id, **defaults)
+
+    async def start(self):
+        self.cfg_addr = f"127.0.0.1:{_free_port()}"
+        self.config = ConfigServer(self.cfg_addr, [], str(self.tmp / "cfg"),
+                                   raft_timings=FAST_RAFT, rpc_client=self.rpc)
+        await self._serve(self.cfg_addr, self.config)
+        await self.config.start()
+        for _ in range(100):
+            if self.config.raft.is_leader:
+                break
+            await asyncio.sleep(0.05)
+
+        self.main_addr = f"127.0.0.1:{_free_port()}"
+        self.spare_addr = f"127.0.0.1:{_free_port()}"
+        self.main = self._make_master(self.main_addr, "shard-0")
+        # The spare never auto-splits in tests (it adopts whatever range the
+        # main shard hands off, which may still be hot when traffic stops).
+        self.spare = self._make_master(self.spare_addr, "",
+                                       split_threshold_rps=1e9)
+        await self._serve(self.main_addr, self.main)
+        await self._serve(self.spare_addr, self.spare)
+        await self.rpc.call(self.cfg_addr, "ConfigService", "AddShard",
+                            {"shard_id": "shard-0",
+                             "peers": [self.main_addr]})
+        await self.main.start()
+        await self.spare.start()
+
+        master_addrs = [self.main_addr, self.spare_addr]
+        for i in range(self.n_cs):
+            store = BlockStore(self.tmp / f"cs{i}/hot")
+            cs = ChunkServer(store, rack_id=f"rack-{i}",
+                             master_addrs=master_addrs, rpc_client=self.rpc)
+            await cs.start(scrubber=False)
+            hb = HeartbeatLoop(cs, master_addrs, [self.cfg_addr],
+                               interval=0.3)
+            hb.start()
+            self.chunkservers.append(cs)
+            self.heartbeats.append(hb)
+
+        for _ in range(200):
+            if self.main.raft.is_leader and self.main.shard_map is not None \
+                    and not self.main.state.safe_mode:
+                break
+            if self.main.state.safe_mode and \
+                    self.main.state.should_exit_safe_mode():
+                self.main.state.exit_safe_mode()
+            await asyncio.sleep(0.05)
+        assert self.main.raft.is_leader
+        self.client = Client(master_addrs, config_addrs=[self.cfg_addr],
+                             rpc_client=self.rpc)
+        await self.client.refresh_shard_map()
+        return self
+
+    async def stop(self):
+        for hb in self.heartbeats:
+            hb.stop()
+        for cs in self.chunkservers:
+            await cs.stop()
+        await self.main.stop()
+        await self.spare.stop()
+        await self.config.stop()
+        for s in self.servers:
+            await s.stop()
+        await self.rpc.close()
+
+
+async def _wait(cond, timeout=15.0, interval=0.1, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------- integration: split
+
+
+async def test_auto_split_migrates_hot_prefix_to_spare(tmp_path):
+    c = await AutoCluster(
+        tmp_path, master_kw={"split_threshold_rps": 3.0}
+    ).start()
+    try:
+        await c.client.create_file("/hot/f1", b"h" * 2048)
+        await c.client.create_file("/cold/f1", b"c" * 1024)
+        # Hammer the hot prefix until the detector splits the shard.
+        for _ in range(300):
+            await c.client.get_file_info("/hot/f1")
+            if c.config.state.shard_map.version > 1 and \
+                    not c.main.state.migrations:
+                break
+            await asyncio.sleep(0.01)
+        await _wait(lambda: not c.main.state.migrations
+                    and c.spare.state.shard_id != "",
+                    msg="split migration to complete")
+        # The spare adopted the new shard and owns the hot prefix per map.
+        new_shard = c.spare.state.shard_id
+        assert new_shard.startswith("shard-0-split-")
+        assert c.config.state.shard_map.get_shard("/hot/f1") == new_shard
+        # Metadata moved: spare has it, main dropped it.
+        assert "/hot/f1" in c.spare.state.files
+        assert "/hot/f1" not in c.main.state.files
+        assert "/cold/f1" in c.main.state.files
+        # Reads still work through the client (redirect + refreshed map).
+        assert await c.client.get_file("/hot/f1") == b"h" * 2048
+        assert await c.client.get_file("/cold/f1") == b"c" * 1024
+        # And new writes land on the right shards.
+        await c.client.create_file("/hot/f2", b"new hot")
+        assert "/hot/f2" in c.spare.state.files
+    finally:
+        await c.stop()
+
+
+# ------------------------------------------------------- integration: merge
+
+
+async def test_auto_merge_retires_idle_shard(tmp_path):
+    c = await AutoCluster(
+        tmp_path,
+        master_kw={"split_threshold_rps": 1e9},
+    ).start()
+    try:
+        # Manually create a second shard on the spare (split at /m).
+        await c.rpc.call(c.cfg_addr, "ConfigService", "SplitShard",
+                         {"shard_id": "shard-0", "split_key": "/m",
+                          "new_shard_id": "shard-low",
+                          "peers": [c.spare_addr]})
+        await _wait(lambda: c.spare.state.shard_id == "shard-low",
+                    msg="spare to adopt shard-low")
+        await c.client.refresh_shard_map()
+        await c.client.create_file("/a/f", b"low keyspace")
+        assert "/a/f" in c.spare.state.files
+        # Now let shard-low be idle and enable auto-merge on it.
+        c.spare.monitor.merge_threshold_rps = 0.5
+        await _wait(lambda: not c.config.state.shard_map.has_shard("shard-low"),
+                    msg="merge to reshape the map")
+        await _wait(lambda: "/a/f" in c.main.state.files
+                    and not c.spare.state.migrations,
+                    msg="metadata handoff to retained shard")
+        # The retired group is back in the spare pool.
+        assert c.spare.state.shard_id == ""
+        # File still readable through the retained shard.
+        await c.client.refresh_shard_map()
+        assert await c.client.get_file("/a/f") == b"low keyspace"
+    finally:
+        await c.stop()
+
+
+# ----------------------------------------------------- integration: shuffle
+
+
+async def test_initiate_shuffle_respreads_blocks(tmp_path):
+    c = await AutoCluster(
+        tmp_path, n_cs=2,
+        master_kw={"split_threshold_rps": 1e9},
+    ).start()
+    try:
+        await c.client.create_file("/p/f1", b"s" * 4096)
+        # Constrain the block onto cs0 only, leaving cs1 without a copy.
+        found = c.main.state.find_block(
+            c.main.state.files["/p/f1"].blocks[0].block_id
+        )
+        _, block = found
+        cs0 = c.chunkservers[0].address
+        cs1 = c.chunkservers[1].address
+        await c.main.raft.propose({
+            "op": "mark_block_locations", "block_id": block.block_id,
+            "locations": [cs0],
+        })
+        await c.client.initiate_shuffle("/p/")
+        assert "/p/" in c.main.state.shuffling_prefixes
+        # The shuffler replicates it to the emptier server, then stops.
+        await _wait(lambda: cs1 in c.main.state.find_block(
+            block.block_id)[1].locations, msg="block re-spread to cs1")
+        await _wait(lambda: "/p/" not in c.main.state.shuffling_prefixes,
+                    msg="shuffle to self-stop")
+    finally:
+        await c.stop()

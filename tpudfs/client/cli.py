@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                                        "transfer-leader", "state"])
     sp.add_argument("address", nargs="?", default="")
 
+    sp = sub.add_parser("shuffle", help="re-spread a prefix's blocks across "
+                        "chunkservers (reference dfs_cli shuffle)")
+    sp.add_argument("prefix")
+
     sp = sub.add_parser("benchmark")
     sp.add_argument("action", choices=["write", "read", "stress-write"])
     sp.add_argument("--files", type=int, default=100)
@@ -236,6 +240,9 @@ async def amain(args) -> int:
                 elif args.action == "transfer-leader":
                     await client.cluster_transfer_leadership(args.address)
                 print("ok")
+        elif args.cmd == "shuffle":
+            await client.initiate_shuffle(args.prefix)
+            print(f"shuffle initiated for {args.prefix}")
         elif args.cmd == "benchmark":
             if args.action == "write":
                 await bench_write(client, args)

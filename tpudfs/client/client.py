@@ -522,5 +522,10 @@ class Client:
     async def cluster_transfer_leadership(self, target: str) -> None:
         await self._execute("TransferLeadership", {"target": target})
 
+    async def initiate_shuffle(self, prefix: str) -> None:
+        """Kick off background block re-spreading for a prefix (reference
+        InitiateShuffle master.rs:3620-3660, CLI `shuffle` dfs_cli.rs:96)."""
+        await self._execute("InitiateShuffle", {"prefix": prefix}, path=prefix)
+
     async def raft_state(self, master: str) -> dict:
         return await self.rpc.call(master, MASTER, "RaftState", {}, timeout=5.0)

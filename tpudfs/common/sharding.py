@@ -151,29 +151,68 @@ class ShardMap:
         self.version += 1
         return True
 
+    def carve_shard(self, start: str, end: str, new_shard_id: str,
+                    peers: list[str]) -> bool:
+        """Give exactly the key interval (start, end] — which must lie within
+        one existing range — to a new shard. Unlike ``split_shard`` (one
+        boundary; the new shard takes everything below the split key,
+        reference sharding.rs:181-208), carving isolates a hot key range
+        without dragging its cold neighbors along: the owner keeps both
+        flanks. Half-open-from-below matches the map's lookup semantics (a
+        key equal to a boundary belongs to the range that boundary
+        terminates), so carving a path prefix uses start=prefix,
+        end=prefix+sentinel: every real file path under the prefix sorts
+        strictly between the two."""
+        if self.strategy != "range" or not self._range_ends:
+            return False
+        if new_shard_id in self._peers or start >= end:
+            return False
+        eidx = bisect.bisect_left(self._range_ends, end)
+        if eidx >= len(self._range_ends):
+            return False  # end beyond all ranges
+        # Keys strictly above `start` live in the range bisect_right finds
+        # (bisect_left would land on `start`'s own terminating range when
+        # start is an existing boundary — e.g. re-carving a prefix whose
+        # lower-flank boundary survived an earlier carve+merge cycle).
+        if bisect.bisect_right(self._range_ends, start) != eidx:
+            return False  # spans an existing boundary
+        owner = self._range_ids[eidx]
+        if self._range_ends[eidx] == end:
+            # Carve reaches the range's top boundary: re-own it.
+            self._range_ids[eidx] = new_shard_id
+        else:
+            self._insert_range(end, new_shard_id)
+        start_boundary_exists = eidx > 0 and self._range_ends[eidx - 1] == start
+        if start and not start_boundary_exists:
+            self._insert_range(start, owner)
+        self._peers[new_shard_id] = list(peers)
+        self.version += 1
+        return True
+
     def merge_shards(self, victim_shard_id: str, retained_shard_id: str) -> bool:
-        """Remove victim's split point, folding its range into a neighbor
-        (reference sharding.rs:212-247)."""
+        """Remove victim's split points, folding each of its ranges into the
+        range above (reference sharding.rs:212-247; generalized to victims
+        owning several carved ranges)."""
         if self.strategy != "range":
             return False
+        if victim_shard_id == retained_shard_id:
+            return False  # self-merge would re-insert the tail forever
         if victim_shard_id not in self._peers or retained_shard_id not in self._peers:
             return False
-        try:
+        while victim_shard_id in self._range_ids:
             vidx = self._range_ids.index(victim_shard_id)
-        except ValueError:
-            return False
-        vkey = self._range_ends[vidx]
-        del self._range_ends[vidx]
-        del self._range_ids[vidx]
-        if vkey == RANGE_MAX:
-            # Victim owned the tail range: retained must take over RANGE_MAX.
-            try:
-                ridx = self._range_ids.index(retained_shard_id)
-                del self._range_ends[ridx]
-                del self._range_ids[ridx]
-            except ValueError:
-                pass
-            self._insert_range(RANGE_MAX, retained_shard_id)
+            vkey = self._range_ends[vidx]
+            del self._range_ends[vidx]
+            del self._range_ids[vidx]
+            if vkey == RANGE_MAX:
+                # Victim owned the tail range: retained takes over RANGE_MAX.
+                try:
+                    ridx = self._range_ids.index(retained_shard_id)
+                    del self._range_ends[ridx]
+                    del self._range_ids[ridx]
+                except ValueError:
+                    pass
+                self._insert_range(RANGE_MAX, retained_shard_id)
         del self._peers[victim_shard_id]
         self.version += 1
         return True
@@ -192,6 +231,42 @@ class ShardMap:
         self._insert_range(new_key, shard_id)
         self.version += 1
         return True
+
+    def shard_interval(self, shard_id: str) -> tuple[str, str] | None:
+        """The (start, end] key interval a shard owns, when it owns exactly
+        one range; None otherwise. ``start`` is the boundary below (keys
+        equal to it belong to the shard below, matching lookup semantics).
+        Delegates the boundary derivation to range_of so the two can't
+        diverge."""
+        if self._range_ids.count(shard_id) != 1:
+            return None
+        return self.range_of(shard_id)
+
+    def merge_target(self, shard_id: str) -> str | None:
+        """The shard that would inherit ``shard_id``'s keyspace if its
+        boundaries were removed: the owner of the range just above, or the
+        predecessor when the victim owns the tail (merge_shards hands
+        RANGE_MAX to the retained shard explicitly). None when the victim
+        owns several disjoint runs (the fold would scatter its keyspace
+        across different inheritors) or has no neighbor."""
+        if self.strategy != "range":
+            return None
+        runs: list[int] = []  # index just past each victim run
+        i, n = 0, len(self._range_ids)
+        while i < n:
+            if self._range_ids[i] == shard_id:
+                while i < n and self._range_ids[i] == shard_id:
+                    i += 1
+                runs.append(i)
+            else:
+                i += 1
+        if len(runs) != 1:
+            return None
+        after = runs[0]
+        if after < n:
+            return self._range_ids[after]
+        prev, _ = self.get_neighbors(shard_id)
+        return prev
 
     def get_neighbors(self, shard_id: str) -> tuple[str | None, str | None]:
         """(previous, next) shards in range order (reference sharding.rs:263-277)."""

@@ -28,6 +28,10 @@ SERVICE = "ConfigService"
 #: (reference config_server.rs:143-156 picks 3).
 AUTO_ALLOC_MASTERS = 3
 
+#: Reserved-but-never-carved spare groups are released after this long.
+ASSIGNMENT_GC_GRACE_MS = 120_000
+ASSIGNMENT_GC_INTERVAL = 30.0
+
 
 class ConfigServer:
     def __init__(
@@ -45,6 +49,8 @@ class ConfigServer:
         self._owns_client = rpc_client is None
         self.client = rpc_client or RpcClient()
         self.auto_alloc_masters = auto_alloc_masters
+        self.gc_interval = ASSIGNMENT_GC_INTERVAL
+        self._tasks: set[asyncio.Task] = set()
         self.raft = RaftNode(
             address, peers, data_dir,
             apply=self.state.apply,
@@ -62,6 +68,8 @@ class ConfigServer:
             "AddShard": self.rpc_add_shard,
             "RemoveShard": self.rpc_remove_shard,
             "SplitShard": self.rpc_split_shard,
+            "CarveShard": self.rpc_carve_shard,
+            "AllocateShardGroup": self.rpc_allocate_shard_group,
             "MergeShards": self.rpc_merge_shards,
             "RebalanceShard": self.rpc_rebalance_shard,
             "RegisterMaster": self.rpc_register_master,
@@ -78,11 +86,42 @@ class ConfigServer:
 
     async def start(self) -> None:
         await self.raft.start()
+        task = asyncio.create_task(self._gc_loop())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def stop(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()
+        self._tasks.clear()
         await self.raft.stop()
         if self._owns_client:
             await self.client.close()
+
+    async def _gc_loop(self) -> None:
+        """Release spare-group reservations whose shard never reached the
+        map (an aborted carve would otherwise leak the group forever)."""
+        while True:
+            await asyncio.sleep(self.gc_interval)
+            if not self.raft.is_leader:
+                continue
+            stale = any(
+                info.get("shard_id")
+                and not self.state.shard_map.has_shard(info["shard_id"])
+                for info in self.state.masters.values()
+            )
+            if not stale:
+                continue
+            try:
+                res = await self.raft.propose({
+                    "op": "gc_assignments", "at_ms": now_ms(),
+                    "grace_ms": ASSIGNMENT_GC_GRACE_MS,
+                })
+                if res.get("cleared"):
+                    logger.info("released stale spare reservations: %s",
+                                res["cleared"])
+            except (NotLeaderError, ValueError):
+                pass
 
     # -------------------------------------------------------------- helpers
 
@@ -94,15 +133,27 @@ class ConfigServer:
         except ValueError as e:
             raise RpcError.invalid(str(e)) from None
 
-    def _allocate_peers(self, requested: list[str] | None) -> list[str]:
+    def _allocate_peers(self, requested: list[str] | None,
+                        allow_assigned: bool = True) -> list[str]:
         """Caller-named peers, or the healthiest unassigned registered
         masters (falling back to assigned ones — the reference shares masters
-        across shards when the registry is small)."""
+        across shards when the registry is small). Auto-splits pass
+        ``allow_assigned=False``: a master already serving a shard keeps its
+        boot shard identity and would never adopt the split-off range, so
+        allocating it would strand the migration."""
         if requested:
             return list(requested)
         at = now_ms()
+        if not allow_assigned:
+            # Auto-split path: allocate one whole spare Raft group.
+            peers = self.state.allocate_group(at)
+            if not peers:
+                raise RpcError.unavailable(
+                    "no healthy registered masters to allocate for the shard"
+                )
+            return peers
         peers = self.state.healthy_masters(at)[: self.auto_alloc_masters]
-        if not peers:
+        if not peers and allow_assigned:
             peers = self.state.healthy_masters(at, unassigned_only=False)[
                 : self.auto_alloc_masters
             ]
@@ -138,10 +189,47 @@ class ConfigServer:
         return {"success": True, "version": result["version"]}
 
     async def rpc_split_shard(self, req: dict) -> dict:
-        peers = self._allocate_peers(req.get("peers"))
+        peers = self._allocate_peers(req.get("peers"),
+                                     allow_assigned=not req.get("auto"))
         result = await self._propose({
             "op": "split_shard",
             "split_key": req["split_key"],
+            "new_shard_id": req["new_shard_id"],
+            "peers": peers,
+        })
+        return {"success": True, "peers": peers, "version": result["version"]}
+
+    async def rpc_allocate_shard_group(self, req: dict) -> dict:
+        """Reserve one whole spare Raft group for a shard about to be carved
+        (pre-map-flip, so the source can stage metadata at the target before
+        any key routes there). Selection happens inside the Raft apply
+        (_apply_allocate_group) — serialized, so concurrent splits can't
+        grab the same group. Idempotent by shard id, and each call
+        refreshes the reservation so the GC leaves live migrations alone."""
+        try:
+            result = await self._propose({
+                "op": "allocate_group", "shard_id": req["shard_id"],
+                "at_ms": now_ms(),
+            })
+        except RpcError as e:
+            if e.code.name == "INVALID_ARGUMENT" and \
+                    "no healthy registered masters" in e.message:
+                # Deterministic capacity refusal — surface as UNAVAILABLE
+                # (the caller's abandon heuristic keys on it).
+                raise RpcError.unavailable(e.message) from None
+            raise
+        return {"success": True, "peers": result["peers"]}
+
+    async def rpc_carve_shard(self, req: dict) -> dict:
+        """Hand exactly the key interval (start, end] inside one shard's
+        range to a freshly allocated shard (the auto-split path; see
+        ShardMap.carve_shard for the boundary semantics)."""
+        peers = self._allocate_peers(req.get("peers"),
+                                     allow_assigned=not req.get("auto"))
+        result = await self._propose({
+            "op": "carve_shard",
+            "start": req["start"],
+            "end": req["end"],
             "new_shard_id": req["new_shard_id"],
             "peers": peers,
         })
@@ -168,9 +256,15 @@ class ConfigServer:
             "op": "register_master",
             "address": req["address"],
             "shard_id": req.get("shard_id"),
+            "group": req.get("group") or [],
             "at_ms": now_ms(),
         })
-        return {"success": True}
+        # The registry's view of this master's assignment: a spare master
+        # registering with an empty shard_id learns here that a split
+        # allocated it to a new shard (it then adopts via Raft).
+        info = self.state.masters.get(req["address"]) or {}
+        return {"success": True,
+                "assigned_shard_id": info.get("shard_id") or ""}
 
     async def rpc_shard_heartbeat(self, req: dict) -> dict:
         await self._propose({
@@ -178,6 +272,7 @@ class ConfigServer:
             "shard_id": req["shard_id"],
             "address": req.get("address", ""),
             "at_ms": now_ms(),
+            "rps_per_prefix": req.get("rps_per_prefix") or {},
         })
         return {"success": True, "shard_map_version": self.state.shard_map.version}
 

@@ -86,6 +86,18 @@ class ConfigState:
         self._assign(list(cmd["peers"]), cmd["new_shard_id"])
         return {"success": True, "version": self.shard_map.version}
 
+    def _apply_carve_shard(self, cmd: dict):
+        ok = self.shard_map.carve_shard(
+            cmd["start"], cmd["end"], cmd["new_shard_id"], list(cmd["peers"])
+        )
+        if not ok:
+            raise ValueError(
+                f"cannot carve ({cmd['start']!r}, {cmd['end']!r}] "
+                f"into {cmd['new_shard_id']!r}"
+            )
+        self._assign(list(cmd["peers"]), cmd["new_shard_id"])
+        return {"success": True, "version": self.shard_map.version}
+
     def _apply_merge_shards(self, cmd: dict):
         victim = cmd["victim_shard_id"]
         peers = self.shard_map.get_peers(victim) or []
@@ -105,28 +117,115 @@ class ConfigState:
         return {"success": True, "version": self.shard_map.version}
 
     def _apply_register_master(self, cmd: dict):
+        """The registry is the assignment authority: a master
+        re-registering with a stale shard id (e.g. during the
+        merge-retirement window, before its own complete_migration clears
+        it) must not resurrect an assignment the registry revoked. A
+        master-REPORTED shard id is honored only when the map corroborates
+        it (the shard exists and lists this master as a peer) — that keeps
+        the manual flow working (operator AddShard + master boot
+        --shard-id) while a group actively serving a mapped shard can
+        never be misread as spare and double-allocated."""
         addr = cmd["address"]
-        prev = self.masters.get(addr, {})
+        prev = self.masters.get(addr)
+        reported = cmd.get("shard_id") or None
+        sid = prev.get("shard_id") if prev is not None else None
+        assigned_at = prev.get("assigned_at_ms", 0) if prev is not None \
+            else int(cmd["at_ms"])
+        if sid is None and reported and self.shard_map.has_shard(reported) \
+                and addr in (self.shard_map.get_peers(reported) or []):
+            sid = reported
+            assigned_at = int(cmd["at_ms"])
         self.masters[addr] = {
-            "shard_id": cmd.get("shard_id") or prev.get("shard_id"),
+            "shard_id": sid,
+            "assigned_at_ms": assigned_at,
             "last_heartbeat_ms": int(cmd["at_ms"]),
+            # The master's full Raft group (voters) — the allocation unit
+            # for auto-splits.
+            "group": list(cmd.get("group")
+                          or (prev or {}).get("group") or [addr]),
         }
         return {"success": True}
+
+    def _apply_allocate_group(self, cmd: dict):
+        """Reserve one whole spare group for ``shard_id`` — selection runs
+        HERE, inside the serialized apply, so two concurrent splits can
+        never read the same unreserved group (the RPC-layer
+        select-then-propose had exactly that TOCTOU). Idempotent by shard
+        id, refreshing the reservation's liveness timestamp on every call
+        so the GC can't release a reservation its migration still uses."""
+        shard_id = cmd["shard_id"]
+        at = int(cmd["at_ms"])
+        existing = sorted(
+            a for a, i in self.masters.items()
+            if i.get("shard_id") == shard_id
+        )
+        if existing:
+            self._assign(existing, shard_id, at_ms=at)
+            return {"success": True, "peers": existing}
+        peers = self.allocate_group(at)
+        if not peers:
+            raise ValueError(
+                "no healthy registered masters to allocate for the shard"
+            )
+        self._assign(peers, shard_id, at_ms=at)
+        return {"success": True, "peers": peers}
+
+    def _apply_assign_group(self, cmd: dict):
+        """Reserve a spare group for a shard about to be carved (the
+        freeze->stage->flip protocol allocates peers before the map
+        changes, so the source knows where to stage the metadata)."""
+        self._assign(list(cmd["peers"]), cmd["shard_id"],
+                     at_ms=int(cmd["at_ms"]))
+        return {"success": True}
+
+    def _apply_gc_assignments(self, cmd: dict):
+        """Release reservations whose shard never made it into the map
+        (aborted carve) after a grace period — otherwise the spare group is
+        leaked forever."""
+        at = int(cmd["at_ms"])
+        cleared = []
+        for addr, info in self.masters.items():
+            sid = info.get("shard_id")
+            if sid and not self.shard_map.has_shard(sid) and \
+                    at - info.get("assigned_at_ms", 0) > int(cmd["grace_ms"]):
+                info["shard_id"] = None
+                cleared.append(addr)
+        return {"success": True, "cleared": cleared}
+
+    def allocate_group(self, at_ms: int) -> list[str]:
+        """One whole spare Raft group for a new shard, healthiest first.
+        Allocating individual addresses from different groups would make
+        each group adopt the shard independently (split brain), so a group
+        qualifies only if every registered member is unassigned."""
+        for addr in self.healthy_masters(at_ms):
+            group = self.masters[addr].get("group") or [addr]
+            if any(self.masters.get(g, {}).get("shard_id") for g in group):
+                continue
+            return list(group)
+        return []
 
     def _apply_shard_heartbeat(self, cmd: dict):
         at = int(cmd["at_ms"])
         self.shard_health[cmd["shard_id"]] = {
             "last_heartbeat_ms": at,
             "from": cmd.get("address", ""),
+            # Per-prefix load reported by the shard leader (reference
+            # ShardHeartbeatRequest.rps_per_prefix, master.rs:1539-1561) —
+            # surfaced via ListMasters/metrics for operators.
+            "rps_per_prefix": dict(cmd.get("rps_per_prefix") or {}),
         }
         if cmd.get("address") in self.masters:
             self.masters[cmd["address"]]["last_heartbeat_ms"] = at
         return {"success": True}
 
-    def _assign(self, peers: list[str], shard_id: str | None) -> None:
+    def _assign(self, peers: list[str], shard_id: str | None,
+                at_ms: int | None = None) -> None:
         for p in peers:
             if p in self.masters:
                 self.masters[p]["shard_id"] = shard_id
+                if at_ms is not None:
+                    self.masters[p]["assigned_at_ms"] = at_ms
 
     # ---------------------------------------------------------- persistence
 

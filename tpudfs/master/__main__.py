@@ -21,8 +21,14 @@ def parse_args(argv=None):
     p.add_argument("--advertise", default="", help="address peers/clients use")
     p.add_argument("--data-dir", required=True)
     p.add_argument("--peers", default="", help="comma-separated peer master addresses")
-    p.add_argument("--shard-id", default="shard-0")
+    p.add_argument("--shard-id", default="shard-0",
+                   help='"" registers as a spare master awaiting allocation')
     p.add_argument("--config-servers", default="")
+    # Dynamic sharding thresholds (reference bin/master.rs:51-58).
+    p.add_argument("--split-threshold-rps", type=float, default=100.0)
+    p.add_argument("--merge-threshold-rps", type=float, default=-1.0,
+                   help="negative disables auto-merge")
+    p.add_argument("--split-cooldown-secs", type=float, default=30.0)
     return p.parse_args(argv)
 
 
@@ -31,7 +37,10 @@ async def amain(args) -> None:
     peers = [x for x in args.peers.split(",") if x]
     configs = [x for x in args.config_servers.split(",") if x]
     master = Master(address, peers, args.data_dir, shard_id=args.shard_id,
-                    config_servers=configs)
+                    config_servers=configs,
+                    split_threshold_rps=args.split_threshold_rps,
+                    merge_threshold_rps=args.merge_threshold_rps,
+                    split_cooldown_secs=args.split_cooldown_secs)
     server = RpcServer(args.host, args.port)
     master.attach(server)
     await server.start()

@@ -1,0 +1,134 @@
+"""Dynamic sharding: per-prefix load monitoring for auto split/merge.
+
+Model: the reference's ThroughputMonitor (dfs/metaserver/src/master.rs:610-675)
+feeding run_split_detector (master.rs:1483-1837) — per-top-level-prefix
+RPS/BPS exponential moving averages decayed on a fixed interval, a split
+threshold with a cooldown, and a merge threshold on total shard RPS (negative
+= disabled, as in the reference's bin/master.rs merge_threshold_rps flag).
+
+Design deviation (deliberate): the reference's split maps the NEW shard to
+keys < prefix but then migrates files >= prefix to it — the moved file set
+contradicts the moved key range (master.rs:1628-1639 vs sharding.rs:181-208).
+Here the split key is ``prefix_end(prefix)`` so the new shard takes the range
+that *contains* the hot prefix, and the migrated file set (< split key) is
+exactly the key range the map hands over. Likewise the reference's merge
+keeps the underutilized shard and swallows a neighbor; here the underutilized
+shard retires itself INTO the neighbor (victim = self), which is the direction
+that actually shrinks the fleet.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+#: Sorts after every real key sharing the prefix (same sentinel as
+#: sharding.RANGE_MAX, scoped to one prefix).
+PREFIX_END_SENTINEL = "\U0010ffff"
+
+
+def prefix_of(path: str) -> str:
+    """Top-level path prefix: "/a/b/c" -> "/a/", "/x" -> "/x/", "/" -> "/"
+    (reference get_path_prefix master.rs:647-655)."""
+    parts = [p for p in path.split("/") if p]
+    return f"/{parts[0]}/" if parts else "/"
+
+
+def prefix_end(prefix: str) -> str:
+    """Exclusive upper bound of all keys under ``prefix``."""
+    return prefix + PREFIX_END_SENTINEL
+
+
+@dataclass
+class PrefixMetrics:
+    """EMA-smoothed load for one prefix (reference master.rs:610-616)."""
+
+    rps: float = 0.0
+    bps: float = 0.0
+    last_count: int = 0
+    last_bytes: int = 0
+
+
+@dataclass
+class ThroughputMonitor:
+    """Per-prefix request/byte rates with periodic EMA decay.
+
+    ``decay()`` folds the counts accumulated since the previous call into the
+    moving averages with weight 0.7 on the new sample (reference
+    decay_metrics master.rs:656-674), assuming calls every ``interval_secs``.
+    """
+
+    split_threshold_rps: float = 100.0  # reference bin/master.rs:51-52
+    merge_threshold_rps: float = -1.0  # < 0 disables merging
+    split_cooldown_secs: float = 30.0  # reference bin/master.rs:54-55
+    interval_secs: float = 5.0
+    metrics: dict[str, PrefixMetrics] = field(default_factory=dict)
+    # None until the first cooldown check: the clock starts on first use, so
+    # a freshly (re)elected leader — whose EMAs are process-local and still
+    # empty — spends one full cooldown warming up before it may reshard.
+    # Without the warm-up, merge-enabled masters would read total_rps()==0
+    # right after failover and retire a shard that was busy seconds earlier.
+    _last_reshard: float | None = None
+
+    def record(self, path: str, num_bytes: int = 0) -> None:
+        m = self.metrics.setdefault(prefix_of(path), PrefixMetrics())
+        m.last_count += 1
+        m.last_bytes += num_bytes
+
+    #: Entries whose EMAs have decayed below this are evicted — otherwise
+    #: the table (and every ShardHeartbeat carrying it) grows with the
+    #: lifetime count of top-level prefixes ever touched.
+    EVICT_RPS = 0.01
+
+    def decay(self) -> None:
+        dead = []
+        for prefix, m in self.metrics.items():
+            m.rps = m.rps * 0.3 + (m.last_count / self.interval_secs) * 0.7
+            m.bps = m.bps * 0.3 + (m.last_bytes / self.interval_secs) * 0.7
+            m.last_count = 0
+            m.last_bytes = 0
+            if m.rps < self.EVICT_RPS and m.bps < self.EVICT_RPS:
+                dead.append(prefix)
+        for prefix in dead:
+            del self.metrics[prefix]
+
+    # ------------------------------------------------------------- decisions
+
+    def in_cooldown(self, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        if self._last_reshard is None:
+            self._last_reshard = now  # warm-up: first check starts the clock
+        return now - self._last_reshard < self.split_cooldown_secs
+
+    def mark_resharded(self, now: float | None = None) -> None:
+        """Start the cooldown clock; shared by split and merge so the two
+        detectors can't thrash the map in alternation."""
+        self._last_reshard = time.monotonic() if now is None else now
+
+    def hot_prefix(self, now: float | None = None) -> tuple[str, float] | None:
+        """Hottest prefix above the split threshold, unless cooling down
+        (reference master.rs:1565-1581)."""
+        if self.in_cooldown(now):
+            return None
+        best: tuple[str, float] | None = None
+        for prefix, m in self.metrics.items():
+            if m.rps > self.split_threshold_rps and (
+                best is None or m.rps > best[1]
+            ):
+                best = (prefix, m.rps)
+        return best
+
+    def total_rps(self) -> float:
+        return sum(m.rps for m in self.metrics.values())
+
+    def should_merge(self, now: float | None = None) -> bool:
+        """Total load below the merge threshold (reference
+        master.rs:1720-1735), respecting the shared cooldown."""
+        return (
+            self.merge_threshold_rps >= 0.0
+            and not self.in_cooldown(now)
+            and self.total_rps() < self.merge_threshold_rps
+        )
+
+    def rps_per_prefix(self) -> dict[str, float]:
+        return {p: m.rps for p, m in self.metrics.items()}

@@ -27,7 +27,7 @@ import uuid
 
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
 from tpudfs.common.sharding import ShardMap
-from tpudfs.master import placement
+from tpudfs.master import autoshard, placement
 from tpudfs.master.state import (
     MasterState,
     REPLICATION_FACTOR,
@@ -50,6 +50,10 @@ TIERING_INTERVAL = 60.0
 SHARD_REFRESH_INTERVAL = 5.0  # reference master.rs:1429
 TX_CLEANUP_INTERVAL = 5.0  # reference master.rs:968
 TX_RECOVERY_INTERVAL = 30.0  # reference master.rs:1171
+METRICS_DECAY_INTERVAL = 5.0  # reference master.rs:1421-1427
+SPLIT_DETECTOR_INTERVAL = 5.0  # reference master.rs:1495
+DATA_SHUFFLER_INTERVAL = 10.0  # reference master.rs:1325
+STAGED_INGEST_TTL_MS = 600_000  # abandoned-stage GC horizon
 DEFAULT_COLD_THRESHOLD_SECS = 7 * 24 * 3600  # reference: COLD_THRESHOLD_SECS
 DEFAULT_EC_THRESHOLD_SECS = 30 * 24 * 3600  # reference: EC_THRESHOLD_SECS
 EC_CONVERSION_SHAPE = (6, 3)  # reference RS(6,3), master.rs:2016-2138
@@ -70,6 +74,9 @@ class Master:
         ec_threshold_secs: int | None = None,
         liveness_cutoff_ms: int = LIVENESS_CUTOFF_MS,
         intervals: dict | None = None,
+        split_threshold_rps: float = 100.0,
+        merge_threshold_rps: float = -1.0,
+        split_cooldown_secs: float = 30.0,
     ):
         self.address = address
         self.config_servers = list(config_servers or [])
@@ -106,7 +113,16 @@ class Master:
             "shard_refresh": iv.get("shard_refresh", SHARD_REFRESH_INTERVAL),
             "tx_cleanup": iv.get("tx_cleanup", TX_CLEANUP_INTERVAL),
             "tx_recovery": iv.get("tx_recovery", TX_RECOVERY_INTERVAL),
+            "metrics_decay": iv.get("metrics_decay", METRICS_DECAY_INTERVAL),
+            "split_detector": iv.get("split_detector", SPLIT_DETECTOR_INTERVAL),
+            "data_shuffler": iv.get("data_shuffler", DATA_SHUFFLER_INTERVAL),
         }
+        self.monitor = autoshard.ThroughputMonitor(
+            split_threshold_rps=split_threshold_rps,
+            merge_threshold_rps=merge_threshold_rps,
+            split_cooldown_secs=split_cooldown_secs,
+            interval_secs=self._intervals["metrics_decay"],
+        )
         self.tx = TransactionManager(self)
         self._tasks: set[asyncio.Task] = set()
 
@@ -136,6 +152,10 @@ class Master:
             "AbortTransaction": self.tx.rpc_abort,
             "InquireTransaction": self.tx.rpc_inquire,
             "IngestMetadata": self.rpc_ingest_metadata,
+            "InitiateShuffle": self.rpc_initiate_shuffle,
+            "StageIngest": self.rpc_stage_ingest,
+            "CommitStagedIngest": self.rpc_commit_staged_ingest,
+            "DropStagedIngest": self.rpc_drop_staged_ingest,
         }
 
     def attach(self, server: RpcServer) -> None:
@@ -151,6 +171,10 @@ class Master:
             self._spawn(self._loop(self._intervals["tiering"], self.run_tiering_scan))
             self._spawn(self._loop(self._intervals["tx_cleanup"], self.tx.run_cleanup))
             self._spawn(self._loop(self._intervals["tx_recovery"], self.tx.run_recovery))
+            self._spawn(self._loop(self._intervals["metrics_decay"],
+                                   self.run_metrics_decay))
+            self._spawn(self._loop(self._intervals["data_shuffler"],
+                                   self.run_data_shuffler))
             if self.config_servers:
                 # Prime the map BEFORE serving: without it a sharded master
                 # can't tell its keys from a peer's and could e.g. apply a
@@ -167,6 +191,8 @@ class Master:
                     await asyncio.sleep(0.5)
                 self._spawn(self._loop(self._intervals["shard_refresh"],
                                        self.run_shard_refresh))
+                self._spawn(self._loop(self._intervals["split_detector"],
+                                       self.run_split_detector))
 
     def _spawn(self, coro) -> None:
         task = asyncio.create_task(coro)
@@ -234,6 +260,19 @@ class Master:
                     f"path {p!r} is locked by an in-flight transaction"
                 )
 
+    def _check_migration_freeze(self, *paths: str) -> None:
+        """Writes in a range with an open outgoing migration are frozen
+        until the handoff completes (or aborts): an acknowledged write after
+        the metadata snapshot was staged would be silently lost when the
+        target publishes the stage. Reads keep being served from our copy
+        until the map flips."""
+        for p in paths:
+            if self.state.migrating_out(p):
+                raise RpcError.unavailable(
+                    f"range containing {p!r} is migrating to another shard; "
+                    "retry shortly"
+                )
+
     def _owner_shard(self, path: str) -> str | None:
         if self.shard_map is None:
             return None
@@ -245,12 +284,26 @@ class Master:
         map hasn't loaded yet fails CLOSED (it can't tell its keys from a
         peer's); an unsharded one (no config servers) skips the check, as
         does one whose shard isn't in the map yet (bootstrap)."""
+        if not self.state.shard_id:
+            # Spare (unassigned) master: it owns no range at all, so every
+            # namespace op fails closed until a split allocates it a shard.
+            raise RpcError.unavailable(
+                "master not yet assigned to a shard; retry shortly"
+            )
         if self.shard_map is None:
             if self.config_servers:
                 raise RpcError.unavailable(
                     "shard map not yet loaded; retry shortly"
                 )
             return
+        if self.state.staged_in(path):
+            # We own this range per the map (or soon will), but its metadata
+            # is still staged, not published: unavailable — NOT found=False,
+            # which would 404 existing files and let new writes be clobbered
+            # by the staged publish.
+            raise RpcError.unavailable(
+                f"range containing {path!r} is migrating in; retry shortly"
+            )
         if not self.shard_map.has_shard(self.state.shard_id):
             return
         owner = self.shard_map.get_shard(path)
@@ -328,7 +381,9 @@ class Master:
     async def rpc_create_file(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
+        self._check_migration_freeze(req["path"])
         self._check_tx_lock(req["path"])
+        self.monitor.record(req["path"])
         await self._propose({
             "op": "create_file",
             "path": req["path"],
@@ -342,6 +397,7 @@ class Master:
     async def rpc_allocate_block(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
+        self._check_migration_freeze(req["path"])
         # Leadership first: a follower's local state may lag, and the client
         # must get a redirect rather than a spurious not_found.
         if not self.raft.is_leader:
@@ -381,7 +437,9 @@ class Master:
     async def rpc_complete_file(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
+        self._check_migration_freeze(req["path"])
         self._check_tx_lock(req["path"])
+        self.monitor.record(req["path"], int(req["size"]))
         await self._propose({
             "op": "complete_file",
             "path": req["path"],
@@ -396,6 +454,7 @@ class Master:
         self._check_shard_ownership(req["path"])
         await self._linearizable_read()
         f = self.state.get_file(req["path"])
+        self.monitor.record(req["path"], f.size if f else 0)
         if f is None:
             return {"found": False, "metadata": None}
         # Fire-and-forget access-stats update for tiering
@@ -414,6 +473,7 @@ class Master:
     async def rpc_delete_file(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
+        self._check_migration_freeze(req["path"])
         self._check_tx_lock(req["path"])
         await self._propose({"op": "delete_file", "path": req["path"]})
         return {"success": True}
@@ -441,6 +501,7 @@ class Master:
                 logger.warning("rename: shard map refresh failed (%s); "
                                "using cached map", e.message)
         self._check_shard_ownership(src)
+        self._check_migration_freeze(src, dst)
         self._check_tx_lock(src, dst)
         replace = bool(req.get("replace"))
         dest_shard = self._owner_shard(dst)
@@ -595,6 +656,10 @@ class Master:
         if not self.raft.is_leader:
             raise RpcError.not_leader(self.raft.leader_hint)
         files = dict(req["files"])
+        # Same freeze as every other namespace write: an ingest into a
+        # migrating (or staged-in) range would be acked and then lost to
+        # the sweep / clobbered by the staged publish. Apply re-checks too.
+        self._check_migration_freeze(*files.keys())
         if self.shard_map is not None and \
                 self.shard_map.has_shard(self.state.shard_id):
             foreign = [p for p in files
@@ -607,6 +672,452 @@ class Master:
                 )
         result = await self._propose({"op": "ingest_metadata", "files": files})
         return {"success": True, "count": result["count"]}
+
+    async def rpc_initiate_shuffle(self, req: dict) -> dict:
+        """Operator-triggered background block re-spread for a prefix
+        (reference InitiateShuffle master.rs:3620-3660)."""
+        self._check_safe_mode()
+        # Probe with a key strictly inside the prefix: the prefix string
+        # itself can be a carve boundary, and a key equal to a boundary
+        # belongs to the range below it (the flank, not the prefix's owner).
+        self._check_shard_ownership(req["prefix"] + "\x00")
+        await self._propose({"op": "trigger_shuffle", "prefix": req["prefix"]})
+        return {"success": True}
+
+    async def run_metrics_decay(self) -> None:
+        """EMA-fold the per-prefix counters (reference master.rs:1421-1427)."""
+        self.monitor.decay()
+
+    async def run_split_detector(self) -> None:
+        """Auto split/merge driver (reference run_split_detector
+        master.rs:1483-1837). Leader-only. Resumes any in-flight migration
+        before considering new ones — at most one reshard is in flight per
+        shard, and a leader crash mid-handoff is picked up here by the next
+        leader from the replicated migration record."""
+        if not self.raft.is_leader or not self.config_servers:
+            return
+        await self._gc_staged_ingests()
+        if self.state.migrations:
+            for mid, mig in list(self.state.migrations.items()):
+                await self._advance_migration(mid, dict(mig))
+            return
+        if not self.state.shard_id:
+            return
+        hot = self.monitor.hot_prefix()
+        if hot is not None:
+            await self._start_split(*hot)
+            return
+        if self.monitor.should_merge():
+            await self._start_merge()
+
+    async def _start_split(self, prefix: str, rps: float) -> None:
+        """Kick off a hot-prefix split: record the migration intent in Raft
+        FIRST (crash-resumable), then carve exactly the hot prefix's range
+        out to a freshly allocated shard and hand its metadata over."""
+        if self.shard_map is not None:
+            owner = self.shard_map.get_shard(prefix)
+            if owner is not None and owner != self.state.shard_id:
+                return  # raced: another shard owns the hot range now
+            interval = self.shard_map.shard_interval(self.state.shard_id)
+            if interval is not None and interval[0] >= prefix \
+                    and interval[1] <= autoshard.prefix_end(prefix):
+                # Our whole range already IS (or sits inside) the hot
+                # prefix: carving it off again cannot spread the load, it
+                # would only hand the identical range to a fresh group and
+                # leave this one range-less — forever, every cooldown.
+                return
+        new_shard_id = f"{self.state.shard_id}-split-{uuid.uuid4().hex[:8]}"
+        mid = f"mig-{uuid.uuid4().hex[:12]}"
+        logger.warning(
+            "hot prefix %s (%.1f rps > %.1f): splitting into %s",
+            prefix, rps, self.monitor.split_threshold_rps, new_shard_id,
+        )
+        await self._propose({
+            "op": "begin_migration", "migration_id": mid, "kind": "split",
+            "target_shard_id": new_shard_id, "start": prefix,
+            "end": autoshard.prefix_end(prefix), "prefix": prefix,
+        })
+        self.monitor.mark_resharded()
+        await self._advance_migration(mid, self.state.migrations.get(mid, {}))
+
+    async def _start_merge(self) -> None:
+        """Underutilized shard retires itself into the range-neighbor that
+        inherits its keyspace when its boundaries fold away (victim = self;
+        deviation from the reference documented in autoshard.py)."""
+        if self.shard_map is None or len(self.shard_map.shards) < 2:
+            return
+        target = self.shard_map.merge_target(self.state.shard_id)
+        interval = self.shard_map.shard_interval(self.state.shard_id)
+        if target is None or interval is None:
+            return
+        mid = f"mig-{uuid.uuid4().hex[:12]}"
+        logger.warning(
+            "shard %s underutilized (%.2f rps < %.2f): merging into %s",
+            self.state.shard_id, self.monitor.total_rps(),
+            self.monitor.merge_threshold_rps, target,
+        )
+        await self._propose({
+            "op": "begin_migration", "migration_id": mid, "kind": "merge",
+            "target_shard_id": target,
+            # Exactly our owned interval: the target's staged-range guard
+            # makes these keys unavailable until the commit, so staging the
+            # whole keyspace would blackout the target's own ranges too.
+            "start": interval[0], "end": interval[1],
+        })
+        self.monitor.mark_resharded()
+        await self._advance_migration(mid, self.state.migrations.get(mid, {}))
+
+    async def _call_group(self, peers: list[str], method: str, req: dict,
+                          attempts: int = 4) -> dict:
+        """RPC to an explicit master group, following Not-Leader hints (like
+        call_shard, but usable for targets not yet in the shard map)."""
+        peers = list(peers)
+        if not peers:
+            raise RpcError.unavailable("no peers for group call")
+        last: RpcError | None = None
+        idx = 0
+        for _ in range(attempts):
+            target = peers[idx % len(peers)]
+            try:
+                return await self.client.call(target, SERVICE, method, req,
+                                              timeout=10.0)
+            except RpcError as e:
+                last = e
+                hint = e.not_leader_hint
+                if e.is_not_leader:
+                    if hint and hint not in peers:
+                        peers.insert(0, hint)
+                        idx = 0
+                    elif hint:
+                        idx = peers.index(hint)
+                    else:
+                        idx += 1
+                        await asyncio.sleep(0.2)
+                    continue
+                if e.code.name in ("INVALID_ARGUMENT", "NOT_FOUND",
+                                   "ALREADY_EXISTS", "FAILED_PRECONDITION"):
+                    raise
+                idx += 1
+                await asyncio.sleep(0.2)
+        raise last if last is not None else RpcError.unavailable(
+            "group unreachable"
+        )
+
+    async def _stage_migration(self, mid: str, mig: dict,
+                               peers: list[str]) -> bool:
+        """Stage the migration's frozen file snapshot at the target group.
+        Built here (not per tick) so the O(namespace) scan only runs when a
+        stage is actually sent."""
+        files = {
+            p: f.to_dict() for p, f in self.state.files.items()
+            if mig["start"] < p <= mig["end"]  # carve_shard's (start, end]
+        }
+        try:
+            await self._call_group(peers, "StageIngest", {
+                "migration_id": mid, "start": mig["start"],
+                "end": mig["end"], "files": files,
+                "staged_at_ms": now_ms(),
+            })
+            return True
+        except RpcError as e:
+            logger.info("migration %s: stage not accepted yet: %s",
+                        mid, e.message)
+            return False
+
+    async def _advance_migration(self, mid: str, mig: dict) -> None:
+        """Drive one migration forward as far as it will go this tick.
+
+        Freeze -> allocate -> stage -> flip map -> commit -> complete:
+        writes in the range are frozen from begin_migration (the freeze
+        check), the metadata snapshot is STAGED at the target before the
+        map flips (so the target never serves found=False for migrated
+        keys — its staged-range guard answers unavailable until commit),
+        and only then does the range route there. Every step is idempotent;
+        a new leader resumes from the replicated migration record."""
+        if not mig:
+            return
+        target = mig["target_shard_id"]
+        kind = mig["kind"]
+        try:
+            resp = await self.call_config("FetchShardMap", {})
+            fetched = ShardMap.from_dict(resp["shard_map"])
+            if self.shard_map is None or fetched.version >= self.shard_map.version:
+                self.shard_map = fetched
+        except RpcError as e:
+            logger.warning("migration %s: map fetch failed: %s", mid, e.message)
+            return
+        map_done = (
+            self.shard_map.has_shard(target)
+            if kind == "split"
+            else not self.shard_map.has_shard(self.state.shard_id)
+        )
+        if not map_done:
+            # 1. Target group's peers: reserved via the config server for a
+            # split — re-requested EVERY tick (idempotent by shard id) so
+            # the reservation's liveness refreshes while we retry staging,
+            # and a GC'd/stolen reservation is transparently re-allocated.
+            # For a merge, read from the map.
+            if kind == "split":
+                try:
+                    resp = await self.call_config("AllocateShardGroup",
+                                                  {"shard_id": target})
+                    peers = list(resp["peers"])
+                except RpcError as e:
+                    # Abandoning is safe while the map is untouched (just
+                    # verified with a linearizable fetch) and the refusal is
+                    # deterministic — no spare capacity.
+                    if "no healthy registered masters" in e.message and \
+                            e.code.name in ("UNAVAILABLE",
+                                            "INVALID_ARGUMENT"):
+                        logger.warning("migration %s abandoned: %s",
+                                       mid, e.message)
+                        await self._propose({
+                            "op": "complete_migration",
+                            "migration_id": mid, "aborted": True,
+                        })
+                    else:
+                        logger.warning("migration %s: allocation failed: %s",
+                                       mid, e.message)
+                    return
+            else:
+                peers = self.shard_map.get_peers(target) or []
+                if not peers:
+                    # Retained neighbor vanished and the map is untouched.
+                    logger.warning("migration %s abandoned: merge target %s "
+                                   "gone", mid, target)
+                    await self._propose({"op": "complete_migration",
+                                         "migration_id": mid,
+                                         "aborted": True})
+                    return
+            if peers != list(mig.get("peers") or []):
+                await self._propose({"op": "update_migration",
+                                     "migration_id": mid, "peers": peers})
+                mig["peers"] = peers
+            # 2. Stage the frozen snapshot at the target (idempotent
+            # overwrite; re-staged on every resume until the flip).
+            if not await self._stage_migration(mid, mig, peers):
+                return
+            # 3. Flip the map. The carve names the reserved peers
+            # explicitly — allocation already happened.
+            try:
+                if kind == "split":
+                    await self.call_config("CarveShard", {
+                        "start": mig["start"], "end": mig["end"],
+                        "new_shard_id": target, "peers": peers,
+                    })
+                else:
+                    await self.call_config("MergeShards", {
+                        "victim_shard_id": self.state.shard_id,
+                        "retained_shard_id": target,
+                    })
+            except RpcError as e:
+                if e.code.name == "INVALID_ARGUMENT":
+                    # Raced/malformed reshard, map untouched: drop the stage
+                    # (best-effort; the target GCs abandoned stages anyway)
+                    # and abandon.
+                    logger.warning("migration %s abandoned: %s", mid,
+                                   e.message)
+                    try:
+                        await self._call_group(peers, "DropStagedIngest",
+                                               {"migration_id": mid})
+                    except RpcError:
+                        pass
+                    await self._propose({"op": "complete_migration",
+                                         "migration_id": mid,
+                                         "aborted": True})
+                else:
+                    logger.warning("migration %s: reshard RPC failed: %s",
+                                   mid, e.message)
+                return
+            return  # commit on the next tick, once the map propagates
+        # 4. Map flipped: publish the stage on the target.
+        peers = list(mig.get("peers") or [])
+        if kind == "merge" and not self.shard_map.has_shard(target):
+            # Retained shard itself vanished (merged/removed) before our
+            # commit landed: redirect the handoff to whoever owns the range
+            # now — we still hold every file (complete never ran).
+            owner = self.shard_map.get_shard(mig["end"])
+            owner_peers = (self.shard_map.get_peers(owner) or []) \
+                if owner else []
+            if not owner or owner == self.state.shard_id or not owner_peers:
+                logger.warning("migration %s: no live owner for the merged "
+                               "range yet; holding", mid)
+                return
+            logger.warning("migration %s: retained shard %s gone; "
+                           "retargeting handoff to %s", mid, target, owner)
+            await self._propose({"op": "update_migration",
+                                 "migration_id": mid, "peers": owner_peers,
+                                 "target_shard_id": owner})
+            mig["peers"], mig["target_shard_id"] = owner_peers, owner
+            peers, target = owner_peers, owner
+        if not peers:
+            peers = self.shard_map.get_peers(target) or []
+            if not peers:
+                logger.warning("migration %s: no peers known for target %s",
+                               mid, target)
+                return
+        try:
+            await self._call_group(peers, "CommitStagedIngest",
+                                   {"migration_id": mid})
+        except RpcError as e:
+            if "no staged ingest" in e.message:
+                # This group never got (or GC'd) the stage — e.g. a
+                # retargeted merge, or a stage dropped as abandoned. We
+                # still hold the files: re-stage, commit next tick.
+                await self._stage_migration(mid, mig, peers)
+            else:
+                logger.info("migration %s: staged commit pending: %s",
+                            mid, e.message)
+            return
+        if kind == "split" and mig.get("prefix"):
+            # The hot prefix's files now live on the target shard — that's
+            # where the block re-spread has to run. Best-effort: the target
+            # can also be told later via the CLI's shuffle command.
+            try:
+                await self._call_group(peers, "InitiateShuffle",
+                                       {"prefix": mig["prefix"]})
+            except RpcError as e:
+                logger.info("migration %s: shuffle handoff skipped: %s",
+                            mid, e.message)
+        # 5. Drop the moved range locally (and, for a merge, retire into
+        # the spare pool — cleared atomically inside the same apply).
+        await self._propose({"op": "complete_migration", "migration_id": mid})
+        if kind == "merge":
+            logger.info("shard merged away; master group back in spare pool")
+
+    async def run_data_shuffler(self) -> None:
+        """Re-spread blocks of shuffling prefixes across chunkservers, one
+        copy per prefix per tick (reference run_data_shuffler
+        master.rs:1324-1419). Deviations from the reference, on purpose:
+        spreading is bounded by each block's replication target (RF or k+m)
+        so a shuffle can never inflate a prefix to N-way replication —
+        space equalization is the balancer's job, not the shuffler's — and
+        the prefix only retires when nothing is left to spread AND nothing
+        is still in flight (the reference stops as soon as one scan finds no
+        candidate, dropping work queued but unacked). Replicate-then-ack:
+        the location list only grows after the copy is confirmed (the
+        REPLICATE result path), so a crashed copy never strands metadata."""
+        if not self.raft.is_leader or not self.state.shuffling_prefixes:
+            return
+        by_fullness = [
+            addr for addr, _ in sorted(
+                ((addr, st.available_space)
+                 for addr, st in self.state.chunk_servers.items()),
+                key=lambda t: t[1],
+            )
+        ]
+        if len(by_fullness) < 2:
+            return
+        live = set(by_fullness)
+        pending = {
+            (c.get("type"), c.get("block_id"))
+            for cmds in self.state.pending_commands.values()
+            for c in cmds
+        }
+        for prefix in list(self.state.shuffling_prefixes):
+            blocks = [
+                b for path, f in self.state.files.items()
+                if path.startswith(prefix) for b in f.blocks
+            ]
+            moved = in_flight = False
+            for b in blocks:
+                if b.ec_data_shards:
+                    # EC locations are positional (shard index -> holder);
+                    # appending a REPLICATE target would corrupt the slot
+                    # mapping. Missing EC shards are the healer's job
+                    # (RECONSTRUCT_EC_SHARD rebuilds into the right slot).
+                    continue
+                want = REPLICATION_FACTOR
+                if len([l for l in b.locations if l in live]) >= want:
+                    continue
+                if ("REPLICATE", b.block_id) in pending:
+                    in_flight = True
+                    continue
+                donor = next(
+                    (d for d in by_fullness if d in b.locations), None
+                )
+                target = next(
+                    (t for t in reversed(by_fullness)
+                     if t not in b.locations), None
+                )
+                if donor is None or target is None:
+                    continue
+                self.state.queue_command(donor, {
+                    "type": "REPLICATE",
+                    "block_id": b.block_id,
+                    "target_chunk_server_address": target,
+                })
+                logger.info("shuffle %s: %s %s -> %s",
+                            prefix, b.block_id, donor, target)
+                moved = True
+                break
+            if not moved and not in_flight:
+                # Nothing left to spread for this prefix — retire it
+                # (reference StopShuffle, simple_raft.rs:3249-3250).
+                try:
+                    await self._propose({"op": "stop_shuffle",
+                                         "prefix": prefix})
+                except RpcError:
+                    pass
+
+    async def rpc_stage_ingest(self, req: dict) -> dict:
+        """Target side of a migration handoff: hold the moved range's
+        metadata without serving it (the staged-range guard answers
+        unavailable for these keys until the commit). Accepted even before
+        this group adopts the new shard — the stage is inert until then."""
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
+        if req["start"] >= req["end"]:
+            raise RpcError.invalid("empty staged range")
+        await self._propose({
+            "op": "stage_ingest",
+            "migration_id": req["migration_id"],
+            "start": req["start"], "end": req["end"],
+            "files": dict(req.get("files") or {}),
+            "staged_at_ms": int(req.get("staged_at_ms") or now_ms()),
+        })
+        return {"success": True}
+
+    async def rpc_commit_staged_ingest(self, req: dict) -> dict:
+        """Publish a staged handoff once the map routes its range here.
+        Idempotent: a commit for an unknown migration id is a duplicate
+        (the stage was already published), not an error."""
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
+        result = await self._propose({
+            "op": "commit_staged_ingest", "migration_id": req["migration_id"],
+            "at_ms": now_ms(),
+        })
+        return {"success": True, "count": result.get("count", 0)}
+
+    async def rpc_drop_staged_ingest(self, req: dict) -> dict:
+        """GC hook for a stage whose migration aborted before the map flip."""
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
+        await self._propose({
+            "op": "drop_staged_ingest", "migration_id": req["migration_id"],
+        })
+        return {"success": True}
+
+    async def _gc_staged_ingests(self) -> None:
+        """Drop stale stages for ranges the map never routed to us (their
+        migration aborted after staging); keeps an abandoned stage from
+        permanently blocking a future carve of the same range."""
+        if not self.state.staged_ingests or not self.raft.is_leader:
+            return
+        at = now_ms()
+        for mid, s in list(self.state.staged_ingests.items()):
+            if at - s.get("staged_at_ms", 0) < STAGED_INGEST_TTL_MS:
+                continue
+            owner = self.shard_map.get_shard(s["end"]) \
+                if self.shard_map is not None else None
+            if owner != self.state.shard_id:
+                logger.warning("dropping abandoned staged ingest %s", mid)
+                try:
+                    await self._propose({"op": "drop_staged_ingest",
+                                         "migration_id": mid})
+                except RpcError:
+                    pass
 
     async def run_shard_refresh(self) -> None:
         """Refresh the shard map from the Config Server, register this
@@ -622,12 +1133,28 @@ class Master:
             # let two shards accept the same key. Install monotonically.
             if self.shard_map is None or fetched.version >= self.shard_map.version:
                 self.shard_map = fetched
-            await self.call_config("RegisterMaster", {
+            reg = await self.call_config("RegisterMaster", {
                 "address": self.address, "shard_id": self.state.shard_id,
+                # This master's whole Raft group: new-shard allocation must
+                # hand a range to ONE group (N addresses from different
+                # groups would each adopt it — split brain).
+                "group": sorted(self.raft.core.config.voters),
             })
-            if self.raft.is_leader:
+            # Spare master allocated to a split-off shard: adopt it through
+            # Raft so the whole group agrees on its new identity — but only
+            # once the shard actually exists in the map (a reservation whose
+            # carve later aborts must not be adopted; and a dead shard id
+            # accidentally echoed back must never resurrect).
+            assigned = reg.get("assigned_shard_id") or ""
+            if assigned and not self.state.shard_id and self.raft.is_leader \
+                    and self.shard_map is not None \
+                    and self.shard_map.has_shard(assigned):
+                logger.info("adopting shard %s from config server", assigned)
+                await self._propose({"op": "adopt_shard", "shard_id": assigned})
+            if self.raft.is_leader and self.state.shard_id:
                 await self.call_config("ShardHeartbeat", {
                     "shard_id": self.state.shard_id, "address": self.address,
+                    "rps_per_prefix": self.monitor.rps_per_prefix(),
                 })
         except RpcError as e:
             logger.warning("shard refresh failed: %s", e.message)

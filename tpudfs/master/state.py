@@ -103,6 +103,26 @@ class MasterState:
         # Replicated.
         self.files: dict[str, FileMetadata] = {}
         self.transactions: dict[str, dict] = {}
+        # Prefixes whose blocks the data shuffler is re-spreading across
+        # chunkservers (reference shuffling_prefixes, simple_raft.rs:3184).
+        self.shuffling_prefixes: set[str] = set()
+        # In-flight metadata migrations (split/merge handoffs to a peer
+        # shard), keyed by migration id. Replicated so a leader crash
+        # mid-migration is resumed by its successor instead of stranding
+        # the moved range with no owner holding its metadata. While a
+        # migration is open, writes in its range are frozen on this shard
+        # (freeze -> stage -> flip map -> commit staged -> complete), which
+        # closes the window where an acknowledged write could be clobbered
+        # by the metadata push.
+        self.migrations: dict[str, dict] = {}
+        # Incoming staged handoffs (we are the migration target), keyed by
+        # migration id: the range is unavailable — not 404 — between the map
+        # flip and the staged commit.
+        self.staged_ingests: dict[str, dict] = {}
+        # Tombstones of published handoffs (migration id -> commit ms):
+        # lets a commit retry be told apart from a commit that was never
+        # staged here (which must fail, or the source drops its only copy).
+        self.committed_migrations: dict[str, int] = {}
         # Soft.
         self.chunk_servers: dict[str, ChunkServerStatus] = {}
         self.pending_commands: dict[str, list[dict]] = {}
@@ -233,6 +253,7 @@ class MasterState:
 
     def _apply_create_file(self, cmd: dict):
         path = cmd["path"]
+        self.check_not_migrating(path)
         existing = self.files.get(path)
         if existing is not None and existing.complete:
             if not cmd.get("overwrite"):
@@ -255,6 +276,7 @@ class MasterState:
 
     def _apply_allocate_block(self, cmd: dict):
         path = cmd["path"]
+        self.check_not_migrating(path)
         f = self.files.get(path)
         if f is None:
             raise ValueError(f"file not found: {path}")
@@ -269,6 +291,7 @@ class MasterState:
 
     def _apply_complete_file(self, cmd: dict):
         path = cmd["path"]
+        self.check_not_migrating(path)
         f = self.files.get(path)
         if f is None:
             raise ValueError(f"file not found: {path}")
@@ -289,6 +312,7 @@ class MasterState:
 
     def _apply_delete_file(self, cmd: dict):
         path = cmd["path"]
+        self.check_not_migrating(path)
         f = self.files.pop(path, None)
         if f is None:
             raise ValueError(f"file not found: {path}")
@@ -301,6 +325,7 @@ class MasterState:
 
     def _apply_rename_file(self, cmd: dict):
         src, dst = cmd["src"], cmd["dst"]
+        self.check_not_migrating(src, dst)
         f = self.files.get(src)
         if f is None or not f.complete:
             raise ValueError(f"file not found: {src}")
@@ -329,6 +354,7 @@ class MasterState:
         return {"success": True}
 
     def _apply_move_to_cold(self, cmd: dict):
+        self.check_not_migrating(cmd["path"])
         f = self.files.get(cmd["path"])
         if f is None:
             raise ValueError(f"file not found: {cmd['path']}")
@@ -343,6 +369,7 @@ class MasterState:
     def _apply_convert_to_ec(self, cmd: dict):
         """Metadata-level EC policy conversion; data migration is not part of
         the reference either (master.rs:2108-2118 leaves it TODO)."""
+        self.check_not_migrating(cmd["path"])
         f = self.files.get(cmd["path"])
         if f is None:
             raise ValueError(f"file not found: {cmd['path']}")
@@ -380,6 +407,16 @@ class MasterState:
                 f"path {sorted(conflict)[0]!r} is locked by an in-flight "
                 "transaction"
             )
+        for p in paths:
+            # Mutual exclusion with shard migrations: a tx committed after
+            # the migration snapshot was staged would be clobbered by the
+            # staged publish (or swept by complete_migration) — and a tx
+            # touching a staged-in range would race its publish. The other
+            # direction is enforced by _apply_begin_migration.
+            if self.migrating_out(p) or self.staged_in(p):
+                raise ValueError(
+                    f"path {p!r} is in a migrating shard range"
+                )
         for op in tx.get("operations", []):
             if op["kind"] == "create" and not tx.get("coordinator") \
                     and op["path"] in self.files and not op.get("replace"):
@@ -445,6 +482,7 @@ class MasterState:
         return {"success": True}
 
     def _apply_ingest_metadata(self, cmd: dict):
+        self.check_not_migrating(*cmd["files"].keys())
         for path, fd in cmd["files"].items():
             self.files[path] = FileMetadata.from_dict(fd)
         return {"success": True, "count": len(cmd["files"])}
@@ -457,6 +495,162 @@ class MasterState:
                 removed += 1
         return {"success": True, "count": removed}
 
+    # --------------------------------------------- dynamic sharding commands
+
+    def _apply_begin_migration(self, cmd: dict):
+        """Record a split/merge metadata handoff (reference SplitShard apply
+        simple_raft.rs:3148-3184; the migration record itself is our
+        crash-resumability addition — the reference loses an in-flight push
+        if the splitting leader dies)."""
+        mid = cmd["migration_id"]
+        if mid in self.migrations:
+            return {"success": True, "duplicate": True}
+        for p in self.tx_locked_paths():
+            if cmd["start"] < p <= cmd["end"]:
+                # A prepared-but-unresolved 2PC op in the range would commit
+                # after the snapshot is staged and be lost; wait it out
+                # (tx cleanup bounds how long). Counterpart of the
+                # migrating_out check in _apply_tx_create.
+                raise ValueError(
+                    f"range has an in-flight transaction on {p!r}"
+                )
+        self.migrations[mid] = {
+            "kind": cmd["kind"],  # "split" | "merge"
+            "target_shard_id": cmd["target_shard_id"],
+            # Migrated key interval (start, end] — for a split, the range
+            # the new shard takes over; for a merge, this shard's whole
+            # range. Matches ShardMap.carve_shard's semantics.
+            "start": cmd["start"],
+            "end": cmd["end"],
+            "prefix": cmd.get("prefix", ""),
+            # Target group's peer addresses, filled in once allocated.
+            "peers": [],
+        }
+        if cmd["kind"] == "split" and cmd.get("prefix"):
+            self.shuffling_prefixes.add(cmd["prefix"])
+        return {"success": True}
+
+    def _apply_complete_migration(self, cmd: dict):
+        """Drop the migrated range once the target shard has the metadata.
+        ``aborted`` completions (the reshard never reshaped the map) keep
+        every file — nothing moved."""
+        mig = self.migrations.pop(cmd["migration_id"], None)
+        if mig is None:
+            return {"success": True, "duplicate": True}
+        if cmd.get("aborted"):
+            if mig.get("prefix"):
+                self.shuffling_prefixes.discard(mig["prefix"])
+            return {"success": True, "count": 0}
+        removed = 0
+        for path in list(self.files):
+            # (start, end] to match ShardMap.carve_shard's interval exactly.
+            if mig["start"] < path <= mig["end"]:
+                del self.files[path]
+                removed += 1
+        if mig["kind"] == "merge":
+            # Retire atomically with the handoff: a separate adopt command
+            # would leave a crash window where the group still claims the
+            # merged-away shard id (and the ownership bootstrap escape in
+            # _check_shard_ownership would then accept writes for any path).
+            self.shard_id = ""
+        return {"success": True, "count": removed}
+
+    def _apply_update_migration(self, cmd: dict):
+        """Record the target group's peers once allocated (idempotent);
+        optionally retarget (a merge whose retained shard vanished before
+        the commit redirects to whoever inherited the range)."""
+        mig = self.migrations.get(cmd["migration_id"])
+        if mig is None:
+            return {"success": True, "duplicate": True}
+        mig["peers"] = list(cmd["peers"])
+        if cmd.get("target_shard_id"):
+            mig["target_shard_id"] = cmd["target_shard_id"]
+        return {"success": True}
+
+    def _apply_stage_ingest(self, cmd: dict):
+        """Target side: hold a migration's file set without serving it.
+        Re-staging overwrites (the source retries with a fresh snapshot)."""
+        self.staged_ingests[cmd["migration_id"]] = {
+            "start": cmd["start"],
+            "end": cmd["end"],
+            "files": dict(cmd["files"]),
+            "staged_at_ms": int(cmd["staged_at_ms"]),
+        }
+        return {"success": True}
+
+    def _apply_commit_staged_ingest(self, cmd: dict):
+        """Target side: the map now routes the range here — publish the
+        staged metadata. No write can have landed in the range before this
+        commit (the staged record made _check_shard_ownership fail closed),
+        so the unconditional overwrite cannot clobber anything.
+
+        A commit for a migration that was never staged here is an ERROR,
+        not a no-op: answering success would let the source drop its copy
+        while no one holds the metadata. Genuine retries (commit applied,
+        ack lost) are recognized via the tombstone."""
+        mid = cmd["migration_id"]
+        staged = self.staged_ingests.pop(mid, None)
+        if staged is None:
+            if mid in self.committed_migrations:
+                return {"success": True, "duplicate": True}
+            raise ValueError(f"no staged ingest for migration {mid!r}")
+        for path, fd in staged["files"].items():
+            self.files[path] = FileMetadata.from_dict(fd)
+        at = int(cmd.get("at_ms") or staged.get("staged_at_ms", 0))
+        self.committed_migrations[mid] = at
+        # Bounded tombstone horizon, pruned deterministically from the
+        # command's own clock.
+        for old, t in list(self.committed_migrations.items()):
+            if at - t > 24 * 3600 * 1000:
+                del self.committed_migrations[old]
+        return {"success": True, "count": len(staged["files"])}
+
+    def _apply_drop_staged_ingest(self, cmd: dict):
+        """GC an abandoned stage (its migration aborted before the map
+        flipped, so the range never routed here)."""
+        self.staged_ingests.pop(cmd["migration_id"], None)
+        return {"success": True}
+
+    def migrating_out(self, path: str) -> bool:
+        """True while an open outgoing migration covers ``path`` — writes
+        are frozen until the handoff completes or aborts."""
+        return any(
+            m["start"] < path <= m["end"] for m in self.migrations.values()
+        )
+
+    def check_not_migrating(self, *paths: str) -> None:
+        """Apply-level freeze: the RPC-layer check has a TOCTOU window (a
+        write that passed it can commit after begin_migration won an
+        earlier log slot, landing after the stage snapshot and before the
+        sweep). Re-checking inside apply is serialized by the log, so no
+        namespace write can slip into an open migration's range."""
+        for p in paths:
+            if self.migrating_out(p) or self.staged_in(p):
+                raise ValueError(
+                    f"path {p!r} is in a migrating shard range"
+                )
+
+    def staged_in(self, path: str) -> bool:
+        """True while an uncommitted incoming stage covers ``path``."""
+        return any(
+            s["start"] < path <= s["end"]
+            for s in self.staged_ingests.values()
+        )
+
+    def _apply_trigger_shuffle(self, cmd: dict):
+        self.shuffling_prefixes.add(cmd["prefix"])
+        return {"success": True}
+
+    def _apply_stop_shuffle(self, cmd: dict):
+        self.shuffling_prefixes.discard(cmd["prefix"])
+        return {"success": True}
+
+    def _apply_adopt_shard(self, cmd: dict):
+        """A spare (unassigned) master group takes over the shard the Config
+        Server allocated to it during a split."""
+        self.shard_id = cmd["shard_id"]
+        return {"success": True}
+
     # ---------------------------------------------------------- persistence
 
     def snapshot(self) -> bytes:
@@ -464,6 +658,10 @@ class MasterState:
             "shard_id": self.shard_id,
             "files": {p: f.to_dict() for p, f in self.files.items()},
             "transactions": self.transactions,
+            "shuffling_prefixes": sorted(self.shuffling_prefixes),
+            "migrations": self.migrations,
+            "staged_ingests": self.staged_ingests,
+            "committed_migrations": self.committed_migrations,
         })
 
     def restore(self, data: bytes) -> None:
@@ -475,3 +673,9 @@ class MasterState:
             p: FileMetadata.from_dict(fd) for p, fd in d.get("files", {}).items()
         }
         self.transactions = dict(d.get("transactions", {}))
+        self.shuffling_prefixes = set(d.get("shuffling_prefixes", []))
+        self.migrations = {k: dict(v) for k, v in d.get("migrations", {}).items()}
+        self.staged_ingests = {
+            k: dict(v) for k, v in d.get("staged_ingests", {}).items()
+        }
+        self.committed_migrations = dict(d.get("committed_migrations", {}))
