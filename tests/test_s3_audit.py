@@ -90,6 +90,22 @@ async def test_queue_overflow_drops_counted(tmp_path):
     await log.stop()
 
 
+async def test_stop_drains_entire_queue(tmp_path):
+    """Shutdown must flush every queued record (one flush pass caps at
+    4x batch_size and used to silently discard the rest)."""
+    log = AuditLog(str(tmp_path / "a.db"), b"key", batch_size=2,
+                   flush_interval=3600.0, queue_max=1000)
+    n = 50  # > 4 * batch_size
+    for i in range(n):
+        log.log(_rec(i))
+    await log.stop()
+    assert log.dropped_count == 0
+    import sqlite3
+    db = sqlite3.connect(str(tmp_path / "a.db"))
+    assert db.execute("SELECT COUNT(*) FROM logs").fetchone()[0] == n
+    db.close()
+
+
 async def test_reader_cli(tmp_path, capsys):
     db = str(tmp_path / "audit.db")
     log = AuditLog(db, b"key", flush_interval=0.05)

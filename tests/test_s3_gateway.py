@@ -261,13 +261,14 @@ async def test_sse_encryption_at_rest(tmp_path):
 
 
 def _sign_request(method, path, *, body=b"", now=None, access_key=AK,
-                  secret=SK, token="", query=None):
+                  secret=SK, token="", query=None, extra_headers=None):
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     date = now.strftime("%Y%m%d")
     payload_hash = signing.sha256_hex(body)
     headers = {"host": "localhost", "x-amz-date": amz_date,
                "x-amz-content-sha256": payload_hash}
+    headers.update(extra_headers or {})
     if token:
         headers["x-amz-security-token"] = token
     signed = sorted(headers)
@@ -337,6 +338,112 @@ async def test_sigv4_auth_and_policy(tmp_path):
         tampered.body = b"evil"
         with pytest.raises(AuthError):
             await gw.handle(tampered)
+    finally:
+        await c.stop()
+
+
+async def test_copy_requires_read_permission_on_source(tmp_path):
+    """CopyObject must be authorized against the SOURCE (s3:GetObject) as
+    well as the destination — PutObject rights on one bucket must not
+    exfiltrate another bucket's data through the copy path."""
+    iam = {
+        "managed_policies": {
+            "Full": {"Statement": [{"Effect": "Allow", "Action": "s3:*",
+                                    "Resource": "*"}]},
+            "PubOnly": {"Statement": [{
+                "Effect": "Allow",
+                "Action": ["s3:PutObject", "s3:GetObject", "s3:ListBucket"],
+                "Resource": ["arn:aws:s3:::pub", "arn:aws:s3:::pub/*"],
+            }]},
+        },
+        "users": {AK: {"policies": ["Full"]},
+                  "AKPUB": {"policies": ["PubOnly"]}},
+        "roles": {},
+    }
+    c, gw = await _gateway(
+        tmp_path, auth_enabled=True,
+        credentials=StaticCredentialProvider({AK: SK, "AKPUB": "sk-pub"}),
+        policy=PolicyEngine.from_json(iam),
+    )
+    try:
+        # Admin seeds a secret bucket and a public one.
+        await gw.handle(_sign_request("PUT", "/secret"))
+        await gw.handle(_sign_request("PUT", "/secret/data",
+                                      body=b"crown jewels"))
+        await gw.handle(_sign_request("PUT", "/pub"))
+        await gw.handle(_sign_request("PUT", "/pub/own", body=b"mine"))
+        # Pub-only principal cannot copy OUT of /secret...
+        with pytest.raises(AuthError) as ei:
+            await gw.handle(_sign_request(
+                "PUT", "/pub/stolen", access_key="AKPUB", secret="sk-pub",
+                extra_headers={"x-amz-copy-source": "/secret/data"}))
+        assert ei.value.code == "AccessDenied"
+        # ...but copying within its own bucket works.
+        r = await gw.handle(_sign_request(
+            "PUT", "/pub/copy", access_key="AKPUB", secret="sk-pub",
+            extra_headers={"x-amz-copy-source": "/pub/own"}))
+        assert r.status == 200
+        assert (await gw.handle(_sign_request("GET", "/pub/copy"))).body \
+            == b"mine"
+    finally:
+        await c.stop()
+
+
+async def test_copy_source_reserved_key_rejected(tmp_path):
+    """The internal namespace (.policy/.bucket/.s3_mpu) is not addressable
+    as a copy SOURCE either."""
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b"))
+        policy_doc = json.dumps({"Statement": []}).encode()
+        await gw.handle(req("PUT", "/b", query=[("policy", "")],
+                            body=policy_doc))
+        r = await gw.handle(req("PUT", "/b/leak",
+                                headers={"x-amz-copy-source": "/b/.policy"}))
+        assert r.status == 404 and b"NoSuchKey" in r.body
+    finally:
+        await c.stop()
+
+
+async def test_create_bucket_conflict_is_409(tmp_path):
+    c, gw = await _gateway(tmp_path)
+    try:
+        assert (await gw.handle(req("PUT", "/twice"))).status == 200
+        r = await gw.handle(req("PUT", "/twice"))
+        assert r.status == 409
+        assert b"BucketAlreadyOwnedByYou" in r.body
+    finally:
+        await c.stop()
+
+
+async def test_multipart_parts_encrypted_at_rest(tmp_path):
+    """With SSE-S3 on, in-progress part bodies must be ciphertext on the
+    DFS (abandoned uploads would otherwise leave plaintext behind), while
+    part ETags stay md5-of-plaintext per AWS semantics."""
+    c, gw = await _gateway(tmp_path, sse=SseEngine(b"K" * 32))
+    try:
+        await gw.handle(req("PUT", "/mb"))
+        part = b"p" * (300 * 1024)
+        r = await gw.handle(req("POST", "/mb/big.bin",
+                                query=[("uploads", "")]))
+        upload_id = r.body.decode().split("<UploadId>")[1].split("<")[0]
+        r = await gw.handle(req("PUT", "/mb/big.bin",
+                                query=[("partNumber", "1"),
+                                       ("uploadId", upload_id)], body=part))
+        assert r.headers["ETag"] == f'"{hashlib.md5(part).hexdigest()}"'
+        stored = await gw.client.get_file(
+            f"/mb/.s3_mpu/{upload_id}/00001")
+        assert stored.startswith(b"SSE1") and part not in stored
+        done = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+                f"<ETag>{hashlib.md5(part).hexdigest()}</ETag></Part>"
+                f"</CompleteMultipartUpload>").encode()
+        r = await gw.handle(req("POST", "/mb/big.bin",
+                                query=[("uploadId", upload_id)], body=done))
+        assert r.status == 200
+        r = await gw.handle(req("GET", "/mb/big.bin"))
+        assert r.body == part
+        at_rest = await gw.client.get_file("/mb/big.bin")
+        assert at_rest.startswith(b"SSE1")
     finally:
         await c.stop()
 

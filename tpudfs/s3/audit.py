@@ -97,7 +97,11 @@ class AuditLog:
                 await self._task
             except asyncio.CancelledError:
                 pass
-        self._flush_pending()
+        # Drain EVERYTHING queued: one _flush_pending pass caps at 4 batches
+        # and would silently discard the rest at shutdown — exactly when a
+        # tamper-evident log must not under-report.
+        while not self._queue.empty():
+            self._flush_pending()
         self._db.close()
 
     # --------------------------------------------------------------- logging

@@ -20,6 +20,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import urllib.parse
 import uuid
 from dataclasses import dataclass, field
 from xml.sax.saxutils import escape
@@ -46,6 +47,19 @@ XML = "application/xml"
 def is_reserved_key(key: str) -> bool:
     """True when the key's first segment is an internal namespace."""
     return key.split("/", 1)[0] in RESERVED_SEGMENTS
+
+
+def parse_copy_source(copy_source: str) -> tuple[str, str] | None:
+    """x-amz-copy-source -> (bucket, key). The header may be URL-encoded
+    and carry a ?versionId suffix; shared with the auth middleware so the
+    resource that gets authorized is the resource that gets read."""
+    src = urllib.parse.unquote(copy_source.split("?", 1)[0]).lstrip("/")
+    if "/" not in src:
+        return None
+    bucket, key = src.split("/", 1)
+    if not bucket or not key:
+        return None
+    return bucket, key
 
 
 @dataclass
@@ -118,7 +132,17 @@ class S3Handlers:
         return S3Response(body=doc.encode())
 
     async def create_bucket(self, bucket: str) -> S3Response:
-        await self.client.create_file(f"/{bucket}/{BUCKET_MARKER}", b"")
+        try:
+            await self.client.create_file(f"/{bucket}/{BUCKET_MARKER}", b"")
+        except DfsError as e:
+            if "exists" in str(e):
+                # Routine for idempotent provisioning scripts (aws s3 mb):
+                # a proper S3 conflict code, not a 500.
+                return _err("BucketAlreadyOwnedByYou",
+                            "Your previous request to create the named "
+                            "bucket succeeded and you already own it.",
+                            409, bucket)
+            raise
         return S3Response(headers={"Location": f"/{bucket}"})
 
     async def head_bucket(self, bucket: str) -> S3Response:
@@ -332,10 +356,14 @@ class S3Handlers:
 
     async def copy_object(self, bucket: str, key: str,
                           copy_source: str) -> S3Response:
-        src = copy_source.lstrip("/")
-        if "/" not in src:
+        src = parse_copy_source(copy_source)
+        if src is None:
             return _err("InvalidArgument", "bad x-amz-copy-source", 400)
-        src_bucket, src_key = src.split("/", 1)
+        src_bucket, src_key = src
+        if is_reserved_key(src_key):
+            # The reserved namespace (.bucket/.policy/.s3_mpu) is not
+            # addressable — not even as a copy SOURCE.
+            return no_such_key(src_key)
         src_meta = await self.client.get_file_info(self.obj_path(src_bucket, src_key))
         if src_meta is None:
             return no_such_key(src_key)
@@ -379,7 +407,13 @@ class S3Handlers:
             f"/{bucket}/{MPU_PREFIX}{upload_id}/key"
         ) is None:
             return _err("NoSuchUpload", "upload does not exist", 404)
+        # ETag is the md5 of the PLAINTEXT part (AWS semantics, and what
+        # complete_multipart's digest-of-digests is built from); the bytes
+        # at rest are encrypted like any object when SSE is on — parts of
+        # in-progress/abandoned uploads must not sit plaintext on disk.
         etag = hashlib.md5(body).hexdigest()
+        if self.sse is not None:
+            body = self.sse.encrypt(body)
         path = self._part_path(bucket, upload_id, part_number)
         await self.client.create_file(path, body, etag=etag, overwrite=True)
         return S3Response(headers={"ETag": f'"{etag}"'})
@@ -397,7 +431,7 @@ class S3Handlers:
             parts.append({
                 "part_number": int(name),
                 "etag": (meta or {}).get("etag_md5", ""),
-                "size": int((meta or {}).get("size") or 0),
+                "size": self._plain_size(meta or {}),
                 "last_modified": xt.iso8601(int((meta or {}).get("created_at_ms") or 0)),
             })
         return S3Response(body=xt.list_parts(bucket, key, upload_id, parts).encode())
@@ -434,7 +468,15 @@ class S3Handlers:
             stored_etag = meta.get("etag_md5", "")
             if claimed_etag and stored_etag and claimed_etag != stored_etag:
                 return _err("InvalidPart", f"part {part_number} ETag mismatch", 400)
-            chunks.append(await self.client.get_file(path))
+            chunk = await self.client.get_file(path)
+            if self.sse is not None:
+                try:
+                    chunk = self.sse.decrypt(chunk)
+                except SseError:
+                    return _err("InternalError",
+                                f"part {part_number} SSE decryption failed",
+                                500, key)
+            chunks.append(chunk)
             digests += bytes.fromhex(stored_etag)
         data = b"".join(chunks)
         # AWS multipart ETag: md5 of the concatenated part digests, -N

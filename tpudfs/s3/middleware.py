@@ -244,26 +244,42 @@ class AuthMiddleware:
             self._audit(req, principal, "Allow", 200)
             return
         action, resource = map_action(req)
+        checks = [(action, resource)]
+        copy_source = req.header("x-amz-copy-source")
+        if copy_source and req.method == "PUT":
+            # CopyObject reads the SOURCE: the caller needs s3:GetObject on
+            # it (AWS semantics), or PutObject rights on one bucket would
+            # exfiltrate any other bucket's data through the copy path.
+            from tpudfs.s3.handlers import parse_copy_source
+            src = parse_copy_source(copy_source)
+            if src is not None:
+                checks.append((
+                    "s3:GetObject", f"arn:aws:s3:::{src[0]}/{src[1]}"
+                ))
         t0 = time.perf_counter()
-        identity_allowed = self.policy.is_allowed(principal, action, resource)
-        verdict = "Neutral"
-        if self.get_bucket_policy is not None:
-            bucket = next((p for p in req.path.split("/") if p), "")
-            if bucket:
-                bp = await self.get_bucket_policy(bucket)
-                if bp is not None:
-                    verdict = bp.evaluate(principal, action, resource)
-        allowed = combined_decision(identity_allowed, verdict)
+        for action, resource in checks:
+            identity_allowed = self.policy.is_allowed(principal, action,
+                                                      resource)
+            verdict = "Neutral"
+            if self.get_bucket_policy is not None:
+                bucket = resource.split(":::", 1)[1].split("/", 1)[0]
+                if bucket:
+                    bp = await self.get_bucket_policy(bucket)
+                    if bp is not None:
+                        verdict = bp.evaluate(principal, action, resource)
+            if not combined_decision(identity_allowed, verdict):
+                if self.observe_policy_latency is not None:
+                    self.observe_policy_latency(time.perf_counter() - t0)
+                self._audit(req, principal, "Deny", 403, action=action,
+                            resource=resource)
+                raise AuthError.access_denied(
+                    f"{principal} is not authorized to perform {action} "
+                    f"on {resource}"
+                )
         if self.observe_policy_latency is not None:
             self.observe_policy_latency(time.perf_counter() - t0)
-        if not allowed:
-            self._audit(req, principal, "Deny", 403, action=action,
-                        resource=resource)
-            raise AuthError.access_denied(
-                f"{principal} is not authorized to perform {action} on {resource}"
-            )
-        self._audit(req, principal, "Allow", 200, action=action,
-                    resource=resource)
+        self._audit(req, principal, "Allow", 200, action=checks[0][0],
+                    resource=checks[0][1])
 
     def _audit(self, req: S3Request, principal: str, outcome: str,
                status: int, detail: str = "", action: str = "",
