@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 
+from tpudfs.common.ops_http import maybe_start_ops
 from tpudfs.common.telemetry import setup_logging
 from tpudfs.chunkserver.blockstore import BlockStore
 from tpudfs.chunkserver.heartbeat import HeartbeatLoop
@@ -27,6 +28,9 @@ def parse_args(argv=None):
     p.add_argument("--config-servers", default="", help="comma-separated config servers")
     p.add_argument("--heartbeat-interval", type=float, default=5.0)
     p.add_argument("--scrub-interval", type=float, default=60.0)
+    p.add_argument("--http-port", type=int, default=-1,
+                   help="ops HTTP (/health /metrics); "
+                        "-1 = rpc port + 1000, 0 = disabled")
     return p.parse_args(argv)
 
 
@@ -44,6 +48,9 @@ async def amain(args) -> None:
     await cs.start(args.host, args.port)
     hb = HeartbeatLoop(cs, masters, configs, interval=args.heartbeat_interval)
     hb.start()
+    await maybe_start_ops("tpudfs_chunkserver", cs.ops_gauges,
+                          host=args.host, rpc_port=args.port,
+                          http_port=args.http_port)
     print(f"READY {cs.address}", flush=True)
     await asyncio.Event().wait()
 

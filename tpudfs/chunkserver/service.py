@@ -290,6 +290,20 @@ class ChunkServer:
             self.cache.put(block_id, data)
         return {"data": data, "bytes_read": len(data), "total_size": total}
 
+    def ops_gauges(self) -> dict[str, float]:
+        """Gauges for /metrics (reference bin/chunkserver.rs:381-428
+        exports space/chunk-count)."""
+        stats = self.store.stats()
+        return {
+            "used_space_bytes": stats["used_space"],
+            "available_space_bytes": stats["available_space"],
+            "chunk_count": stats["chunk_count"],
+            "cache_hits": self.cache.hits,
+            "cache_misses": self.cache.misses,
+            "known_master_term": self.known_term,
+            "pending_bad_blocks": len(self.pending_bad_blocks),
+        }
+
     async def rpc_stats(self, _req: dict) -> dict:
         stats = await asyncio.to_thread(self.store.stats)
         stats.update(

@@ -85,6 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("check-history", help="linearizability-check a history")
     sp.add_argument("history")
+
+    sp = sub.add_parser("presign", help="generate a presigned S3 URL "
+                        "(creds from AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY; "
+                        "reference dfs_cli.rs:471-520)")
+    sp.add_argument("method", choices=["GET", "PUT", "DELETE", "HEAD"])
+    sp.add_argument("endpoint", help="e.g. http://127.0.0.1:9000")
+    sp.add_argument("path", help="e.g. /bucket/key")
+    sp.add_argument("--expires", type=int, default=3600)
     return p
 
 
@@ -271,9 +279,28 @@ async def amain(args) -> int:
         await client.close()
 
 
+def cmd_presign(args) -> int:
+    """Offline: no DFS connection needed, just env credentials."""
+    import os
+
+    from tpudfs.auth.presign import presign_url
+
+    ak = os.environ.get("AWS_ACCESS_KEY_ID", "")
+    sk = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+    if not ak or not sk:
+        print("error: set AWS_ACCESS_KEY_ID and AWS_SECRET_ACCESS_KEY",
+              file=sys.stderr)
+        return 2
+    print(presign_url(args.method, args.endpoint, args.path, ak, sk,
+                      expires_seconds=args.expires))
+    return 0
+
+
 def main(argv=None) -> None:
     setup_logging()
     args = build_parser().parse_args(argv)
+    if args.cmd == "presign":
+        sys.exit(cmd_presign(args))
     sys.exit(asyncio.run(amain(args)))
 
 

@@ -1,0 +1,116 @@
+"""Per-service ops HTTP endpoints: /health, /metrics, /raft/state.
+
+Model: the reference's axum sidecar servers — master /health /metrics
+/raft/state (bin/master.rs:163-192,261-350), chunkserver /metrics
+(bin/chunkserver.rs:381-428), config server equivalents. Prometheus text
+exposition is rendered by hand (no client library); /raft/state serves the
+introspection JSON the reference's test scripts use to find leaders
+(run_s3_test.sh:42-56 polls it).
+"""
+
+from __future__ import annotations
+
+import json
+from collections.abc import Callable
+
+from aiohttp import web
+
+#: Raft gauge set exported for every Raft-backed service (reference
+#: bin/master.rs:280-350 exports role/term/commit/applied/log-len).
+_ROLE_CODE = {"leader": 2, "candidate": 1, "follower": 0}
+
+
+def render_metrics(prefix: str, gauges: dict[str, float]) -> str:
+    lines = []
+    for name, value in gauges.items():
+        full = f"{prefix}_{name}"
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def raft_gauges(status: dict) -> dict[str, float]:
+    return {
+        "raft_role": _ROLE_CODE.get(status.get("role", ""), 0),
+        "raft_term": status.get("term", 0),
+        "raft_commit_index": status.get("commit_index", 0),
+        "raft_last_applied": status.get("last_applied", 0),
+        "raft_log_len": status.get("log_len", 0),
+        "raft_snapshot_index": status.get("snapshot_index", 0),
+    }
+
+
+class OpsServer:
+    """Small aiohttp server exposing health/metrics (+ raft state when the
+    service is Raft-backed). ``gauges_fn`` returns the service's gauge dict;
+    ``raft_status_fn`` (optional) returns RaftCore.status()."""
+
+    def __init__(self, prefix: str,
+                 gauges_fn: Callable[[], dict[str, float]],
+                 raft_status_fn: Callable[[], dict] | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.prefix = prefix
+        self.gauges_fn = gauges_fn
+        self.raft_status_fn = raft_status_fn
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+
+    async def _health(self, _req) -> web.Response:
+        return web.Response(text="ok")
+
+    async def _metrics(self, _req) -> web.Response:
+        # Off the event loop: a chunkserver's gauge fn walks its block
+        # directory (BlockStore.stats), which must not stall RPCs for the
+        # duration of a Prometheus scrape.
+        import asyncio
+
+        gauges = dict(await asyncio.to_thread(self.gauges_fn))
+        if self.raft_status_fn is not None:
+            gauges.update(raft_gauges(self.raft_status_fn()))
+        return web.Response(
+            text=render_metrics(self.prefix, gauges),
+            content_type="text/plain",
+        )
+
+    async def _raft_state(self, _req) -> web.Response:
+        if self.raft_status_fn is None:
+            raise web.HTTPNotFound()
+        return web.Response(
+            text=json.dumps(self.raft_status_fn()),
+            content_type="application/json",
+        )
+
+    async def start(self) -> int:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/raft/state", self._raft_state)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # Resolve the ephemeral port when port=0 was requested.
+        server = site._server  # noqa: SLF001 - aiohttp exposes no getter
+        if server and server.sockets:
+            self.port = server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+async def maybe_start_ops(prefix: str, gauges_fn, raft_status_fn=None, *,
+                          host: str, rpc_port: int,
+                          http_port: int) -> OpsServer | None:
+    """Shared __main__ wiring: ``http_port`` -1 means rpc_port + 1000,
+    0 disables. Prints the OPS line the launch scripts grep for."""
+    port = rpc_port + 1000 if http_port == -1 else http_port
+    if not port:
+        return None
+    ops = OpsServer(prefix, gauges_fn, raft_status_fn, host=host, port=port)
+    await ops.start()
+    print(f"OPS http://{host}:{ops.port}", flush=True)
+    return ops

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 
+from tpudfs.common.ops_http import maybe_start_ops
 from tpudfs.common.rpc import RpcServer
 from tpudfs.common.telemetry import setup_logging
 from tpudfs.configserver.service import ConfigServer
@@ -22,17 +23,29 @@ def parse_args(argv=None):
     p.add_argument("--advertise", default="", help="address peers/clients use")
     p.add_argument("--data-dir", required=True)
     p.add_argument("--peers", default="", help="comma-separated peer addresses")
+    p.add_argument("--http-port", type=int, default=-1,
+                   help="ops HTTP; -1 = rpc port + 1000, 0 = disabled")
+    p.add_argument("--snapshot-backup-dir", default="",
+                   help="directory sink for leader snapshot backups")
     return p.parse_args(argv)
 
 
 async def amain(args) -> None:
     address = args.advertise or f"{args.host}:{args.port}"
     peers = [x for x in args.peers.split(",") if x]
-    cfg = ConfigServer(address, peers, args.data_dir)
+    backup = None
+    if args.snapshot_backup_dir:
+        from tpudfs.raft.backup import DirSnapshotBackup
+        backup = DirSnapshotBackup(args.snapshot_backup_dir)
+    cfg = ConfigServer(address, peers, args.data_dir,
+                       snapshot_backup=backup)
     server = RpcServer(args.host, args.port)
     cfg.attach(server)
     await server.start()
     await cfg.start()
+    await maybe_start_ops("tpudfs_config", cfg.ops_gauges, cfg.raft.status,
+                          host=args.host, rpc_port=args.port,
+                          http_port=args.http_port)
     print(f"READY {address}", flush=True)
     await asyncio.Event().wait()
 

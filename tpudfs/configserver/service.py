@@ -43,6 +43,7 @@ class ConfigServer:
         raft_timings: Timings | None = None,
         rpc_client: RpcClient | None = None,
         auto_alloc_masters: int = AUTO_ALLOC_MASTERS,
+        snapshot_backup=None,
     ):
         self.address = address
         self.state = ConfigState()
@@ -58,6 +59,7 @@ class ConfigServer:
             restore=self.state.restore,
             timings=raft_timings,
             rpc_client=self.client,
+            snapshot_backup=snapshot_backup,
         )
 
     # --------------------------------------------------------------- wiring
@@ -298,6 +300,16 @@ class ConfigServer:
         except ValueError as e:
             raise RpcError.invalid(str(e)) from None
         return {"success": True}
+
+    def ops_gauges(self) -> dict[str, float]:
+        """Gauges for /metrics (config-plane health: map + registry)."""
+        at = now_ms()
+        return {
+            "shards": len(self.state.shard_map.shards),
+            "shard_map_version": self.state.shard_map.version,
+            "registered_masters": len(self.state.masters),
+            "spare_masters": len(self.state.healthy_masters(at)),
+        }
 
     async def rpc_raft_state(self, _req: dict) -> dict:
         return self.raft.status()

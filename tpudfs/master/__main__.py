@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 
+from tpudfs.common.ops_http import maybe_start_ops
 from tpudfs.common.rpc import RpcServer
 from tpudfs.common.telemetry import setup_logging
 from tpudfs.master.service import Master
@@ -24,12 +25,37 @@ def parse_args(argv=None):
     p.add_argument("--shard-id", default="shard-0",
                    help='"" registers as a spare master awaiting allocation')
     p.add_argument("--config-servers", default="")
+    p.add_argument("--http-port", type=int, default=-1,
+                   help="ops HTTP (/health /metrics /raft/state); "
+                        "-1 = rpc port + 1000, 0 = disabled")
     # Dynamic sharding thresholds (reference bin/master.rs:51-58).
     p.add_argument("--split-threshold-rps", type=float, default=100.0)
     p.add_argument("--merge-threshold-rps", type=float, default=-1.0,
                    help="negative disables auto-merge")
     p.add_argument("--split-cooldown-secs", type=float, default=30.0)
+    # Off-site Raft snapshot backup (reference bin/master.rs:72-79).
+    p.add_argument("--snapshot-backup-dir", default="",
+                   help="directory sink for leader snapshot backups")
+    p.add_argument("--snapshot-backup-s3", default="",
+                   help="S3 endpoint sink (creds from S3_ACCESS_KEY/"
+                        "S3_SECRET_KEY env)")
+    p.add_argument("--snapshot-backup-bucket", default="raft-backups")
     return p.parse_args(argv)
+
+
+def make_backup(args):
+    if args.snapshot_backup_dir:
+        from tpudfs.raft.backup import DirSnapshotBackup
+        return DirSnapshotBackup(args.snapshot_backup_dir)
+    if args.snapshot_backup_s3:
+        import os as _os
+        from tpudfs.raft.backup import S3SnapshotBackup
+        return S3SnapshotBackup(
+            args.snapshot_backup_s3, args.snapshot_backup_bucket,
+            _os.environ.get("S3_ACCESS_KEY", ""),
+            _os.environ.get("S3_SECRET_KEY", ""),
+        )
+    return None
 
 
 async def amain(args) -> None:
@@ -40,11 +66,15 @@ async def amain(args) -> None:
                     config_servers=configs,
                     split_threshold_rps=args.split_threshold_rps,
                     merge_threshold_rps=args.merge_threshold_rps,
-                    split_cooldown_secs=args.split_cooldown_secs)
+                    split_cooldown_secs=args.split_cooldown_secs,
+                    snapshot_backup=make_backup(args))
     server = RpcServer(args.host, args.port)
     master.attach(server)
     await server.start()
     await master.start()
+    await maybe_start_ops("tpudfs_master", master.ops_gauges,
+                          master.raft.status, host=args.host,
+                          rpc_port=args.port, http_port=args.http_port)
     print(f"READY {address}", flush=True)
     await asyncio.Event().wait()
 

@@ -77,6 +77,7 @@ class Master:
         split_threshold_rps: float = 100.0,
         merge_threshold_rps: float = -1.0,
         split_cooldown_secs: float = 30.0,
+        snapshot_backup=None,
     ):
         self.address = address
         self.config_servers = list(config_servers or [])
@@ -92,6 +93,7 @@ class Master:
             restore=self.state.restore,
             timings=raft_timings,
             rpc_client=self.client,
+            snapshot_backup=snapshot_backup,
         )
         self.cold_threshold_ms = 1000 * (
             cold_threshold_secs
@@ -1205,6 +1207,22 @@ class Master:
 
     async def rpc_raft_state(self, _req: dict) -> dict:
         return self.raft.status()
+
+    def ops_gauges(self) -> dict[str, float]:
+        """Gauges for /metrics (reference bin/master.rs:280-350 exports
+        raft + safe-mode; raft gauges are appended by OpsServer)."""
+        st = self.state
+        return {
+            "safe_mode": 1 if st.safe_mode else 0,
+            "files": len(st.files),
+            "blocks": st.total_known_blocks(),
+            "chunk_servers": len(st.chunk_servers),
+            "transactions": len(st.transactions),
+            "migrations": len(st.migrations),
+            "staged_ingests": len(st.staged_ingests),
+            "shuffling_prefixes": len(st.shuffling_prefixes),
+            "bad_blocks": len(st.bad_block_locations),
+        }
 
     # ------------------------------------------------------ background tasks
 

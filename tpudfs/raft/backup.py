@@ -1,0 +1,137 @@
+"""Off-site Raft snapshot backup.
+
+Model: the reference leader uploads every compaction snapshot to S3 under
+``master-snapshots/node-{id}/...`` (simple_raft.rs:1214-1271, flags
+bin/master.rs:72-79). Two sinks:
+
+- ``DirSnapshotBackup`` — a local/NFS directory (operationally the common
+  case for on-prem TPU pods).
+- ``S3SnapshotBackup`` — HTTP PUT against any S3-compatible endpoint using
+  this project's own SigV4 presigner (tpudfs.auth.presign), so a cluster
+  can back its metadata up into its own S3 gateway or any external store.
+
+Uploads are fire-and-forget from the Raft apply loop (a slow or down sink
+must never block consensus); restore is a manual operator action via
+``fetch_latest`` (the reference's restore path is manual too).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pathlib
+import re
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+KEEP_SNAPSHOTS = 5  # pruned oldest-first beyond this
+
+
+def _node_slug(node_id: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", node_id)
+
+
+def encode_snapshot(snapshot) -> bytes:
+    """Self-describing envelope: meta + state-machine bytes."""
+    return msgpack.packb({
+        "last_index": snapshot.last_index,
+        "last_term": snapshot.last_term,
+        "config": snapshot.config.to_dict() if snapshot.config else None,
+        "data": snapshot.data,
+    })
+
+
+def decode_snapshot(raw: bytes) -> dict:
+    return msgpack.unpackb(raw, raw=False)
+
+
+class DirSnapshotBackup:
+    """Snapshot sink on a filesystem path (atomic tmp+rename publish)."""
+
+    def __init__(self, root: str, keep: int = KEEP_SNAPSHOTS):
+        self.root = pathlib.Path(root)
+        self.keep = keep
+
+    def _dir(self, node_id: str) -> pathlib.Path:
+        return self.root / _node_slug(node_id)
+
+    def upload(self, node_id: str, snapshot) -> None:
+        d = self._dir(node_id)
+        d.mkdir(parents=True, exist_ok=True)
+        name = f"snap-{snapshot.last_index:012d}.bin"
+        tmp = d / (name + ".tmp")
+        tmp.write_bytes(encode_snapshot(snapshot))
+        os.replace(tmp, d / name)
+        snaps = sorted(p for p in d.iterdir()
+                       if p.name.startswith("snap-")
+                       and p.name.endswith(".bin"))
+        for old in snaps[: -self.keep]:
+            old.unlink(missing_ok=True)
+
+    def fetch_latest(self, node_id: str) -> dict | None:
+        d = self._dir(node_id)
+        if not d.is_dir():
+            return None
+        snaps = sorted(p for p in d.iterdir()
+                       if p.name.startswith("snap-")
+                       and p.name.endswith(".bin"))
+        if not snaps:
+            return None
+        return decode_snapshot(snaps[-1].read_bytes())
+
+
+class S3SnapshotBackup:
+    """Snapshot sink on an S3-compatible endpoint via presigned PUT/GET
+    (reference backup_snapshot_to_s3 simple_raft.rs:1214-1271; key layout
+    ``master-snapshots/node-{id}/snap-{index}``)."""
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str,
+                 secret_key: str, *, region: str = "us-east-1"):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    def _key(self, node_id: str, last_index: int) -> str:
+        return (f"master-snapshots/node-{_node_slug(node_id)}/"
+                f"snap-{last_index:012d}")
+
+    def _url(self, method: str, key: str) -> str:
+        from tpudfs.auth import presign
+
+        return presign.presign_url(
+            method,
+            self.endpoint,
+            f"/{self.bucket}/{key}",
+            self.access_key,
+            self.secret_key,
+            region=self.region,
+            expires_seconds=300,
+        )
+
+    async def aupload(self, node_id: str, snapshot) -> None:
+        import aiohttp
+
+        url = self._url("PUT", self._key(node_id, snapshot.last_index))
+        async with aiohttp.ClientSession() as s:
+            async with s.put(url, data=encode_snapshot(snapshot)) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"snapshot upload HTTP {resp.status}: "
+                        f"{(await resp.text())[:200]}"
+                    )
+
+    async def afetch(self, node_id: str, last_index: int) -> dict | None:
+        import aiohttp
+
+        url = self._url("GET", self._key(node_id, last_index))
+        async with aiohttp.ClientSession() as s:
+            async with s.get(url) as resp:
+                if resp.status == 404:
+                    return None
+                if resp.status != 200:
+                    raise RuntimeError(f"snapshot fetch HTTP {resp.status}")
+                return decode_snapshot(await resp.read())

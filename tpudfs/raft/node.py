@@ -59,6 +59,7 @@ class RaftNode:
         restore: Callable[[bytes], None],
         timings: Timings | None = None,
         rpc_client: RpcClient | None = None,
+        snapshot_backup=None,
     ):
         self.node_id = node_id
         self.storage = RaftStorage(data_dir)
@@ -89,6 +90,9 @@ class RaftNode:
         self._tick_task: asyncio.Task | None = None
         self._send_tasks: set[asyncio.Task] = set()
         self._snapshotting = False
+        # Off-site snapshot sink (tpudfs.raft.backup); leader-only uploads,
+        # fire-and-forget (reference simple_raft.rs:1214-1271).
+        self._backup = snapshot_backup
 
     # ---------------------------------------------------------------- server
 
@@ -234,6 +238,12 @@ class RaftNode:
                 await asyncio.to_thread(
                     self.storage.save_snapshot, eff.snapshot, list(self.core.log)
                 )
+                if self._backup is not None and self.is_leader:
+                    task = asyncio.create_task(
+                        self._backup_snapshot(eff.snapshot)
+                    )
+                    self._send_tasks.add(task)
+                    task.add_done_callback(self._send_tasks.discard)
             elif isinstance(eff, RestoreFromSnapshot):
                 self._restore_fn(eff.snapshot.data)
             elif isinstance(eff, ReadReady):
@@ -256,6 +266,20 @@ class RaftNode:
             task = asyncio.create_task(self._send(s.to, s.msg))
             self._send_tasks.add(task)
             task.add_done_callback(self._send_tasks.discard)
+
+    async def _backup_snapshot(self, snapshot) -> None:
+        """Upload to the off-site sink without ever blocking consensus."""
+        try:
+            aupload = getattr(self._backup, "aupload", None)
+            if aupload is not None:
+                await aupload(self.node_id, snapshot)
+            else:
+                await asyncio.to_thread(
+                    self._backup.upload, self.node_id, snapshot
+                )
+            logger.info("snapshot @%d backed up off-site", snapshot.last_index)
+        except Exception:
+            logger.exception("off-site snapshot backup failed")
 
     def _fail_pending_from(self, index: int) -> None:
         for idx in [i for i in self._pending if i >= index]:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import time
 import urllib.parse
 import uuid
 from dataclasses import dataclass, field
@@ -417,6 +418,55 @@ class S3Handlers:
         path = self._part_path(bucket, upload_id, part_number)
         await self.client.create_file(path, body, etag=etag, overwrite=True)
         return S3Response(headers={"ETag": f'"{etag}"'})
+
+    async def upload_part_copy(self, bucket: str, upload_id: str,
+                               part_number: int, copy_source: str,
+                               copy_range: str = "") -> S3Response:
+        """UploadPartCopy: a part whose bytes come from an existing object
+        (not in the reference's gateway at all; required for server-side
+        copies of large objects, e.g. aws s3 cp between buckets)."""
+        if not 1 <= part_number <= 10_000:
+            return _err("InvalidArgument", "partNumber out of range", 400)
+        src = parse_copy_source(copy_source)
+        if src is None:
+            return _err("InvalidArgument", "bad x-amz-copy-source", 400)
+        src_bucket, src_key = src
+        if is_reserved_key(src_key):
+            return no_such_key(src_key)
+        if await self.client.get_file_info(
+            f"/{bucket}/{MPU_PREFIX}{upload_id}/key"
+        ) is None:
+            return _err("NoSuchUpload", "upload does not exist", 404)
+        data = await self.client.get_file(self.obj_path(src_bucket, src_key))
+        if self.sse is not None:
+            try:
+                data = self.sse.decrypt(data)
+            except SseError:
+                return _err("InternalError", "SSE decryption failed", 500,
+                            src_key)
+        if copy_range:
+            m = copy_range.strip()
+            if not m.startswith("bytes=") or "-" not in m[6:]:
+                return _err("InvalidArgument", "bad x-amz-copy-source-range",
+                            400)
+            lo_s, hi_s = m[6:].split("-", 1)
+            try:
+                lo, hi = int(lo_s), int(hi_s)
+            except ValueError:
+                return _err("InvalidArgument", "bad x-amz-copy-source-range",
+                            400)
+            if lo > hi or hi >= len(data):
+                return _err("InvalidRange", "range outside source object",
+                            416)
+            data = data[lo:hi + 1]
+        etag = hashlib.md5(data).hexdigest()
+        if self.sse is not None:
+            data = self.sse.encrypt(data)
+        path = self._part_path(bucket, upload_id, part_number)
+        await self.client.create_file(path, data, etag=etag, overwrite=True)
+        return S3Response(body=xt.copy_part_result(
+            etag, xt.iso8601(int(time.time() * 1000))
+        ).encode())
 
     async def list_parts(self, bucket: str, key: str,
                          upload_id: str) -> S3Response:

@@ -243,6 +243,14 @@ class Gateway:
                 except ValueError:
                     return _err("InvalidArgument",
                                 "partNumber must be an integer", 400)
+                copy_source = req.header("x-amz-copy-source")
+                if copy_source:
+                    # UploadPartCopy — the part's bytes come from an
+                    # existing object, not the request body.
+                    return await h.upload_part_copy(
+                        bucket, q["uploadId"], part_number, copy_source,
+                        req.header("x-amz-copy-source-range"),
+                    )
                 return await h.upload_part(bucket, q["uploadId"],
                                            part_number, body)
             copy_source = req.header("x-amz-copy-source")

@@ -195,6 +195,15 @@ def copy_object_result(etag: str, last_modified: str) -> str:
     )
 
 
+def copy_part_result(etag: str, last_modified: str) -> str:
+    return (
+        _HEADER
+        + f'<CopyPartResult xmlns="{XMLNS}">'
+        + _tag("LastModified", last_modified) + _tag("ETag", f'"{etag}"')
+        + "</CopyPartResult>"
+    )
+
+
 def location_constraint() -> str:
     return _HEADER + f'<LocationConstraint xmlns="{XMLNS}"/>'
 
