@@ -1,0 +1,244 @@
+// Native block I/O engine: fused checksum+durable-write and pread+verify.
+//
+// TPU-host twin of the reference's Rust hot I/O (write_block_async /
+// read_block_async / verify_partial_read, dfs/chunkserver/src/
+// chunkserver.rs:192-351). One ctypes call per block operation: the GIL is
+// released for the whole open/CRC/write/fsync/rename (or pread/verify)
+// sequence instead of bouncing between Python-level read, numpy CRC, and
+// os.* syscalls.
+//
+// Sidecar layout must match tpudfs/chunkserver/blockstore.py exactly:
+//   <4sHHII little-endian: magic "TPUM", version=1, reserved, chunk_size,
+//   count> followed by count little-endian u32 chunk CRCs.
+//
+// Exported C ABI (loaded in tpudfs/common/native.py):
+//   int64_t tpudfs_block_write(const char* data_path, const char* meta_path,
+//                              const uint8_t* data, uint64_t len,
+//                              uint32_t chunk, uint32_t* out_crcs);
+//     -> number of chunks, or -errno on I/O failure.
+//   int64_t tpudfs_block_read_verify(const char* data_path,
+//                                    const char* meta_path, uint64_t offset,
+//                                    uint64_t length, uint8_t* out,
+//                                    int verify, uint32_t expected_chunk);
+//     -> bytes copied into out, TPUDFS_EBADMETA (-200001) on malformed or
+//        chunk-size-mismatched sidecars, TPUDFS_ECORRUPT (-200002) on
+//        checksum mismatch, TPUDFS_ENOMETA (-200003) when the sidecar file
+//        is absent, or -errno on I/O failure. expected_chunk=0 skips the
+//        store-chunk-size cross-check.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+extern "C" uint32_t tpudfs_crc32c(uint32_t crc, const uint8_t* buf,
+                                  size_t len);
+
+namespace {
+
+constexpr int64_t kBadMeta = -200001;
+constexpr int64_t kCorrupt = -200002;
+constexpr int64_t kNoMeta = -200003;   // sidecar file absent
+constexpr char kMagic[4] = {'T', 'P', 'U', 'M'};
+constexpr uint16_t kVersion = 1;
+constexpr size_t kHeader = 16;  // 4s + u16 + u16 + u32 + u32
+
+// Durable publish: write whole buffer to <path>.tmp, fsync, rename.
+int64_t write_durable(const std::string& path, const uint8_t* data,
+                      uint64_t len) {
+  std::string tmp = path + ".tmp";
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = ::write(fd, data + done, len - done);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      int e = errno;
+      ::close(fd);
+      ::unlink(tmp.c_str());
+      return -e;
+    }
+    done += static_cast<uint64_t>(n);
+  }
+  if (::fsync(fd) != 0) {
+    int e = errno;
+    ::close(fd);
+    ::unlink(tmp.c_str());
+    return -e;
+  }
+  ::close(fd);
+  if (::rename(tmp.c_str(), path.c_str()) != 0) return -errno;
+  return 0;
+}
+
+void put_u16(uint8_t* p, uint16_t v) {
+  p[0] = v & 0xff;
+  p[1] = (v >> 8) & 0xff;
+}
+void put_u32(uint8_t* p, uint32_t v) {
+  p[0] = v & 0xff;
+  p[1] = (v >> 8) & 0xff;
+  p[2] = (v >> 16) & 0xff;
+  p[3] = (v >> 24) & 0xff;
+}
+uint32_t get_u32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t tpudfs_block_write(const char* data_path, const char* meta_path,
+                           const uint8_t* data, uint64_t len, uint32_t chunk,
+                           uint32_t* out_crcs) {
+  if (chunk == 0) return kBadMeta;
+  uint64_t n = (len + chunk - 1) / chunk;
+  std::vector<uint8_t> meta(kHeader + n * 4);
+  std::memcpy(meta.data(), kMagic, 4);
+  put_u16(meta.data() + 4, kVersion);
+  put_u16(meta.data() + 6, 0);
+  put_u32(meta.data() + 8, chunk);
+  put_u32(meta.data() + 12, static_cast<uint32_t>(n));
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t off = i * chunk;
+    uint64_t clen = (off + chunk <= len) ? chunk : len - off;
+    uint32_t c = tpudfs_crc32c(0, data + off, clen);
+    put_u32(meta.data() + kHeader + i * 4, c);
+    if (out_crcs) out_crcs[i] = c;
+  }
+  int64_t rc = write_durable(data_path, data, len);
+  if (rc != 0) return rc;
+  rc = write_durable(meta_path, meta.data(), meta.size());
+  if (rc != 0) return rc;
+  return static_cast<int64_t>(n);
+}
+
+int64_t tpudfs_block_read_verify(const char* data_path, const char* meta_path,
+                                 uint64_t offset, uint64_t length,
+                                 uint8_t* out, int verify,
+                                 uint32_t expected_chunk) {
+  int fd = ::open(data_path, O_RDONLY);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  uint64_t total = static_cast<uint64_t>(st.st_size);
+  if (offset >= total) {
+    ::close(fd);
+    return 0;
+  }
+  if (offset + length > total) length = total - offset;
+
+  if (!verify) {
+    uint64_t done = 0;
+    while (done < length) {
+      ssize_t n = ::pread(fd, out + done, length - done, offset + done);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        int e = errno;
+        ::close(fd);
+        return -e;
+      }
+      if (n == 0) break;
+      done += static_cast<uint64_t>(n);
+    }
+    ::close(fd);
+    return static_cast<int64_t>(done);
+  }
+
+  // Verified read: load the sidecar, pread the chunk-aligned span covering
+  // [offset, offset+length), CRC each affected chunk, then hand back the
+  // requested subrange (reference verify_partial_read chunkserver.rs:296-351).
+  int mfd = ::open(meta_path, O_RDONLY);
+  if (mfd < 0) {
+    int e = errno;
+    ::close(fd);
+    return e == ENOENT ? kNoMeta : -e;
+  }
+  struct stat mst;
+  if (::fstat(mfd, &mst) != 0 ||
+      static_cast<size_t>(mst.st_size) < kHeader) {
+    ::close(mfd);
+    ::close(fd);
+    return kBadMeta;
+  }
+  std::vector<uint8_t> meta(mst.st_size);
+  {
+    uint64_t done = 0;
+    while (done < meta.size()) {
+      ssize_t n = ::pread(mfd, meta.data() + done, meta.size() - done, done);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        ::close(mfd);
+        ::close(fd);
+        return kBadMeta;
+      }
+      done += static_cast<uint64_t>(n);
+    }
+  }
+  ::close(mfd);
+  if (std::memcmp(meta.data(), kMagic, 4) != 0 ||
+      (meta[4] | (meta[5] << 8)) != kVersion)
+    { ::close(fd); return kBadMeta; }
+  uint32_t chunk = get_u32(meta.data() + 8);
+  uint32_t count = get_u32(meta.data() + 12);
+  if (chunk == 0 || meta.size() < kHeader + static_cast<size_t>(count) * 4)
+    { ::close(fd); return kBadMeta; }
+  if (expected_chunk != 0 && chunk != expected_chunk)
+    { ::close(fd); return kBadMeta; }  // mismatched store chunk size
+
+  uint64_t first = offset / chunk;
+  uint64_t last = (offset + length - 1) / chunk;
+  if (last >= count) {
+    ::close(fd);
+    return kBadMeta;
+  }
+  uint64_t span_off = first * chunk;
+  uint64_t span_len = (last - first + 1) * chunk;
+  if (span_off + span_len > total) span_len = total - span_off;
+  std::vector<uint8_t> span(span_len);
+  {
+    uint64_t done = 0;
+    while (done < span_len) {
+      ssize_t n =
+          ::pread(fd, span.data() + done, span_len - done, span_off + done);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        int e = errno;
+        ::close(fd);
+        return -e;
+      }
+      if (n == 0) break;
+      done += static_cast<uint64_t>(n);
+    }
+    span_len = done;
+  }
+  ::close(fd);
+  for (uint64_t i = first; i <= last; i++) {
+    uint64_t off = (i - first) * chunk;
+    if (off >= span_len) return kCorrupt;  // shorter than sidecar says
+    uint64_t clen = (off + chunk <= span_len) ? chunk : span_len - off;
+    uint32_t want = get_u32(meta.data() + kHeader + i * 4);
+    if (tpudfs_crc32c(0, span.data() + off, clen) != want) return kCorrupt;
+  }
+  uint64_t rel = offset - span_off;
+  if (rel >= span_len) return 0;
+  uint64_t avail = span_len - rel;
+  if (length > avail) length = avail;
+  std::memcpy(out, span.data() + rel, length);
+  return static_cast<int64_t>(length);
+}
+
+}  // extern "C"

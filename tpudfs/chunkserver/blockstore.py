@@ -20,6 +20,7 @@ All methods are synchronous; the service layer runs them in threads
 
 from __future__ import annotations
 
+import ctypes
 import errno
 import os
 import shutil
@@ -28,7 +29,13 @@ from pathlib import Path
 
 import numpy as np
 
+from tpudfs.common import native
 from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE, crc32c_chunks
+
+#: Native block engine status codes (native/blockio.cc).
+_NATIVE_EBADMETA = -200001
+_NATIVE_ECORRUPT = -200002
+_NATIVE_ENOMETA = -200003
 
 _META_MAGIC = b"TPUM"
 _META_VERSION = 1
@@ -78,10 +85,25 @@ class BlockStore:
     # -- write --------------------------------------------------------------
 
     def write(self, block_id: str, data: bytes) -> np.ndarray:
-        """Store block + sidecar durably; returns the per-chunk CRCs."""
+        """Store block + sidecar durably; returns the per-chunk CRCs.
+        The native engine (native/blockio.cc) fuses CRC + tmp/fsync/rename
+        of data and sidecar into one GIL-free call; the Python path below is
+        the behavior-identical fallback."""
         _check_block_id(block_id)
-        checksums = crc32c_chunks(data, self.chunk_size)
         path = self.hot_dir / block_id
+        lib = native.get_lib()
+        if lib is not None and native.has_blockio():
+            n = (len(data) + self.chunk_size - 1) // self.chunk_size
+            out = np.empty(n, dtype="<u4")
+            rc = lib.tpudfs_block_write(
+                str(path).encode(), str(self._meta_path(path)).encode(),
+                data, len(data), self.chunk_size,
+                out.ctypes.data if n else None,
+            )
+            if rc < 0:
+                raise OSError(-rc, os.strerror(int(-rc)), str(path))
+            return out.astype(np.uint32)
+        checksums = crc32c_chunks(data, self.chunk_size)
         self._write_durable(path, data)
         self._write_durable(self._meta_path(path), self._encode_meta(checksums))
         return checksums
@@ -147,6 +169,46 @@ class BlockStore:
             return os.pread(fd, length, offset)
         finally:
             os.close(fd)
+
+    def read_verified(self, block_id: str, offset: int = 0,
+                      length: int | None = None) -> bytes:
+        """Fused pread + partial-chunk verify of exactly the chunks the
+        range touches (reference verify_partial_read chunkserver.rs:296-351)
+        — one native call when the engine is available, read + verify_range
+        otherwise."""
+        path = self.block_path(block_id)
+        lib = native.get_lib()
+        if lib is not None and native.has_blockio():
+            if length is None:
+                length = max(self.size(block_id) - offset, 0)
+            if length <= 0:
+                return b""
+            out = bytearray(length)
+            buf = (ctypes.c_char * length).from_buffer(out)
+            rc = lib.tpudfs_block_read_verify(
+                str(path).encode(), str(self._meta_path(path)).encode(),
+                offset, length, buf, 1, self.chunk_size,
+            )
+            if rc == _NATIVE_ECORRUPT:
+                raise BlockCorruptionError(
+                    f"block {block_id}: corrupt chunk in verified read"
+                )
+            if rc == _NATIVE_EBADMETA:
+                raise BlockCorruptionError(
+                    f"block {block_id}: unreadable/inconsistent sidecar"
+                )
+            if rc == _NATIVE_ENOMETA:
+                # Same type the Python fallback's read_meta raises.
+                raise BlockNotFoundError(f"no sidecar for block {block_id}")
+            if rc < 0:
+                if -rc == errno.ENOENT:
+                    raise BlockNotFoundError(f"block {block_id} not found")
+                raise OSError(-rc, os.strerror(int(-rc)), str(path))
+            return bytes(out[: int(rc)])
+        data = self.read(block_id, offset, length)
+        if data:
+            self.verify_range(block_id, offset, len(data))
+        return data
 
     # -- verification -------------------------------------------------------
 

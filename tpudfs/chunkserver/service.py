@@ -252,20 +252,25 @@ class ChunkServer:
             if cached is not None:
                 return {"data": cached, "bytes_read": len(cached), "total_size": total}
 
-        data = await asyncio.to_thread(self.store.read, block_id, offset, bytes_to_read)
-
         if not full_read:
-            # Verify only the touched chunks; corruption does not fail the
-            # read but kicks off background recovery (chunkserver.rs:893-911).
+            # Fused pread + touched-chunk verify (native engine when built);
+            # corruption does not fail the read but kicks off background
+            # recovery (chunkserver.rs:893-911) — serve the raw bytes.
             try:
-                await asyncio.to_thread(
-                    self.store.verify_range, block_id, offset, bytes_to_read
+                data = await asyncio.to_thread(
+                    self.store.read_verified, block_id, offset, bytes_to_read
                 )
             except (BlockCorruptionError, BlockNotFoundError) as e:
                 logger.warning("partial-read verify failed for %s: %s", block_id, e)
                 self.pending_bad_blocks.add(block_id)
                 self._spawn(self._recover_silently(block_id))
+                data = await asyncio.to_thread(
+                    self.store.read, block_id, offset, bytes_to_read
+                )
         else:
+            data = await asyncio.to_thread(
+                self.store.read, block_id, offset, bytes_to_read
+            )
             try:
                 await asyncio.to_thread(self.store.verify_full, block_id, data)
             except (BlockCorruptionError, BlockNotFoundError) as e:

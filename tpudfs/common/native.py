@@ -75,6 +75,31 @@ def get_lib() -> ctypes.CDLL | None:
         ctypes.c_void_p,
         ctypes.c_size_t,
     ]
+    try:
+        lib.tpudfs_block_write.restype = ctypes.c_int64
+        lib.tpudfs_block_write.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_void_p,
+        ]
+        lib.tpudfs_block_read_verify.restype = ctypes.c_int64
+        lib.tpudfs_block_read_verify.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_uint32,
+        ]
+    except AttributeError:
+        # Prebuilt library (TPUDFS_NATIVE_LIB) predating the block I/O
+        # engine: checksum/GF math still work, block ops use the fallback.
+        logger.warning("native library has no block I/O engine; "
+                       "using Python block path")
     lib.tpudfs_gf256_mul.restype = ctypes.c_uint8
     lib.tpudfs_gf256_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
     lib.tpudfs_gf256_mul_slice.restype = None
@@ -99,3 +124,10 @@ def get_lib() -> ctypes.CDLL | None:
 
 def have_native() -> bool:
     return get_lib() is not None
+
+
+def has_blockio() -> bool:
+    """True when the loaded library carries the block I/O engine (an older
+    prebuilt .so named via TPUDFS_NATIVE_LIB may predate it)."""
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "tpudfs_block_write")

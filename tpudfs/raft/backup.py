@@ -62,7 +62,14 @@ class DirSnapshotBackup:
         d.mkdir(parents=True, exist_ok=True)
         name = f"snap-{snapshot.last_index:012d}.bin"
         tmp = d / (name + ".tmp")
-        tmp.write_bytes(encode_snapshot(snapshot))
+        # fsync before rename: a backup that can be torn by power loss is
+        # not a backup (same protocol as BlockStore._write_durable).
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            os.write(fd, encode_snapshot(snapshot))
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp, d / name)
         snaps = sorted(p for p in d.iterdir()
                        if p.name.startswith("snap-")
@@ -71,15 +78,21 @@ class DirSnapshotBackup:
             old.unlink(missing_ok=True)
 
     def fetch_latest(self, node_id: str) -> dict | None:
+        """Newest restorable snapshot — falls back past torn/corrupt files
+        (disaster recovery must not crash on the one bad file when intact
+        older snapshots sit right next to it)."""
         d = self._dir(node_id)
         if not d.is_dir():
             return None
         snaps = sorted(p for p in d.iterdir()
                        if p.name.startswith("snap-")
                        and p.name.endswith(".bin"))
-        if not snaps:
-            return None
-        return decode_snapshot(snaps[-1].read_bytes())
+        for p in reversed(snaps):
+            try:
+                return decode_snapshot(p.read_bytes())
+            except Exception:
+                logger.warning("skipping unreadable backup snapshot %s", p)
+        return None
 
 
 class S3SnapshotBackup:

@@ -355,8 +355,12 @@ class S3Handlers:
                     errors.append((key, "InternalError", str(e)))
         return S3Response(body=xt.delete_result(deleted, errors, quiet).encode())
 
-    async def copy_object(self, bucket: str, key: str,
-                          copy_source: str) -> S3Response:
+    async def _read_copy_source(
+        self, copy_source: str, copy_range: str = ""
+    ) -> tuple[bytes, dict] | S3Response:
+        """Shared source fetch for CopyObject/UploadPartCopy: parse +
+        reserved-namespace + existence checks, optional byte range, SSE
+        round-trip. Returns (plaintext, src_meta) or an error response."""
         src = parse_copy_source(copy_source)
         if src is None:
             return _err("InvalidArgument", "bad x-amz-copy-source", 400)
@@ -365,15 +369,47 @@ class S3Handlers:
             # The reserved namespace (.bucket/.policy/.s3_mpu) is not
             # addressable — not even as a copy SOURCE.
             return no_such_key(src_key)
-        src_meta = await self.client.get_file_info(self.obj_path(src_bucket, src_key))
+        path = self.obj_path(src_bucket, src_key)
+        src_meta = await self.client.get_file_info(path)
         if src_meta is None:
             return no_such_key(src_key)
-        data = await self.client.get_file(self.obj_path(src_bucket, src_key))
+        lo = hi = None
+        if copy_range:
+            m = copy_range.strip()
+            if not m.startswith("bytes=") or "-" not in m[6:]:
+                return _err("InvalidArgument",
+                            "bad x-amz-copy-source-range", 400)
+            lo_s, hi_s = m[6:].split("-", 1)
+            try:
+                lo, hi = int(lo_s), int(hi_s)
+            except ValueError:
+                return _err("InvalidArgument",
+                            "bad x-amz-copy-source-range", 400)
+            plain_total = self._plain_size(src_meta)
+            if lo > hi or hi >= plain_total:
+                return _err("InvalidRange", "range outside source object",
+                            416)
+        if self.sse is None and lo is not None:
+            # Plaintext at rest: fetch only the requested bytes.
+            data = await self.client.read_file_range(path, lo, hi - lo + 1)
+            return data, src_meta
+        data = await self.client.get_file(path)
         if self.sse is not None:
             try:
                 data = self.sse.decrypt(data)
             except SseError:
-                return _err("InternalError", "SSE decryption failed", 500, src_key)
+                return _err("InternalError", "SSE decryption failed", 500,
+                            src_key)
+        if lo is not None:
+            data = data[lo:hi + 1]
+        return data, src_meta
+
+    async def copy_object(self, bucket: str, key: str,
+                          copy_source: str) -> S3Response:
+        got = await self._read_copy_source(copy_source)
+        if isinstance(got, S3Response):
+            return got
+        data, src_meta = got
         resp = await self.put_object(bucket, key, data)
         if resp.status != 200:
             return resp
@@ -427,38 +463,14 @@ class S3Handlers:
         copies of large objects, e.g. aws s3 cp between buckets)."""
         if not 1 <= part_number <= 10_000:
             return _err("InvalidArgument", "partNumber out of range", 400)
-        src = parse_copy_source(copy_source)
-        if src is None:
-            return _err("InvalidArgument", "bad x-amz-copy-source", 400)
-        src_bucket, src_key = src
-        if is_reserved_key(src_key):
-            return no_such_key(src_key)
         if await self.client.get_file_info(
             f"/{bucket}/{MPU_PREFIX}{upload_id}/key"
         ) is None:
             return _err("NoSuchUpload", "upload does not exist", 404)
-        data = await self.client.get_file(self.obj_path(src_bucket, src_key))
-        if self.sse is not None:
-            try:
-                data = self.sse.decrypt(data)
-            except SseError:
-                return _err("InternalError", "SSE decryption failed", 500,
-                            src_key)
-        if copy_range:
-            m = copy_range.strip()
-            if not m.startswith("bytes=") or "-" not in m[6:]:
-                return _err("InvalidArgument", "bad x-amz-copy-source-range",
-                            400)
-            lo_s, hi_s = m[6:].split("-", 1)
-            try:
-                lo, hi = int(lo_s), int(hi_s)
-            except ValueError:
-                return _err("InvalidArgument", "bad x-amz-copy-source-range",
-                            400)
-            if lo > hi or hi >= len(data):
-                return _err("InvalidRange", "range outside source object",
-                            416)
-            data = data[lo:hi + 1]
+        got = await self._read_copy_source(copy_source, copy_range)
+        if isinstance(got, S3Response):
+            return got
+        data, _src_meta = got
         etag = hashlib.md5(data).hexdigest()
         if self.sse is not None:
             data = self.sse.encrypt(data)
