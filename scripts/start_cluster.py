@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""Local cluster launcher (reference start_cluster.sh HA topology).
+
+Spawns, as separate OS processes: 1 config server, a master group (default
+3-node HA Raft for shard-0) plus optional spare masters, N chunkservers, and
+the S3 gateway. Prints every endpoint; Ctrl-C tears everything down.
+
+  python scripts/start_cluster.py --masters 3 --chunkservers 5 --spares 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import atexit
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PROCS: list[subprocess.Popen] = []
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn(name: str, logdir: pathlib.Path, mod: str, *args: str,
+          env: dict | None = None) -> subprocess.Popen:
+    log = open(logdir / f"{name}.log", "w")
+    p = subprocess.Popen(
+        [sys.executable, "-m", mod, *args],
+        env={**os.environ, "PYTHONPATH": str(REPO), **(env or {})},
+        stdout=log, stderr=subprocess.STDOUT,
+    )
+    PROCS.append(p)
+    return p
+
+
+def wait_ready(logdir: pathlib.Path, name: str, timeout: float = 60.0) -> None:
+    deadline = time.time() + timeout
+    path = logdir / f"{name}.log"
+    while time.time() < deadline:
+        if path.exists() and "READY" in path.read_text():
+            return
+        time.sleep(0.3)
+    raise SystemExit(f"{name} failed to start; see {path}")
+
+
+def cleanup() -> None:
+    for p in PROCS:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + 5
+    for p in PROCS:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.kill()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("tpudfs-start-cluster")
+    ap.add_argument("--masters", type=int, default=3,
+                    help="HA Raft group size for shard-0")
+    ap.add_argument("--spares", type=int, default=0,
+                    help="unassigned masters for auto-split adoption")
+    ap.add_argument("--chunkservers", type=int, default=5)
+    ap.add_argument("--data-dir", default="cluster-data")
+    ap.add_argument("--s3-port", type=int, default=9000)
+    ap.add_argument("--split-threshold-rps", type=float, default=100.0)
+    args = ap.parse_args()
+
+    root = pathlib.Path(args.data_dir).resolve()
+    logdir = root / "logs"
+    logdir.mkdir(parents=True, exist_ok=True)
+    atexit.register(cleanup)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
+    cfg_port = free_port()
+    cfg = f"127.0.0.1:{cfg_port}"
+    spawn("config", logdir, "tpudfs.configserver", "--port", str(cfg_port),
+          "--data-dir", str(root / "cfg"))
+    wait_ready(logdir, "config")
+    print(f"config server  {cfg}  (ops http://127.0.0.1:{cfg_port + 1000})")
+
+    master_ports = [free_port() for _ in range(args.masters)]
+    master_addrs = [f"127.0.0.1:{p}" for p in master_ports]
+    # Register the shard before the masters boot so their first map refresh
+    # sees the final layout.
+    import asyncio  # noqa: E402
+
+    from tpudfs.common.rpc import RpcClient  # noqa: E402
+
+    async def add_shard():
+        rpc = RpcClient()
+        for _ in range(60):
+            try:
+                await rpc.call(cfg, "ConfigService", "AddShard",
+                               {"shard_id": "shard-0",
+                                "peers": master_addrs})
+                break
+            except Exception:
+                await asyncio.sleep(0.5)
+        await rpc.close()
+
+    asyncio.run(add_shard())
+
+    for i, port in enumerate(master_ports):
+        peers = [a for a in master_addrs if a != f"127.0.0.1:{port}"]
+        spawn(f"master{i}", logdir, "tpudfs.master", "--port", str(port),
+              "--data-dir", str(root / f"m{i}"),
+              "--peers", ",".join(peers), "--config-servers", cfg,
+              "--split-threshold-rps", str(args.split_threshold_rps))
+    for i in range(args.masters):
+        wait_ready(logdir, f"master{i}")
+        print(f"master{i}        {master_addrs[i]}  "
+              f"(ops http://127.0.0.1:{master_ports[i] + 1000})")
+
+    for i in range(args.spares):
+        port = free_port()
+        spawn(f"spare{i}", logdir, "tpudfs.master", "--port", str(port),
+              "--data-dir", str(root / f"spare{i}"), "--shard-id", "",
+              "--config-servers", cfg)
+        wait_ready(logdir, f"spare{i}")
+        print(f"spare{i}         127.0.0.1:{port}")
+
+    for i in range(args.chunkservers):
+        port = free_port()
+        spawn(f"cs{i}", logdir, "tpudfs.chunkserver", "--port", str(port),
+              "--data-dir", str(root / f"cs{i}"), "--rack-id", f"rack-{i % 3}",
+              "--masters", ",".join(master_addrs), "--config-servers", cfg,
+              "--heartbeat-interval", "2")
+        wait_ready(logdir, f"cs{i}")
+        print(f"chunkserver{i}   127.0.0.1:{port}  "
+              f"(ops http://127.0.0.1:{port + 1000})")
+
+    spawn("s3", logdir, "tpudfs.s3", env={
+        "MASTER_ADDRS": ",".join(master_addrs), "CONFIG_SERVERS": cfg,
+        "S3_PORT": str(args.s3_port), "S3_AUTH_ENABLED": "false",
+    })
+    print(f"s3 gateway     http://127.0.0.1:{args.s3_port}")
+    print(f"\nCLI: python -m tpudfs.client.cli --config-servers {cfg} "
+          f"--masters {','.join(master_addrs)} <cmd>")
+    print("logs:", logdir)
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(REPO))
+    main()

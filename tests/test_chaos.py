@@ -1,0 +1,237 @@
+"""Chaos and network-fault tests over real sockets.
+
+Model: the reference's Docker chaos tier — chaos_test.sh kills chunkservers
+and masters and md5-verifies a multi-block file (chaos_test.sh:31-70),
+network_partition_test.sh drives Toxiproxy partitions in front of the
+metadata plane, and linearizability_test.sh runs the workload generator
+under faults and feeds the history to the WGL checker. Here the same
+scenarios run in-process: real gRPC sockets, real Raft groups, and the
+FaultProxy (tpudfs/testing/netem.py) standing in for Toxiproxy.
+"""
+
+import asyncio
+import hashlib
+
+from tests.test_master_service import FAST_RAFT, MiniCluster, _free_port
+from tpudfs.client.checker import check_linearizability
+from tpudfs.client.client import Client
+from tpudfs.client.workload import WorkloadConfig, run_workload
+from tpudfs.common.rpc import RpcClient, RpcServer
+from tpudfs.master.service import Master
+from tpudfs.testing.netem import FaultProxy
+
+
+async def _wait(cond, timeout=20.0, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ----------------------------------------------------------- chunkserver kill
+
+
+async def test_chunkserver_death_heals_and_data_survives(tmp_path):
+    """Kill a chunkserver holding replicas of a multi-block file: the
+    liveness checker drops it, the healer re-replicates, and the file reads
+    back bit-identical (reference chaos_test.sh:31-70)."""
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=4,
+                    liveness_cutoff_ms=1500,
+                    intervals={"liveness": 0.3, "healer": 0.5})
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=256 * 1024)
+        data = hashlib.sha256(b"seed").digest() * (3 * 256 * 1024 // 32)
+        digest = hashlib.md5(data).hexdigest()
+        await client.create_file("/chaos/big.bin", data)
+
+        # Kill the CS holding the most replicas.
+        counts: dict[str, int] = {}
+        for f in leader.state.files.values():
+            for b in f.blocks:
+                for loc in b.locations:
+                    counts[loc] = counts.get(loc, 0) + 1
+        victim_addr = max(counts, key=counts.get)
+        idx = [cs.address for cs in c.chunkservers].index(victim_addr)
+        c.heartbeats[idx].stop()
+        await c.chunkservers[idx].stop()
+
+        # Liveness drops it; healer restores 3 live replicas per block.
+        live = set(cs.address for cs in c.chunkservers) - {victim_addr}
+
+        def healed():
+            if victim_addr in leader.state.chunk_servers:
+                return False
+            for f in leader.state.files.values():
+                for b in f.blocks:
+                    if len([l for l in b.locations if l in live]) < 3:
+                        return False
+            return True
+
+        await _wait(healed, timeout=30.0, msg="re-replication after CS death")
+        got = await client.get_file("/chaos/big.bin")
+        assert hashlib.md5(got).hexdigest() == digest
+    finally:
+        await c.stop()
+
+
+# --------------------------------------------------------------- leader kill
+
+
+async def test_master_leader_kill_failover(tmp_path):
+    """Kill the Raft leader master process-equivalent: a new leader takes
+    over and reads AND writes keep working through the client's
+    Not-Leader retry (reference chaos_test.sh master-kill phase)."""
+    c = MiniCluster(tmp_path, n_masters=3, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client)
+        await client.create_file("/ha/before.bin", b"pre-failover" * 100)
+
+        dead_addr = leader.address
+        await c.masters[dead_addr].stop()
+        await c.servers[dead_addr].stop()
+        del c.masters[dead_addr]
+        del c.servers[dead_addr]
+
+        new_leader = await c.leader(timeout=15.0)
+        assert new_leader.address != dead_addr
+        await c.wait_out_of_safe_mode(new_leader)
+        # Survivors serve reads of pre-failover data and accept new writes.
+        assert await client.get_file("/ha/before.bin") == b"pre-failover" * 100
+        await client.create_file("/ha/after.bin", b"post-failover")
+        assert await client.get_file("/ha/after.bin") == b"post-failover"
+    finally:
+        await c.stop()
+
+
+# ------------------------------------------------- netem: follower isolation
+
+
+async def test_follower_isolation_and_heal_via_netem(tmp_path):
+    """Toxiproxy-equivalent partition: every master is addressed through a
+    FaultProxy; isolating one follower makes it campaign with inflated
+    terms while the majority keeps serving; healing converges back to one
+    leader and the cluster accepts writes (reference
+    network_partition_test.sh single-node partition scenario)."""
+    rpc = RpcClient()
+    real_ports = [_free_port() for _ in range(3)]
+    proxies = [FaultProxy("127.0.0.1", p) for p in real_ports]
+    proxy_addrs = [await p.start() for p in proxies]
+
+    masters, servers = [], []
+    for i, real_port in enumerate(real_ports):
+        peers = [a for j, a in enumerate(proxy_addrs) if j != i]
+        m = Master(proxy_addrs[i], peers, str(tmp_path / f"m{i}"),
+                   raft_timings=FAST_RAFT, rpc_client=rpc)
+        server = RpcServer(port=real_port)
+        m.attach(server)
+        await server.start()
+        await m.start(background_tasks=False)
+        masters.append(m)
+        servers.append(server)
+    try:
+        from tpudfs.raft.core import NotLeaderError
+
+        async def propose_any(cmd, timeout=15.0):
+            """Commit via whichever node currently leads (leadership may
+            bounce while the fault is active)."""
+            deadline = asyncio.get_event_loop().time() + timeout
+            while asyncio.get_event_loop().time() < deadline:
+                for m in masters:
+                    if m.raft.is_leader:
+                        try:
+                            m.state.exit_safe_mode()
+                            return await m.raft.propose(cmd)
+                        except (NotLeaderError, ValueError):
+                            pass
+                await asyncio.sleep(0.2)
+            raise AssertionError("no leader accepted the proposal")
+
+        await _wait(lambda: any(m.raft.is_leader for m in masters),
+                    msg="initial election through proxies")
+        leader = next(m for m in masters if m.raft.is_leader)
+        term_before = leader.raft.core.term
+        follower_idx = next(i for i, m in enumerate(masters)
+                            if not m.raft.is_leader)
+
+        # Blackhole the follower's inbound side: it stops hearing
+        # heartbeats and campaigns with an inflated term. (RPC responses
+        # ride the connections it initiates, so — unlike a symmetric
+        # partition — it may even win; either way the cluster must stay
+        # available and converge after the heal.)
+        proxies[follower_idx].partition()
+        isolated = masters[follower_idx]
+        await _wait(lambda: isolated.raft.core.term > term_before,
+                    timeout=10.0, msg="isolated follower to campaign")
+        await propose_any({
+            "op": "create_file", "path": "/during-partition",
+            "created_at_ms": 1, "ec_data_shards": 0, "ec_parity_shards": 0,
+        })
+
+        proxies[follower_idx].heal()
+        await _wait(
+            lambda: sum(m.raft.is_leader for m in masters) == 1
+            and all(m.raft.core.term == masters[0].raft.core.term
+                    for m in masters),
+            timeout=15.0, msg="single leader on one term after heal",
+        )
+        await propose_any({
+            "op": "create_file", "path": "/after-heal",
+            "created_at_ms": 1, "ec_data_shards": 0, "ec_parity_shards": 0,
+        })
+        await _wait(
+            lambda: all("/after-heal" in m.state.files
+                        and "/during-partition" in m.state.files
+                        for m in masters),
+            timeout=10.0, msg="both entries replicated everywhere",
+        )
+    finally:
+        for m in masters:
+            await m.stop()
+        for s in servers:
+            await s.stop()
+        for p in proxies:
+            await p.stop()
+        await rpc.close()
+
+
+# --------------------------------------- linearizability under leader crash
+
+
+async def test_linearizable_history_under_leader_failover(tmp_path):
+    """Run the concurrent workload generator while the leader is killed
+    mid-run, then feed the recorded history to the WGL checker (reference
+    linearizability_test.sh)."""
+    c = MiniCluster(tmp_path, n_masters=3, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client)
+        cfg = WorkloadConfig(clients=3, ops_per_client=12, keys=4, seed=7)
+
+        async def kill_leader_mid_run():
+            await asyncio.sleep(1.0)
+            dead = leader.address
+            await c.masters[dead].stop()
+            await c.servers[dead].stop()
+            del c.masters[dead]
+            del c.servers[dead]
+
+        history, _ = await asyncio.gather(
+            run_workload(client, cfg), kill_leader_mid_run()
+        )
+        completed = [e for e in history if e["return_ts"] is not None]
+        assert len(completed) >= 10, "workload made no progress"
+        result = check_linearizability(history)
+        assert result.linearizable, result.message
+    finally:
+        await c.stop()

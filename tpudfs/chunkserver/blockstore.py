@@ -31,6 +31,7 @@ import numpy as np
 
 from tpudfs.common import native
 from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE, crc32c_chunks
+from tpudfs.common.fsutil import write_durable
 
 #: Native block engine status codes (native/blockio.cc).
 _NATIVE_EBADMETA = -200001
@@ -108,15 +109,9 @@ class BlockStore:
         self._write_durable(self._meta_path(path), self._encode_meta(checksums))
         return checksums
 
-    def _write_durable(self, path: Path, data: bytes) -> None:
-        tmp = path.with_name(path.name + ".tmp")
-        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
-        try:
-            os.write(fd, data)
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-        os.replace(tmp, path)
+    @staticmethod
+    def _write_durable(path: Path, data: bytes) -> None:
+        write_durable(path, data)
 
     def _encode_meta(self, checksums: np.ndarray) -> bytes:
         header = _META_HEADER.pack(

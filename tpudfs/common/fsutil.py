@@ -1,0 +1,24 @@
+"""Small shared filesystem utilities."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def write_durable(path: str | Path, data: bytes) -> None:
+    """Atomic durable publish: write to <path>.tmp (looping over short
+    writes — a single os.write may stop at MAX_RW_COUNT), fsync, rename.
+    Python twin of write_durable in native/blockio.cc."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        view = memoryview(data)
+        while view:
+            n = os.write(fd, view)
+            view = view[n:]
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)

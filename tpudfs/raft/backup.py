@@ -24,6 +24,8 @@ import re
 
 import msgpack
 
+from tpudfs.common.fsutil import write_durable
+
 logger = logging.getLogger(__name__)
 
 KEEP_SNAPSHOTS = 5  # pruned oldest-first beyond this
@@ -61,16 +63,9 @@ class DirSnapshotBackup:
         d = self._dir(node_id)
         d.mkdir(parents=True, exist_ok=True)
         name = f"snap-{snapshot.last_index:012d}.bin"
-        tmp = d / (name + ".tmp")
-        # fsync before rename: a backup that can be torn by power loss is
-        # not a backup (same protocol as BlockStore._write_durable).
-        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
-        try:
-            os.write(fd, encode_snapshot(snapshot))
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-        os.replace(tmp, d / name)
+        # fsync-then-rename via the shared helper: a backup that can be
+        # torn by power loss (or a short write) is not a backup.
+        write_durable(d / name, encode_snapshot(snapshot))
         snaps = sorted(p for p in d.iterdir()
                        if p.name.startswith("snap-")
                        and p.name.endswith(".bin"))

@@ -1,0 +1,1 @@
+"""Test/ops tooling: network fault injection, cluster harnesses."""

@@ -1,0 +1,149 @@
+"""In-process TCP fault-injection proxy (toxiproxy equivalent).
+
+Model: the reference's network-fault tier drives ghcr.io/shopify/toxiproxy
+containers in front of masters/chunkservers/config servers
+(test_scripts/network_partition_test.sh:30-52, docker-compose.toxiproxy.yml)
+to create partitions and latency. This build injects the same faults from
+inside the test process: a ``FaultProxy`` listens on a local port and pipes
+bytes to its upstream, with switchable toxics:
+
+- ``partition`` — refuse new connections AND sever established ones (the
+  both-directions blackhole toxiproxy calls a timeout/reset pair);
+- ``latency`` — delay each forwarded chunk;
+- ``reset_peer`` — kill current connections once (flaky-network blip).
+
+Services under test are simply configured with the proxy's address as their
+peer address; tests flip toxics at runtime.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class FaultProxy:
+    """One listening port forwarding to one upstream address."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0):
+        self.upstream_host = upstream_host
+        self.upstream_port = upstream_port
+        self.listen_host = listen_host
+        self.listen_port = listen_port
+        self.partitioned = False
+        self.latency = 0.0  # seconds added per forwarded chunk
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.Task] = set()
+        self._writers: set[asyncio.StreamWriter] = set()
+
+    @property
+    def address(self) -> str:
+        return f"{self.listen_host}:{self.listen_port}"
+
+    async def start(self) -> str:
+        self._server = await asyncio.start_server(
+            self._handle, self.listen_host, self.listen_port
+        )
+        self.listen_port = self._server.sockets[0].getsockname()[1]
+        return self.address
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.sever()
+        for t in list(self._conns):
+            t.cancel()
+        self._conns.clear()
+
+    # ------------------------------------------------------------- toxics
+
+    def partition(self) -> None:
+        """Blackhole: refuse new connections and sever live ones."""
+        self.partitioned = True
+        self.sever()
+
+    def heal(self) -> None:
+        self.partitioned = False
+
+    def set_latency(self, seconds: float) -> None:
+        self.latency = seconds
+
+    def sever(self) -> None:
+        """Reset all established connections (one-shot blip)."""
+        for w in list(self._writers):
+            with contextlib.suppress(Exception):
+                w.transport.abort()
+        self._writers.clear()
+
+    # ------------------------------------------------------------ plumbing
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        if self.partitioned:
+            writer.transport.abort()
+            return
+        try:
+            up_reader, up_writer = await asyncio.open_connection(
+                self.upstream_host, self.upstream_port
+            )
+        except OSError:
+            writer.transport.abort()
+            return
+        self._writers.add(writer)
+        self._writers.add(up_writer)
+
+        async def pipe(src: asyncio.StreamReader,
+                       dst: asyncio.StreamWriter) -> None:
+            try:
+                while True:
+                    chunk = await src.read(64 * 1024)
+                    if not chunk:
+                        break
+                    if self.partitioned:
+                        break
+                    if self.latency:
+                        await asyncio.sleep(self.latency)
+                    dst.write(chunk)
+                    await dst.drain()
+            except (ConnectionError, asyncio.CancelledError, OSError):
+                pass
+            finally:
+                with contextlib.suppress(Exception):
+                    dst.transport.abort()
+                self._writers.discard(dst)
+
+        t1 = asyncio.create_task(pipe(reader, up_writer))
+        t2 = asyncio.create_task(pipe(up_reader, writer))
+        self._conns.update({t1, t2})
+        t1.add_done_callback(self._conns.discard)
+        t2.add_done_callback(self._conns.discard)
+
+
+class ProxyFleet:
+    """Named set of proxies, one per protected endpoint (the reference's
+    proxy/port map, network_partition_test.sh:30-52)."""
+
+    def __init__(self):
+        self.proxies: dict[str, FaultProxy] = {}
+
+    async def guard(self, name: str, upstream: str) -> str:
+        """Create a proxy in front of ``upstream``; returns proxy address."""
+        host, port = upstream.rsplit(":", 1)
+        p = FaultProxy(host, int(port))
+        addr = await p.start()
+        self.proxies[name] = p
+        return addr
+
+    def __getitem__(self, name: str) -> FaultProxy:
+        return self.proxies[name]
+
+    async def stop(self) -> None:
+        for p in self.proxies.values():
+            await p.stop()
+        self.proxies.clear()
