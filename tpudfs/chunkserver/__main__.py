@@ -10,6 +10,7 @@ import argparse
 import asyncio
 
 from tpudfs.common.ops_http import maybe_start_ops
+from tpudfs.common.rpc import add_tls_args, tls_from_args
 from tpudfs.common.telemetry import setup_logging
 from tpudfs.chunkserver.blockstore import BlockStore
 from tpudfs.chunkserver.heartbeat import HeartbeatLoop
@@ -28,6 +29,7 @@ def parse_args(argv=None):
     p.add_argument("--config-servers", default="", help="comma-separated config servers")
     p.add_argument("--heartbeat-interval", type=float, default=5.0)
     p.add_argument("--scrub-interval", type=float, default=60.0)
+    add_tls_args(p)
     p.add_argument("--http-port", type=int, default=-1,
                    help="ops HTTP (/health /metrics); "
                         "-1 = rpc port + 1000, 0 = disabled")
@@ -38,14 +40,17 @@ async def amain(args) -> None:
     store = BlockStore(args.data_dir, args.cold_dir)
     masters = [m for m in args.masters.split(",") if m]
     configs = [c for c in args.config_servers.split(",") if c]
+    stls, ctls = tls_from_args(args)
+    from tpudfs.common.rpc import RpcClient
     cs = ChunkServer(
         store,
         address=args.advertise,
         rack_id=args.rack_id,
         master_addrs=masters,
         scrub_interval=args.scrub_interval,
+        rpc_client=RpcClient(tls=ctls) if ctls else None,
     )
-    await cs.start(args.host, args.port)
+    await cs.start(args.host, args.port, tls=stls)
     hb = HeartbeatLoop(cs, masters, configs, interval=args.heartbeat_interval)
     hb.start()
     await maybe_start_ops("tpudfs_chunkserver", cs.ops_gauges,

@@ -21,6 +21,7 @@ import numpy as np
 from tpudfs.client.checker import check_linearizability, load_history
 from tpudfs.client.client import Client, DfsError
 from tpudfs.client.workload import WorkloadConfig, dump_history, run_workload
+from tpudfs.common.rpc import add_tls_args, tls_from_args
 from tpudfs.common.telemetry import setup_logging
 
 
@@ -28,6 +29,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tpudfs")
     p.add_argument("--masters", default="", help="comma-separated master addresses")
     p.add_argument("--config-servers", default="")
+    add_tls_args(p)
     p.add_argument("--hedge-delay", type=float, default=None,
                    help="enable hedged reads with this delay in seconds")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -102,7 +104,9 @@ def make_client(args) -> Client:
     if not masters and not configs:
         print("error: pass --masters and/or --config-servers", file=sys.stderr)
         sys.exit(2)
-    return Client(masters or None, configs or None, hedge_delay=args.hedge_delay)
+    _stls, ctls = tls_from_args(args)
+    return Client(masters or None, configs or None,
+                  hedge_delay=args.hedge_delay, tls=ctls)
 
 
 def print_stats(label: str, latencies: list[float], total_bytes: int,

@@ -133,6 +133,39 @@ class ClientTls:
     key_path: str | None = None
 
 
+def add_tls_args(parser) -> None:
+    """Uniform TLS flags for every service entry point."""
+    parser.add_argument("--tls-cert", default="",
+                        help="server TLS certificate (requires --tls-key)")
+    parser.add_argument("--tls-key", default="", help="server TLS private key")
+    parser.add_argument("--tls-ca", default="",
+                        help="CA bundle used to verify outbound peers")
+    parser.add_argument("--tls-mtls", action="store_true",
+                        help="require verified client certificates "
+                             "(needs --tls-cert/--tls-key/--tls-ca)")
+
+
+def tls_from_args(args) -> tuple["ServerTls | None", "ClientTls | None"]:
+    """Build (server, client) TLS configs from the shared flags, failing
+    fast on inconsistent combinations — a half-specified TLS setup must
+    never silently bind a plaintext or non-mTLS port."""
+    if bool(args.tls_cert) != bool(args.tls_key):
+        raise SystemExit("--tls-cert and --tls-key must be given together")
+    if args.tls_mtls and not (args.tls_cert and args.tls_ca):
+        raise SystemExit(
+            "--tls-mtls requires --tls-cert, --tls-key and --tls-ca"
+        )
+    stls = ctls = None
+    if args.tls_cert:
+        stls = ServerTls(args.tls_cert, args.tls_key,
+                         ca_path=args.tls_ca if args.tls_mtls else None)
+    if args.tls_ca:
+        ctls = ClientTls(ca_path=args.tls_ca,
+                         cert_path=args.tls_cert or None,
+                         key_path=args.tls_key or None)
+    return stls, ctls
+
+
 class RpcServer:
     """gRPC server hosting msgpack generic services.
 

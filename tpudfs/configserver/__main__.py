@@ -11,6 +11,7 @@ import argparse
 import asyncio
 
 from tpudfs.common.ops_http import maybe_start_ops
+from tpudfs.common.rpc import add_tls_args, tls_from_args
 from tpudfs.common.rpc import RpcServer
 from tpudfs.common.telemetry import setup_logging
 from tpudfs.configserver.service import ConfigServer
@@ -23,6 +24,7 @@ def parse_args(argv=None):
     p.add_argument("--advertise", default="", help="address peers/clients use")
     p.add_argument("--data-dir", required=True)
     p.add_argument("--peers", default="", help="comma-separated peer addresses")
+    add_tls_args(p)
     p.add_argument("--http-port", type=int, default=-1,
                    help="ops HTTP; -1 = rpc port + 1000, 0 = disabled")
     p.add_argument("--snapshot-backup-dir", default="",
@@ -37,9 +39,12 @@ async def amain(args) -> None:
     if args.snapshot_backup_dir:
         from tpudfs.raft.backup import DirSnapshotBackup
         backup = DirSnapshotBackup(args.snapshot_backup_dir)
+    stls, ctls = tls_from_args(args)
+    from tpudfs.common.rpc import RpcClient
     cfg = ConfigServer(address, peers, args.data_dir,
-                       snapshot_backup=backup)
-    server = RpcServer(args.host, args.port)
+                       snapshot_backup=backup,
+                       rpc_client=RpcClient(tls=ctls) if ctls else None)
+    server = RpcServer(args.host, args.port, tls=stls)
     cfg.attach(server)
     await server.start()
     await cfg.start()

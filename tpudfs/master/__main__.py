@@ -10,6 +10,7 @@ import argparse
 import asyncio
 
 from tpudfs.common.ops_http import maybe_start_ops
+from tpudfs.common.rpc import add_tls_args, tls_from_args
 from tpudfs.common.rpc import RpcServer
 from tpudfs.common.telemetry import setup_logging
 from tpudfs.master.service import Master
@@ -25,6 +26,7 @@ def parse_args(argv=None):
     p.add_argument("--shard-id", default="shard-0",
                    help='"" registers as a spare master awaiting allocation')
     p.add_argument("--config-servers", default="")
+    add_tls_args(p)
     p.add_argument("--http-port", type=int, default=-1,
                    help="ops HTTP (/health /metrics /raft/state); "
                         "-1 = rpc port + 1000, 0 = disabled")
@@ -62,13 +64,16 @@ async def amain(args) -> None:
     address = args.advertise or f"{args.host}:{args.port}"
     peers = [x for x in args.peers.split(",") if x]
     configs = [x for x in args.config_servers.split(",") if x]
+    stls, ctls = tls_from_args(args)
+    from tpudfs.common.rpc import RpcClient
     master = Master(address, peers, args.data_dir, shard_id=args.shard_id,
                     config_servers=configs,
                     split_threshold_rps=args.split_threshold_rps,
                     merge_threshold_rps=args.merge_threshold_rps,
                     split_cooldown_secs=args.split_cooldown_secs,
-                    snapshot_backup=make_backup(args))
-    server = RpcServer(args.host, args.port)
+                    snapshot_backup=make_backup(args),
+                    rpc_client=RpcClient(tls=ctls) if ctls else None)
+    server = RpcServer(args.host, args.port, tls=stls)
     master.attach(server)
     await server.start()
     await master.start()

@@ -276,7 +276,18 @@ def gateway_from_env(client: Client | None = None) -> Gateway:
     if client is None:
         masters = [a for a in env.get("MASTER_ADDRS", "").split(",") if a]
         configs = [a for a in env.get("CONFIG_SERVERS", "").split(",") if a]
-        client = Client(masters or None, configs or None)
+        # Backend TLS: when the metadata/data plane runs with --tls-cert,
+        # the gateway's DFS client must speak TLS too.
+        backend_tls = None
+        if env.get("S3_BACKEND_TLS_CA"):
+            from tpudfs.common.rpc import ClientTls
+
+            backend_tls = ClientTls(
+                ca_path=env["S3_BACKEND_TLS_CA"],
+                cert_path=env.get("S3_BACKEND_TLS_CERT") or None,
+                key_path=env.get("S3_BACKEND_TLS_KEY") or None,
+            )
+        client = Client(masters or None, configs or None, tls=backend_tls)
 
     users_json = env.get("S3_USERS_JSON", "")
     credentials: CredentialProvider

@@ -1,0 +1,54 @@
+"""Self-signed test-CA + certificate generation via the openssl CLI.
+
+Test/dev twin of the reference's PEM fixtures (dfs/common/src/security.rs
+loads CA/server/client PEMs; its TLS e2e scripts generate throwaway certs
+the same way). Production deployments bring their own PKI — these helpers
+only back the TLS test tier and local clusters.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+
+
+def _run(*args: str, input_text: str | None = None) -> None:
+    subprocess.run(
+        ["openssl", *args], check=True, capture_output=True,
+        input=input_text.encode() if input_text else None,
+    )
+
+
+def make_test_pki(root: str | pathlib.Path,
+                  hosts: tuple[str, ...] = ("127.0.0.1", "localhost")) -> dict:
+    """Create ca.pem plus server/client keypairs signed by it. Returns the
+    path map: {ca, server_cert, server_key, client_cert, client_key}."""
+    d = pathlib.Path(root)
+    d.mkdir(parents=True, exist_ok=True)
+    ca_key, ca = d / "ca.key", d / "ca.pem"
+    _run("req", "-x509", "-newkey", "rsa:2048", "-nodes", "-days", "2",
+         "-keyout", str(ca_key), "-out", str(ca),
+         "-subj", "/CN=tpudfs-test-ca")
+    import ipaddress
+
+    def _san(h: str) -> str:
+        try:
+            ipaddress.ip_address(h)
+            return f"IP:{h}"
+        except ValueError:
+            return f"DNS:{h}"
+
+    san = ",".join(_san(h) for h in hosts)
+    out = {"ca": str(ca)}
+    for role in ("server", "client"):
+        key, csr, cert = d / f"{role}.key", d / f"{role}.csr", d / f"{role}.pem"
+        _run("req", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(csr),
+             "-subj", f"/CN=tpudfs-test-{role}")
+        _run("x509", "-req", "-in", str(csr), "-CA", str(ca),
+             "-CAkey", str(ca_key), "-CAcreateserial", "-days", "2",
+             "-out", str(cert), "-extfile", "/dev/stdin",
+             input_text=f"subjectAltName={san}\n")
+        out[f"{role}_cert"] = str(cert)
+        out[f"{role}_key"] = str(key)
+    return out
