@@ -46,7 +46,12 @@ class IciReplicator:
         self.axis = axis or mesh.axis_names[0]
         self.replication = replication
         n = mesh.devices.size
-        if replication > n:
+        # Single-chip exception: every hop is a self-ppermute, replicas
+        # coincide — degenerate but still compiles and runs the full
+        # collective graph, which is what the driver's entry() exercises
+        # on the one real chip. Any larger mesh must hold R distinct
+        # replicas, so replication > n stays an error there.
+        if n > 1 and replication > n:
             raise ValueError(f"replication {replication} > mesh size {n}")
         self._fn = self._build()
 
