@@ -263,3 +263,42 @@ def test_quorum_intersection_property():
         for q1 in quorums[:30]:
             for q2 in quorums[:30]:
                 assert q1 & q2, f"disjoint quorums {q1} {q2} for {cfg}"
+
+
+def test_propose_batch_single_append_and_order():
+    """A batch of commands becomes ONE AppendLog effect with contiguous
+    indices and commits in order everywhere (reference batch-append,
+    simple_raft.rs:1689-1778)."""
+    from tpudfs.raft.core import AppendLog
+
+    c = SimCluster(3, seed=21)
+    lead = c.wait_for_leader()
+    cmds = [{"op": "set", "k": f"b{i}"} for i in range(10)]
+    indices, effects = lead.core.propose_batch(cmds, c.now)
+    appends = [e for e in effects if isinstance(e, AppendLog)]
+    assert len(appends) == 1
+    assert [e.command for e in appends[0].entries] == cmds
+    assert indices == list(range(indices[0], indices[0] + 10))
+    c._process_effects(lead, effects)
+    for _ in range(2000):
+        c.step()
+        if all(
+            len(c.committed_commands(nid)) >= 10 for nid in c.ids
+        ):
+            break
+    seqs = [
+        [cmd["k"] for cmd in c.committed_commands(nid)
+         if isinstance(cmd, dict) and "k" in cmd]
+        for nid in c.ids
+    ]
+    assert seqs[0] == seqs[1] == seqs[2] == [f"b{i}" for i in range(10)]
+
+
+def test_propose_batch_not_leader_raises():
+    c = SimCluster(3, seed=22)
+    lead = c.wait_for_leader()
+    follower = next(
+        n for n in c.nodes.values() if n.node_id != lead.node_id
+    )
+    with pytest.raises(NotLeaderError):
+        follower.core.propose_batch([{"op": "x"}], c.now)

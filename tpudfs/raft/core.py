@@ -427,20 +427,36 @@ class RaftCore:
         """Append a command; returns (log index, effects). Raises NotLeaderError
         with the last-known leader hint when not leader (the client-visible
         ``Not Leader|<hint>`` convention, reference mod.rs:1442-1467)."""
+        indices, effects = self.propose_batch([command], now)
+        return indices[0], effects
+
+    def propose_batch(self, commands: list, now: float) -> tuple[list[int], list]:
+        """Append a batch of commands as one log-append + one replication
+        round (the reference drains up to 256 queued events per loop and
+        batch-appends them, simple_raft.rs:1174-1185,1689-1778). Returns
+        (log indices, effects) — a single AppendLog effect covers the whole
+        batch, so the WAL takes one fsync for N proposals."""
         if self.role != Role.LEADER or self._transfer_target:
             raise NotLeaderError(self._transfer_target or self.leader_id)
-        effects = self._append_local(command)
+        effects = self._append_local_batch(commands)
         effects += self._broadcast_append()
         self._heartbeat_due = now + self.timings.heartbeat
-        return self.last_index, effects
+        first = self.last_index - len(commands) + 1
+        return list(range(first, self.last_index + 1)), effects
 
     def _append_local(self, command: Any) -> list:
-        entry = LogEntry(self.last_index + 1, self.term, command)
-        self.log.append(entry)
-        cfg = self._config_of(entry)
-        if cfg is not None:
-            self.config = cfg
-        effects: list = [AppendLog((entry,))]
+        return self._append_local_batch([command])
+
+    def _append_local_batch(self, commands: list) -> list:
+        entries = []
+        for command in commands:
+            entry = LogEntry(self.last_index + 1, self.term, command)
+            self.log.append(entry)
+            cfg = self._config_of(entry)
+            if cfg is not None:
+                self.config = cfg
+            entries.append(entry)
+        effects: list = [AppendLog(tuple(entries))]
         # Single-node: may commit immediately.
         effects += self._advance_commit()
         return effects

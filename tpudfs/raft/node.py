@@ -45,6 +45,7 @@ logger = logging.getLogger(__name__)
 SERVICE = "RaftService"
 PEER_RPC_TIMEOUT = 1.5  # reference simple_raft.rs:690
 TICK_INTERVAL = 0.1  # reference simple_raft.rs:1190
+PROPOSE_BATCH = 256  # reference event-batch drain, simple_raft.rs:1174-1185
 
 
 class RaftNode:
@@ -84,6 +85,8 @@ class RaftNode:
         self._owns_client = rpc_client is None
         self.client = rpc_client or RpcClient()
         self._pending: dict[int, tuple[int, asyncio.Future]] = {}
+        self._propose_queue: list[list] = []
+        self._drain_task: asyncio.Task | None = None
         self._pending_reads: dict[int, asyncio.Future] = {}
         self._read_seq = 0
         self._lock = asyncio.Lock()
@@ -109,6 +112,15 @@ class RaftNode:
         if self._tick_task:
             self._tick_task.cancel()
             self._tick_task = None
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+            self._drain_task = None
+        # Fail queued-but-undrained proposals so callers don't sit out
+        # their full timeout against a stopped node.
+        queued, self._propose_queue = self._propose_queue, []
+        for item in queued:
+            if not item[1].done():
+                item[1].set_exception(NotLeaderError(self.core.leader_id))
         for t in list(self._send_tasks):
             t.cancel()
         self.storage.close()
@@ -142,17 +154,61 @@ class RaftNode:
 
     async def propose(self, command: Any, timeout: float = 10.0) -> Any:
         """Replicate ``command``; resolves with the state machine's apply
-        result once committed (commit-wait, reference simple_raft.rs:2452)."""
-        async with self._lock:
-            index, effects = self.core.propose(command, self._now())
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._pending[index] = (self.core.term, fut)
-            await self._execute(effects)
+        result once committed (commit-wait, reference simple_raft.rs:2452).
+
+        Concurrent proposals are group-committed: they queue here and a
+        single drainer appends up to PROPOSE_BATCH of them as one log-append
+        (one WAL fsync) and one replication round, matching the reference's
+        256-event batch drain (simple_raft.rs:1174-1185,1689-1778)."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        item = [command, fut, None]  # slot 2 = log index once drained
+        self._propose_queue.append(item)
+        if self._drain_task is None or self._drain_task.done():
+            self._drain_task = asyncio.create_task(self._drain_proposals())
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
-            self._pending.pop(index, None)
+            if item[2] is not None:
+                self._pending.pop(item[2], None)
+            else:
+                try:
+                    self._propose_queue.remove(item)
+                except ValueError:
+                    pass
             raise NotLeaderError(self.core.leader_id) from None
+
+    async def _drain_proposals(self) -> None:
+        while self._propose_queue:
+            batch = self._propose_queue[:PROPOSE_BATCH]
+            del self._propose_queue[: len(batch)]
+            async with self._lock:
+                try:
+                    indices, effects = self.core.propose_batch(
+                        [item[0] for item in batch], self._now()
+                    )
+                except NotLeaderError as e:
+                    for item in batch:
+                        if not item[1].done():
+                            item[1].set_exception(
+                                NotLeaderError(e.leader_hint)
+                            )
+                    continue
+                for item, index in zip(batch, indices):
+                    item[2] = index
+                    self._pending[index] = (self.core.term, item[1])
+                try:
+                    await self._execute(effects)
+                except Exception as e:
+                    # Persistence/effect failure (e.g. WAL append ENOSPC):
+                    # surface the real error to this batch — the entries are
+                    # appended in-memory so they MAY still commit ("maybe
+                    # applied", same contract as a propose timeout) — and
+                    # keep draining so later proposals aren't stranded.
+                    logger.exception("proposal batch effects failed")
+                    for item in batch:
+                        self._pending.pop(item[2], None)
+                        if not item[1].done():
+                            item[1].set_exception(e)
 
     async def read_index(self, timeout: float = 10.0) -> int:
         """Linearizable read barrier; resolves once this node has confirmed
