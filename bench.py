@@ -1,18 +1,25 @@
 """tpudfs flagship benchmark (driver-run, one JSON line).
 
-Metric (BASELINE.json): chunk read GB/s/host into TPU HBM with 3x-replicated
-storage and end-to-end CRC32C verification running ON the device (Pallas).
+Metric (BASELINE.json): "chunk read GB/s/host into TPU HBM; 3x-replication
+write GB/s over ICI" — BOTH sides are reported:
 
-Path measured: a live in-process DFS (1 master + 3 chunkservers over real
-gRPC sockets, 3x pipeline-replicated 1 MiB blocks) read through the client's
-concurrent fan-out into device memory via HbmReader — per-block device_put,
-per-512B-chunk CRC32C on the accelerator, GF(2)-combine against the stored
-block checksum.
+- read side: a live in-process DFS (1 master + 3 chunkservers over real gRPC
+  sockets, 3x pipeline-replicated 1 MiB blocks) read through the client's
+  concurrent fan-out into device memory via HbmReader — per-block device_put,
+  per-512B-chunk CRC32C on the accelerator, GF(2)-combine against the stored
+  block checksum. The dataset (128 x 1 MiB) far exceeds the chunkservers'
+  LRU block cache (capped at 8 blocks here), so reads exercise the disk path.
+- write side: (a) the DFS 3x pipeline-replicated write path (client -> CS1 ->
+  CS2 -> CS3 chain over gRPC), logical GB/s; (b) the TPU-native replacement:
+  `replicated_write_step` — ppermute chain + on-device CRC verify + ack psum
+  — timed on the real chip (replication-degenerate on a 1-device mesh; the
+  multi-device layout is validated by dryrun_multichip).
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the ratio
 is against the BASELINE.json north-star target = 90% of this host's raw
-host->device infeed bandwidth (measured in the same process with plain
-device_put of identical buffers). vs_baseline = achieved / (0.9 * raw_infeed).
+host->device infeed bandwidth, measured honestly: one dispatcher thread
+issues all device_puts of DISTINCT buffers back-to-back and blocks once on
+the batch (no per-call thread hops or syncs).
 """
 
 from __future__ import annotations
@@ -23,8 +30,74 @@ import time
 
 import numpy as np
 
-FILES = 48
+FILES = 128
 BLOCK_MB = 1
+CS_CACHE_BLOCKS = 8  # << FILES so the read phase cannot ride the LRU cache
+READ_CONCURRENCY = 8
+ICI_STEP_MB = 8
+ICI_REPS = 16
+
+
+def _bench_raw_infeed(device, nbytes_each: int, reps: int) -> float:
+    """Raw host->HBM bandwidth, taken as the BEST of two honest harnesses so
+    the denominator is strictly favorable: (a) one dispatcher issuing all
+    device_puts back-to-back with a single final sync (pipelined), and
+    (b) READ_CONCURRENCY persistent threads each pipelining its share (what
+    the measured path's 8-way fan-out gets to use). Distinct buffers per
+    transfer — no residency reuse."""
+    import concurrent.futures
+
+    import jax
+
+    bufs = [
+        np.random.default_rng(i).integers(
+            0, 256, nbytes_each, dtype=np.uint8
+        ).reshape(-1, 512).view("<u4")
+        for i in range(reps)
+    ]
+    # Warm-up transfer.
+    jax.block_until_ready(jax.device_put(bufs[0], device))
+    t0 = time.perf_counter()
+    arrs = [jax.device_put(b, device) for b in bufs]
+    jax.block_until_ready(arrs)
+    serial = nbytes_each * reps / (time.perf_counter() - t0) / 1e9
+
+    def put_shard(shard):
+        return [jax.device_put(b, device) for b in shard]
+
+    shards = [bufs[i::READ_CONCURRENCY] for i in range(READ_CONCURRENCY)]
+    with concurrent.futures.ThreadPoolExecutor(READ_CONCURRENCY) as pool:
+        t0 = time.perf_counter()
+        out = list(pool.map(put_shard, shards))
+        jax.block_until_ready(out)
+        threaded = nbytes_each * reps / (time.perf_counter() - t0) / 1e9
+    return max(serial, threaded)
+
+
+def _bench_ici_write_step(device) -> float:
+    """On-chip 3x replication round: ppermute chain + Pallas CRC verify +
+    ack psum, timed over ICI_REPS rounds of ICI_STEP_MB each."""
+    import jax
+
+    from tpudfs.common.checksum import crc32c_chunks
+    from tpudfs.tpu.crc32c_pallas import bytes_to_words
+    from tpudfs.tpu.ici_replication import make_mesh, replicated_write_step
+
+    mesh = make_mesh([device])
+    step = replicated_write_step(mesh, replication=3)
+    nbytes = ICI_STEP_MB << 20
+    data = np.random.default_rng(7).integers(
+        0, 256, nbytes, dtype=np.uint8
+    ).tobytes()
+    words = jax.device_put(bytes_to_words(data), device)
+    crcs = jax.device_put(crc32c_chunks(data).astype(np.uint32), device)
+    jax.block_until_ready(step(words, crcs))  # compile + warm up
+    t0 = time.perf_counter()
+    outs = [step(words, crcs) for _ in range(ICI_REPS)]
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    assert all(bool(o["ok"].reshape(-1)[0]) for o in outs)
+    return nbytes * ICI_REPS / dt / 1e9
 
 
 async def _run() -> dict:
@@ -58,7 +131,7 @@ async def _run() -> dict:
     for i in range(3):
         cs = ChunkServer(
             BlockStore(f"{root}/cs{i}/hot"), master_addrs=[maddr],
-            rpc_client=rpc,
+            rpc_client=rpc, cache_size=CS_CACHE_BLOCKS,
         )
         await cs.start(scrubber=False)
         chunkservers.append(cs)
@@ -75,18 +148,23 @@ async def _run() -> dict:
     data = np.random.default_rng(0).integers(
         0, 256, BLOCK_MB << 20, dtype=np.uint8
     ).tobytes()
-    sem = asyncio.Semaphore(8)
+    sem = asyncio.Semaphore(READ_CONCURRENCY)
 
     async def put(i):
         async with sem:
             await client.create_file(f"/bench/f{i:04d}", data)
 
+    # ---- write side: 3x pipeline-replicated DFS writes (logical GB/s).
+    t0 = time.perf_counter()
     await asyncio.gather(*(put(i) for i in range(FILES)))
+    write_wall = time.perf_counter() - t0
+    write_gbps = FILES * len(data) / write_wall / 1e9
 
     device = jax.devices()[0]
     reader = HbmReader(client, [device])
 
-    # Warm up kernels + caches.
+    # Warm up kernels + compile caches (not the CS block cache: it only
+    # holds CS_CACHE_BLOCKS blocks, and the measured sweep touches FILES).
     await reader.read_file_to_device_blocks("/bench/f0000", verify=True)
 
     async def read_one(i):
@@ -102,22 +180,11 @@ async def _run() -> dict:
     total = sum(sizes)
     achieved = total / wall / 1e9
 
-    # Raw host->HBM infeed bandwidth on identical buffers with the SAME
-    # 8-way concurrency as the measured path (the north-star denominator:
-    # target is 90% of this).
-    buf = np.frombuffer(data, dtype=np.uint8).reshape(-1, 512).view("<u4")
-    jax.device_put(buf, device).block_until_ready()
-    reps = 32
+    cache_hits = sum(cs.cache.hits for cs in chunkservers)
+    cache_misses = sum(cs.cache.misses for cs in chunkservers)
 
-    async def raw_put(_):
-        async with sem:
-            await asyncio.to_thread(
-                lambda: jax.device_put(buf, device).block_until_ready()
-            )
-
-    t0 = time.perf_counter()
-    await asyncio.gather(*(raw_put(i) for i in range(reps)))
-    raw = (len(data) * reps) / (time.perf_counter() - t0) / 1e9
+    raw = _bench_raw_infeed(device, len(data), 32)
+    ici_write = _bench_ici_write_step(device)
 
     for cs in chunkservers:
         await cs.stop()
@@ -129,14 +196,19 @@ async def _run() -> dict:
     target = 0.9 * raw
     return {
         "metric": (
-            "1MiB-chunk read GB/s/host into TPU HBM "
-            "(3x-replicated DFS, on-device CRC32C verify)"
+            "1MiB-chunk read GB/s/host into TPU HBM (3x-replicated DFS, "
+            "on-device CRC32C verify) + 3x-replication write GB/s over ICI"
         ),
         "value": round(achieved, 3),
         "unit": "GB/s",
         "vs_baseline": round(achieved / target, 3) if target else 0.0,
+        "write_pipeline_GBps": round(write_gbps, 3),
+        "ici_write_GBps": round(ici_write, 3),
         "raw_infeed_GBps": round(raw, 3),
         "files": FILES,
+        "cs_cache_hit_rate": round(
+            cache_hits / max(1, cache_hits + cache_misses), 3
+        ),
         "platform": jax.devices()[0].platform,
     }
 
