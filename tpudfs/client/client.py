@@ -320,6 +320,12 @@ class Client:
         meta = await self.get_file_info(path)
         if meta is None:
             raise DfsError(f"file not found: {path}")
+        return await self.read_meta_range(meta, offset, length)
+
+    async def read_meta_range(self, meta: dict, offset: int, length: int) -> bytes:
+        """Range read against already-fetched file metadata. Hot-path variant
+        for callers (e.g. the grain infeed) that cache the immutable block
+        layout and must not pay a master GetFileInfo round-trip per read."""
         if offset >= meta["size"] or length <= 0:
             return b""
         length = min(length, meta["size"] - offset)
