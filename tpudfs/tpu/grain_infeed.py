@@ -68,9 +68,14 @@ class _ClientLoop:
         return Client(addrs, **kwargs)
 
     def run(self, coro, timeout: float = 120.0) -> Any:
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
-            timeout
-        )
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            # Don't let the orphaned coroutine keep running (and holding
+            # RPCs in flight) after the caller has given up on it.
+            fut.cancel()
+            raise
 
     def close(self) -> None:
         try:
@@ -105,6 +110,12 @@ class DfsRecordSource:
     ):
         if record_bytes <= 0:
             raise ValueError("record_bytes must be positive")
+        itemsize = np.dtype(dtype).itemsize
+        if record_bytes % itemsize:
+            raise ValueError(
+                f"record_bytes={record_bytes} is not a multiple of "
+                f"dtype {dtype} itemsize {itemsize}"
+            )
         self.master_addrs = list(master_addrs)
         self.paths = list(paths)
         self.record_bytes = int(record_bytes)
@@ -117,7 +128,13 @@ class DfsRecordSource:
         # Immutable block layout per path, cached so record fetches skip the
         # per-read master GetFileInfo round-trip (read_meta_range fast path).
         self._metas: dict[str, dict] = {}
-        self._build_index()
+        try:
+            self._build_index()
+        except BaseException:
+            # __init__ failed — the caller never gets an object to close(),
+            # so tear down the client loop thread here.
+            self.close()
+            raise
 
     # ------------------------------------------------------------- plumbing
 
