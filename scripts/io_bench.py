@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Local block-engine microbenchmark (reference dfs/chunkserver/benches/
+io_bench.rs:9-45 — criterion write/read at 4 KB / 64 KB / 1 MB).
+
+Times the ChunkServer block engine in isolation — no RPC, no cluster — in
+both modes:
+
+- native: the C++ fused engine (native/blockio.cc — CRC + tmp/fsync/rename
+  write, read + range-verify in one call);
+- python: the numpy/std-lib fallback path.
+
+Per (engine, size): durable write MB/s, verified read MB/s, ops/s. Output is
+one JSON document; pass --json for machine-only output.
+
+  python scripts/io_bench.py [--secs 1.0] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+SIZES = [("4KB", 4 << 10), ("64KB", 64 << 10), ("1MB", 1 << 20)]
+
+
+def _force_python_fallback() -> None:
+    from tpudfs.common import native
+
+    native._lib = None
+    native._load_attempted = True
+
+
+def _bench_engine(engine: str, secs: float) -> list[dict]:
+    from tpudfs.chunkserver.blockstore import BlockStore
+    from tpudfs.common import native
+
+    if engine == "python":
+        _force_python_fallback()
+    else:
+        if native.get_lib() is None or not native.has_blockio():
+            return [{"engine": engine, "error": "native engine unavailable"}]
+
+    results = []
+    with tempfile.TemporaryDirectory(prefix=f"iobench-{engine}-") as tmp:
+        store = BlockStore(tmp)
+        for label, size in SIZES:
+            data = np.random.default_rng(size).integers(
+                0, 256, size, dtype=np.uint8
+            ).tobytes()
+            # Warm-up (also populates one block for the read pass).
+            store.write(f"warm-{label}", data)
+            store.read_verified(f"warm-{label}")
+
+            n, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < secs:
+                store.write(f"w-{label}-{n % 64}", data)
+                n += 1
+            dt = time.perf_counter() - t0
+            write_mbps = n * size / dt / 1e6
+            write_ops = n / dt
+            written = min(n, 64)
+
+            n, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < secs:
+                out = store.read_verified(f"w-{label}-{n % written}")
+                n += 1
+            dt = time.perf_counter() - t0
+            assert out == data
+            results.append({
+                "engine": engine,
+                "size": label,
+                "write_MBps": round(write_mbps, 1),
+                "write_ops_s": round(write_ops, 1),
+                "read_verified_MBps": round(n * size / dt / 1e6, 1),
+                "read_ops_s": round(n / dt, 1),
+            })
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("tpudfs-io-bench")
+    ap.add_argument("--secs", type=float, default=1.0,
+                    help="measure window per (engine, size) op")
+    ap.add_argument("--json", action="store_true", help="JSON only")
+    args = ap.parse_args()
+
+    # Native pass must run before the fallback pass poisons the loader cache.
+    rows = _bench_engine("native", args.secs) + _bench_engine(
+        "python", args.secs
+    )
+    doc = {"bench": "block-engine", "results": rows}
+    if args.json:
+        print(json.dumps(doc))
+        return
+    for r in rows:
+        if "error" in r:
+            print(f"{r['engine']:7s}  {r['error']}")
+            continue
+        print(
+            f"{r['engine']:7s} {r['size']:>5s}  "
+            f"write {r['write_MBps']:9.1f} MB/s ({r['write_ops_s']:8.1f} op/s)  "
+            f"read+verify {r['read_verified_MBps']:9.1f} MB/s "
+            f"({r['read_ops_s']:8.1f} op/s)"
+        )
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

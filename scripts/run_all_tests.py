@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Full test driver (reference run_all_tests.sh): lint gate, unit suite,
+then a LIVE sharded-HA cluster exercised end-to-end — cross-shard writes and
+renames, a benchmark burst, and a concurrent workload whose history is
+linearizability-checked.
+
+  python scripts/run_all_tests.py             # everything
+  python scripts/run_all_tests.py --skip-unit # live-cluster tiers only
+  python scripts/run_all_tests.py --topology deploy/topologies/two-shard.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+ENV = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
+
+
+def run(title: str, cmd: list[str], **kw) -> None:
+    print(f"\n=== {title}: {' '.join(cmd[:6])} ...")
+    t0 = time.time()
+    r = subprocess.run(cmd, env=ENV, cwd=REPO, **kw)
+    if r.returncode != 0:
+        raise SystemExit(f"FAILED: {title} (rc={r.returncode})")
+    print(f"=== ok ({time.time() - t0:.1f}s)")
+
+
+def cli(masters: list[str], cfg: str, *args: str,
+        check: bool = True) -> subprocess.CompletedProcess:
+    cmd = [sys.executable, "-m", "tpudfs.client.cli",
+           "--masters", ",".join(masters), "--config-servers", cfg, *args]
+    r = subprocess.run(cmd, env=ENV, cwd=REPO, capture_output=True, text=True)
+    if check and r.returncode != 0:
+        print(r.stdout)
+        print(r.stderr)
+        raise SystemExit(f"CLI failed: {' '.join(args)}")
+    return r
+
+
+def live_cluster_tier(topology: str, workload_ops: int) -> None:
+    with tempfile.TemporaryDirectory(prefix="tpudfs-alltests-") as tmp:
+        ready = pathlib.Path(tmp) / "endpoints.json"
+        launcher = subprocess.Popen(
+            [sys.executable, "scripts/start_cluster.py",
+             "--topology", topology, "--data-dir", f"{tmp}/cluster",
+             "--s3-port", str(_free_port()), "--ready-file", str(ready)],
+            env=ENV, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            deadline = time.time() + 120
+            while not ready.exists():
+                if launcher.poll() is not None:
+                    out = launcher.stdout.read() if launcher.stdout else ""
+                    raise SystemExit(f"cluster failed to start:\n{out}")
+                if time.time() > deadline:
+                    raise SystemExit("cluster start timed out")
+                time.sleep(0.5)
+            eps = json.loads(ready.read_text())
+            masters = [a for addrs in eps["shards"].values() for a in addrs]
+            cfg = eps["config_server"]
+            print(f"live cluster up: {eps['topology']} "
+                  f"({len(eps['shards'])} shards, "
+                  f"{len(eps['chunkservers'])} chunkservers)")
+
+            # --- cross-shard smoke: keys on both sides of the /m split.
+            src = pathlib.Path(tmp) / "payload.bin"
+            src.write_bytes(os.urandom(256 * 1024))
+            cli(masters, cfg, "put", str(src), "/a/left-shard-file")
+            cli(masters, cfg, "put", str(src), "/z/right-shard-file")
+            for path in ("/a/left-shard-file", "/z/right-shard-file"):
+                dst = pathlib.Path(tmp) / "out.bin"
+                cli(masters, cfg, "get", path, str(dst))
+                assert dst.read_bytes() == src.read_bytes(), path
+            # Cross-shard rename = 2PC over two Raft groups.
+            cli(masters, cfg, "rename", "/a/left-shard-file", "/z/moved")
+            dst = pathlib.Path(tmp) / "moved.bin"
+            cli(masters, cfg, "get", "/z/moved", str(dst))
+            assert dst.read_bytes() == src.read_bytes()
+            r = cli(masters, cfg, "inspect", "/a/left-shard-file",
+                    check=False)
+            assert r.returncode != 0 or "not found" in (
+                r.stdout + r.stderr).lower()
+            print("cross-shard put/get/rename ok")
+
+            # --- benchmark burst (reference dfs_cli benchmark semantics).
+            cli(masters, cfg, "benchmark", "write", "--files", "20",
+                "--size", str(64 * 1024), "--concurrency", "5",
+                "--prefix", "/a/bench/")
+            cli(masters, cfg, "benchmark", "read", "--files", "20",
+                "--concurrency", "5", "--prefix", "/a/bench/")
+            print("benchmark write/read ok")
+
+            # --- concurrent workload spanning both shards + WGL check.
+            hist = pathlib.Path(tmp) / "history.jsonl"
+            cli(masters, cfg, "workload", "--clients", "4",
+                "--ops", str(workload_ops), "--keys", "6",
+                "--out", str(hist))
+            r = cli(masters, cfg, "check-history", str(hist))
+            print(r.stdout.strip().splitlines()[-1])
+            print("linearizability check ok")
+        finally:
+            launcher.send_signal(signal.SIGINT)
+            try:
+                launcher.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                launcher.kill()
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser("tpudfs-run-all-tests")
+    ap.add_argument("--skip-unit", action="store_true")
+    ap.add_argument("--skip-live", action="store_true")
+    ap.add_argument("--topology",
+                    default="deploy/topologies/two-shard-ha.json")
+    ap.add_argument("--workload-ops", type=int, default=25)
+    args = ap.parse_args()
+
+    run("lint (compile gate)", [
+        sys.executable, "-m", "compileall", "-q",
+        "tpudfs", "tests", "scripts", "bench.py", "__graft_entry__.py",
+    ])
+    if not args.skip_unit:
+        run("unit + integration suite",
+            [sys.executable, "-m", "pytest", "tests/", "-x", "-q"])
+    if not args.skip_live:
+        live_cluster_tier(args.topology, args.workload_ops)
+    print("\nALL TIERS PASSED")
+
+
+if __name__ == "__main__":
+    main()

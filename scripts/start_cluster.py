@@ -1,17 +1,24 @@
 #!/usr/bin/env python3
-"""Local cluster launcher (reference start_cluster.sh HA topology).
+"""Local cluster launcher (reference start_cluster.sh / docker-compose.yml).
 
-Spawns, as separate OS processes: 1 config server, a master group (default
-3-node HA Raft for shard-0) plus optional spare masters, N chunkservers, and
-the S3 gateway. Prints every endpoint; Ctrl-C tears everything down.
+Spawns, as separate OS processes: 1 config server, one master Raft group per
+shard, optional spare masters, N chunkservers, and the S3 gateway. The
+topology comes either from CLI flags (single-shard) or from a declarative
+JSON spec (deploy/topologies/*.json — the compose-file analogue):
 
-  python scripts/start_cluster.py --masters 3 --chunkservers 5 --spares 1
+  python scripts/start_cluster.py --masters 3 --chunkservers 5
+  python scripts/start_cluster.py --topology deploy/topologies/two-shard.json
+
+Prints every endpoint; Ctrl-C tears everything down. With --ready-file PATH,
+writes a JSON endpoint map there once the whole topology is up (used by
+scripts/run_all_tests.py and the chaos harness to drive a live cluster).
 """
 
 from __future__ import annotations
 
 import argparse
 import atexit
+import json
 import os
 import pathlib
 import signal
@@ -64,22 +71,54 @@ def cleanup() -> None:
             p.kill()
 
 
+def load_topology(args: argparse.Namespace) -> dict:
+    if args.topology:
+        spec = json.loads(pathlib.Path(args.topology).read_text())
+    else:
+        spec = {
+            "name": "flags",
+            "shards": [{"id": "shard-0", "masters": args.masters}],
+            "spares": args.spares,
+            "chunkservers": args.chunkservers,
+            "racks": 3,
+            "s3": True,
+            "split_threshold_rps": args.split_threshold_rps,
+        }
+    spec.setdefault("name", pathlib.Path(args.topology).stem
+                    if args.topology else "flags")
+    spec.setdefault("spares", 0)
+    spec.setdefault("racks", 3)
+    spec.setdefault("s3", True)
+    spec.setdefault("split_threshold_rps", 100.0)
+    if not spec.get("shards"):
+        raise SystemExit("topology needs at least one shard")
+    return spec
+
+
 def main() -> None:
     ap = argparse.ArgumentParser("tpudfs-start-cluster")
+    ap.add_argument("--topology", default="",
+                    help="declarative topology JSON (deploy/topologies/)")
     ap.add_argument("--masters", type=int, default=3,
-                    help="HA Raft group size for shard-0")
+                    help="HA Raft group size for shard-0 (no --topology)")
     ap.add_argument("--spares", type=int, default=0,
                     help="unassigned masters for auto-split adoption")
     ap.add_argument("--chunkservers", type=int, default=5)
     ap.add_argument("--data-dir", default="cluster-data")
     ap.add_argument("--s3-port", type=int, default=9000)
     ap.add_argument("--split-threshold-rps", type=float, default=100.0)
+    ap.add_argument("--ready-file", default="",
+                    help="write endpoint-map JSON here when fully up")
+    ap.add_argument("--no-wait", action="store_true",
+                    help="exit after starting (processes keep running)")
     args = ap.parse_args()
+    topo = load_topology(args)
 
     root = pathlib.Path(args.data_dir).resolve()
     logdir = root / "logs"
     logdir.mkdir(parents=True, exist_ok=True)
-    atexit.register(cleanup)
+    if not args.no_wait:
+        atexit.register(cleanup)
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
 
     cfg_port = free_port()
@@ -89,40 +128,60 @@ def main() -> None:
     wait_ready(logdir, "config")
     print(f"config server  {cfg}  (ops http://127.0.0.1:{cfg_port + 1000})")
 
-    master_ports = [free_port() for _ in range(args.masters)]
-    master_addrs = [f"127.0.0.1:{p}" for p in master_ports]
-    # Register the shard before the masters boot so their first map refresh
-    # sees the final layout.
+    # Reserve every master address up front, then register all shards before
+    # any master boots so their first shard-map refresh sees the final
+    # layout (AddShard order defines the bootstrap range split: the second
+    # shard takes keys < /m — common/sharding.py add_shard).
+    shard_addrs: dict[str, list[str]] = {
+        s["id"]: [f"127.0.0.1:{free_port()}" for _ in range(s["masters"])]
+        for s in topo["shards"]
+    }
+
     import asyncio  # noqa: E402
 
     from tpudfs.common.rpc import RpcClient  # noqa: E402
 
-    async def add_shard():
+    async def add_shards():
         rpc = RpcClient()
-        for _ in range(60):
-            try:
-                await rpc.call(cfg, "ConfigService", "AddShard",
-                               {"shard_id": "shard-0",
-                                "peers": master_addrs})
-                break
-            except Exception:
-                await asyncio.sleep(0.5)
+        for s in topo["shards"]:
+            for _ in range(60):
+                try:
+                    await rpc.call(cfg, "ConfigService", "AddShard",
+                                   {"shard_id": s["id"],
+                                    "peers": shard_addrs[s["id"]]})
+                    break
+                except Exception:
+                    await asyncio.sleep(0.5)
+            else:
+                raise SystemExit(f"could not register {s['id']} with {cfg}")
         await rpc.close()
 
-    asyncio.run(add_shard())
+    asyncio.run(add_shards())
 
-    for i, port in enumerate(master_ports):
-        peers = [a for a in master_addrs if a != f"127.0.0.1:{port}"]
-        spawn(f"master{i}", logdir, "tpudfs.master", "--port", str(port),
-              "--data-dir", str(root / f"m{i}"),
-              "--peers", ",".join(peers), "--config-servers", cfg,
-              "--split-threshold-rps", str(args.split_threshold_rps))
-    for i in range(args.masters):
-        wait_ready(logdir, f"master{i}")
-        print(f"master{i}        {master_addrs[i]}  "
-              f"(ops http://127.0.0.1:{master_ports[i] + 1000})")
+    all_masters: list[str] = []
+    endpoints: dict = {"config_server": cfg, "shards": {}, "chunkservers": [],
+                       "topology": topo["name"]}
+    for s in topo["shards"]:
+        sid = s["id"]
+        addrs = shard_addrs[sid]
+        for i, addr in enumerate(addrs):
+            port = int(addr.rsplit(":", 1)[1])
+            peers = [a for a in addrs if a != addr]
+            name = f"{sid}-m{i}"
+            spawn(name, logdir, "tpudfs.master", "--port", str(port),
+                  "--data-dir", str(root / name),
+                  "--peers", ",".join(peers), "--shard-id", sid,
+                  "--config-servers", cfg,
+                  "--split-threshold-rps",
+                  str(topo["split_threshold_rps"]))
+        for i, addr in enumerate(addrs):
+            wait_ready(logdir, f"{sid}-m{i}")
+            print(f"{sid}-m{i}     {addr}  "
+                  f"(ops http://127.0.0.1:{int(addr.rsplit(':', 1)[1]) + 1000})")
+        all_masters.extend(addrs)
+        endpoints["shards"][sid] = addrs
 
-    for i in range(args.spares):
+    for i in range(topo["spares"]):
         port = free_port()
         spawn(f"spare{i}", logdir, "tpudfs.master", "--port", str(port),
               "--data-dir", str(root / f"spare{i}"), "--shard-id", "",
@@ -130,24 +189,35 @@ def main() -> None:
         wait_ready(logdir, f"spare{i}")
         print(f"spare{i}         127.0.0.1:{port}")
 
-    for i in range(args.chunkservers):
+    for i in range(topo["chunkservers"]):
         port = free_port()
         spawn(f"cs{i}", logdir, "tpudfs.chunkserver", "--port", str(port),
-              "--data-dir", str(root / f"cs{i}"), "--rack-id", f"rack-{i % 3}",
-              "--masters", ",".join(master_addrs), "--config-servers", cfg,
+              "--data-dir", str(root / f"cs{i}"),
+              "--rack-id", f"rack-{i % topo['racks']}",
+              "--masters", ",".join(all_masters), "--config-servers", cfg,
               "--heartbeat-interval", "2")
         wait_ready(logdir, f"cs{i}")
         print(f"chunkserver{i}   127.0.0.1:{port}  "
               f"(ops http://127.0.0.1:{port + 1000})")
+        endpoints["chunkservers"].append(f"127.0.0.1:{port}")
 
-    spawn("s3", logdir, "tpudfs.s3", env={
-        "MASTER_ADDRS": ",".join(master_addrs), "CONFIG_SERVERS": cfg,
-        "S3_PORT": str(args.s3_port), "S3_AUTH_ENABLED": "false",
-    })
-    print(f"s3 gateway     http://127.0.0.1:{args.s3_port}")
+    if topo["s3"]:
+        spawn("s3", logdir, "tpudfs.s3", env={
+            "MASTER_ADDRS": ",".join(all_masters), "CONFIG_SERVERS": cfg,
+            "S3_PORT": str(args.s3_port), "S3_AUTH_ENABLED": "false",
+        })
+        wait_ready(logdir, "s3")
+        print(f"s3 gateway     http://127.0.0.1:{args.s3_port}")
+        endpoints["s3"] = f"http://127.0.0.1:{args.s3_port}"
+
     print(f"\nCLI: python -m tpudfs.client.cli --config-servers {cfg} "
-          f"--masters {','.join(master_addrs)} <cmd>")
+          f"--masters {','.join(all_masters)} <cmd>")
     print("logs:", logdir)
+    if args.ready_file:
+        endpoints["pids"] = [p.pid for p in PROCS]
+        pathlib.Path(args.ready_file).write_text(json.dumps(endpoints))
+    if args.no_wait:
+        return
     try:
         signal.pause()
     except KeyboardInterrupt:

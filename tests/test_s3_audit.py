@@ -122,3 +122,25 @@ async def test_reader_cli(tmp_path, capsys):
     audit_reader.main(["--db", db, "--hmac-key", "key", "--principal", "U1"])
     out = capsys.readouterr().out
     assert '"U1"' in out and '"U2"' not in out
+
+
+async def test_audit_counters_exposed_in_metrics(tmp_path):
+    """Drop/flush/write counters surface in the Prometheus exposition the
+    gateway serves at /metrics (reference audit.rs:20-40 + iam_metrics.rs)."""
+    from tpudfs.s3.metrics import S3Metrics
+
+    log = AuditLog(str(tmp_path / "a.db"), b"key", queue_max=3,
+                   flush_interval=0.05)
+    # Overflow before the flusher starts: 8 of 11 drop.
+    for i in range(11):
+        log.log(_rec(i))
+    log.start()
+    await asyncio.sleep(0.3)
+
+    text = S3Metrics().render(audit=log)
+    assert f"s3_audit_dropped_total {log.dropped_count}" in text
+    assert log.dropped_count == 8
+    assert f"s3_audit_written_total {log.written_count}" in text
+    assert log.written_count == 3
+    assert "s3_audit_flush_errors_total 0" in text
+    await log.stop()

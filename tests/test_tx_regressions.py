@@ -225,3 +225,34 @@ async def test_shard_refresh_version_monotonic(tmp_path):
         assert m.shard_map.version == current.version + 5
     finally:
         await c.stop()
+
+
+async def test_participant_tx_rpcs_leader_gated(tmp_path):
+    """HA regression: in a 3-replica participant group, Commit/Abort landing
+    on a follower must answer Not Leader (so the coordinator re-routes), NOT
+    'unknown transaction' / false-success from lagging follower state —
+    that abandoned live cross-shard renames to the recovery path."""
+    from tests.test_master_service import MiniCluster
+    from tpudfs.common.rpc import RpcError
+
+    c = MiniCluster(tmp_path, n_masters=3, n_cs=1)
+    try:
+        await c.start()
+        leader = await c.leader()
+        follower = next(m for m in c.masters.values() if m is not leader)
+        for call, req in [
+            (follower.tx.rpc_commit, {"txid": "tx-nope"}),
+            (follower.tx.rpc_abort, {"txid": "tx-nope"}),
+            (follower.tx.rpc_prepare,
+             {"txid": "tx-nope", "operations": []}),
+        ]:
+            with pytest.raises(RpcError) as ei:
+                await call(req)
+            assert ei.value.is_not_leader, ei.value.message
+        # On the leader an unknown commit is authoritatively NOT_FOUND.
+        with pytest.raises(RpcError) as ei:
+            await leader.tx.rpc_commit({"txid": "tx-nope"})
+        assert not ei.value.is_not_leader
+        assert ei.value.code.name == "NOT_FOUND"
+    finally:
+        await c.stop()

@@ -7,6 +7,20 @@ record) are treated as *maybe applied*: the search may either linearize them
 at any point after their invocation or drop them entirely
 (checker.rs:186,452).
 
+Two DFS operations are inherently multi-point and are checked as LINKED
+sub-op pairs (first half always linearizes before the second; a dropped
+crashed first half drops the second):
+
+- ``rename`` -> copy + delete: cross-shard 2PC creates the destination at
+  participant commit and deletes the source after (SURVEY §3.4 steps 4-5),
+  so both paths are transiently visible;
+- ``put`` -> create + fill: CreateFile exposes an empty file before block
+  writes and CompleteFile land the content, so a concurrent get may
+  legally observe "".
+
+Histories decompose by rename-connected key components (linearizability is
+local, Herlihy & Wing), so each component is searched independently.
+
 History entries are dicts (JSONL on disk):
   {"id": int, "client": str, "op": {"type": "put|get|delete|rename",
    "key": str, "value": str|None, "dst": str|None},
@@ -37,6 +51,7 @@ class Op:
     ret: float  # INF for crashed ops
     result: Any
     crashed: bool
+    client: str = "?"
 
     @classmethod
     def from_entry(cls, e: dict) -> "Op":
@@ -52,7 +67,21 @@ class Op:
             ret=INF if ret is None else float(ret),
             result=e.get("result"),
             crashed=ret is None,
+            client=str(e.get("client", "?")),
         )
+
+    def describe(self, t0: float = 0.0) -> str:
+        ret = "CRASH" if self.ret == INF else f"{self.ret - t0:.3f}"
+        what = f"{self.kind}({self.key!r}"
+        if self.kind == "put":
+            what += f", {self.value!r}"
+        elif self.kind == "rename":
+            what += f" -> {self.dst!r}"
+        what += ")"
+        res = "" if self.result is None and self.kind != "get" \
+            else f" = {self.result!r}"
+        return (f"#{self.op_id} {self.client} {what}{res} "
+                f"[{self.invoke - t0:.3f}, {ret}]")
 
 
 def load_history(path: str) -> list[dict]:
@@ -73,6 +102,154 @@ class CheckResult:
     #: True when the search budget ran out before proving either way —
     #: the history is UNKNOWN, not proven non-linearizable.
     exhausted: bool = False
+
+
+def _apply(state: dict, op: Op) -> dict | None:
+    """Returns the next state, or None if op's observation contradicts."""
+    if op.kind == "put":
+        # Atomic fast path: completed puts stay unsplit unless the component
+        # observed the empty create-intermediate (see _expand_linked).
+        new = dict(state)
+        new[op.key] = op.value
+        return new
+    if op.kind == "put_create":
+        # First half of a linked put: CreateFile makes the path visible and
+        # EMPTY before any block lands (reference create_file_from_buffer
+        # mod.rs:225-494 — namespace create, then block writes, then
+        # CompleteFile). A concurrent get legally observes "".
+        new = dict(state)
+        new[op.key] = ""
+        return new
+    if op.kind == "put_fill":
+        # Second half: the content is fully written and completed.
+        new = dict(state)
+        new[op.key] = op.value
+        return new
+    if op.kind == "delete":
+        new = dict(state)
+        new.pop(op.key, None)
+        return new
+    if op.kind == "rename_copy":
+        # First half of a linked rename: destination becomes visible while
+        # the source still exists (the cross-shard 2PC transient: the
+        # participant creates dest at commit, the coordinator deletes src
+        # afterwards — reference master.rs:2952, SURVEY §3.4 steps 4-5).
+        if op.key not in state:
+            return dict(state)  # no-op rename of missing key
+        new = dict(state)
+        new[op.dst] = new[op.key]
+        return new
+    if op.kind == "rename_del":
+        # Second half: source disappears.
+        new = dict(state)
+        new.pop(op.key, None)
+        return new
+    if op.kind == "get":
+        observed = op.result
+        actual = state.get(op.key)
+        if observed != actual:
+            return None
+        return state
+    return None
+
+
+def _expand_linked(ops: list[Op]) -> tuple[list[Op], dict[int, int], dict[int, int]]:
+    """Split multi-point operations into linked sub-ops (the reference
+    checker's linked entries, checker.rs:186):
+
+    - rename -> (copy, del): the cross-shard 2PC creates the destination at
+      participant commit and deletes the source afterwards;
+    - put -> (create, fill): CreateFile exposes an empty file before block
+      writes and CompleteFile fill in the content.
+
+    The second sub-op may only linearize after the first, and a dropped
+    (crashed) first half forces the second to drop too. Returns
+    (ops, deps second_id->first_id, synth second_id->original id).
+
+    Splitting doubles the op count, so completed puts stay atomic unless the
+    component contains an observation of the empty create-intermediate (a
+    get returning ""): for a completed, never-observed-empty put the split
+    admits no extra read sequence, while crashed puts must always split
+    (they may be stuck incomplete forever)."""
+    out: list[Op] = []
+    deps: dict[int, int] = {}
+    synth: dict[int, int] = {}
+    next_id = max((o.op_id for o in ops), default=0) + 1
+    empty_observed = any(
+        o.kind == "get" and o.result == "" for o in ops
+    )
+    for o in ops:
+        if o.kind == "rename":
+            first, second = "rename_copy", "rename_del"
+        elif o.kind == "put" and (o.crashed or empty_observed):
+            first, second = "put_create", "put_fill"
+        else:
+            out.append(o)
+            continue
+        a = Op(o.op_id, first, o.key, o.value, o.dst,
+               o.invoke, o.ret, o.result, o.crashed, o.client)
+        b = Op(next_id, second, o.key, o.value, o.dst,
+               o.invoke, o.ret, o.result, o.crashed, o.client)
+        synth[next_id] = o.op_id
+        deps[next_id] = o.op_id
+        next_id += 1
+        out.extend([a, b])
+    return out, deps, synth
+
+
+def _search(ops: list[Op], max_states: int) -> tuple[list[int] | None, bool]:
+    """Core WGL search over ``ops``. Returns (witness | None, exhausted);
+    witness entries are original op ids (a rename contributes its id twice:
+    once for the copy point, once for the delete point)."""
+    ops, deps, synth = _expand_linked(ops)
+    pair = {c: d for d, c in deps.items()}  # copy_id -> del_id
+    # State = immutable dict of key -> value.
+    seen: set[tuple[frozenset, frozenset]] = set()
+    budget = [max_states]
+
+    def search(remaining: frozenset, state: dict) -> list[int] | None:
+        if not remaining:
+            return []
+        key = (remaining, frozenset(state.items()))
+        if key in seen:
+            return None
+        if budget[0] <= 0:
+            return None
+        budget[0] -= 1
+        seen.add(key)
+        rem_ops = [o for o in ops if o.op_id in remaining]
+        # An op may linearize first only if no other remaining op RETURNED
+        # before it was invoked (real-time order).
+        min_ret = min(o.ret for o in rem_ops)
+        candidates = [
+            o for o in rem_ops
+            if o.invoke <= min_ret
+            # A rename's delete half waits for its copy half.
+            and not (o.op_id in deps and deps[o.op_id] in remaining)
+        ]
+        for op in candidates:
+            nxt = _apply(state, op)
+            if nxt is not None:
+                rest = search(remaining - {op.op_id}, nxt)
+                if rest is not None:
+                    return [op.op_id] + rest
+            if op.crashed:
+                # Maybe-applied: also try dropping it entirely. Dropping a
+                # linked op's first half drops the second with it (the 2PC
+                # never deletes the source without creating the dest; a put
+                # never completes content without the namespace create).
+                drop = {op.op_id}
+                if op.op_id in pair:
+                    drop.add(pair[op.op_id])
+                rest = search(remaining - drop, state)
+                if rest is not None:
+                    return rest
+        return None
+
+    witness = search(frozenset(o.op_id for o in ops), {})
+    if witness is not None:
+        witness = [synth.get(i, i) for i in witness]
+    return witness, budget[0] <= 0
 
 
 def check_linearizability(entries: list[dict],
@@ -96,87 +273,146 @@ def check_linearizability(entries: list[dict],
     if n == 0:
         return CheckResult(True, "empty history")
 
-    # State = immutable dict of key -> value.
-    seen: set[tuple[frozenset, frozenset]] = set()
-    budget = [max_states]
-
-    def apply(state: dict, op: Op) -> dict | None:
-        """Returns the next state, or None if op's observation contradicts."""
-        if op.kind == "put":
-            new = dict(state)
-            new[op.key] = op.value
-            return new
-        if op.kind == "delete":
-            new = dict(state)
-            new.pop(op.key, None)
-            return new
-        if op.kind == "rename":
-            if op.key not in state:
-                return dict(state)  # no-op rename of missing key
-            new = dict(state)
-            new[op.dst] = new.pop(op.key)
-            return new
-        if op.kind == "get":
-            observed = op.result
-            actual = state.get(op.key)
-            if observed != actual:
-                return None
-            return state
-        return None
-
-    def search(remaining: frozenset, state: dict) -> list[int] | None:
-        if not remaining:
-            return []
-        key = (remaining, frozenset(state.items()))
-        if key in seen:
-            return None
-        if budget[0] <= 0:
-            return None
-        budget[0] -= 1
-        seen.add(key)
-        rem_ops = [o for o in ops if o.op_id in remaining]
-        # An op may linearize first only if no other remaining op RETURNED
-        # before it was invoked (real-time order).
-        min_ret = min(o.ret for o in rem_ops)
-        candidates = [o for o in rem_ops if o.invoke <= min_ret]
-        for op in candidates:
-            nxt = apply(state, op)
-            if nxt is not None:
-                rest = search(remaining - {op.op_id}, nxt)
-                if rest is not None:
-                    return [op.op_id] + rest
-            if op.crashed:
-                # Maybe-applied: also try dropping it entirely.
-                rest = search(remaining - {op.op_id}, state)
-                if rest is not None:
-                    return rest
-        return None
-
-    witness = search(frozenset(o.op_id for o in ops), {})
-    if witness is not None:
-        return CheckResult(True, f"linearizable ({n} ops)", witness)
-    if budget[0] <= 0:
+    # Linearizability is LOCAL (Herlihy & Wing): a multi-register history is
+    # linearizable iff each register's subhistory is. Registers coupled by a
+    # rename form one object, so group keys by rename-connectivity and check
+    # each group independently — this is what keeps 200+ op cross-shard
+    # workload histories tractable (the reference checker's linked-rename
+    # handling, checker.rs:186-772).
+    groups = _group_ops(ops)
+    any_exhausted = False
+    witnesses: list[list[int]] = []
+    for group in groups:
+        witness, exhausted = _search(group, max_states)
+        if witness is not None:
+            witnesses.append(witness)
+            continue
+        if exhausted:
+            any_exhausted = True
+            continue
+        return CheckResult(False, _diagnose(group, max_states))
+    if any_exhausted:
         return CheckResult(
             False,
             f"UNKNOWN: search budget exhausted after {max_states} states",
             exhausted=True,
         )
-    return CheckResult(False, _diagnose(ops))
+    if len(groups) == 1:
+        return CheckResult(True, f"linearizable ({n} ops)", witnesses[0])
+    # Multi-group: each object linearizes; a single global witness order is
+    # implied by locality but not materialized.
+    return CheckResult(True, f"linearizable ({n} ops, {len(groups)} objects)")
 
 
-def _diagnose(ops: list[Op]) -> str:
-    """Best-effort diagnosis of the violation (reference checker.rs diagnosis
-    output): find a get whose value was never concurrently writable."""
+def _group_ops(ops: list[Op]) -> list[list[Op]]:
+    """Partition ops into rename-connected key components (union-find)."""
+    parent: dict[str, str] = {}
+
+    def find(k: str) -> str:
+        parent.setdefault(k, k)
+        while parent[k] != k:
+            parent[k] = parent[parent[k]]
+            k = parent[k]
+        return k
+
+    def union(a: str, b: str) -> None:
+        parent[find(a)] = find(b)
+
     for o in ops:
-        if o.kind != "get":
+        find(o.key)
+        if o.kind == "rename" and o.dst is not None:
+            union(o.key, o.dst)
+    by_root: dict[str, list[Op]] = {}
+    for o in ops:
+        by_root.setdefault(find(o.key), []).append(o)
+    return list(by_root.values())
+
+
+def _diagnose(ops: list[Op], max_states: int) -> str:
+    """Name the violation and its real-time window (reference checker.rs's
+    diagnosis output, checker.rs:186-772): classify the anomaly where
+    possible (phantom read, stale read), then shrink to the minimal failing
+    prefix and print every op concurrent with the one that breaks it."""
+    t0 = min(o.invoke for o in ops)
+    # Renames move values between keys, so value-provenance classifiers are
+    # only sound key-locally when no rename touches the key (otherwise a
+    # legal put->rename->get chain would be called a phantom).
+    renamed_keys = {o.key for o in ops if o.kind == "rename"} \
+        | {o.dst for o in ops if o.kind == "rename"}
+
+    # 1. Phantom read: an observed value no put in this rename-connected
+    #    component ever wrote.
+    for o in ops:
+        if o.kind != "get" or o.result is None:
+            continue
+        if o.result == "":
+            continue  # empty = a put's create-intermediate, never phantom
+        if not any(
+            w.kind == "put" and w.value == o.result and (
+                w.key == o.key or o.key in renamed_keys
+            )
+            for w in ops
+        ):
+            return (
+                "not linearizable: PHANTOM READ — "
+                f"{o.describe(t0)} observed a value no put ever wrote"
+            )
+
+    # 2. Stale read: the observed value's writers all returned before some
+    #    completed overwrite/delete that itself returned before the get began
+    #    — the value was definitively not current by the time of the get.
+    #    Skipped for rename-touched keys, where provenance isn't key-local.
+    for o in ops:
+        if o.kind != "get" or o.result is None or o.key in renamed_keys:
             continue
         writers = [
             w for w in ops
             if w.kind == "put" and w.key == o.key and w.value == o.result
         ]
-        if o.result is not None and not writers:
+        if not writers:
+            continue
+        last_writer_ret = max(w.ret for w in writers)
+        for m in ops:
+            if (
+                m.kind in ("put", "delete")
+                and m.key == o.key
+                and not m.crashed
+                and not (m.kind == "put" and m.value == o.result)
+                and m.invoke > last_writer_ret
+                and m.ret < o.invoke
+            ):
+                return (
+                    "not linearizable: STALE READ — "
+                    f"{o.describe(t0)} observed a value overwritten by "
+                    f"{m.describe(t0)}, which completed before the get began"
+                )
+
+    # 3. Minimal failing window: grow the history in completion order until
+    #    the search first fails; everything concurrent with the breaking op
+    #    is the suspect window.
+    ordered = sorted(ops, key=lambda o: (o.ret, o.invoke))
+    step_budget = max(10_000, max_states // 20)
+    lo_ok = 0
+    for k in range(1, len(ordered) + 1):
+        witness, exhausted = _search(ordered[:k], step_budget)
+        if exhausted:
+            break  # window search too expensive; fall back to generic msg
+        if witness is None:
+            trigger = ordered[k - 1]
+            window = [
+                o for o in ordered[:k]
+                if o is trigger
+                or (o.invoke <= trigger.ret and o.ret >= trigger.invoke)
+            ]
+            lines = "\n  ".join(o.describe(t0) for o in window)
             return (
-                f"not linearizable: get(id={o.op_id}, key={o.key!r}) observed "
-                f"{o.result!r}, which no put ever wrote"
+                "not linearizable: minimal failing window — history first "
+                f"becomes unlinearizable at {trigger.describe(t0)}; "
+                f"ops concurrent with it:\n  {lines}"
             )
-    return "not linearizable: no valid linearization order exists"
+        lo_ok = k
+    return (
+        "not linearizable: no valid linearization order exists "
+        f"(no single violating window isolated; first {lo_ok} ops in "
+        "completion order still linearize)"
+    )

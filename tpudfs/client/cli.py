@@ -79,9 +79,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--duration", type=float, default=60.0)
 
     sp = sub.add_parser("workload", help="run a concurrent workload, save history")
-    sp.add_argument("--clients", type=int, default=4)
-    sp.add_argument("--ops", type=int, default=20)
-    sp.add_argument("--keys", type=int, default=5)
+    sp.add_argument("--clients", type=int, default=6)
+    sp.add_argument("--ops", type=int, default=40)
+    sp.add_argument("--keys", type=int, default=8)
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--out", default="history.jsonl")
 

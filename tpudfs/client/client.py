@@ -68,6 +68,7 @@ class Client:
         rpc_client: RpcClient | None = None,
         tls: ClientTls | None = None,
         rpc_timeout: float = 30.0,
+        host_aliases: dict[str, str] | None = None,
     ):
         if not master_addrs and not config_addrs:
             raise ValueError("need master_addrs or config_addrs")
@@ -83,6 +84,15 @@ class Client:
         self.rpc = rpc_client or RpcClient(tls=tls)
         self.shard_map: ShardMap | None = None
         self._refreshing = False
+        #: Address rewriting applied just before dialing (reference host-alias
+        #: indirection, mod.rs:86-99: cluster-internal addresses in the shard
+        #: map / block locations are remapped to client-reachable ones — the
+        #: Docker<->host case; also how the chaos harness interposes
+        #: FaultProxy on shard-map-discovered routes).
+        self.host_aliases = dict(host_aliases or {})
+
+    def _dial(self, addr: str) -> str:
+        return self.host_aliases.get(addr, addr)
 
     async def close(self) -> None:
         if self._owns_rpc:
@@ -95,7 +105,7 @@ class Client:
         for cfg in self.config_addrs:
             try:
                 resp = await self.rpc.call(
-                    cfg, "ConfigService", "FetchShardMap", {}, timeout=5.0
+                    self._dial(cfg), "ConfigService", "FetchShardMap", {}, timeout=5.0
                 )
                 self.shard_map = ShardMap.from_dict(resp["shard_map"])
                 return
@@ -147,7 +157,7 @@ class Client:
             target = targets[idx % len(targets)]
             try:
                 resp = await self.rpc.call(
-                    target, MASTER, method, req, timeout=self.rpc_timeout
+                    self._dial(target), MASTER, method, req, timeout=self.rpc_timeout
                 )
                 return resp, target
             except RpcError as e:
@@ -207,6 +217,21 @@ class Client:
             "path": path, "ec_data_shards": k, "ec_parity_shards": m,
             "overwrite": overwrite,
         }, path=path, retry_benign=("ALREADY_EXISTS",))
+        try:
+            await self._write_blocks_and_complete(path, data, master, k, m,
+                                                  etag)
+        except IndeterminateError:
+            raise
+        except DfsError as e:
+            # CreateFile already mutated the namespace: the path is visible
+            # (empty/incomplete), so this failure is NOT "nothing applied".
+            raise IndeterminateError(
+                f"write failed after namespace create for {path}: {e}"
+            ) from e
+
+    async def _write_blocks_and_complete(self, path: str, data: bytes,
+                                         master: str, k: int, m: int,
+                                         etag: str | None) -> None:
         # Stick to the creating master for read-your-writes (mod.rs:256-266).
         sticky = [master] + [a for a in self._masters_for(path) if a != master]
         block_checksums = []
@@ -249,7 +274,7 @@ class Client:
 
     async def _write_replicated_block(self, block_id: str, data: bytes,
                                       servers: list[str], term: int) -> None:
-        resp = await self.rpc.call(servers[0], CS, "WriteBlock", {
+        resp = await self.rpc.call(self._dial(servers[0]), CS, "WriteBlock", {
             "block_id": block_id,
             "data": data,
             "next_servers": servers[1:],
@@ -277,7 +302,7 @@ class Client:
         shards = ec_encode(data, k, m)
 
         async def write_shard(i: int) -> None:
-            resp = await self.rpc.call(servers[i], CS, "WriteBlock", {
+            resp = await self.rpc.call(self._dial(servers[i]), CS, "WriteBlock", {
                 "block_id": block_id,
                 "data": shards[i],
                 "next_servers": [],
@@ -378,7 +403,7 @@ class Client:
         req = {"block_id": block["block_id"], "offset": offset, "length": length}
 
         async def read_from(addr: str) -> bytes:
-            resp = await self.rpc.call(addr, CS, "ReadBlock", req,
+            resp = await self.rpc.call(self._dial(addr), CS, "ReadBlock", req,
                                        timeout=max(self.rpc_timeout, 60.0))
             return resp["data"]
 
@@ -442,7 +467,7 @@ class Client:
                 return None
             try:
                 resp = await self.rpc.call(
-                    addr, CS, "ReadBlock",
+                    self._dial(addr), CS, "ReadBlock",
                     {"block_id": block["block_id"], "offset": 0, "length": 0},
                     timeout=max(self.rpc_timeout, 60.0),
                 )
@@ -534,4 +559,4 @@ class Client:
         await self._execute("InitiateShuffle", {"prefix": prefix}, path=prefix)
 
     async def raft_state(self, master: str) -> dict:
-        return await self.rpc.call(master, MASTER, "RaftState", {}, timeout=5.0)
+        return await self.rpc.call(self._dial(master), MASTER, "RaftState", {}, timeout=5.0)

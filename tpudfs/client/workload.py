@@ -30,6 +30,12 @@ class WorkloadConfig:
     op_weights: dict = field(default_factory=lambda: {
         "put": 0.5, "get": 0.3, "delete": 0.1, "rename": 0.1,
     })
+    #: Renames pick their destination within a pod of this many keys. Pods
+    #: keep the checker's rename-connected components small enough for the
+    #: exact WGL search (linearizability is per-object/local, so this loses
+    #: no checking power — it only bounds object size); each pod still spans
+    #: both shard prefixes, so cross-shard renames remain exercised.
+    rename_pod_size: int = 4
 
 
 class HistoryRecorder:
@@ -70,6 +76,12 @@ async def run_workload(client: Client, cfg: WorkloadConfig) -> list[dict]:
     keyspace = [
         f"{cfg.prefixes[i % len(cfg.prefixes)]}wl-{i}" for i in range(cfg.keys)
     ]
+    pod = max(2, cfg.rename_pod_size)
+
+    def pod_of(key: str) -> list[str]:
+        i = keyspace.index(key)
+        start = (i // pod) * pod
+        return keyspace[start:start + pod]
 
     async def run_client(name: str, seed: int) -> None:
         crng = random.Random(seed)
@@ -81,7 +93,9 @@ async def run_workload(client: Client, cfg: WorkloadConfig) -> list[dict]:
             if kind == "put":
                 op["value"] = f"{name}-{i}"
             elif kind == "rename":
-                op["dst"] = crng.choice([k for k in keyspace if k != key])
+                choices = [k for k in pod_of(key) if k != key]
+                op["dst"] = crng.choice(choices or
+                                        [k for k in keyspace if k != key])
             if kind == "put":
                 # The DFS has create-once semantics, so a put is issued as a
                 # RECORDED delete followed by a RECORDED create — both appear

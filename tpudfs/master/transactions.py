@@ -143,7 +143,10 @@ class TransactionManager:
 
     async def rpc_prepare(self, req: dict) -> dict:
         """Participant Prepare (reference master.rs:3026-3129): idempotent on
-        resend, validates the destination doesn't already exist."""
+        resend, validates the destination doesn't already exist. Leader-gated
+        so the idempotency check never answers from lagging follower state
+        (see rpc_commit)."""
+        await self.m._linearizable_read()
         m = self.m
         txid = req["txid"]
         existing = m.state.transactions.get(txid)
@@ -177,7 +180,15 @@ class TransactionManager:
 
     async def rpc_commit(self, req: dict) -> dict:
         """Participant Commit (reference master.rs:3131-3229): apply the
-        prepared operations, mark Committed; idempotent."""
+        prepared operations, mark Committed; idempotent.
+
+        Leader-gated via the ReadIndex barrier: in an HA participant group
+        the commit RPC can land on a follower that hasn't applied the
+        prepare yet — answering ``unknown transaction`` from lagging state
+        would make the coordinator abandon the tx to recovery (and fail the
+        client rename). Followers instead raise Not Leader so call_shard
+        re-routes to the authoritative replica."""
+        await self.m._linearizable_read()
         m = self.m
         txid = req["txid"]
         tx = m.state.transactions.get(txid)
@@ -196,7 +207,10 @@ class TransactionManager:
 
     async def rpc_abort(self, req: dict) -> dict:
         """Participant Abort (reference master.rs:3231-3274); idempotent,
-        refuses only after commit."""
+        refuses only after commit. Leader-gated like rpc_commit: a lagging
+        follower seeing tx=None would report a false ``aborted`` success
+        while the prepared record lives on at the leader."""
+        await self.m._linearizable_read()
         m = self.m
         txid = req["txid"]
         tx = m.state.transactions.get(txid)

@@ -52,14 +52,21 @@ class FaultProxy:
         return self.address
 
     async def stop(self) -> None:
+        # Order matters: stop accepting FIRST (close() is non-blocking), so a
+        # retrying client can't sneak a fresh pipe in after the sever; then
+        # kill live pipes; then bound the wait — wait_closed() blocks until
+        # every handler finishes and a blackholed pipe never would, and
+        # losing a listener at teardown must not hang the harness.
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
-            self._server = None
         self.sever()
         for t in list(self._conns):
             t.cancel()
         self._conns.clear()
+        if self._server is not None:
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            self._server = None
 
     # ------------------------------------------------------------- toxics
 
