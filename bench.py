@@ -3,12 +3,14 @@
 Metric (BASELINE.json): "chunk read GB/s/host into TPU HBM; 3x-replication
 write GB/s over ICI" — BOTH sides are reported:
 
-- read side: a live in-process DFS (1 master + 3 chunkservers over real gRPC
-  sockets, 3x pipeline-replicated 1 MiB blocks) read through the client's
-  concurrent fan-out into device memory via HbmReader — per-block device_put,
-  per-512B-chunk CRC32C on the accelerator, GF(2)-combine against the stored
-  block checksum. The dataset (128 x 1 MiB) far exceeds the chunkservers'
-  LRU block cache (capped at 8 blocks here), so reads exercise the disk path.
+- read side: a live DFS — 1 master + 3 chunkservers, each its OWN OS process
+  (as in the reference's docker-compose topology; servers must not share the
+  client's GIL) — with 3x pipeline-replicated 1 MiB blocks, read through the
+  client's concurrent fan-out into device memory via HbmReader: per-block
+  device_put, per-512B-chunk CRC32C + GF(2) combine-fold ON the accelerator
+  (block_crc_device), one host sync for the whole sweep (lazy verify +
+  confirm). The dataset (128 x 1 MiB) far exceeds the chunkservers' LRU
+  block cache (capped at 8 blocks here), so reads exercise the disk path.
 - write side: (a) the DFS 3x pipeline-replicated write path (client -> CS1 ->
   CS2 -> CS3 chain over gRPC), logical GB/s; (b) the TPU-native replacement:
   `replicated_write_step` — ppermute chain + on-device CRC verify + ack psum
@@ -100,51 +102,80 @@ def _bench_ici_write_step(device) -> float:
     return nbytes * ICI_REPS / dt / 1e9
 
 
-async def _run() -> dict:
-    import jax
+def _spawn_cluster(root: str, cache_blocks: int = CS_CACHE_BLOCKS):
+    """1 master + 3 chunkservers as separate OS processes (real sockets,
+    real GIL isolation — the client must not time-share with the servers).
+    On failure every already-started process is torn down before raising."""
+    import atexit
+    import pathlib
 
-    from tpudfs.chunkserver.blockstore import BlockStore
-    from tpudfs.chunkserver.service import ChunkServer
-    from tpudfs.client.client import Client
-    from tpudfs.common.rpc import RpcClient, RpcServer
-    from tpudfs.master.service import Master
-    from tpudfs.tpu.hbm_reader import HbmReader
-    import socket
+    from tpudfs.testing.procs import free_port, spawn, terminate_all, wait_ready
+
+    logdir = pathlib.Path(root) / "logs"
+    logdir.mkdir(parents=True)
+    procs = []
+    atexit.register(terminate_all, procs)  # belt-and-braces orphan guard
+    env = {"JAX_PLATFORMS": "cpu"}  # servers never touch the TPU
+    try:
+        maddr = f"127.0.0.1:{free_port()}"
+        spawn(procs, "master", logdir, "tpudfs.master",
+              "--port", maddr.rsplit(":", 1)[1],
+              "--data-dir", f"{root}/m0", "--http-port", "0", env=env)
+        wait_ready(logdir, "master")
+        cs_addrs = []
+        for i in range(3):
+            port = free_port()
+            spawn(procs, f"cs{i}", logdir, "tpudfs.chunkserver",
+                  "--port", str(port),
+                  "--data-dir", f"{root}/cs{i}", "--masters", maddr,
+                  "--rack-id", f"rack-{i}", "--heartbeat-interval", "0.5",
+                  "--http-port", "0",
+                  env={**env, "BLOCK_CACHE_SIZE": str(cache_blocks)})
+            wait_ready(logdir, f"cs{i}")
+            cs_addrs.append(f"127.0.0.1:{port}")
+    except BaseException:
+        terminate_all(procs)
+        raise
+    return maddr, cs_addrs, procs
+
+
+async def _run() -> dict:
     import tempfile
 
     tmp = tempfile.TemporaryDirectory(prefix="tpudfs-bench-")
     root = tmp.name
+    maddr, cs_addrs, procs = _spawn_cluster(root)
+    try:
+        return await _run_against(maddr, cs_addrs)
+    finally:
+        from tpudfs.testing.procs import terminate_all
 
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
+        terminate_all(procs)
+        tmp.cleanup()
+
+
+async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
+    import jax
+
+    from tpudfs.client.client import Client
+    from tpudfs.common.rpc import RpcClient
+    from tpudfs.tpu.hbm_reader import HbmReader
 
     rpc = RpcClient()
-    maddr = f"127.0.0.1:{free_port()}"
-    master = Master(maddr, [], f"{root}/m0", rpc_client=rpc)
-    mserver = RpcServer(port=int(maddr.rsplit(":", 1)[1]))
-    master.attach(mserver)
-    await mserver.start()
-    await master.start(background_tasks=False)
-    chunkservers = []
-    for i in range(3):
-        cs = ChunkServer(
-            BlockStore(f"{root}/cs{i}/hot"), master_addrs=[maddr],
-            rpc_client=rpc, cache_size=CS_CACHE_BLOCKS,
-        )
-        await cs.start(scrubber=False)
-        chunkservers.append(cs)
-    # Register CSes via one synthetic heartbeat each (no loop needed).
-    for cs in chunkservers:
-        await master.rpc_heartbeat({
-            "chunk_server_address": cs.address,
-            "used_space": 0, "available_space": 1 << 40, "chunk_count": 0,
-            "bad_blocks": [], "rack_id": cs.address,
-        })
-    master.state.exit_safe_mode()
-
     client = Client([maddr], rpc_client=rpc, block_size=BLOCK_MB << 20)
+
+    # Wait until the master has left safe mode and all 3 chunkservers are
+    # registered (first placement needs a full replication set).
+    deadline = asyncio.get_event_loop().time() + 60
+    while True:
+        try:
+            await client.create_file("/bench/probe", b"x")
+            await client.delete_file("/bench/probe")
+            break
+        except Exception:
+            if asyncio.get_event_loop().time() > deadline:
+                raise
+            await asyncio.sleep(0.3)
     data = np.random.default_rng(0).integers(
         0, 256, BLOCK_MB << 20, dtype=np.uint8
     ).tobytes()
@@ -165,33 +196,39 @@ async def _run() -> dict:
 
     # Warm up kernels + compile caches (not the CS block cache: it only
     # holds CS_CACHE_BLOCKS blocks, and the measured sweep touches FILES).
-    await reader.read_file_to_device_blocks("/bench/f0000", verify=True)
+    warm = await reader.read_file_to_device_blocks("/bench/f0000", verify="lazy")
+    await reader.confirm(warm)
+
+    all_blocks: list = []
 
     async def read_one(i):
         async with sem:
             blocks = await reader.read_file_to_device_blocks(
-                f"/bench/f{i:04d}", verify=True
+                f"/bench/f{i:04d}", verify="lazy"
             )
+            all_blocks.extend(blocks)
             return sum(b.size for b in blocks)
 
+    # The timed window covers fetch + device_put + on-device CRC fold AND
+    # the single confirm sync that resolves every block's verification.
     t0 = time.perf_counter()
     sizes = await asyncio.gather(*(read_one(i) for i in range(FILES)))
+    await reader.confirm(all_blocks)
     wall = time.perf_counter() - t0
     total = sum(sizes)
     achieved = total / wall / 1e9
+    assert all(b.verified for b in all_blocks)
 
-    cache_hits = sum(cs.cache.hits for cs in chunkservers)
-    cache_misses = sum(cs.cache.misses for cs in chunkservers)
+    cache_hits = cache_misses = 0
+    for addr in cs_addrs:
+        stats = await rpc.call(addr, "ChunkServerService", "Stats", {})
+        cache_hits += stats["cache_hits"]
+        cache_misses += stats["cache_misses"]
 
     raw = _bench_raw_infeed(device, len(data), 32)
     ici_write = _bench_ici_write_step(device)
 
-    for cs in chunkservers:
-        await cs.stop()
-    await master.stop()
-    await mserver.stop()
     await rpc.close()
-    tmp.cleanup()
 
     target = 0.9 * raw
     return {

@@ -19,56 +19,37 @@ from __future__ import annotations
 import argparse
 import atexit
 import json
-import os
 import pathlib
 import signal
-import socket
 import subprocess
 import sys
-import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpudfs.testing import procs as procutil  # noqa: E402
+
 PROCS: list[subprocess.Popen] = []
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def spawn(name: str, logdir: pathlib.Path, mod: str, *args: str,
           env: dict | None = None) -> subprocess.Popen:
-    log = open(logdir / f"{name}.log", "w")
-    p = subprocess.Popen(
-        [sys.executable, "-m", mod, *args],
-        env={**os.environ, "PYTHONPATH": str(REPO), **(env or {})},
-        stdout=log, stderr=subprocess.STDOUT,
-    )
-    PROCS.append(p)
-    return p
+    return procutil.spawn(PROCS, name, logdir, mod, *args, env=env)
+
+
+def free_port() -> int:
+    return procutil.free_port()
 
 
 def wait_ready(logdir: pathlib.Path, name: str, timeout: float = 60.0) -> None:
-    deadline = time.time() + timeout
-    path = logdir / f"{name}.log"
-    while time.time() < deadline:
-        if path.exists() and "READY" in path.read_text():
-            return
-        time.sleep(0.3)
-    raise SystemExit(f"{name} failed to start; see {path}")
+    try:
+        procutil.wait_ready(logdir, name, timeout)
+    except RuntimeError as e:
+        raise SystemExit(str(e))
 
 
 def cleanup() -> None:
-    for p in PROCS:
-        if p.poll() is None:
-            p.terminate()
-    deadline = time.time() + 5
-    for p in PROCS:
-        while p.poll() is None and time.time() < deadline:
-            time.sleep(0.1)
-        if p.poll() is None:
-            p.kill()
+    procutil.terminate_all(PROCS)
 
 
 def load_topology(args: argparse.Namespace) -> dict:
@@ -225,5 +206,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, str(REPO))
     main()

@@ -80,3 +80,26 @@ def test_verify_chunks_detects_bitrot():
 def test_empty():
     assert crc32c(b"") == 0
     assert crc32c_chunks(b"").shape == (0,)
+
+
+@pytest.mark.parametrize("n_chunks", [1, 2, 7, 64])
+def test_combine_chunks_matches_scalar_fold(n_chunks):
+    from tpudfs.common.checksum import crc32c_combine_chunks
+
+    data = _rand(n_chunks * CHECKSUM_CHUNK_SIZE, seed=n_chunks)
+    crcs = crc32c_chunks(data)
+    # Vectorized fold == scalar fold == whole-buffer CRC.
+    scalar = 0
+    for c in crcs:
+        scalar = crc32c_combine(scalar, int(c), CHECKSUM_CHUNK_SIZE)
+    assert crc32c_combine_chunks(crcs, CHECKSUM_CHUNK_SIZE) == scalar == crc32c(data)
+
+
+def test_combine_chunks_with_prefix_and_empty():
+    from tpudfs.common.checksum import crc32c_combine_chunks
+
+    a = _rand(300, 9)
+    b = _rand(4 * CHECKSUM_CHUNK_SIZE, 10)
+    crcs = crc32c_chunks(b)
+    assert crc32c_combine_chunks(crcs, CHECKSUM_CHUNK_SIZE, crc=crc32c(a)) == crc32c(a + b)
+    assert crc32c_combine_chunks([], CHECKSUM_CHUNK_SIZE, crc=123) == 123

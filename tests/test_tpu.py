@@ -348,3 +348,87 @@ async def test_grain_infeed_sharded_batches(tmp_path):
             assert len(b.sharding.device_set) == len(jax.devices())
     finally:
         await c.stop()
+
+
+# ------------------------------------------------- device CRC fold + lazy
+
+
+@pytest.mark.parametrize("n", [512, 64 * 1024, 1 << 20])
+def test_block_crc_device_matches_host(n):
+    from tpudfs.common.checksum import crc32c
+    from tpudfs.tpu.crc32c_pallas import block_crc_device
+
+    data = _rand(n, seed=n % 97)
+    got = int(np.asarray(block_crc_device(jnp.asarray(bytes_to_words(data)))))
+    assert got == crc32c(data)
+
+
+async def test_hbm_reader_lazy_verify_and_confirm(tmp_path):
+    data = _rand(4 * 64 * 1024, seed=11)  # chunk-multiple blocks
+    c, client = await _cluster_with_files(tmp_path, [("/t/lazy", data)])
+    try:
+        reader = HbmReader(client, jax.devices())
+        blocks = await reader.read_file_to_device_blocks("/t/lazy", verify="lazy")
+        assert all(not b.verified and b.pending_crc is not None for b in blocks)
+        await reader.confirm(blocks)
+        assert all(b.verified and b.pending_crc is None for b in blocks)
+        assert b"".join(
+            device_array_to_bytes(b.array, b.size) for b in blocks
+        ) == data
+        await reader.confirm(blocks)  # idempotent, no pending flags left
+    finally:
+        await c.stop()
+
+
+async def test_hbm_reader_lazy_confirm_detects_tamper(tmp_path):
+    data = _rand(64 * 1024, seed=12)
+    c, client = await _cluster_with_files(tmp_path, [("/t/lazybad", data)])
+    try:
+        meta = await client.get_file_info("/t/lazybad")
+        bid = meta["blocks"][0]["block_id"]
+        for cs in c.chunkservers:
+            if cs.store.exists(bid):
+                raw = bytearray(cs.store.read(bid))
+                raw[4000] ^= 0x10
+                cs.store.write(bid, bytes(raw))
+                cs.cache.invalidate(bid)
+        reader = HbmReader(client, jax.devices())
+        blocks = await reader.read_file_to_device_blocks("/t/lazybad", verify="lazy")
+        with pytest.raises(DfsError) as ei:
+            await reader.confirm(blocks)
+        assert bid in str(ei.value)
+    finally:
+        await c.stop()
+
+
+async def test_hbm_reader_lazy_tail_block_raises_eagerly(tmp_path):
+    # Non-chunk-multiple tail blocks cannot defer to confirm() (the device
+    # fold runs on the padded stream) — lazy mode must verify them eagerly
+    # and raise AT READ TIME on corruption.
+    data = _rand(64 * 1024 + 300, seed=13)
+    c, client = await _cluster_with_files(tmp_path, [("/t/tail", data)])
+    try:
+        reader = HbmReader(client, jax.devices())
+        blocks = await reader.read_file_to_device_blocks("/t/tail", verify="lazy")
+        tail = [b for b in blocks if b.size % 512 != 0]
+        assert tail and all(b.verified and b.pending_crc is None for b in tail)
+        meta = await client.get_file_info("/t/tail")
+        bid = meta["blocks"][-1]["block_id"]
+        for cs in c.chunkservers:
+            if cs.store.exists(bid):
+                raw = bytearray(cs.store.read(bid))
+                raw[-1] ^= 0x01
+                cs.store.write(bid, bytes(raw))
+                cs.cache.invalidate(bid)
+        with pytest.raises(DfsError):
+            await reader.read_file_to_device_blocks("/t/tail", verify="lazy")
+    finally:
+        await c.stop()
+
+
+def test_block_crc_device_empty():
+    from tpudfs.tpu.crc32c_pallas import block_crc_device
+
+    assert int(np.asarray(
+        block_crc_device(jnp.zeros((0, 128), jnp.uint32))
+    )) == 0

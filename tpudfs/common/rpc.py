@@ -267,6 +267,9 @@ class RpcClient:
     def __init__(self, tls: ClientTls | None = None):
         self._tls = tls
         self._channels: dict[str, grpc.aio.Channel] = {}
+        # Multicallables are not free to build (serializer plumbing per
+        # call); cache one per (addr, service, method).
+        self._stubs: dict[tuple[str, str, str], grpc.aio.UnaryUnaryMultiCallable] = {}
         self._lock = asyncio.Lock()
 
     async def _channel(self, addr: str) -> grpc.aio.Channel:
@@ -303,12 +306,15 @@ class RpcClient:
         request: Any,
         timeout: float | None = 10.0,
     ) -> Any:
-        ch = await self._channel(addr)
-        rpc = ch.unary_unary(
-            f"/{service}/{method}",
-            request_serializer=_dumps,
-            response_deserializer=_loads,
-        )
+        rpc = self._stubs.get((addr, service, method))
+        if rpc is None:
+            ch = await self._channel(addr)
+            rpc = ch.unary_unary(
+                f"/{service}/{method}",
+                request_serializer=_dumps,
+                response_deserializer=_loads,
+            )
+            self._stubs[addr, service, method] = rpc
         metadata = ((REQUEST_ID_KEY, current_request_id()),)
         try:
             return await rpc(request, timeout=timeout, metadata=metadata)
@@ -319,3 +325,4 @@ class RpcClient:
         for ch in self._channels.values():
             await ch.close()
         self._channels.clear()
+        self._stubs.clear()

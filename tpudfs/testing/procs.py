@@ -1,0 +1,61 @@
+"""Subprocess helpers for multi-process cluster harnesses.
+
+Shared by scripts/start_cluster.py and bench.py (the reference drives the
+same need with start_cluster.sh + docker-compose): spawn service entry
+points as real OS processes, redirect their output to per-process logs, and
+poll for the ``READY <addr>`` line each tpudfs ``__main__`` prints once its
+sockets are bound.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn(procs: list[subprocess.Popen], name: str, logdir: pathlib.Path,
+          mod: str, *args: str, env: dict | None = None) -> subprocess.Popen:
+    """Start ``python -m mod`` appended to ``procs``, stdout+stderr to
+    ``logdir/name.log``."""
+    with open(logdir / f"{name}.log", "w") as log:
+        p = subprocess.Popen(
+            [sys.executable, "-m", mod, *args],
+            env={**os.environ, "PYTHONPATH": str(REPO), **(env or {})},
+            stdout=log, stderr=subprocess.STDOUT,
+        )
+    procs.append(p)
+    return p
+
+
+def wait_ready(logdir: pathlib.Path, name: str, timeout: float = 60.0) -> None:
+    deadline = time.time() + timeout
+    path = logdir / f"{name}.log"
+    while time.time() < deadline:
+        if path.exists() and "READY" in path.read_text():
+            return
+        time.sleep(0.2)
+    raise RuntimeError(f"{name} failed to start; see {path}")
+
+
+def terminate_all(procs: list[subprocess.Popen], grace: float = 5.0) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + grace
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.kill()

@@ -140,6 +140,32 @@ def crc32c_chunks_jax(data: bytes, **kw) -> np.ndarray:
     return np.asarray(crc32c_chunks_device(words, **kw))
 
 
+@jax.jit
+def block_crc_device(words: jax.Array) -> jax.Array:
+    """Whole-(padded-)block CRC32C, entirely on device — uint32 scalar.
+
+    Per-chunk Pallas CRCs folded with the GF(2) combine table
+    (tpudfs.common.checksum.combine_fold_table): CRC concatenation is linear
+    over GF(2), so the whole-block CRC is an XOR of per-bit contributions of
+    the chunk CRCs. No host readback — on a tunneled TPU a small
+    device→host transfer costs 10-50 ms, so folding on device and syncing
+    once per *batch* (HbmReader.confirm) is what makes per-block verification
+    affordable. NOTE: computed over the zero-padded chunk stream; equals the
+    stored whole-block CRC only when the block length is a chunk multiple.
+    """
+    from tpudfs.common.checksum import combine_fold_table
+
+    n = words.shape[0]
+    if n == 0:
+        return jnp.uint32(0)  # crc32c(b"") == 0
+    crcs = crc32c_chunks_device(words)
+    d = jnp.asarray(combine_fold_table(CHECKSUM_CHUNK_SIZE, n))
+    bits = ((crcs[:, None] >> jnp.arange(32, dtype=jnp.uint32)[None, :])
+            & jnp.uint32(1)) != 0
+    contrib = jnp.where(bits, d, jnp.uint32(0))
+    return jax.lax.reduce(contrib, np.uint32(0), jax.lax.bitwise_xor, (0, 1))
+
+
 def verify_block_device(words: jax.Array, expected: jax.Array) -> jax.Array:
     """Jittable full-block verify: True iff every chunk CRC matches.
 

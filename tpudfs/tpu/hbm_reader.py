@@ -26,6 +26,7 @@ from tpudfs.client.client import Client, DfsError
 from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE, crc32c_combine
 from tpudfs.tpu.crc32c_pallas import (
     WORDS_PER_CHUNK,
+    block_crc_device,
     bytes_to_words,
     crc32c_chunks_device,
 )
@@ -37,6 +38,14 @@ class DeviceBlock:
     array: jax.Array  # (chunks, 128) uint32 words on one device
     size: int  # unpadded byte length
     verified: bool
+    #: lazy mode: 0-d device uint32 (the on-device whole-block CRC fold),
+    #: resolved against expected_crc by HbmReader.confirm with ONE host sync
+    #: per batch; None once resolved or in eager/no-verify modes. The
+    #: comparison happens on the HOST — an eager per-block `== expected`
+    #: would upload a scalar per block, and small transfers cost 10-50 ms
+    #: on a tunneled TPU.
+    pending_crc: jax.Array | None = None
+    expected_crc: int | None = None
 
 
 class HbmReader:
@@ -47,7 +56,11 @@ class HbmReader:
     # ------------------------------------------------------------ per block
 
     async def read_block_to_device(self, block: dict, device,
-                                   verify: bool = True) -> DeviceBlock:
+                                   verify: bool | str = True) -> DeviceBlock:
+        """``verify``: False = no check; True = eager (syncs this block's
+        device CRC now); ``"lazy"`` = dispatch the on-device check but defer
+        the (expensive on a tunneled TPU) host sync to a later batched
+        ``confirm`` call."""
         data = await self.client._read_block_range(block, 0, 0) \
             if not block.get("ec_data_shards") else \
             await self.client._read_ec_block(block)
@@ -55,43 +68,74 @@ class HbmReader:
         # verified means "an on-device CRC check ran and passed" — a block
         # with no recorded checksum was NOT verified.
         verified = False
+        pending: jax.Array | None = None
+        expected: int | None = None
         if verify and block.get("checksum_crc32c"):
-            verified = await asyncio.to_thread(
-                self._verify_device_block, words, len(data),
-                int(block["checksum_crc32c"]),
-            )
-            if not verified:
+            expected = int(block["checksum_crc32c"])
+            if len(data) % CHECKSUM_CHUNK_SIZE == 0:
+                # Device fold: whole-block CRC without any chunk readback
+                # (and no host->device scalar upload — compare on host).
+                crc = block_crc_device(words)
+                if verify == "lazy":
+                    pending = crc
+                else:
+                    got = int(await asyncio.to_thread(np.asarray, crc))
+                    verified = got == expected
+            else:
+                # Tail chunk was zero-padded on device, so the device fold
+                # diverges from the stored CRC — rebuild the tail on host.
+                # This path is eager even under verify="lazy" (there is no
+                # device result to defer), so it must raise here: confirm()
+                # only inspects pending_crc and would silently pass it.
+                verified = await asyncio.to_thread(
+                    self._verify_host_tail_block, words, len(data), expected
+                )
+            if pending is None and not verified:
                 raise DfsError(
                     f"on-device checksum mismatch for block {block['block_id']}"
                 )
-        return DeviceBlock(block["block_id"], words, len(data), verified)
+        return DeviceBlock(block["block_id"], words, len(data), verified,
+                           pending_crc=pending, expected_crc=expected)
 
-    def _verify_device_block(self, words: jax.Array, size: int,
-                             expected_crc: int) -> bool:
-        """Device chunk CRCs + host GF(2) combine == stored whole-block CRC."""
+    async def confirm(self, blocks: list[DeviceBlock]) -> None:
+        """Resolve every lazy verification with ONE device→host sync.
+
+        Raises DfsError naming each failed block; marks the rest verified.
+        """
+        pend = [b for b in blocks if b.pending_crc is not None]
+        if not pend:
+            return
+        # CRCs may live on different devices; gather them onto one device
+        # (free when everything is already there) so ONE transfer resolves
+        # the whole batch, then compare host-side.
+        home = self.devices[0]
+        got = await asyncio.to_thread(
+            np.asarray,
+            jnp.stack([jax.device_put(b.pending_crc, home) for b in pend]),
+        )
+        bad = []
+        for b, crc in zip(pend, got):
+            b.pending_crc = None
+            b.verified = int(crc) == b.expected_crc
+            if not b.verified:
+                bad.append(b.block_id)
+        if bad:
+            raise DfsError(
+                "on-device checksum mismatch for blocks: " + ", ".join(bad)
+            )
+
+    def _verify_host_tail_block(self, words: jax.Array, size: int,
+                                expected_crc: int) -> bool:
         chunk_crcs = np.asarray(crc32c_chunks_device(words))
-        crc = 0
-        remaining = size
-        for c in chunk_crcs:
-            if remaining <= 0:
-                break
-            clen = min(CHECKSUM_CHUNK_SIZE, remaining)
-            if clen < CHECKSUM_CHUNK_SIZE:
-                # Tail chunk was zero-padded on device; unwind the padding:
-                # crc(data+zeros) relates by the combine operator, so compute
-                # the tail directly instead (tiny).
-                return self._verify_with_host_tail(
-                    words, size, expected_crc, chunk_crcs
-                )
-            crc = crc32c_combine(crc, int(c), clen)
-            remaining -= clen
-        return crc == expected_crc
+        return self._verify_with_host_tail(words, size, expected_crc, chunk_crcs)
 
     def _verify_with_host_tail(self, words, size, expected_crc, chunk_crcs):
+        from tpudfs.common.checksum import crc32c_combine_chunks
+
         full_chunks = size // CHECKSUM_CHUNK_SIZE
-        crc = 0
-        for c in chunk_crcs[:full_chunks]:
-            crc = crc32c_combine(crc, int(c), CHECKSUM_CHUNK_SIZE)
+        crc = crc32c_combine_chunks(
+            chunk_crcs[:full_chunks], CHECKSUM_CHUNK_SIZE
+        )
         tail_len = size - full_chunks * CHECKSUM_CHUNK_SIZE
         if tail_len:
             from tpudfs.common.checksum import crc32c
@@ -104,7 +148,7 @@ class HbmReader:
     # ------------------------------------------------------------- per file
 
     async def read_file_to_device_blocks(
-        self, path: str, verify: bool = True,
+        self, path: str, verify: bool | str = True,
         placement: str = "round_robin",
     ) -> list[DeviceBlock]:
         """Fetch every block concurrently with per-block device placement
@@ -129,7 +173,7 @@ class HbmReader:
         return list(await asyncio.gather(*coros))
 
     async def read_file_sharded(self, path: str, mesh: Mesh | None = None,
-                                verify: bool = True) -> jax.Array:
+                                verify: bool | str = True) -> jax.Array:
         """Whole file as ONE sharded jax.Array ((total_chunks, 128) uint32
         words, sharded over the device axis IN FILE ORDER). Blocks are
         assigned contiguously (block i → device i // per_group) and
@@ -138,6 +182,7 @@ class HbmReader:
         dblocks = await self.read_file_to_device_blocks(
             path, verify=verify, placement="contiguous"
         )
+        await self.confirm(dblocks)  # one sync even in lazy mode
         if not dblocks:
             raise DfsError(f"file has no blocks: {path}")
         ndev = len(self.devices)
