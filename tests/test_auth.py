@@ -247,3 +247,112 @@ def test_static_credentials():
 def test_auth_error_xml():
     xml = AuthError.signature_mismatch().to_xml("/bucket/key", "req-1")
     assert "<Code>SignatureDoesNotMatch</Code>" in xml and "req-1" in xml
+
+
+# ----------------------- unsigned aws-chunked (flexible-checksum trailers)
+
+
+def _frame_unsigned(payload: bytes, chunk: int = 64,
+                    trailers: dict[str, str] | None = None) -> bytes:
+    out = bytearray()
+    for i in range(0, len(payload), chunk):
+        piece = payload[i:i + chunk]
+        out += f"{len(piece):x}\r\n".encode() + piece + b"\r\n"
+    out += b"0\r\n"
+    for k, v in (trailers or {}).items():
+        out += f"{k}:{v}\r\n".encode()
+    out += b"\r\n"
+    return bytes(out)
+
+
+def test_unsigned_chunked_decode_roundtrip():
+    payload = bytes(range(256)) * 3
+    body = _frame_unsigned(payload, chunk=100)
+    got, trailers = chunked.decode_unsigned_chunked_body(body)
+    assert got == payload and trailers == {}
+
+
+def test_unsigned_chunked_trailer_checksums_all_algos():
+    import hashlib as hl
+    import zlib
+
+    from tpudfs.common.checksum import crc32c, crc64nvme
+
+    payload = b"trailer-checked payload" * 40
+    trailers = {
+        "x-amz-checksum-crc32": base64.b64encode(
+            (zlib.crc32(payload) & 0xFFFFFFFF).to_bytes(4, "big")).decode(),
+        "x-amz-checksum-crc32c": base64.b64encode(
+            crc32c(payload).to_bytes(4, "big")).decode(),
+        "x-amz-checksum-crc64nvme": base64.b64encode(
+            crc64nvme(payload).to_bytes(8, "big")).decode(),
+        "x-amz-checksum-sha1": base64.b64encode(
+            hl.sha1(payload).digest()).decode(),
+        "x-amz-checksum-sha256": base64.b64encode(
+            hl.sha256(payload).digest()).decode(),
+    }
+    body = _frame_unsigned(payload, trailers=trailers)
+    got, parsed = chunked.decode_unsigned_chunked_body(body)
+    assert got == payload
+    chunked.verify_trailer_checksums(got, parsed)  # all five validate
+
+
+def test_unsigned_chunked_trailer_mismatch_rejected():
+    payload = b"x" * 100
+    bad = base64.b64encode(b"\x00" * 8).decode()
+    body = _frame_unsigned(payload,
+                           trailers={"x-amz-checksum-crc64nvme": bad})
+    got, parsed = chunked.decode_unsigned_chunked_body(body)
+    with pytest.raises(AuthError) as ei:
+        chunked.verify_trailer_checksums(got, parsed)
+    assert ei.value.code == "BadDigest"
+
+
+def test_unsigned_chunked_unknown_algo_ignored():
+    payload = b"y" * 10
+    body = _frame_unsigned(payload, trailers={"x-amz-checksum-frobnicate": "AAAA"})
+    got, parsed = chunked.decode_unsigned_chunked_body(body)
+    chunked.verify_trailer_checksums(got, parsed)  # no raise
+
+
+def test_unsigned_chunked_malformed_frames():
+    with pytest.raises(AuthError):
+        chunked.decode_unsigned_chunked_body(b"zz\r\nxx\r\n")
+    with pytest.raises(AuthError):
+        chunked.decode_unsigned_chunked_body(b"5\r\nhello")  # missing CRLF+final
+
+
+def test_crc64nvme_vectors():
+    from tpudfs.common.checksum import crc64nvme
+
+    assert crc64nvme(b"123456789") == 0xAE8B14860A799888
+    assert crc64nvme(b"") == 0
+    # incremental == one-shot
+    a, b = b"hello ", b"world"
+    assert crc64nvme(b, crc=crc64nvme(a)) == crc64nvme(a + b)
+
+
+def test_chunked_negative_and_malformed_sizes_rejected():
+    # int(x, 16) alone accepts "-6"/"+6"/"0x6"/"6_0"; a negative size made
+    # the framing loop walk backwards and spin forever on a 10-byte body.
+    for evil in (b"1\r\nX\r\n-6\r\n", b"+5\r\nhello\r\n0\r\n\r\n",
+                 b"0x5\r\nhello\r\n0\r\n\r\n", b"5_0\r\n", b"\r\n"):
+        with pytest.raises(AuthError):
+            chunked.decode_unsigned_chunked_body(evil)
+    with pytest.raises(AuthError):
+        chunked.decode_chunked_body(
+            b"-6;chunk-signature=00\r\n", b"k" * 32, "d", "s", "seed"
+        )
+
+
+def test_map_action_resource_keeps_trailing_slash():
+    from tpudfs.s3.middleware import S3Request, map_action, split_bucket_key
+
+    assert split_bucket_key("/b1/dir/") == ("b1", "dir/")
+    assert split_bucket_key("/b1/dir") == ("b1", "dir")
+    assert split_bucket_key("/b1") == ("b1", "")
+    assert split_bucket_key("/") == ("", "")
+    req = S3Request(method="PUT", path="/b1/dir/", query=[], headers={},
+                    body=b"")
+    action, resource = map_action(req)
+    assert (action, resource) == ("s3:PutObject", "arn:aws:s3:::b1/dir/")

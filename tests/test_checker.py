@@ -64,15 +64,6 @@ def test_crashed_put_maybe_applied():
         assert check_linearizability(h).linearizable, observed
 
 
-def test_failed_mutator_must_not_apply():
-    h = [
-        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
-        _op(1, "put", "k", 2, 3, value="b", result={"ok": False}),  # failed
-        _op(2, "get", "k", 4, 5, result="b"),
-    ]
-    assert not check_linearizability(h).linearizable
-
-
 def test_rename_moves_value():
     h = [
         _op(0, "put", "x", 0, 1, value="v", result={"ok": True}),
@@ -208,3 +199,53 @@ def test_rename_never_deletes_source_without_creating_dest():
     ]
     r = check_linearizability(h)
     assert not r.linearizable, "delete-without-create must not linearize"
+
+
+def test_failed_rename_is_maybe_applied():
+    # A cross-shard rename that RETURNED an error can still commit later via
+    # the 2PC recovery task (the client's response was lost mid-commit), so
+    # the value legitimately shows up at the destination AFTER the error.
+    h = [
+        _op(0, "put", "/a/k", 0, 1, value="v1", result={"ok": True}),
+        _op(1, "rename", "/a/k", 2, 3, dst="/z/w", result={"ok": False}),
+        _op(2, "get", "/z/w", 10, 11, result="v1"),  # recovery applied it
+        _op(3, "get", "/a/k", 12, 13, result=None),
+    ]
+    r = check_linearizability(h)
+    assert r.linearizable, r.message
+
+
+def test_failed_rename_not_applied_also_ok():
+    # ...and the same failed rename may equally have NOT applied.
+    h = [
+        _op(0, "put", "/a/k", 0, 1, value="v1", result={"ok": True}),
+        _op(1, "rename", "/a/k", 2, 3, dst="/z/w", result={"ok": False}),
+        _op(2, "get", "/z/w", 10, 11, result=None),
+        _op(3, "get", "/a/k", 12, 13, result="v1"),
+    ]
+    r = check_linearizability(h)
+    assert r.linearizable, r.message
+
+
+def test_failed_put_is_maybe_applied():
+    # Lost response + internal retry exhaustion: the put errored at the
+    # client but attempt 1 landed.
+    h = [
+        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+        _op(1, "put", "k", 2, 3, value="b", result={"ok": False}),
+        _op(2, "get", "k", 4, 5, result="b"),
+    ]
+    r = check_linearizability(h)
+    assert r.linearizable, r.message
+
+
+def test_phantom_still_detected_with_failed_ops_present():
+    # Maybe-applied failures must not mask a genuine phantom: "z" was never
+    # written by ANY op, failed or not.
+    h = [
+        _op(0, "put", "k", 0, 1, value="a", result={"ok": True}),
+        _op(1, "put", "k", 2, 3, value="b", result={"ok": False}),
+        _op(2, "get", "k", 4, 5, result="z"),
+    ]
+    r = check_linearizability(h)
+    assert not r.linearizable

@@ -261,11 +261,13 @@ async def test_sse_encryption_at_rest(tmp_path):
 
 
 def _sign_request(method, path, *, body=b"", now=None, access_key=AK,
-                  secret=SK, token="", query=None, extra_headers=None):
+                  secret=SK, token="", query=None, extra_headers=None,
+                  payload_hash=None):
     now = now or datetime.datetime.now(datetime.timezone.utc)
     amz_date = now.strftime("%Y%m%dT%H%M%SZ")
     date = now.strftime("%Y%m%d")
-    payload_hash = signing.sha256_hex(body)
+    if payload_hash is None:
+        payload_hash = signing.sha256_hex(body)
     headers = {"host": "localhost", "x-amz-date": amz_date,
                "x-amz-content-sha256": payload_hash}
     headers.update(extra_headers or {})
@@ -644,5 +646,69 @@ async def test_bucket_policy_grants_and_denies(tmp_path):
         with pytest.raises(AuthError):
             await gw.handle(_sign_request("GET", "/pub/o",
                                           access_key="AKGUEST", secret="gsk"))
+    finally:
+        await c.stop()
+
+
+async def test_directory_marker_keys_distinct_from_plain(tmp_path):
+    # "dir/" (a directory-marker object, as the AWS SDKs' create_dir writes)
+    # and "dir" are distinct S3 keys; HEAD on the unslashed key must 404 or
+    # third-party clients (pyarrow S3FileSystem) misclassify the prefix as a
+    # file and refuse directory operations.
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b1"))
+        assert (await gw.handle(req("PUT", "/b1/dir/"))).status == 200
+        assert (await gw.handle(req("HEAD", "/b1/dir/"))).status == 200
+        assert (await gw.handle(req("HEAD", "/b1/dir"))).status == 404
+        assert (await gw.handle(req("GET", "/b1/dir"))).status == 404
+        # marker appears in listings under its own key
+        r = await gw.handle(req("GET", "/b1", query=[("list-type", "2")]))
+        assert b"<Key>dir/</Key>" in r.body
+        assert (await gw.handle(req("DELETE", "/b1/dir/"))).status == 204
+        assert (await gw.handle(req("HEAD", "/b1/dir/"))).status == 404
+    finally:
+        await c.stop()
+
+
+async def test_unsigned_trailer_streaming_upload(tmp_path):
+    """STREAMING-UNSIGNED-PAYLOAD-TRAILER (modern AWS SDK default): the
+    aws-chunked body is accepted, the announced trailing checksum is
+    REQUIRED and validated, and the stored object is the decoded payload."""
+    from tpudfs.common.checksum import crc64nvme
+
+    c, gw = await _gateway(tmp_path, auth_enabled=True,
+                           credentials=StaticCredentialProvider({AK: SK}))
+    try:
+        await gw.handle(_sign_request("PUT", "/tb"))
+        payload = b"streamed with a trailer" * 50
+        crc = base64.b64encode(crc64nvme(payload).to_bytes(8, "big")).decode()
+        frame = (f"{len(payload):x}\r\n".encode() + payload + b"\r\n0\r\n"
+                 + f"x-amz-checksum-crc64nvme:{crc}\r\n\r\n".encode())
+        hdrs = {"x-amz-trailer": "x-amz-checksum-crc64nvme",
+                "content-encoding": "aws-chunked"}
+        r = await gw.handle(_sign_request(
+            "PUT", "/tb/obj", body=frame, extra_headers=hdrs,
+            payload_hash="STREAMING-UNSIGNED-PAYLOAD-TRAILER"))
+        assert r.status == 200
+        r = await gw.handle(_sign_request("GET", "/tb/obj"))
+        assert r.body == payload
+
+        # Stripping the announced (signed-by-header) trailer must fail:
+        # otherwise tampering with chunk bytes goes undetected.
+        naked = f"{len(payload):x}\r\n".encode() + payload + b"\r\n0\r\n\r\n"
+        with pytest.raises(AuthError):
+            await gw.handle(_sign_request(
+                "PUT", "/tb/strip", body=naked, extra_headers=hdrs,
+                payload_hash="STREAMING-UNSIGNED-PAYLOAD-TRAILER"))
+
+        # A corrupted payload fails the trailer checksum with BadDigest.
+        bad = bytearray(frame)
+        bad[10] ^= 0xFF
+        with pytest.raises(AuthError) as ei:
+            await gw.handle(_sign_request(
+                "PUT", "/tb/corrupt", body=bytes(bad), extra_headers=hdrs,
+                payload_hash="STREAMING-UNSIGNED-PAYLOAD-TRAILER"))
+        assert ei.value.code == "BadDigest"
     finally:
         await c.stop()

@@ -13,12 +13,27 @@ signature)::
 
 :func:`decode_chunked_body` verifies every chunk and returns the decoded
 payload; any broken frame or signature raises :class:`AuthError`.
+
+Modern SDKs (the AWS C++/Java/Go SDKs with flexible checksums, e.g. behind
+pyarrow's S3FileSystem) instead sign ``x-amz-content-sha256:
+STREAMING-UNSIGNED-PAYLOAD-TRAILER``: the request signature covers headers
+only, the body is aws-chunked WITHOUT per-chunk signatures, and integrity
+rides a trailing checksum header announced by ``x-amz-trailer``::
+
+    <hex-size>\r\n<data>\r\n ... 0\r\nx-amz-checksum-crc64nvme:<b64>\r\n\r\n
+
+:func:`decode_unsigned_chunked_body` parses that framing and returns
+``(payload, trailers)``; :func:`verify_trailer_checksums` validates any
+``x-amz-checksum-*`` trailer whose algorithm we implement (crc64nvme, crc32c,
+crc32, sha1, sha256 — digest base64-encoded, big-endian for the CRCs).
 """
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import hmac
+import zlib
 
 from tpudfs.auth.errors import AuthError
 from tpudfs.auth.signing import EMPTY_SHA256, sha256_hex
@@ -42,6 +57,27 @@ def chunk_signature(
     return hmac.new(signing_key, string_to_sign.encode(), hashlib.sha256).hexdigest()
 
 
+_HEX_DIGITS = frozenset("0123456789abcdefABCDEF")
+
+
+def _parse_chunk_header(body: bytes, pos: int) -> tuple[int, str, int]:
+    """One ``<hex-size>[;ext]\\r\\n`` frame header -> (size, ext, data_start).
+
+    The size charset is validated strictly: ``int(x, 16)`` alone would accept
+    ``-6``/``+6``/``0x6``/``6_0``, and a negative size makes the framing loop
+    walk BACKWARDS — ``pos`` never advances and a 10-byte crafted body wedges
+    the gateway event loop forever.
+    """
+    header_end = body.find(b"\r\n", pos)
+    if header_end < 0:
+        raise AuthError.malformed("truncated chunk header")
+    header = body[pos:header_end].decode("ascii", errors="replace")
+    size_part, _, ext = header.partition(";")
+    if not size_part or not set(size_part) <= _HEX_DIGITS:
+        raise AuthError.malformed(f"bad chunk size: {size_part}")
+    return int(size_part, 16), ext, header_end + 2
+
+
 def decode_chunked_body(
     body: bytes, signing_key: bytes, amz_date: str, scope: str, seed_signature: str
 ) -> bytes:
@@ -50,18 +86,10 @@ def decode_chunked_body(
     prev_sig = seed_signature
     pos = 0
     while True:
-        header_end = body.find(b"\r\n", pos)
-        if header_end < 0:
-            raise AuthError.malformed("truncated chunk header")
-        header = body[pos:header_end].decode("ascii", errors="replace")
-        size_part, sep, sig_part = header.partition(";chunk-signature=")
-        if not sep:
+        size, ext, data_start = _parse_chunk_header(body, pos)
+        if not ext.startswith("chunk-signature="):
             raise AuthError.malformed("chunk header missing chunk-signature")
-        try:
-            size = int(size_part, 16)
-        except ValueError as exc:
-            raise AuthError.malformed(f"bad chunk size: {size_part}") from exc
-        data_start = header_end + 2
+        sig_part = ext[len("chunk-signature="):]
         data_end = data_start + size
         if body[data_end : data_end + 2] != b"\r\n":
             raise AuthError.malformed("chunk data not CRLF-terminated")
@@ -74,3 +102,80 @@ def decode_chunked_body(
             return bytes(out)
         out.extend(data)
         pos = data_end + 2
+
+
+def decode_unsigned_chunked_body(body: bytes) -> tuple[bytes, dict[str, str]]:
+    """Parse an unsigned aws-chunked body (STREAMING-UNSIGNED-PAYLOAD-TRAILER).
+
+    Frames are ``<hex-size>[;ext]\\r\\n<data>\\r\\n`` ending with a zero-size
+    frame followed by optional ``name:value`` trailer lines. Returns the
+    decoded payload and the trailer map (names lowercased). Raises AuthError
+    on any malformed frame.
+    """
+    out = bytearray()
+    pos = 0
+    while True:
+        size, _ext, data_start = _parse_chunk_header(body, pos)
+        if size == 0:
+            return bytes(out), _parse_trailers(body[data_start:])
+        data_end = data_start + size
+        if body[data_end : data_end + 2] != b"\r\n":
+            raise AuthError.malformed("chunk data not CRLF-terminated")
+        out.extend(body[data_start:data_end])
+        pos = data_end + 2
+
+
+def _parse_trailers(tail: bytes) -> dict[str, str]:
+    trailers: dict[str, str] = {}
+    for line in tail.split(b"\r\n"):
+        if not line:
+            continue
+        name, sep, value = line.partition(b":")
+        if not sep:
+            raise AuthError.malformed("malformed trailer line")
+        trailers[name.decode("ascii", "replace").strip().lower()] = (
+            value.decode("ascii", "replace").strip()
+        )
+    return trailers
+
+
+def _crc32c_digest(payload: bytes) -> bytes:
+    from tpudfs.common.checksum import crc32c
+
+    return crc32c(payload).to_bytes(4, "big")
+
+
+def _crc64nvme_digest(payload: bytes) -> bytes:
+    from tpudfs.common.checksum import crc64nvme
+
+    return crc64nvme(payload).to_bytes(8, "big")
+
+
+#: x-amz-checksum-<algo> -> digest function (bytes -> raw digest bytes).
+_TRAILER_ALGOS = {
+    "x-amz-checksum-crc32": lambda p: (zlib.crc32(p) & 0xFFFFFFFF).to_bytes(4, "big"),
+    "x-amz-checksum-crc32c": _crc32c_digest,
+    "x-amz-checksum-crc64nvme": _crc64nvme_digest,
+    "x-amz-checksum-sha1": lambda p: hashlib.sha1(p).digest(),
+    "x-amz-checksum-sha256": lambda p: hashlib.sha256(p).digest(),
+}
+
+
+def verify_trailer_checksums(payload: bytes, trailers: dict[str, str]) -> None:
+    """Validate every known ``x-amz-checksum-*`` trailer against the payload.
+
+    A mismatch raises AuthError (the client's own integrity check failed in
+    transit); unknown checksum algorithms are ignored — the signature and
+    frame structure were already verified, and the DFS adds its own CRC32C
+    end-to-end checksums downstream.
+    """
+    for name, value in trailers.items():
+        fn = _TRAILER_ALGOS.get(name)
+        if fn is None:
+            continue
+        try:
+            provided = base64.b64decode(value, validate=True)
+        except Exception as exc:
+            raise AuthError.malformed(f"bad {name} trailer encoding") from exc
+        if not hmac.compare_digest(fn(payload), provided):
+            raise AuthError.bad_digest(name)

@@ -47,6 +47,14 @@ class AuthError(Exception):
         )
 
     @classmethod
+    def bad_digest(cls, which: str) -> "AuthError":
+        return cls(
+            "BadDigest",
+            f"The {which} you specified did not match the calculated checksum.",
+            400,
+        )
+
+    @classmethod
     def clock_skew(cls) -> "AuthError":
         return cls(
             "RequestTimeTooSkewed",

@@ -19,6 +19,10 @@ from tpudfs.auth.errors import AuthError
 ALGORITHM = "AWS4-HMAC-SHA256"
 UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
 STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+#: Flexible-checksum streaming (modern AWS SDK default for uploads): body is
+#: aws-chunked with NO per-chunk signatures; integrity rides an
+#: x-amz-checksum-* trailer announced by the signed x-amz-trailer header.
+STREAMING_UNSIGNED_TRAILER = "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
 EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 
 

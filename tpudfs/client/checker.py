@@ -27,14 +27,16 @@ History entries are dicts (JSONL on disk):
    "invoke_ts": float, "return_ts": float|None, "result": Any}
 
 For ``get``, ``result`` is the observed value or None (not found). For
-mutators, ``result`` is {"ok": bool}; a failed mutator (ok=False) is treated
-as not applied. A crashed mutator (return_ts None) is maybe-applied.
+mutators, ``result`` is {"ok": bool}. A crashed mutator (return_ts None) is
+maybe-applied, and so is a FAILED one (ok=False): the client retries
+internally and 2PC recovery can commit a "failed" rename after the error
+was returned, so a failure report never proves the op did not apply.
 """
 
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Any
 
 INF = float("inf")
@@ -258,15 +260,26 @@ def check_linearizability(entries: list[dict],
     which every get sees the model state (reference check_linearizability
     checker.rs:186, try_linearize checker.rs:452)."""
     ops = [Op.from_entry(e) for e in entries]
-    # A failed mutator is known not to have applied; drop it from the search.
+    # A mutator that RETURNED a failure is still only *maybe* applied: the
+    # client retries internally (a lost response means attempt 1 applied and
+    # the retry reports NotFound/AlreadyExists), and a cross-shard rename
+    # left Prepared by a partition is committed LATER by the 2PC recovery
+    # task (transactions.py run_recovery; reference master.rs:1171-1322) —
+    # its effect can even land after the error reached the client. The
+    # Jepsen treatment for indeterminate ops applies: keep the op with an
+    # infinite window (same as a crash) so the search may include or omit
+    # it. Dropping them instead produced false PHANTOM READ verdicts when a
+    # failed-but-recovered rename delivered a value to its destination.
     ops = [
-        o for o in ops
-        if not (
+        replace(o, crashed=True, ret=INF)
+        if (
             o.kind in ("put", "delete", "rename")
             and not o.crashed
             and isinstance(o.result, dict)
             and o.result.get("ok") is False
         )
+        else o
+        for o in ops
     ]
     ops.sort(key=lambda o: o.invoke)
     n = len(ops)

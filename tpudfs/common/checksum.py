@@ -248,6 +248,41 @@ def crc32c_combine_chunks(crcs, chunk_len: int, crc: int = 0) -> int:
     return total
 
 
+# ---------------------------------------------------------------------------
+# CRC-64/NVME (AWS flexible-checksum trailers: x-amz-checksum-crc64nvme)
+# ---------------------------------------------------------------------------
+
+_POLY64 = 0x9A6C9329AC4BC9B5
+
+
+@lru_cache(maxsize=1)
+def _crc64_table() -> np.ndarray:
+    c = np.arange(256, dtype=np.uint64)
+    for _ in range(8):
+        c = np.where(c & np.uint64(1),
+                     (c >> np.uint64(1)) ^ np.uint64(_POLY64),
+                     c >> np.uint64(1))
+    return c
+
+
+def crc64nvme(data: bytes | bytearray | memoryview | np.ndarray,
+              crc: int = 0) -> int:
+    """CRC-64/NVME (refin/refout, init/xorout all-ones) — the checksum modern
+    AWS SDKs attach as an aws-chunked upload trailer. Native slice-by-8 fast
+    path (native/crc64.cc); per-byte table fallback."""
+    buf = _as_bytes(data)
+    lib = native.get_lib()
+    if lib is not None and hasattr(lib, "tpudfs_crc64nvme"):
+        return int(lib.tpudfs_crc64nvme(crc & 0xFFFFFFFFFFFFFFFF, buf, len(buf)))
+    t = _crc64_table()
+    reg = np.uint64(~crc & 0xFFFFFFFFFFFFFFFF)
+    eight = np.uint64(8)
+    mask = np.uint64(0xFF)
+    for b in buf:
+        reg = t[int((reg ^ np.uint64(b)) & mask)] ^ (reg >> eight)
+    return int(~reg & 0xFFFFFFFFFFFFFFFF)
+
+
 def verify_chunks(
     data: bytes, checksums: np.ndarray, chunk: int = CHECKSUM_CHUNK_SIZE
 ) -> bool:

@@ -76,6 +76,16 @@ def get_lib() -> ctypes.CDLL | None:
         ctypes.c_size_t,
     ]
     try:
+        lib.tpudfs_crc64nvme.restype = ctypes.c_uint64
+        lib.tpudfs_crc64nvme.argtypes = [
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+        ]
+    except AttributeError:
+        # Prebuilt library predating the CRC-64/NVME trailer support.
+        pass
+    try:
         lib.tpudfs_block_write.restype = ctypes.c_int64
         lib.tpudfs_block_write.argtypes = [
             ctypes.c_char_p,

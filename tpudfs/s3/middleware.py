@@ -23,7 +23,11 @@ from typing import Awaitable, Callable
 from tpudfs.auth import signing
 from tpudfs.auth.audit import AuditRecord
 from tpudfs.auth.bucket_policy import BucketPolicy, combined_decision
-from tpudfs.auth.chunked import decode_chunked_body
+from tpudfs.auth.chunked import (
+    decode_chunked_body,
+    decode_unsigned_chunked_body,
+    verify_trailer_checksums,
+)
 from tpudfs.auth.credentials import CredentialProvider, SigningKeyCache
 from tpudfs.auth.errors import AuthError
 from tpudfs.auth.policy import PolicyEngine
@@ -60,16 +64,33 @@ class AuthResult:
     session_role: str = ""
 
 
+def split_bucket_key(path: str) -> tuple[str, str]:
+    """URL path -> (bucket, key); ("", "") for the service root.
+
+    S3 keys are raw byte strings where a trailing slash is significant
+    ("dir/" is a directory-marker object, distinct from "dir") — naive
+    segment-splitting drops it. Single source of truth for the gateway
+    router AND policy/audit resource mapping, so both always name the same
+    object.
+    """
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return "", ""
+    key = "/".join(parts[1:])
+    if key and path.endswith("/"):
+        key += "/"
+    return parts[0], key
+
+
 def map_action(req: S3Request) -> tuple[str, str]:
     """(action, resource) for policy evaluation
     (reference auth_middleware.rs:394)."""
-    parts = [p for p in req.path.split("/") if p]
+    bucket, key = split_bucket_key(req.path)
     q = req.query_map()
-    if not parts:
+    if not bucket:
         return "s3:ListAllMyBuckets", "arn:aws:s3:::"
-    bucket = parts[0]
     bucket_arn = f"arn:aws:s3:::{bucket}"
-    if len(parts) == 1:
+    if not key:
         if "policy" in q:
             action = {"GET": "s3:GetBucketPolicy", "PUT": "s3:PutBucketPolicy",
                       "DELETE": "s3:DeleteBucketPolicy"}.get(req.method, "s3:GetBucketPolicy")
@@ -79,7 +100,6 @@ def map_action(req: S3Request) -> tuple[str, str]:
                   "POST": "s3:DeleteObject" if "delete" in q else "s3:PutObject",
                   }.get(req.method, "s3:ListBucket")
         return action, bucket_arn
-    key = "/".join(parts[1:])
     obj_arn = f"{bucket_arn}/{key}"
     if req.method in ("GET", "HEAD"):
         return "s3:GetObject", obj_arn
@@ -204,6 +224,24 @@ class AuthMiddleware:
                 req.body, signing_key, amz_date, parsed.credential.scope,
                 parsed.signature,
             )
+        elif payload_mode == signing.STREAMING_UNSIGNED_TRAILER:
+            body, trailers = decode_unsigned_chunked_body(req.body)
+            # The x-amz-trailer header is covered by the SigV4 signature; the
+            # trailer LINES are not. Every announced checksum must actually
+            # appear in the body, or stripping the (unsigned) trailer would
+            # silently bypass the integrity check the client opted into.
+            announced = [
+                t.strip().lower()
+                for t in (req.header("x-amz-trailer") or "").split(",")
+                if t.strip()
+            ]
+            missing = [t for t in announced if t not in trailers]
+            if missing:
+                raise AuthError.malformed(
+                    "announced trailer(s) missing from body: "
+                    + ", ".join(missing)
+                )
+            verify_trailer_checksums(body, trailers)
         elif payload_mode not in (signing.UNSIGNED_PAYLOAD, ""):
             if signing.sha256_hex(req.body) != payload_mode:
                 raise AuthError.signature_mismatch()

@@ -41,7 +41,7 @@ from tpudfs.client.client import Client, DfsError
 from tpudfs.s3.audit import AuditLog
 from tpudfs.s3.handlers import S3Handlers, S3Response, _err, is_reserved_key
 from tpudfs.s3.metrics import S3Metrics
-from tpudfs.s3.middleware import AuthMiddleware, S3Request
+from tpudfs.s3.middleware import AuthMiddleware, S3Request, split_bucket_key
 from tpudfs.s3.sts_handler import StsHandler
 
 logger = logging.getLogger(__name__)
@@ -187,15 +187,13 @@ class Gateway:
             self.metrics.auth_outcomes[
                 "anonymous" if auth.principal == "-" else "allowed"] += 1
         h = self.handlers
-        parts = [p for p in req.path.split("/") if p]
-        if not parts:
+        bucket, key = split_bucket_key(req.path)
+        if not bucket:
             if req.method == "GET":
                 return await h.list_buckets()
             return _err("MethodNotAllowed", "unsupported", 405)
-        bucket = parts[0]
-        if len(parts) == 1:
+        if not key:
             return await self._bucket_route(req, q, auth.body, bucket)
-        key = "/".join(parts[1:])
         if is_reserved_key(key):
             # Internal namespaces (.policy, .bucket, .s3_mpu, .s3_tmp) are
             # unreachable through the object API — writing .policy directly
