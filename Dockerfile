@@ -1,0 +1,32 @@
+# tpudfs service image (reference: the multi-stage rust builder Dockerfile).
+# One image serves every role — master, config server, chunkserver, S3
+# gateway — selected by the container command (python -m tpudfs.<role>).
+FROM python:3.12-slim AS build
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY native/ native/
+RUN make -C native
+
+FROM python:3.12-slim
+
+RUN pip install --no-cache-dir \
+        grpcio msgpack numpy aiohttp cryptography
+
+WORKDIR /app
+COPY tpudfs/ tpudfs/
+COPY scripts/ scripts/
+COPY deploy/ deploy/
+COPY --from=build /app/native/libtpudfs_native.so native/libtpudfs_native.so
+
+ENV PYTHONPATH=/app \
+    TPUDFS_NATIVE_LIB=/app/native/libtpudfs_native.so
+
+# Roles (override `command`):
+#   python -m tpudfs.configserver --port 50050 --data-dir /data/cfg
+#   python -m tpudfs.master       --port 50051 --data-dir /data/raft ...
+#   python -m tpudfs.chunkserver  --port 50100 --data-dir /data/blocks ...
+#   python -m tpudfs.s3           (env-configured)
+CMD ["python", "-m", "tpudfs.master", "--help"]

@@ -1,0 +1,17 @@
+{{- define "tpudfs.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
+
+{{- define "tpudfs.labels" -}}
+app.kubernetes.io/name: tpudfs
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}
+
+{{/* Comma list of config-server endpoints, e.g. tpudfs-config-0.tpudfs-config:50200,... */}}
+{{- define "tpudfs.configEndpoints" -}}
+{{- $parts := list -}}
+{{- range $i := until (int .Values.configServer.replicas) -}}
+{{- $parts = append $parts (printf "%s-config-%d.%s-config:50200" $.Release.Name $i $.Release.Name) -}}
+{{- end -}}
+{{- join "," $parts -}}
+{{- end -}}

@@ -712,3 +712,23 @@ async def test_unsigned_trailer_streaming_upload(tmp_path):
         assert ei.value.code == "BadDigest"
     finally:
         await c.stop()
+
+
+async def test_unsigned_trailer_requires_signed_announce(tmp_path):
+    # x-amz-trailer must itself be a SIGNED header, or deleting it together
+    # with the (unsigned) trailer lines would bypass integrity entirely.
+    c, gw = await _gateway(tmp_path, auth_enabled=True,
+                           credentials=StaticCredentialProvider({AK: SK}))
+    try:
+        await gw.handle(_sign_request("PUT", "/tr"))
+        payload = b"x" * 64
+        frame = f"{len(payload):x}\r\n".encode() + payload + b"\r\n0\r\n\r\n"
+        r = _sign_request("PUT", "/tr/obj", body=frame,
+                          payload_hash="STREAMING-UNSIGNED-PAYLOAD-TRAILER")
+        # Header present but NOT signed (added after signing).
+        r.headers["x-amz-trailer"] = "x-amz-checksum-crc64nvme"
+        with pytest.raises(AuthError) as ei:
+            await gw.handle(r)
+        assert "signed header" in ei.value.message
+    finally:
+        await c.stop()

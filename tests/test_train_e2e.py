@@ -1,0 +1,109 @@
+"""End-to-end training on DFS data: the BASELINE "config 5" capability.
+
+The reference's closest analogue is the Spark-on-s3a pipeline
+(test_scripts/spark-s3-test/spark_s3_test.py — CSV/Parquet batch jobs over
+the S3 gateway). The TPU-native equivalent is a JAX training loop whose
+batches stream from DFS through the Grain infeed as sharded device arrays:
+
+    DFS files -> DfsRecordSource (byte-range fetches over gRPC)
+             -> grain shuffle/batch -> device_iterator (batch dim sharded
+                over the mesh's data axis) -> pjit'd SGD step
+
+This test runs the WHOLE stack on the virtual 8-device CPU mesh and
+asserts the model actually LEARNS (loss drops 10x on a synthetic linear
+regression task), i.e. the bytes that reach the accelerators are the right
+bytes in the right layout — not just that shapes line up.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.test_master_service import MiniCluster
+from tpudfs.client.client import Client
+
+FEATURES = 16
+RECORD_FLOATS = FEATURES + 1  # features + regression target
+RECORD_BYTES = RECORD_FLOATS * 4
+N_FILES = 4
+RECORDS_PER_FILE = 128
+BATCH = 64
+
+
+def _make_shard(seed: int, w_true: np.ndarray) -> bytes:
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(RECORDS_PER_FILE, FEATURES)).astype(np.float32)
+    y = (x @ w_true + 0.01 * rng.normal(size=RECORDS_PER_FILE)).astype(
+        np.float32
+    )
+    return np.concatenate([x, y[:, None]], axis=1).tobytes()
+
+
+async def test_sgd_on_dfs_batches_learns(tmp_path):
+    pytest.importorskip("grain")
+    from tpudfs.tpu import grain_infeed as gi
+
+    w_true = np.random.default_rng(99).normal(size=FEATURES).astype(
+        np.float32
+    )
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=2048)  # several blocks per shard file
+        paths = []
+        for i in range(N_FILES):
+            path = f"/train/shard-{i:02d}.f32"
+            await client.create_file(path, _make_shard(7 + i, w_true))
+            paths.append(path)
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        repl = NamedSharding(mesh, P())
+
+        @jax.jit
+        def train_step(w, batch):
+            x, y = batch[:, :FEATURES], batch[:, FEATURES]
+
+            def loss_fn(w):
+                return jnp.mean((x @ w - y) ** 2)
+
+            loss, grad = jax.value_and_grad(loss_fn)(w)
+            return w - 0.1 * grad, loss
+
+        def run_epochs():
+            source = gi.DfsRecordSource(
+                list(c.masters), paths, RECORD_BYTES, dtype="float32"
+            )
+            try:
+                ds = gi.make_dataset(
+                    source, batch_size=BATCH, shuffle_seed=3, num_epochs=4
+                )
+                w = jax.device_put(jnp.zeros(FEATURES, jnp.float32), repl)
+                losses = []
+                for batch in gi.device_iterator(ds, mesh=mesh, axis="data"):
+                    # Infeed layout contract: batch dim sharded over the
+                    # mesh's data axis, features replicated.
+                    assert batch.shape == (BATCH, RECORD_FLOATS)
+                    assert batch.sharding.spec == P("data")
+                    w, loss = train_step(w, batch)
+                    losses.append(float(loss))
+                return np.asarray(w), losses
+            finally:
+                source.close()
+
+        w, losses = await asyncio.to_thread(run_epochs)
+        assert len(losses) == 4 * (N_FILES * RECORDS_PER_FILE // BATCH)
+        # The model must LEARN: final loss well under the initial one and
+        # recovered weights close to the generating ones.
+        assert losses[-1] < losses[0] / 10, (losses[0], losses[-1])
+        assert np.linalg.norm(w - w_true) < 0.5 * np.linalg.norm(w_true)
+    finally:
+        await c.stop()

@@ -29,7 +29,54 @@ def parse_args(argv=None):
                    help="ops HTTP; -1 = rpc port + 1000, 0 = disabled")
     p.add_argument("--snapshot-backup-dir", default="",
                    help="directory sink for leader snapshot backups")
+    p.add_argument("--bootstrap-shards", default="",
+                   help="declarative shard bootstrap for compose/k8s "
+                        "bring-up: 'shard-a=m1:50051+m2:50051,shard-z' — "
+                        "entries with peers pin them, bare entries "
+                        "auto-allocate from the registered (spare) master "
+                        "pool; each missing shard is registered once a "
+                        "leader exists (idempotent across restarts)")
     return p.parse_args(argv)
+
+
+async def _bootstrap_shards(cfg, spec: str) -> None:
+    """Register the declared shards once this node leads (the launcher
+    script does this via AddShard RPCs; compose/k8s topologies have no
+    post-boot hook, so the config server self-registers instead)."""
+    wanted: list[tuple[str, list[str] | None]] = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        sid, eq, addrs = item.partition("=")
+        peers = [a.strip() for a in addrs.split("+") if a.strip()]
+        if not sid or (eq and not peers):
+            raise SystemExit(f"bad --bootstrap-shards entry: {item!r}")
+        wanted.append((sid.strip(), peers or None))
+    import logging
+
+    log = logging.getLogger("tpudfs.configserver.bootstrap")
+    while wanted:
+        await asyncio.sleep(0.5)
+        try:
+            existing = set(
+                (await cfg.rpc_fetch_shard_map({"allow_stale": True}))
+                ["shard_map"].get("peers", {})
+            )
+            for sid, peers in list(wanted):
+                if sid in existing:
+                    wanted.remove((sid, peers))
+                    continue
+                await cfg.rpc_add_shard({"shard_id": sid, "peers": peers})
+                log.info("bootstrapped shard %s (peers=%s)", sid, peers)
+                wanted.remove((sid, peers))
+        except Exception as e:
+            # Expected while the Raft group is still electing (Not Leader /
+            # unavailable) — but a permanent rejection must be VISIBLE, not
+            # a silent forever-loop behind a READY banner.
+            log.warning("shard bootstrap retry (%d pending): %s",
+                        len(wanted), e)
+            continue
 
 
 async def amain(args) -> None:
@@ -51,8 +98,20 @@ async def amain(args) -> None:
     await maybe_start_ops("tpudfs_config", cfg.ops_gauges, cfg.raft.status,
                           host=args.host, rpc_port=args.port,
                           http_port=args.http_port)
+    bootstrap_task = None
+    if args.bootstrap_shards:
+        # Keep a strong reference: the loop only weakly references running
+        # tasks, and a GC'd bootstrap task would silently never register
+        # the declared shards.
+        bootstrap_task = asyncio.get_running_loop().create_task(
+            _bootstrap_shards(cfg, args.bootstrap_shards)
+        )
     print(f"READY {address}", flush=True)
-    await asyncio.Event().wait()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        if bootstrap_task is not None:
+            bootstrap_task.cancel()
 
 
 def main(argv=None) -> None:

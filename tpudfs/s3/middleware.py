@@ -226,10 +226,20 @@ class AuthMiddleware:
             )
         elif payload_mode == signing.STREAMING_UNSIGNED_TRAILER:
             body, trailers = decode_unsigned_chunked_body(req.body)
-            # The x-amz-trailer header is covered by the SigV4 signature; the
-            # trailer LINES are not. Every announced checksum must actually
-            # appear in the body, or stripping the (unsigned) trailer would
-            # silently bypass the integrity check the client opted into.
+            # The anti-stripping property below only holds if x-amz-trailer
+            # itself is covered by the SigV4 signature — require it in
+            # SignedHeaders (AWS mandates this for the trailer modes), or an
+            # on-path attacker could delete the header AND the trailer lines
+            # together.
+            if "x-amz-trailer" not in parsed.signed_headers:
+                raise AuthError.malformed(
+                    "x-amz-trailer must be a signed header for "
+                    "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
+                )
+            # The trailer LINES are not signed. Every announced checksum must
+            # actually appear in the body, or stripping the unsigned trailer
+            # would silently bypass the integrity check the client opted
+            # into.
             announced = [
                 t.strip().lower()
                 for t in (req.header("x-amz-trailer") or "").split(",")
