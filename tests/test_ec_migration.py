@@ -1,0 +1,204 @@
+"""Storage-tier EC conversion with REAL data migration.
+
+The reference's scan_ec_conversion flips the file's EC policy but leaves
+the data migration TODO (master.rs:2108-2118) — blocks stay replicated
+forever. Here the conversion completes: the master schedules CONVERT_TO_EC
+on a replica holder, the chunkserver RS-encodes the block and distributes
+one shard per target under a new block id, the master commits the metadata
+swap through Raft, and the old replicas are garbage-collected — at every
+point the block is readable (replicas stay authoritative until the swap).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from tests.test_master_service import MiniCluster
+from tpudfs.client.client import Client
+from tpudfs.common.erasure import shard_len
+
+
+def _rand(n, seed=0):
+    import numpy as np
+
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+async def _converted(client, path, timeout=30.0):
+    """Wait until every block of ``path`` is EC; returns the metadata."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        meta = await client.get_file_info(path)
+        if meta and all(b.get("ec_data_shards") for b in meta["blocks"]):
+            return meta
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"{path} never finished EC migration: {meta}")
+
+
+async def test_ec_migration_end_to_end(tmp_path):
+    data = _rand(200_000, seed=1)
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=3,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(2, 1),
+        intervals={"tiering": 0.3},
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/cold/a.bin", data)
+        before = await client.get_file_info("/cold/a.bin")
+        old_ids = [b["block_id"] for b in before["blocks"]]
+
+        meta = await _converted(client, "/cold/a.bin")
+        for old_id, b in zip(old_ids, meta["blocks"]):
+            assert b["block_id"].startswith(f"{old_id}.ec-")
+            assert (b["ec_data_shards"], b["ec_parity_shards"]) == (2, 1)
+            assert len(b["locations"]) == 3
+            assert b["original_size"] == b["size"]
+
+        # Data survives the migration byte-for-byte.
+        assert await client.get_file("/cold/a.bin") == data
+
+        # Old replicas are garbage-collected from every store (commands
+        # drain via heartbeats).
+        deadline = asyncio.get_event_loop().time() + 15
+        while asyncio.get_event_loop().time() < deadline:
+            leftovers = [
+                bid for bid in old_ids
+                for cs in c.chunkservers if cs.store.exists(bid)
+            ]
+            if not leftovers:
+                break
+            await asyncio.sleep(0.2)
+        assert not leftovers, f"old replicas not GC'd: {leftovers}"
+
+        # Each store holds exactly one shard per block, of shard length.
+        for b in meta["blocks"]:
+            sizes = [
+                len(cs.store.read(b["block_id"]))
+                for cs in c.chunkservers if cs.store.exists(b["block_id"])
+            ]
+            assert len(sizes) == 3
+            assert all(s == shard_len(b["original_size"], 2) for s in sizes)
+
+        # Degraded read: lose one shard holder's copy, RS decode recovers.
+        victim = meta["blocks"][0]
+        addr = victim["locations"][-1]  # a parity or data shard
+        cs = next(x for x in c.chunkservers if x.address == addr)
+        cs.store.delete(victim["block_id"])
+        cs.cache.invalidate(victim["block_id"])
+        assert await client.get_file("/cold/a.bin") == data
+    finally:
+        await c.stop()
+
+
+async def test_ec_migration_skipped_without_enough_servers(tmp_path):
+    # RS(6,3) needs 9 distinct chunkservers; with 3 the policy flips but the
+    # data migration must hold off (and the file stays fully readable).
+    data = _rand(50_000, seed=2)
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=3,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(6, 3),
+        intervals={"tiering": 0.3},
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/cold/b.bin", data)
+        # Wait for the policy flip, then some more scans.
+        deadline = asyncio.get_event_loop().time() + 15
+        while asyncio.get_event_loop().time() < deadline:
+            meta = await client.get_file_info("/cold/b.bin")
+            if meta["ec_data_shards"]:
+                break
+            await asyncio.sleep(0.2)
+        await asyncio.sleep(1.0)
+        meta = await client.get_file_info("/cold/b.bin")
+        assert meta["ec_data_shards"] == 6  # policy set
+        assert all(not b.get("ec_data_shards") for b in meta["blocks"])
+        assert await client.get_file("/cold/b.bin") == data
+    finally:
+        await c.stop()
+
+
+def test_ec_shape_env_validation():
+    import pytest as _pytest
+
+    from tpudfs.master.service import _parse_ec_shape
+
+    assert _parse_ec_shape("2,1") == (2, 1)
+    for bad in ("6", "6,3,", "a,b", "", ","):
+        with _pytest.raises(ValueError):
+            _parse_ec_shape(bad)
+
+
+async def test_superseded_conversion_attempt_fenced(tmp_path):
+    # A re-issued conversion gets a fresh unique block id; a stale attempt
+    # reporting afterwards must be rejected, not committed over the new
+    # attempt's positional shard layout.
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=3,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(2, 1),
+        intervals={"tiering": 3600},  # manual scans only
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/cold/c.bin", _rand(10_000, seed=3))
+        # Freeze the data plane: commands must queue, not execute, so the
+        # two attempts stay in flight for the fencing assertions.
+        for hb in c.heartbeats:
+            hb.stop()
+        await leader.run_tiering_scan()   # -> cold
+        await leader.run_tiering_scan()   # -> EC policy
+        await leader.run_tiering_scan()   # -> attempt 1 scheduled
+        meta = await client.get_file_info("/cold/c.bin")
+        bid = meta["blocks"][0]["block_id"]
+        attempt1 = dict(leader._ec_migrations[bid])
+        # Simulate the retry timeout elapsing -> attempt 2 with a NEW id.
+        leader._ec_migrations[bid]["ts"] -= 10_000
+        await leader.run_tiering_scan()
+        attempt2 = leader._ec_migrations[bid]
+        assert attempt2["new_id"] != attempt1["new_id"]
+        assert (attempt1["new_id"], attempt1["targets"]) in attempt2["stale"]
+        # The stale attempt's completion is fenced off.
+        import pytest as _pytest
+
+        from tpudfs.common.rpc import RpcError
+
+        with _pytest.raises(RpcError, match="superseded"):
+            await leader.rpc_complete_ec_conversion({
+                "block_id": bid,
+                "new_block_id": attempt1["new_id"],
+                "ec_data_shards": 2, "ec_parity_shards": 1,
+                "targets": attempt1["targets"],
+            })
+        # The current attempt commits fine.
+        resp = await leader.rpc_complete_ec_conversion({
+            "block_id": bid,
+            "new_block_id": attempt2["new_id"],
+            "ec_data_shards": 2, "ec_parity_shards": 1,
+            "targets": attempt2["targets"],
+        })
+        assert resp["success"]
+        meta = await client.get_file_info("/cold/c.bin")
+        assert meta["blocks"][0]["block_id"] == attempt2["new_id"]
+        # Stale attempt's shards were queued for deletion on its targets.
+        queued = [
+            cmd for addr in attempt1["targets"]
+            for cmd in leader.state.pending_commands.get(addr, [])
+            if cmd.get("type") == "DELETE"
+            and cmd.get("block_id") == attempt1["new_id"]
+        ]
+        assert len(queued) == len(attempt1["targets"])
+    finally:
+        await c.stop()

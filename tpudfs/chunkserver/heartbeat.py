@@ -144,6 +144,10 @@ class HeartbeatLoop:
                 int(cmd["ec_parity_shards"]),
                 list(cmd["ec_shard_sources"]),
             )
+        elif ctype == "CONVERT_TO_EC":
+            # Runs in the background — inline it and a large block would
+            # stall heartbeats past the master's liveness cutoff.
+            err = self.cs.start_ec_conversion(cmd)
         elif ctype == "MOVE_TO_COLD":
             moved = await asyncio.to_thread(self.cs.store.move_to_cold, block_id)
             err = None if moved else f"block {block_id} not in hot tier"

@@ -31,7 +31,7 @@ from collections import OrderedDict
 import grpc
 
 from tpudfs.common.checksum import crc32c
-from tpudfs.common.erasure import reconstruct
+from tpudfs.common.erasure import encode as ec_encode, reconstruct
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer, ServerTls
 from tpudfs.chunkserver.blockstore import (
     BlockCorruptionError,
@@ -102,6 +102,8 @@ class ChunkServer:
         #: Corrupt blocks found by scrubber/reads, drained into heartbeats
         #: (reference pending_bad_blocks).
         self.pending_bad_blocks: set[str] = set()
+        #: block ids with an in-flight CONVERT_TO_EC (dedups master retries).
+        self._ec_converting: set[str] = set()
         self._tasks: set[asyncio.Task] = set()
         self._server: RpcServer | None = None
 
@@ -367,6 +369,141 @@ class ChunkServer:
             logger.info("recovered block %s from %s", block_id, loc)
             return None
         return "Failed to recover block from any replica"
+
+    def start_ec_conversion(self, cmd: dict) -> str | None:
+        """Run CONVERT_TO_EC in the background. The heartbeat loop executes
+        commands inline before the next heartbeat; encoding + distributing a
+        large block takes longer than LIVENESS_CUTOFF_MS, so an inline
+        conversion would get this chunkserver declared dead mid-migration.
+        The master learns the outcome through CompleteEcConversion (or by
+        re-issuing after its retry timeout), so scheduling == success here.
+        """
+        block_id = cmd["block_id"]
+        if block_id in self._ec_converting:
+            return None  # master retry raced a still-running attempt
+
+        self._ec_converting.add(block_id)
+
+        async def run() -> None:
+            try:
+                err = await self.convert_block_to_ec(
+                    block_id,
+                    cmd["new_block_id"],
+                    int(cmd["ec_data_shards"]),
+                    int(cmd["ec_parity_shards"]),
+                    list(cmd["targets"]),
+                )
+                if err:
+                    logger.error("EC conversion of %s failed: %s",
+                                 block_id, err)
+            finally:
+                self._ec_converting.discard(block_id)
+
+        self._spawn(run())
+        return None
+
+    async def convert_block_to_ec(
+        self,
+        block_id: str,
+        new_block_id: str,
+        data_shards: int,
+        parity_shards: int,
+        targets: list[str],
+    ) -> str | None:
+        """Migrate a replicated block to RS(k,m) shards (CONVERT_TO_EC
+        command). Implements the data half of storage-tier EC conversion —
+        the reference stops at the metadata policy flip (master.rs:2108-2118
+        leaves migration TODO). The local replica is read and verified,
+        RS-encoded (native GF(2^8) codec), and shard i is written to
+        ``targets[i]`` under the NEW block id (so nothing collides with the
+        still-authoritative replicas); the master is then asked to commit
+        the metadata swap, after which it GCs the old replicas."""
+        if len(set(targets)) != data_shards + parity_shards:
+            return "targets must be k+m distinct chunkservers"
+        try:
+            data = await asyncio.to_thread(self.store.read, block_id)
+            await asyncio.to_thread(self.store.verify_full, block_id, data)
+        except BlockNotFoundError:
+            return f"block {block_id} not found locally"
+        except BlockCorruptionError as e:
+            # Don't encode corrupt bytes into shards; heal first.
+            self._spawn(self._recover_silently(block_id))
+            return f"local replica failed verification: {e}"
+        shards = await asyncio.to_thread(ec_encode, data, data_shards,
+                                         parity_shards)
+
+        async def put_shard(i: int, target: str) -> str | None:
+            if target == self.address:
+                try:
+                    await asyncio.to_thread(self.store.write, new_block_id,
+                                            shards[i])
+                    self.cache.invalidate(new_block_id)
+                    return None
+                except OSError as e:
+                    return f"local shard write failed: {e}"
+            try:
+                resp = await self.client.call(
+                    target, SERVICE, "ReplicateBlock",
+                    {
+                        "block_id": new_block_id,
+                        "data": shards[i],
+                        "next_servers": [],
+                        "expected_crc32c": crc32c(shards[i]),
+                        "master_term": self.known_term,
+                    },
+                    timeout=30.0,
+                )
+            except RpcError as e:
+                return f"shard {i} to {target} failed: {e.message}"
+            if not resp.get("success"):
+                return f"shard {i} to {target} failed: {resp.get('error_message')}"
+            return None
+
+        errs = [e for e in await asyncio.gather(
+            *(put_shard(i, t) for i, t in enumerate(targets))
+        ) if e]
+        if errs:
+            return "; ".join(errs)
+
+        report = {
+            "block_id": block_id,
+            "new_block_id": new_block_id,
+            "ec_data_shards": data_shards,
+            "ec_parity_shards": parity_shards,
+            "targets": list(targets),
+        }
+        resp, err = await self._call_master_leader(
+            "CompleteEcConversion", report
+        )
+        if resp is not None and resp.get("success"):
+            logger.info("EC migration of %s -> %s committed",
+                        block_id, new_block_id)
+            return None
+        return f"CompleteEcConversion failed: {err}"
+
+    async def _call_master_leader(
+        self, method: str, req: dict, timeout: float = 10.0
+    ) -> tuple[dict | None, str]:
+        """Try every known master, following one Not-Leader hint hop, until
+        a call succeeds. Returns (response, "") or (None, last_error)."""
+        last = "no masters configured"
+        for master in self.master_addrs:
+            try:
+                return await self.client.call(
+                    master, "MasterService", method, req, timeout=timeout
+                ), ""
+            except RpcError as e:
+                hint = e.not_leader_hint
+                if hint and hint not in self.master_addrs:
+                    try:
+                        return await self.client.call(
+                            hint, "MasterService", method, req,
+                            timeout=timeout,
+                        ), ""
+                    except RpcError as e2:
+                        e = e2
+                last = e.message
+        return None, last
 
     async def initiate_replication(self, block_id: str, target_addr: str) -> str | None:
         """Push a local block to ``target_addr`` (healer REPLICATE command,

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 import uuid
 
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
@@ -47,6 +48,7 @@ LIVENESS_INTERVAL = 5.0
 HEALER_INTERVAL = 300.0
 BALANCER_INTERVAL = 30.0
 TIERING_INTERVAL = 60.0
+EC_MIGRATION_RETRY_SECS = 60.0  # re-issue CONVERT_TO_EC after this silence
 SHARD_REFRESH_INTERVAL = 5.0  # reference master.rs:1429
 TX_CLEANUP_INTERVAL = 5.0  # reference master.rs:968
 TX_RECOVERY_INTERVAL = 30.0  # reference master.rs:1171
@@ -57,6 +59,20 @@ STAGED_INGEST_TTL_MS = 600_000  # abandoned-stage GC horizon
 DEFAULT_COLD_THRESHOLD_SECS = 7 * 24 * 3600  # reference: COLD_THRESHOLD_SECS
 DEFAULT_EC_THRESHOLD_SECS = 30 * 24 * 3600  # reference: EC_THRESHOLD_SECS
 EC_CONVERSION_SHAPE = (6, 3)  # reference RS(6,3), master.rs:2016-2138
+
+
+def _parse_ec_shape(value: str) -> tuple[int, int]:
+    """Validate an EC_SHAPE env value ("k,m") at startup — a malformed or
+    degenerate shape must fail fast, not persist an unusable policy into
+    the replicated metadata."""
+    parts = [p.strip() for p in value.split(",")]
+    if len(parts) != 2 or not all(parts):
+        raise ValueError(f'EC_SHAPE must be "k,m", got {value!r}')
+    try:
+        k, m = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(f'EC_SHAPE must be "k,m" integers, got {value!r}')
+    return k, m
 
 
 class Master:
@@ -72,6 +88,7 @@ class Master:
         rpc_client: RpcClient | None = None,
         cold_threshold_secs: int | None = None,
         ec_threshold_secs: int | None = None,
+        ec_shape: tuple[int, int] | None = None,
         liveness_cutoff_ms: int = LIVENESS_CUTOFF_MS,
         intervals: dict | None = None,
         split_threshold_rps: float = 100.0,
@@ -105,6 +122,21 @@ class Master:
             if ec_threshold_secs is not None
             else int(os.environ.get("EC_THRESHOLD_SECS", DEFAULT_EC_THRESHOLD_SECS))
         )
+        if ec_shape:
+            self.ec_shape = tuple(ec_shape)
+        elif os.environ.get("EC_SHAPE"):  # "k,m" — env-driven like the
+            self.ec_shape = _parse_ec_shape(os.environ["EC_SHAPE"])
+        else:
+            self.ec_shape = EC_CONVERSION_SHAPE
+        k_, m_ = self.ec_shape
+        if k_ < 1 or m_ < 1 or k_ + m_ > 64:
+            raise ValueError(f"invalid EC shape RS({k_},{m_})")
+        #: block_id -> in-flight CONVERT_TO_EC attempt (leader soft state):
+        #: {"ts", "new_id", "targets", "stale": [(new_id, targets), ...]}.
+        #: Re-issued after EC_MIGRATION_RETRY_SECS; each attempt gets a
+        #: UNIQUE new block id so a slow earlier attempt can never mix its
+        #: shard writes into a later attempt's positional layout.
+        self._ec_migrations: dict[str, dict] = {}
         self.liveness_cutoff_ms = liveness_cutoff_ms
         iv = intervals or {}
         self._intervals = {
@@ -153,6 +185,7 @@ class Master:
             "CommitTransaction": self.tx.rpc_commit,
             "AbortTransaction": self.tx.rpc_abort,
             "InquireTransaction": self.tx.rpc_inquire,
+            "CompleteEcConversion": self.rpc_complete_ec_conversion,
             "IngestMetadata": self.rpc_ingest_metadata,
             "InitiateShuffle": self.rpc_initiate_shuffle,
             "StageIngest": self.rpc_stage_ingest,
@@ -1255,6 +1288,102 @@ class Master:
         for addr, cmd in plan.queues:
             self.state.queue_command(addr, cmd)
 
+    def _schedule_ec_migrations(self, path: str, f) -> None:
+        """Queue CONVERT_TO_EC commands for still-replicated blocks of an
+        EC-policy file: one source chunkserver reads its replica, RS-encodes
+        it, distributes one shard per target server under a new block id,
+        then reports back (CompleteEcConversion) for the atomic metadata
+        swap. Issue-tracking is leader soft state with a retry timeout —
+        a lost command or crashed chunkserver just re-issues."""
+        k, m = f.ec_data_shards, f.ec_parity_shards
+        now = time.monotonic()
+        live = set(self.state.live_servers())
+        for b in f.blocks:
+            if b.is_ec or not b.size:
+                continue
+            attempt = self._ec_migrations.get(b.block_id)
+            if attempt is not None and \
+                    now - attempt["ts"] < EC_MIGRATION_RETRY_SECS:
+                continue
+            sources = [loc for loc in b.locations if loc in live]
+            if not sources:
+                continue
+            targets = placement.select_servers_rack_aware(
+                [(a, s) for a, s in self.state.chunk_servers.items()
+                 if a in live],
+                k + m,
+            )
+            if len(set(targets)) < k + m:
+                logger.warning(
+                    "EC migration for %s needs %d live chunkservers, "
+                    "have %d", b.block_id, k + m, len(set(targets)),
+                )
+                continue
+            # Unique id per attempt: a slow superseded attempt writes its
+            # shards under ITS id and can never corrupt the positional
+            # shard layout the committed attempt's metadata points at.
+            new_id = f"{b.block_id}.ec-{uuid.uuid4().hex[:8]}"
+            stale = []
+            if attempt is not None:
+                stale = attempt["stale"] + [
+                    (attempt["new_id"], attempt["targets"])
+                ]
+            self._ec_migrations[b.block_id] = {
+                "ts": now, "new_id": new_id, "targets": targets,
+                "stale": stale,
+            }
+            self.state.queue_command(sources[0], {
+                "type": "CONVERT_TO_EC",
+                "block_id": b.block_id,
+                "new_block_id": new_id,
+                "ec_data_shards": k,
+                "ec_parity_shards": m,
+                "targets": targets,
+                "master_term": self.raft.core.term,
+            })
+            logger.info("tiering: EC data migration of %s scheduled on %s "
+                        "(targets=%s)", b.block_id, sources[0], targets)
+
+    async def rpc_complete_ec_conversion(self, req: dict) -> dict:
+        """Chunkserver reports a finished shard distribution; commit the
+        metadata swap through Raft."""
+        if not self.raft.is_leader:
+            raise RpcError.not_leader(self.raft.leader_hint)
+        found = self.state.find_block(req["block_id"])
+        if found is None:
+            # Either already swapped (the new id resolves) or deleted.
+            if self.state.find_block(req["new_block_id"]) is not None:
+                return {"success": True}
+            raise RpcError.not_found(f"block not found: {req['block_id']}")
+        attempt = self._ec_migrations.get(req["block_id"])
+        if attempt is not None and attempt["new_id"] != req["new_block_id"]:
+            # Fencing: a superseded attempt must not commit — its target
+            # list no longer matches what the current attempt will report.
+            # (After a leader change the soft state is empty and any attempt
+            # is accepted; that is safe because attempt ids are unique.)
+            raise RpcError.failed_precondition(
+                f"conversion attempt {req['new_block_id']} superseded"
+            )
+        f, _block = found
+        await self._propose({
+            "op": "complete_ec_block_conversion",
+            "path": f.path,
+            "block_id": req["block_id"],
+            "new_block_id": req["new_block_id"],
+            "ec_data_shards": int(req["ec_data_shards"]),
+            "ec_parity_shards": int(req["ec_parity_shards"]),
+            "targets": list(req["targets"]),
+        })
+        # GC shards any superseded attempt managed to write.
+        if attempt is not None:
+            for stale_id, stale_targets in attempt["stale"]:
+                for addr in stale_targets:
+                    self.state.queue_command(
+                        addr, {"type": "DELETE", "block_id": stale_id}
+                    )
+        self._ec_migrations.pop(req["block_id"], None)
+        return {"success": True}
+
     async def run_tiering_scan(self) -> None:
         """Mark cold files + schedule EC policy conversion
         (reference scan_tiering master.rs:1933-2013, scan_ec_conversion
@@ -1277,7 +1406,7 @@ class Master:
                     logger.warning("tiering move failed for %s: %s", path, e)
             elif f.moved_to_cold_at_ms and not f.ec_data_shards and \
                     at - f.moved_to_cold_at_ms >= self.ec_threshold_ms:
-                k, m = EC_CONVERSION_SHAPE
+                k, m = self.ec_shape
                 try:
                     await self.raft.propose({
                         "op": "convert_to_ec", "path": path,
@@ -1286,3 +1415,8 @@ class Master:
                     logger.info("tiering: EC policy conversion for %s", path)
                 except (NotLeaderError, ValueError) as e:
                     logger.warning("EC conversion failed for %s: %s", path, e)
+            elif f.ec_data_shards:
+                # Policy already EC: migrate any block still replicated —
+                # the DATA half of the conversion, which the reference
+                # leaves TODO (master.rs:2108-2118).
+                self._schedule_ec_migrations(path, f)

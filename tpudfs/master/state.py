@@ -377,6 +377,39 @@ class MasterState:
         f.ec_parity_shards = int(cmd["ec_parity_shards"])
         return {"success": True}
 
+    def _apply_complete_ec_block_conversion(self, cmd: dict):
+        """Atomic metadata swap after a chunkserver distributed a block's RS
+        shards (CONVERT_TO_EC command). This implements the data migration
+        the reference leaves TODO (master.rs:2108-2118): the EC copy lives
+        under a NEW block id, so until this command commits the replicated
+        copy stays fully readable, and a crash anywhere re-runs the
+        (idempotent) conversion. Old replicas are queued for deletion only
+        after the swap is in the replicated log.
+        """
+        f = self.files.get(cmd["path"])
+        if f is None:
+            raise ValueError(f"file not found: {cmd['path']}")
+        for b in f.blocks:
+            if b.block_id == cmd["new_block_id"] and b.is_ec:
+                return {"success": True}  # duplicate completion
+            if b.block_id == cmd["block_id"]:
+                if b.is_ec:
+                    raise ValueError(
+                        f"block {b.block_id} already erasure-coded"
+                    )
+                old_locations = list(b.locations)
+                b.block_id = cmd["new_block_id"]
+                b.ec_data_shards = int(cmd["ec_data_shards"])
+                b.ec_parity_shards = int(cmd["ec_parity_shards"])
+                b.original_size = b.size
+                b.locations = list(cmd["targets"])
+                for loc in old_locations:
+                    self.queue_command(
+                        loc, {"type": "DELETE", "block_id": cmd["block_id"]}
+                    )
+                return {"success": True}
+        raise ValueError(f"block not found: {cmd['block_id']}")
+
     def _apply_mark_block_locations(self, cmd: dict):
         """Healer/balancer result: replace a block's location set."""
         found = self.find_block(cmd["block_id"])
