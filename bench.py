@@ -139,6 +139,31 @@ def _spawn_cluster(root: str, cache_blocks: int = CS_CACHE_BLOCKS):
     return maddr, cs_addrs, procs
 
 
+def _bench_ec_scatter_step(device) -> float:
+    """On-chip RS(6,3) encode + shard scatter + CRC-verify round
+    (replication-degenerate ring on 1 device; multi-device layout is
+    validated by dryrun_multichip)."""
+    import jax
+
+    from tpudfs.tpu.crc32c_pallas import bytes_to_words
+    from tpudfs.tpu.ici_replication import EcShardScatter, make_mesh
+
+    mesh = make_mesh([device])
+    scatter = EcShardScatter(mesh, 6, 3)
+    nbytes = ICI_STEP_MB << 20
+    data = np.random.default_rng(9).integers(
+        0, 256, nbytes, dtype=np.uint8
+    ).tobytes()
+    words = jax.device_put(bytes_to_words(data), device)
+    jax.block_until_ready(scatter.scatter(words))  # compile + warm up
+    t0 = time.perf_counter()
+    outs = [scatter.scatter(words) for _ in range(ICI_REPS)]
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    assert all(int(acks) == 1 for _, _, acks in outs)
+    return nbytes * ICI_REPS / dt / 1e9
+
+
 async def _run() -> dict:
     import tempfile
 
@@ -227,6 +252,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
 
     raw = _bench_raw_infeed(device, len(data), 32)
     ici_write = _bench_ici_write_step(device)
+    ec_scatter = _bench_ec_scatter_step(device)
 
     await rpc.close()
 
@@ -241,6 +267,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "vs_baseline": round(achieved / target, 3) if target else 0.0,
         "write_pipeline_GBps": round(write_gbps, 3),
         "ici_write_GBps": round(ici_write, 3),
+        "ici_ec_scatter_GBps": round(ec_scatter, 3),
         "raw_infeed_GBps": round(raw, 3),
         "files": FILES,
         "cs_cache_hit_rate": round(

@@ -202,3 +202,66 @@ async def test_superseded_conversion_attempt_fenced(tmp_path):
         assert len(queued) == len(attempt1["targets"])
     finally:
         await c.stop()
+
+
+async def test_delete_mid_migration_gcs_orphan_shards(tmp_path):
+    # Deleting a file while its conversion is in flight must not strand the
+    # attempt's shards on the target stores or leak leader tracking state.
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=3,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(2, 1),
+        intervals={"tiering": 3600},
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/cold/d.bin", _rand(10_000, seed=4))
+        for hb in c.heartbeats:
+            hb.stop()
+        await leader.run_tiering_scan()
+        await leader.run_tiering_scan()
+        await leader.run_tiering_scan()  # attempt scheduled
+        meta = await client.get_file_info("/cold/d.bin")
+        bid = meta["blocks"][0]["block_id"]
+        attempt = dict(leader._ec_migrations[bid])
+        await client.delete_file("/cold/d.bin")
+
+        # Path A: a late completion report for the deleted file — rejected,
+        # and the reported shards queued for deletion.
+        import pytest as _pytest
+
+        from tpudfs.common.rpc import RpcError
+
+        with _pytest.raises(RpcError):
+            await leader.rpc_complete_ec_conversion({
+                "block_id": bid,
+                "new_block_id": attempt["new_id"],
+                "ec_data_shards": 2, "ec_parity_shards": 1,
+                "targets": attempt["targets"],
+            })
+        assert bid not in leader._ec_migrations
+        deletes = [
+            cmd for addr in attempt["targets"]
+            for cmd in leader.state.pending_commands.get(addr, [])
+            if cmd.get("type") == "DELETE"
+            and cmd.get("block_id") == attempt["new_id"]
+        ]
+        assert len(deletes) == len(attempt["targets"])
+
+        # Path B: no completion ever arrives — the tiering sweep drops the
+        # tracking entry of a vanished block.
+        await client.create_file("/cold/e.bin", _rand(10_000, seed=5))
+        await leader.run_tiering_scan()
+        await leader.run_tiering_scan()
+        await leader.run_tiering_scan()
+        meta = await client.get_file_info("/cold/e.bin")
+        bid2 = meta["blocks"][0]["block_id"]
+        assert bid2 in leader._ec_migrations
+        await client.delete_file("/cold/e.bin")
+        await leader.run_tiering_scan()
+        assert bid2 not in leader._ec_migrations
+    finally:
+        await c.stop()

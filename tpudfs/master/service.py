@@ -1344,6 +1344,38 @@ class Master:
             logger.info("tiering: EC data migration of %s scheduled on %s "
                         "(targets=%s)", b.block_id, sources[0], targets)
 
+    def _gc_ec_attempt(self, block_id: str, new_id: str,
+                       targets: list[str]) -> None:
+        """Delete the shards a dead conversion attempt wrote (file deleted
+        mid-migration / attempt superseded across a leader change) and drop
+        its tracking entry."""
+        for addr in targets:
+            self.state.queue_command(
+                addr, {"type": "DELETE", "block_id": new_id}
+            )
+        attempt = self._ec_migrations.pop(block_id, None)
+        if attempt is not None:
+            stale = attempt["stale"] + [
+                (attempt["new_id"], attempt["targets"])
+            ]
+            for stale_id, stale_targets in stale:
+                if stale_id == new_id:
+                    continue
+                for addr in stale_targets:
+                    self.state.queue_command(
+                        addr, {"type": "DELETE", "block_id": stale_id}
+                    )
+
+    def _sweep_dead_ec_migrations(self) -> None:
+        """Drop tracking (and GC issued shards) for migrations whose source
+        block vanished — e.g. the file was deleted before any completion
+        report arrived, so no RPC path ever cleans the entry."""
+        for block_id in list(self._ec_migrations):
+            if self.state.find_block(block_id) is None:
+                attempt = self._ec_migrations[block_id]
+                self._gc_ec_attempt(block_id, attempt["new_id"],
+                                    attempt["targets"])
+
     async def rpc_complete_ec_conversion(self, req: dict) -> dict:
         """Chunkserver reports a finished shard distribution; commit the
         metadata swap through Raft."""
@@ -1351,9 +1383,15 @@ class Master:
             raise RpcError.not_leader(self.raft.leader_hint)
         found = self.state.find_block(req["block_id"])
         if found is None:
-            # Either already swapped (the new id resolves) or deleted.
+            # Already swapped (the new id resolves) — duplicate completion.
             if self.state.find_block(req["new_block_id"]) is not None:
                 return {"success": True}
+            # Otherwise the file was deleted mid-migration, or another
+            # attempt won after a leader change: the shards THIS attempt
+            # wrote are orphans — queue their deletion before failing, or
+            # they live on the target stores forever.
+            self._gc_ec_attempt(req["block_id"], req["new_block_id"],
+                                req.get("targets") or [])
             raise RpcError.not_found(f"block not found: {req['block_id']}")
         attempt = self._ec_migrations.get(req["block_id"])
         if attempt is not None and attempt["new_id"] != req["new_block_id"]:
@@ -1390,6 +1428,7 @@ class Master:
         master.rs:2016-2138)."""
         if not self.raft.is_leader:
             return
+        self._sweep_dead_ec_migrations()
         at = now_ms()
         for path, f in list(self.state.files.items()):
             if not f.complete:

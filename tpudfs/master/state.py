@@ -386,6 +386,7 @@ class MasterState:
         (idempotent) conversion. Old replicas are queued for deletion only
         after the swap is in the replicated log.
         """
+        self.check_not_migrating(cmd["path"])
         f = self.files.get(cmd["path"])
         if f is None:
             raise ValueError(f"file not found: {cmd['path']}")

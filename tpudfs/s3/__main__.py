@@ -1,3 +1,4 @@
 from tpudfs.s3.server import main
 
-main()
+if __name__ == "__main__":
+    main()

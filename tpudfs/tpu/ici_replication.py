@@ -123,6 +123,88 @@ def _parity_of_words(words: jnp.ndarray, k: int, m: int) -> jnp.ndarray:
     return rs_encode_device(flat.reshape(k, shard), k, m)
 
 
+class EcShardScatter:
+    """RS(k,m) shard distribution over ICI — the device twin of the
+    storage-tier CONVERT_TO_EC migration (tpudfs/master:
+    _schedule_ec_migrations / chunkserver convert_block_to_ec, which move
+    shards host-to-host over gRPC).
+
+    Each host RS-encodes its local chunk batch into k+m shards on device
+    (Pallas GF(2^8) kernel), then shard j rides a ``ppermute`` ring shift
+    of offset j: device d ends up holding shard j of host (d - j) mod n —
+    the positional round-robin layout the master's rack-aware placement
+    produces, with every transfer scheduled by XLA on ICI links. Every
+    received shard is CRC-verified on device against the sender's
+    per-chunk CRCs (which travel on the same ring), and the ack count is
+    a ``psum`` — one collective round converts a whole batch of blocks,
+    versus (k+m) gRPC hops per block on the host path.
+    """
+
+    def __init__(self, mesh: Mesh, k: int, m: int, axis: str | None = None):
+        n = mesh.devices.size
+        if n > 1 and k + m > n:
+            raise ValueError(f"RS({k},{m}) scatter needs {k + m} devices, "
+                             f"mesh has {n}")
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.k, self.m = k, m
+        self._fn = self._build()
+
+    def _build(self):
+        axis, k, m = self.axis, self.k, self.m
+        mesh = self.mesh
+        n = mesh.devices.size
+
+        def step(local_words: jnp.ndarray):
+            # local_words: (C, 128) uint32 — this host's block batch.
+            C = local_words.shape[0]
+            total = C * WORDS_PER_CHUNK * 4
+            # Shard length padded to a 512-byte multiple so per-shard CRC
+            # chunking stays lane-aligned (512 is a multiple of the RS
+            # kernel's 128-byte lane requirement).
+            per = -(-total // k)          # ceil bytes per data shard
+            shard = -(-per // 512) * 512  # …rounded up to whole 512B chunks
+            flat = jax.lax.bitcast_convert_type(
+                local_words, jnp.uint8
+            ).reshape(-1)
+            flat = jnp.pad(flat, (0, k * shard - total))
+            data = flat.reshape(k, shard)
+            from tpudfs.tpu.rs_pallas import rs_encode_device
+
+            parity = rs_encode_device(data, k, m)
+            shards = jnp.concatenate([data, parity])  # (k+m, shard)
+            # Per-chunk CRCs of every shard, computed on the SENDER.
+            swords = jax.lax.bitcast_convert_type(
+                shards.reshape(k + m, -1, 4), jnp.uint32
+            ).reshape(k + m, -1, WORDS_PER_CHUNK)
+            sent_crcs = jax.vmap(crc32c_chunks_device)(swords)  # (k+m, C')
+            received = []
+            recv_crcs = []
+            for j in range(k + m):
+                perm = [(i, (i + j) % n) for i in range(n)]
+                received.append(jax.lax.ppermute(swords[j], axis, perm))
+                recv_crcs.append(jax.lax.ppermute(sent_crcs[j], axis, perm))
+            stacked = jnp.stack(received)        # (k+m, C', 128)
+            expected = jnp.stack(recv_crcs)      # (k+m, C')
+            actual = jax.vmap(crc32c_chunks_device)(stacked)
+            ok = jnp.all(actual == expected)
+            acks = jax.lax.psum(ok.astype(jnp.int32), axis)
+            return stacked, ok[None], acks
+
+        spec = P(self.axis)
+        return jax.jit(shard_map(
+            step, mesh=mesh, in_specs=(spec,),
+            out_specs=(spec, spec, P()), check_vma=False,
+        ))
+
+    def scatter(self, words: jax.Array):
+        """words: (n*C, 128) uint32 sharded over the mesh axis. Returns
+        (shards, ok, acks): shards (n*(k+m), C', 128) — device d's group
+        holds shard j of host (d - j) mod n at row j — per-host verify
+        bit, and the psum'd ack count."""
+        return self._fn(words)
+
+
 def replicated_write_step(mesh: Mesh, replication: int = 3,
                           ec: tuple[int, int] | None = None):
     """The full distributed data-plane step used by ``dryrun_multichip``:
