@@ -99,6 +99,9 @@ async def test_follower_redirect_transparent(tmp_path):
 async def test_hedged_read_slow_primary(tmp_path):
     c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
     try:
+        # Hedging lives on the RPC path; short-circuit would serve the
+        # bytes off disk and never exercise it.
+        client.local_reads = False
         data = _rand(30_000, 3)
         await client.create_file("/h/f", data)
         meta = await client.get_file_info("/h/f")
@@ -155,5 +158,67 @@ async def test_workload_history_linearizable(tmp_path):
         assert len(entries) >= 24
         result = check_linearizability(entries)
         assert result.linearizable, result.message
+    finally:
+        await c.stop()
+
+
+# ------------------------------------------------ short-circuit local reads
+
+
+async def test_short_circuit_local_reads(tmp_path):
+    c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
+    try:
+        data = _rand(300_000, 31)
+        await client.create_file("/sc/a.bin", data)
+        assert client.local_read_blocks == 0
+        assert await client.get_file("/sc/a.bin") == data
+        # MiniCluster chunkservers share this filesystem, so every block
+        # was served off disk, not through ReadBlock RPCs.
+        assert client.local_read_blocks == len(
+            (await client.get_file_info("/sc/a.bin"))["blocks"]
+        )
+        for cs in c.chunkservers:
+            assert cs.cache.hits == 0 and cs.cache.misses == 0
+
+        # Range reads short-circuit too, with chunk-level verification.
+        n0 = client.local_read_blocks
+        assert await client.read_file_range("/sc/a.bin", 70_000, 123) == \
+            data[70_000:70_123]
+        assert client.local_read_blocks > n0
+    finally:
+        await c.stop()
+
+
+async def test_short_circuit_corruption_falls_back_and_detects(tmp_path):
+    c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
+    try:
+        data = _rand(40_000, 32)
+        await client.create_file("/sc/bad.bin", data)
+        meta = await client.get_file_info("/sc/bad.bin")
+        bid = meta["blocks"][0]["block_id"]
+        # Corrupt ONE replica's bytes on disk (sidecar left stale, so the
+        # short-circuit verified read refuses it and falls back to RPC,
+        # which serves a healthy replica).
+        victim = next(cs for cs in c.chunkservers if cs.store.exists(bid))
+        path = victim.store.block_path(bid)
+        raw = bytearray(path.read_bytes())
+        raw[100] ^= 0xFF
+        path.write_bytes(bytes(raw))
+        victim.cache.invalidate(bid)
+        assert await client.get_file("/sc/bad.bin") == data
+    finally:
+        await c.stop()
+
+
+async def test_short_circuit_disabled(tmp_path):
+    c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
+    try:
+        client.local_reads = False
+        data = _rand(50_000, 33)
+        await client.create_file("/sc/rpc.bin", data)
+        assert await client.get_file("/sc/rpc.bin") == data
+        assert client.local_read_blocks == 0
+        assert sum(cs.cache.misses + cs.cache.hits
+                   for cs in c.chunkservers) > 0  # RPC path exercised
     finally:
         await c.stop()

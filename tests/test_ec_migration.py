@@ -265,3 +265,91 @@ async def test_delete_mid_migration_gcs_orphan_shards(tmp_path):
         assert bid2 not in leader._ec_migrations
     finally:
         await c.stop()
+
+
+async def test_healer_reconstructs_migrated_shard(tmp_path):
+    # Blocks produced by the migration must flow into the SAME healing
+    # machinery as client-written EC blocks: lose a shard holder and the
+    # healer schedules RECONSTRUCT_EC_SHARD from the surviving shards.
+    data = _rand(100_000, seed=6)
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=4,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(2, 1),
+        intervals={"tiering": 0.3, "liveness": 0.3, "healer": 0.5},
+        liveness_cutoff_ms=1500,
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/cold/h.bin", data)
+        meta = await _converted(client, "/cold/h.bin")
+
+        # Kill one shard holder of the first block (stop its heartbeat AND
+        # its RPC server so the healer must re-place the shard).
+        victim_addr = meta["blocks"][0]["locations"][1]
+        idx = next(i for i, cs in enumerate(c.chunkservers)
+                   if cs.address == victim_addr)
+        c.heartbeats[idx].stop()
+        await c.chunkservers[idx].stop()
+
+        # Healer re-places the lost shard on a live CS and the master
+        # updates that block's location slot.
+        deadline = asyncio.get_event_loop().time() + 30
+        while asyncio.get_event_loop().time() < deadline:
+            meta2 = await client.get_file_info("/cold/h.bin")
+            locs = meta2["blocks"][0]["locations"]
+            if victim_addr not in locs and all(locs):
+                break
+            await asyncio.sleep(0.3)
+        assert victim_addr not in locs and all(locs), locs
+        assert await client.get_file("/cold/h.bin") == data
+    finally:
+        await c.stop()
+
+
+async def test_sweep_never_gcs_committed_swap(tmp_path):
+    # The periodic sweep can observe the moment after a swap committed but
+    # before the completion handler popped its tracking entry; it must GC
+    # only superseded attempts, never the committed attempt's live shards.
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=3,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(2, 1),
+        intervals={"tiering": 3600},
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/cold/s.bin", _rand(10_000, seed=7))
+        for hb in c.heartbeats:
+            hb.stop()
+        await leader.run_tiering_scan()
+        await leader.run_tiering_scan()
+        await leader.run_tiering_scan()  # attempt scheduled
+        meta = await client.get_file_info("/cold/s.bin")
+        bid = meta["blocks"][0]["block_id"]
+        attempt = dict(leader._ec_migrations[bid])
+        # Commit the swap directly (as the completion handler's propose
+        # does), leaving the tracking entry in place — the race window.
+        await leader.raft.propose({
+            "op": "complete_ec_block_conversion",
+            "path": "/cold/s.bin",
+            "block_id": bid,
+            "new_block_id": attempt["new_id"],
+            "ec_data_shards": 2, "ec_parity_shards": 1,
+            "targets": attempt["targets"],
+        })
+        leader._sweep_dead_ec_migrations()
+        assert bid not in leader._ec_migrations  # entry cleaned up
+        # No DELETE of the committed attempt's shards was queued.
+        for addr in attempt["targets"]:
+            for cmd in leader.state.pending_commands.get(addr, []):
+                assert not (cmd.get("type") == "DELETE" and
+                            cmd.get("block_id") == attempt["new_id"]), cmd
+    finally:
+        await c.stop()

@@ -308,8 +308,9 @@ class BlockStore:
         for d in filter(None, (self.hot_dir, self.cold_dir)):
             for p in d.iterdir():
                 name = p.name
-                if name.endswith(".meta") or name.endswith(".tmp"):
-                    continue
+                if name.endswith(".meta") or name.endswith(".tmp") or \
+                        name.startswith("."):
+                    continue  # sidecars, temps, control dirs (.sc probes)
                 out.add(name)
         return sorted(out)
 
@@ -320,7 +321,8 @@ class BlockStore:
         count = 0
         for d in filter(None, (self.hot_dir, self.cold_dir)):
             for p in d.iterdir():
-                if p.name.endswith(".meta") or p.name.endswith(".tmp"):
+                if p.name.endswith(".meta") or p.name.endswith(".tmp") or \
+                        p.name.startswith("."):
                     continue
                 try:
                     used += p.stat().st_size

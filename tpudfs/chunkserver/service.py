@@ -114,7 +114,44 @@ class ChunkServer:
             "WriteBlock": self.rpc_write_block,
             "ReadBlock": self.rpc_read_block,
             "ReplicateBlock": self.rpc_replicate_block,
+            "LocalAccess": self.rpc_local_access,
             "Stats": self.rpc_stats,
+        }
+
+    async def rpc_local_access(self, req: dict) -> dict:
+        """Short-circuit local-read handshake (the HDFS short-circuit idea,
+        filesystem-probe flavored; the reference has no equivalent). The
+        chunkserver writes the caller's nonce under ``<hot>/.sc/``; a client
+        that can read that file back shares this host's filesystem — the
+        north-star topology colocates chunkservers on the TPU hosts — and
+        may pread blocks directly with sidecar verification instead of
+        pulling every byte through gRPC."""
+        nonce = str(req.get("nonce") or "")
+        if not nonce.isalnum() or not (8 <= len(nonce) <= 64):
+            raise RpcError.invalid("bad short-circuit nonce")
+        probe_dir = self.store.hot_dir / ".sc"
+
+        def write_probe() -> str:
+            probe_dir.mkdir(exist_ok=True)
+            # Opportunistic GC of probes older than an hour.
+            import time as _time
+
+            cutoff = _time.time() - 3600
+            for p in probe_dir.iterdir():
+                try:
+                    if p.stat().st_mtime < cutoff:
+                        p.unlink()
+                except OSError:
+                    pass
+            path = probe_dir / nonce
+            path.write_bytes(nonce.encode())
+            return str(path)
+
+        probe = await asyncio.to_thread(write_probe)
+        return {
+            "hot_dir": str(self.store.hot_dir),
+            "cold_dir": str(self.store.cold_dir or ""),
+            "probe": probe,
         }
 
     async def start(self, host: str = "127.0.0.1", port: int = 0,

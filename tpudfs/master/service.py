@@ -1371,10 +1371,23 @@ class Master:
         block vanished — e.g. the file was deleted before any completion
         report arrived, so no RPC path ever cleans the entry."""
         for block_id in list(self._ec_migrations):
-            if self.state.find_block(block_id) is None:
-                attempt = self._ec_migrations[block_id]
-                self._gc_ec_attempt(block_id, attempt["new_id"],
-                                    attempt["targets"])
+            if self.state.find_block(block_id) is not None:
+                continue
+            attempt = self._ec_migrations[block_id]
+            if self.state.find_block(attempt["new_id"]) is not None:
+                # The swap COMMITTED and the completion handler's pop is
+                # still in flight (its propose yielded) or was lost to a
+                # restart. The new_id shards are live data — GC only the
+                # superseded attempts, never the committed one.
+                self._ec_migrations.pop(block_id, None)
+                for stale_id, stale_targets in attempt["stale"]:
+                    for addr in stale_targets:
+                        self.state.queue_command(
+                            addr, {"type": "DELETE", "block_id": stale_id}
+                        )
+                continue
+            self._gc_ec_attempt(block_id, attempt["new_id"],
+                                attempt["targets"])
 
     async def rpc_complete_ec_conversion(self, req: dict) -> dict:
         """Chunkserver reports a finished shard distribution; commit the
