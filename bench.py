@@ -274,6 +274,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
             all_blocks.extend(blocks)
             return sum(b.size for b in blocks)
 
+    local_before = client.local_read_blocks
     t0 = time.perf_counter()
     sizes = await asyncio.gather(*(read_one(i) for i in range(FILES)))
     await reader.confirm(all_blocks)
@@ -281,7 +282,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     total = sum(sizes)
     achieved = total / wall / 1e9
     assert all(b.verified for b in all_blocks)
-    local_blocks = client.local_read_blocks
+    local_blocks = client.local_read_blocks - local_before
     await reader.confirm(grpc_blocks + warm)
     assert all(b.verified for b in grpc_blocks)
 

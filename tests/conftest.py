@@ -26,6 +26,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Short-circuit local reads default OFF in tests: every MiniCluster
+# chunkserver shares the test host's filesystem, so the fast path would
+# silently reroute reads off disk and bypass the RPC machinery that
+# chaos/failover/cache tests exist to exercise. Short-circuit tests opt in
+# with Client(..., local_reads=True).
+os.environ.setdefault("TPUDFS_LOCAL_READS", "0")
+
 
 def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj

@@ -168,6 +168,7 @@ async def test_workload_history_linearizable(tmp_path):
 async def test_short_circuit_local_reads(tmp_path):
     c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
     try:
+        client.local_reads = True
         data = _rand(300_000, 31)
         await client.create_file("/sc/a.bin", data)
         assert client.local_read_blocks == 0
@@ -192,6 +193,7 @@ async def test_short_circuit_local_reads(tmp_path):
 async def test_short_circuit_corruption_falls_back_and_detects(tmp_path):
     c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
     try:
+        client.local_reads = True
         data = _rand(40_000, 32)
         await client.create_file("/sc/bad.bin", data)
         meta = await client.get_file_info("/sc/bad.bin")

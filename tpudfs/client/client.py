@@ -102,7 +102,9 @@ class Client:
         if local_reads is None:
             local_reads = os.environ.get("TPUDFS_LOCAL_READS", "1") != "0"
         self.local_reads = local_reads
-        self._local_stores: dict[str, object | None] = {}
+        #: addr -> (BlockStore|None, retry_at|None): conclusive probes are
+        #: cached forever; transport failures carry a retry deadline.
+        self._local_stores: dict[str, tuple[object | None, float | None]] = {}
         self._local_probe_lock = asyncio.Lock()
         #: Blocks served via the short-circuit path (observability/tests).
         self.local_read_blocks = 0
@@ -115,31 +117,47 @@ class Client:
         None (cached either way)."""
         if not self.local_reads:
             return None
-        if addr in self._local_stores:
-            return self._local_stores[addr]
+        cached = self._local_stores.get(addr)
+        if cached is not None:
+            store, retry_at = cached
+            if store is not None or retry_at is None or \
+                    asyncio.get_event_loop().time() < retry_at:
+                return store
         async with self._local_probe_lock:  # no handshake stampede
-            if addr in self._local_stores:
-                return self._local_stores[addr]
+            cached = self._local_stores.get(addr)
+            if cached is not None:
+                store, retry_at = cached
+                if store is not None or retry_at is None or \
+                        asyncio.get_event_loop().time() < retry_at:
+                    return store
             store = None
+            retry_at = None
             try:
                 nonce = uuid.uuid4().hex
                 resp = await self.rpc.call(
                     self._dial(addr), CS, "LocalAccess", {"nonce": nonce},
-                    timeout=5.0,
+                    timeout=1.5,
                 )
             except RpcError as e:
-                # Transport errors / restarting server: don't cache — a
-                # transient failure must not disable the fast path for the
-                # process lifetime. (Servers predating the RPC answer
-                # UNIMPLEMENTED, which also retries harmlessly.)
+                # Transport errors / restarting / pre-feature servers: a
+                # transient failure must not disable the fast path forever,
+                # but re-probing on EVERY read would put a timeout-sized
+                # stall ahead of the hedged RPC path whenever a replica is
+                # down — negative-cache with an expiry instead.
                 logger.debug("short-circuit probe of %s failed: %s",
                              addr, e.message)
+                self._local_stores[addr] = (
+                    None, asyncio.get_event_loop().time() + 30.0
+                )
                 return None
             probe = Path(resp["probe"])
             same_fs = False
             try:
+                # Never unlink: the path is server-supplied, and deleting
+                # it would hand a hostile server an arbitrary-file-delete
+                # primitive on this host. The chunkserver GCs its own
+                # probe files.
                 same_fs = probe.read_bytes() == nonce.encode()
-                probe.unlink()
             except OSError:
                 pass
             if same_fs:
@@ -147,8 +165,8 @@ class Client:
 
                 store = BlockStore(resp["hot_dir"],
                                    resp["cold_dir"] or None)
-            # A conclusive probe (shared or not) is cached either way.
-            self._local_stores[addr] = store
+            # A conclusive probe (shared or not) is cached permanently.
+            self._local_stores[addr] = (store, retry_at)
             return store
 
     async def _read_local(self, addr: str, block_id: str, offset: int,

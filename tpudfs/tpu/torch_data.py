@@ -1,0 +1,80 @@
+"""PyTorch interop: DFS files as a ``torch.utils.data.Dataset``.
+
+The reference proves third-party compute-stack integration through Spark
+reading Parquet over s3a (test_scripts/spark-s3-test/spark_s3_test.py). The
+JAX-native path here is the Grain infeed (tpudfs/tpu/grain_infeed.py); this
+module covers the other major training ecosystem: ``DfsTorchDataset`` wraps
+the same ``DfsRecordSource`` (byte-range fetches over the DFS client, with
+short-circuit local reads when colocated) as a map-style torch Dataset, so
+a standard ``DataLoader`` — shuffling, batching, pinned memory — trains
+straight off DFS files with zero staging copies to an intermediate store.
+
+Pickling for DataLoader worker processes is inherited from
+DfsRecordSource (the client/event-loop is re-created lazily per process).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from typing import Any
+
+from tpudfs.tpu.grain_infeed import DfsRecordSource
+
+try:
+    import torch
+    from torch.utils.data import Dataset
+
+    _HAVE_TORCH = True
+except Exception:  # pragma: no cover - torch is installed in this image
+    torch = None
+
+    class Dataset:  # type: ignore[no-redef]
+        pass
+
+    _HAVE_TORCH = False
+
+
+class DfsTorchDataset(Dataset):
+    """Map-style dataset of fixed-size records stored in DFS files.
+
+    ``transform`` maps the raw numpy record to the sample a model consumes
+    (e.g. split features/label, reshape an image); by default records come
+    back as torch tensors of the source dtype.
+    """
+
+    def __init__(
+        self,
+        master_addrs: Sequence[str],
+        paths: Sequence[str],
+        record_bytes: int,
+        dtype: str = "uint8",
+        transform: Callable[[Any], Any] | None = None,
+        client_kwargs: dict | None = None,
+    ):
+        if not _HAVE_TORCH:
+            raise RuntimeError("torch is not installed")
+        self.source = DfsRecordSource(
+            master_addrs, paths, record_bytes, dtype=dtype,
+            client_kwargs=client_kwargs,
+        )
+        self.transform = transform
+
+    def __len__(self) -> int:
+        return len(self.source)
+
+    def __getitem__(self, idx: int):
+        record = self.source[idx]
+        if self.transform is not None:
+            return self.transform(record)
+        # .copy(): frombuffer memory is read-only; torch wants writable.
+        return torch.from_numpy(record.copy())
+
+    def close(self) -> None:
+        self.source.close()
+
+    def __getstate__(self):
+        return {"source": self.source, "transform": self.transform}
+
+    def __setstate__(self, state):
+        self.source = state["source"]
+        self.transform = state["transform"]
