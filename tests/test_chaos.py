@@ -163,18 +163,20 @@ async def test_follower_isolation_and_heal_via_netem(tmp_path):
                             if not m.raft.is_leader)
 
         # Blackhole the follower's inbound side: it stops hearing
-        # heartbeats and campaigns with an inflated term. (RPC responses
-        # ride the connections it initiates, so — unlike a symmetric
-        # partition — it may even win; either way the cluster must stay
-        # available and converge after the heal.)
+        # heartbeats and times out. With pre-vote (Raft §9.6 — an
+        # improvement over the reference, whose isolated node campaigns
+        # with ever-inflating terms) it only POLLS: the majority still
+        # hears the leader and refuses, so the loner's term must NOT grow
+        # and the cluster keeps serving undisturbed.
         proxies[follower_idx].partition()
         isolated = masters[follower_idx]
-        await _wait(lambda: isolated.raft.core.term > term_before,
-                    timeout=10.0, msg="isolated follower to campaign")
         await propose_any({
             "op": "create_file", "path": "/during-partition",
             "created_at_ms": 1, "ec_data_shards": 0, "ec_parity_shards": 0,
         })
+        await asyncio.sleep(FAST_RAFT.election_max * 4)  # many timeouts
+        assert isolated.raft.core.term == term_before, \
+            f"pre-vote failed to contain the loner: term {isolated.raft.core.term}"
 
         proxies[follower_idx].heal()
         await _wait(

@@ -127,3 +127,131 @@ def test_crashed_majority_blocks_then_recovers():
     vals = [x["v"] for x in c.committed_commands(lead.node_id)
             if isinstance(x, dict) and "v" in x]
     assert vals == ["before", "after"]
+
+
+def test_prevote_blocks_disruptive_server():
+    """Raft §9.6 (beyond the reference): a node isolated for a long time
+    must NOT inflate its term — with pre-vote its real election never
+    starts, so when the partition heals the healthy leader keeps leading
+    without being deposed by a higher stale term."""
+    c = SimCluster(3, seed=11)
+    c.run(5.0)
+    leader = c.leader()
+    assert leader is not None
+    term_before = leader.core.term
+    loner = next(n for n in c.ids if n != leader.node_id)
+
+    # Isolate one follower for many election timeouts.
+    others = [n for n in c.ids if n != loner]
+    c.partition(others, [loner])
+    c.run(20.0)
+    lone = c.nodes[loner]
+    assert lone.core.term == term_before, \
+        f"isolated node inflated its term to {lone.core.term}"
+    assert lone.core.role is not Role.LEADER
+
+    # Heal: leadership and term are UNDISTURBED (without pre-vote the healed
+    # node's inflated term would depose the leader at least once).
+    stepdowns_before = c.nodes[leader.node_id].stepdowns
+    c.heal()
+    c.run(5.0)
+    assert c.leader() is not None
+    assert c.leader().node_id == leader.node_id
+    assert c.leader().core.term == term_before
+    assert c.nodes[leader.node_id].stepdowns == stepdowns_before
+
+
+def test_prevote_still_elects_after_leader_death():
+    """Pre-vote must not cost liveness: kill the leader and a new one rises
+    (one pre-vote round + one election)."""
+    c = SimCluster(3, seed=12)
+    c.run(5.0)
+    leader = c.leader()
+    assert leader is not None
+    c.crash(leader.node_id)
+    c.run(5.0)
+    survivors = [n for n in c.nodes.values()
+                 if n.alive and n.core.role is Role.LEADER]
+    assert len(survivors) == 1
+    assert survivors[0].core.term > leader.core.term
+
+
+def test_prevote_denied_while_leader_alive():
+    """A node that merely has a noisy link (briefly misses heartbeats) polls
+    a pre-vote; peers still hearing the leader refuse, and no election
+    happens — terms stay put."""
+    c = SimCluster(3, seed=13)
+    c.run(5.0)
+    leader = c.leader()
+    assert leader is not None
+    term = leader.core.term
+    follower = next(n for n in c.ids if n != leader.node_id)
+    # Force an immediate timeout on one follower while everyone is healthy.
+    c.nodes[follower].core._election_deadline = c.now
+    c.run(3.0)
+    assert c.leader() is not None and c.leader().node_id == leader.node_id
+    assert c.leader().core.term == term
+
+
+def test_prevote_candidate_reverts_on_timeout():
+    """A candidate partitioned mid-election must NOT keep bumping its term:
+    on the next timeout it steps back through pre-vote (etcd's
+    pre-candidate), which its isolation cannot win."""
+    c = SimCluster(3, seed=14)
+    c.run(5.0)
+    leader = c.leader()
+    assert leader is not None
+    loner_id = next(n for n in c.ids if n != leader.node_id)
+    lone = c.nodes[loner_id]
+
+    # Force the loner into a real election while already isolated: its
+    # pre-vote succeeded moments before the partition closed around it.
+    others = [n for n in c.ids if n != loner_id]
+    c.partition(others, [loner_id])
+    lone.core._prevote_term = None
+    c._process_effects(lone, lone.core._start_election(c.now))
+    term_after_one_bump = lone.core.term
+    assert lone.core.role is Role.CANDIDATE
+
+    c.run(20.0)  # many timeouts while partitioned
+    assert lone.core.term == term_after_one_bump, \
+        f"candidate kept inflating: {lone.core.term}"
+
+    # Heal: the loner's single extra term may win one election at most;
+    # the cluster converges to one leader and stays there.
+    c.heal()
+    c.run(5.0)
+    assert c.leader() is not None
+
+
+def test_prevote_round_aborted_by_leader_contact():
+    """A late heartbeat from the live leader must cancel an open pre-vote
+    round — otherwise stale grants arriving afterwards would spring a
+    term-bumping election on a healthy leader."""
+    import random as _random
+
+    from tpudfs.raft.core import Config, RaftCore, Send
+
+    FAST = __import__("tests.raft_sim", fromlist=["FAST"]).FAST
+    core = RaftCore("f", Config(voters=frozenset(["f", "a", "b"])),
+                    term=3, timings=FAST, rng=_random.Random(1))
+    # Election timeout fires: a pre-vote round opens for term 4.
+    effects = core.tick(100.0)
+    pre = [e for e in effects if isinstance(e, Send)
+           and e.msg["type"] == "pre_vote"]
+    assert len(pre) == 2 and core._prevote_term == 4
+    # The leader's delayed heartbeat (same term) arrives.
+    core.handle_message({
+        "type": "append_entries", "term": 3, "leader_id": "a",
+        "prev_log_index": 0, "prev_log_term": 0, "entries": [],
+        "leader_commit": 0, "probe_seq": 0,
+    }, 100.1)
+    assert core._prevote_term is None
+    # Stale grants now arrive: they must NOT start an election.
+    for peer in ("a", "b"):
+        out = core.handle_message({
+            "type": "pre_vote_response", "term": 4, "from": peer,
+            "vote_granted": True,
+        }, 100.2)
+        assert out == []
+    assert core.role is Role.FOLLOWER and core.term == 3

@@ -15,7 +15,8 @@ from tpudfs.raft.core import (
     Timings,
 )
 
-FAST = Timings(election_min=0.1, election_max=0.2, heartbeat=0.05)
+FAST = Timings(election_min=0.1, election_max=0.2, heartbeat=0.05,
+               prevote=False)  # these tests hand-drive raw elections
 
 
 def _mk(node_id, voters, log=None, term=0):

@@ -219,6 +219,11 @@ class Timings:
     heartbeat: float = 0.5
     snapshot_threshold: int = 100
     catchup_rounds: int = 10
+    #: Pre-vote (Raft §9.6, the etcd extension; the reference lacks it): a
+    #: timed-out follower first polls a non-binding quorum before
+    #: incrementing its term, so a partitioned node cannot inflate terms
+    #: and depose a healthy leader when its partition heals.
+    prevote: bool = True
 
 
 # ---------------------------------------------------------------------------
@@ -277,6 +282,12 @@ class RaftCore:
         self._catchup: dict | None = None  # {node, rounds_left, last_match}
         self._transfer_target: str | None = None
         self._transfer_deadline = 0.0
+        # Pre-vote machinery: target term of the open round (None = no
+        # round) and its grants. Nothing here persists — pre-votes are
+        # non-binding and never touch term/voted_for.
+        self._prevote_term: int | None = None
+        self._prevotes: set[str] = set()
+        self._last_leader_contact = float("-inf")
 
         self._election_deadline = now + self._election_timeout()
         self._heartbeat_due = now
@@ -359,13 +370,49 @@ class RaftCore:
                     self.last_applied >= self.log_start:
                 effects.append(SnapshotNeeded())
         elif self.is_voter and now >= self._election_deadline:
-            effects += self._start_election(now)
+            if self.timings.prevote:
+                # Timed-out CANDIDATES step back through pre-vote too
+                # (etcd's pre-candidate): a candidate partitioned
+                # mid-election would otherwise bump its term every timeout
+                # — the exact disruption pre-vote exists to prevent.
+                if self.role == Role.CANDIDATE:
+                    self.role = Role.FOLLOWER
+                    self.votes = set()
+                effects += self._start_prevote(now)
+            else:
+                effects += self._start_election(now)
         return effects
 
     # -------------------------------------------------------------- elections
 
+    def _start_prevote(self, now: float) -> list:
+        """Open a pre-vote round for term+1 — no state is changed beyond the
+        round bookkeeping; a quorum of non-binding grants gates the real
+        election (so an isolated node never inflates its term)."""
+        self._prevote_term = self.term + 1
+        self._prevotes = {self.node_id}
+        self._election_deadline = now + self._election_timeout()
+        effects: list = []
+        voters = self.config.voters | (self.config.voters_old or frozenset())
+        for peer in voters - {self.node_id}:
+            effects.append(
+                Send(peer, {
+                    "type": "pre_vote",
+                    "term": self._prevote_term,
+                    "candidate_id": self.node_id,
+                    "last_log_index": self.last_index,
+                    "last_log_term": self.last_term,
+                })
+            )
+        if self.config.has_quorum(self._prevotes):  # single-node cluster
+            self._prevote_term = None
+            effects += self._start_election(now)
+        return effects
+
     def _start_election(self, now: float) -> list:
         self.role = Role.CANDIDATE
+        self._prevote_term = None
+        self._prevotes = set()
         self.term += 1
         self.voted_for = self.node_id
         self.leader_id = None
@@ -413,6 +460,8 @@ class RaftCore:
             effects.append(PersistHardState(self.term, self.voted_for))
         self.role = Role.FOLLOWER
         self.votes = set()
+        self._prevote_term = None
+        self._prevotes = set()
         self._pending_reads = []
         self._catchup = None
         self._transfer_target = None
@@ -607,9 +656,14 @@ class RaftCore:
         mtype = msg["type"]
         term = int(msg.get("term", 0))
         effects: list = []
-        if term > self.term:
+        # Pre-vote traffic carries the PROSPECTIVE term and must never bump
+        # anyone's real term — that is the whole point of pre-vote.
+        if term > self.term and mtype not in ("pre_vote",
+                                              "pre_vote_response"):
             effects += self._step_down(term, now)
         handler = {
+            "pre_vote": self._on_pre_vote,
+            "pre_vote_response": self._on_pre_vote_response,
             "request_vote": self._on_request_vote,
             "request_vote_response": self._on_vote_response,
             "append_entries": self._on_append_entries,
@@ -621,6 +675,47 @@ class RaftCore:
         if handler is None:
             return effects
         return effects + handler(msg, now)
+
+    def _on_pre_vote(self, msg: dict, now: float) -> list:
+        """Grant iff we'd plausibly vote for this candidate in a real
+        election AND we have not heard from a live leader within the minimum
+        election timeout — a node still in contact with its leader refuses,
+        which is what stops a healed stragglers' election from deposing a
+        healthy leader. Grants are non-binding: no term bump, no voted_for,
+        nothing persisted, any number of grants per term."""
+        up_to_date = (
+            int(msg["last_log_term"]) > self.last_term
+            or (
+                int(msg["last_log_term"]) == self.last_term
+                and int(msg["last_log_index"]) >= self.last_index
+            )
+        )
+        granted = (
+            int(msg["term"]) > self.term
+            and up_to_date
+            and self.role != Role.LEADER
+            and now - self._last_leader_contact >= self.timings.election_min
+        )
+        return [Send(msg["candidate_id"], {
+            "type": "pre_vote_response",
+            "term": int(msg["term"]),
+            "from": self.node_id,
+            "vote_granted": granted,
+        })]
+
+    def _on_pre_vote_response(self, msg: dict, now: float) -> list:
+        if self._prevote_term is None or \
+                int(msg["term"]) != self._prevote_term or \
+                self._prevote_term != self.term + 1 or \
+                self.role == Role.LEADER:
+            return []
+        if msg["vote_granted"]:
+            self._prevotes.add(msg["from"])
+            if self.config.has_quorum(self._prevotes):
+                self._prevote_term = None
+                self._prevotes = set()
+                return self._start_election(now)
+        return []
 
     def _on_request_vote(self, msg: dict, now: float) -> list:
         granted = False
@@ -667,6 +762,11 @@ class RaftCore:
             effects += self._step_down(int(msg["term"]), now)
         self.leader_id = leader
         self._election_deadline = now + self._election_timeout()
+        self._last_leader_contact = now
+        # A live leader aborts any open pre-vote round: late-arriving
+        # grants must not spring a term-bumping election on it.
+        self._prevote_term = None
+        self._prevotes = set()
 
         prev_index = int(msg["prev_log_index"])
         prev_term = int(msg["prev_log_term"])
@@ -774,6 +874,11 @@ class RaftCore:
             effects += self._step_down(int(msg["term"]), now)
         self.leader_id = msg["leader_id"]
         self._election_deadline = now + self._election_timeout()
+        self._last_leader_contact = now
+        # A live leader aborts any open pre-vote round: late-arriving
+        # grants must not spring a term-bumping election on it.
+        self._prevote_term = None
+        self._prevotes = set()
         snap = Snapshot.from_dict(msg["snapshot"])
         if self.snapshot is None or snap.last_index > self.snapshot.last_index:
             # Keep any log suffix that extends past the snapshot and matches.

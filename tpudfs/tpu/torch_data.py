@@ -71,10 +71,3 @@ class DfsTorchDataset(Dataset):
 
     def close(self) -> None:
         self.source.close()
-
-    def __getstate__(self):
-        return {"source": self.source, "transform": self.transform}
-
-    def __setstate__(self, state):
-        self.source = state["source"]
-        self.transform = state["transform"]
