@@ -30,6 +30,16 @@ def parse_args(argv=None):
     p.add_argument("--http-port", type=int, default=-1,
                    help="ops HTTP (/health /metrics /raft/state); "
                         "-1 = rpc port + 1000, 0 = disabled")
+    # Storage tiering (env COLD_THRESHOLD_SECS / EC_THRESHOLD_SECS /
+    # EC_SHAPE also work, reference bin/master.rs:216-223; flags win).
+    p.add_argument("--cold-threshold-secs", type=int, default=None,
+                   help="idle seconds before a file moves to the cold tier")
+    p.add_argument("--ec-threshold-secs", type=int, default=None,
+                   help="cold seconds before RS conversion (policy + data "
+                        "migration)")
+    from tpudfs.master.service import _parse_ec_shape
+    p.add_argument("--ec-shape", type=_parse_ec_shape, default=None,
+                   help='RS shape for tier conversion, "k,m" (default 6,3)')
     # Dynamic sharding thresholds (reference bin/master.rs:51-58).
     p.add_argument("--split-threshold-rps", type=float, default=100.0)
     p.add_argument("--merge-threshold-rps", type=float, default=-1.0,
@@ -68,6 +78,9 @@ async def amain(args) -> None:
     from tpudfs.common.rpc import RpcClient
     master = Master(address, peers, args.data_dir, shard_id=args.shard_id,
                     config_servers=configs,
+                    cold_threshold_secs=args.cold_threshold_secs,
+                    ec_threshold_secs=args.ec_threshold_secs,
+                    ec_shape=args.ec_shape,
                     split_threshold_rps=args.split_threshold_rps,
                     merge_threshold_rps=args.merge_threshold_rps,
                     split_cooldown_secs=args.split_cooldown_secs,
