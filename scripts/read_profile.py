@@ -1,0 +1,126 @@
+"""Read-path breakdown probe (not part of the bench): times each stage of
+the DFS→HBM sweep separately to locate the bottleneck.
+
+Stages, each over the same 64 x 1 MiB dataset at concurrency 12:
+  meta   — GetFileInfo only
+  disk   — + verified pread (short-circuit local read), bytes stay on host
+  h2d    — + device_put (verify=False: no CRC kernel dispatch)
+  full   — + on-device CRC fold dispatch (verify="lazy", block_until_ready)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+FILES = 64
+CONC = 12
+
+
+async def run() -> None:
+    import tempfile
+
+    import jax
+
+    from tpudfs.client.client import Client
+    from tpudfs.common.rpc import RpcClient
+    from tpudfs.tpu.hbm_reader import HbmReader
+
+    tmp = tempfile.TemporaryDirectory(prefix="tpudfs-prof-")
+    maddr, cs_addrs, procs = bench._spawn_cluster(tmp.name)
+    try:
+        rpc = RpcClient()
+        client = Client([maddr], rpc_client=rpc, block_size=1 << 20)
+        deadline = asyncio.get_event_loop().time() + 60
+        while True:
+            try:
+                await client.create_file("/p/probe", b"x")
+                await client.delete_file("/p/probe")
+                break
+            except Exception:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.3)
+        data = np.random.default_rng(0).integers(
+            0, 256, 1 << 20, dtype=np.uint8
+        ).tobytes()
+        sem = asyncio.Semaphore(CONC)
+
+        async def put(i):
+            async with sem:
+                await client.create_file(f"/p/f{i:04d}", data)
+
+        await asyncio.gather(*(put(i) for i in range(FILES)))
+
+        device = jax.devices()[0]
+        reader = HbmReader(client, [device])
+        warm = await reader.read_file_to_device_blocks("/p/f0000",
+                                                       verify="lazy")
+        jax.block_until_ready([warm[0].array, warm[0].pending_crc])
+
+        async def sweep(fn):
+            t0 = time.perf_counter()
+            out = await asyncio.gather(*(fn(i) for i in range(FILES)))
+            return out, time.perf_counter() - t0
+
+        async def meta_one(i):
+            async with sem:
+                return await client.get_file_info(f"/p/f{i:04d}")
+
+        metas, dt = await sweep(meta_one)
+        print(f"meta : {dt:6.3f}s  {FILES / dt:7.1f} files/s")
+
+        async def disk_one(i):
+            async with sem:
+                meta = metas[i]
+                return [
+                    await client._read_block_range(b, 0, 0)
+                    for b in meta["blocks"]
+                ]
+
+        _, dt = await sweep(disk_one)
+        print(f"disk : {dt:6.3f}s  {FILES * len(data) / dt / 1e9:6.3f} GB/s")
+
+        async def h2d_one(i):
+            async with sem:
+                return await reader.read_file_to_device_blocks(
+                    f"/p/f{i:04d}", verify=False
+                )
+
+        t0 = time.perf_counter()
+        blocks = await asyncio.gather(*(h2d_one(i) for i in range(FILES)))
+        jax.block_until_ready([b.array for bl in blocks for b in bl])
+        dt = time.perf_counter() - t0
+        print(f"h2d  : {dt:6.3f}s  {FILES * len(data) / dt / 1e9:6.3f} GB/s")
+
+        async def full_one(i):
+            async with sem:
+                return await reader.read_file_to_device_blocks(
+                    f"/p/f{i:04d}", verify="lazy"
+                )
+
+        t0 = time.perf_counter()
+        blocks = await asyncio.gather(*(full_one(i) for i in range(FILES)))
+        arrs = [b.array for bl in blocks for b in bl]
+        arrs += [b.pending_crc for bl in blocks for b in bl
+                 if b.pending_crc is not None]
+        jax.block_until_ready(arrs)
+        dt = time.perf_counter() - t0
+        print(f"full : {dt:6.3f}s  {FILES * len(data) / dt / 1e9:6.3f} GB/s")
+        await rpc.close()
+    finally:
+        from tpudfs.testing.procs import terminate_all
+
+        terminate_all(procs)
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    asyncio.run(run())

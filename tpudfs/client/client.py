@@ -170,14 +170,20 @@ class Client:
             return store
 
     async def _read_local(self, addr: str, block_id: str, offset: int,
-                          length: int) -> bytes | None:
-        """Try the short-circuit path; None means use the RPC path."""
+                          length: int, verify: bool = True) -> bytes | None:
+        """Try the short-circuit path; None means use the RPC path.
+
+        ``verify=False`` skips the host-side sidecar CRC pass — ONLY for
+        callers that run their own end-to-end verification of the returned
+        bytes (the HBM reader's on-device CRC fold); otherwise a plain
+        pread would silently return bit-rot."""
         store = await self._local_store(addr)
         if store is None:
             return None
         try:
             data = await asyncio.to_thread(
-                store.read_verified, block_id, offset, length or None
+                store.read_verified if verify else store.read,
+                block_id, offset, length or None,
             )
         except Exception as e:
             # Not-found (tiering move race, stale location) or corruption:
@@ -488,10 +494,14 @@ class Client:
         return data
 
     async def _read_block_range(self, block: dict, offset: int,
-                                length: int) -> bytes:
+                                length: int, *,
+                                local_verify: bool = True) -> bytes:
         """Replica read with optional hedging (reference read_block_range
         mod.rs:948-1107): fire the primary, start a delayed hedge at the
-        second replica, first success wins; then sequential fallback."""
+        second replica, first success wins; then sequential fallback.
+
+        ``local_verify=False``: short-circuit reads skip the host sidecar
+        CRC pass — only for callers doing their own end-to-end verify."""
         locations = [l for l in block["locations"] if l]
         if not locations:
             raise DfsError(f"no locations for block {block['block_id']}")
@@ -500,7 +510,7 @@ class Client:
         # (verified against its sidecar) — no gRPC byte shuffling.
         for addr in locations:
             data = await self._read_local(
-                addr, block["block_id"], offset, length
+                addr, block["block_id"], offset, length, verify=local_verify
             )
             if data is not None:
                 return data
@@ -558,19 +568,20 @@ class Client:
             f"all replicas failed for block {block['block_id']}: {errors}"
         )
 
-    async def _read_ec_block(self, block: dict) -> bytes:
-        """Concurrent shard fetch; concat fast path when all data shards
-        arrive, RS decode otherwise (reference read_ec_block mod.rs:1110-1165)."""
+    async def _fetch_ec_shards(self, block: dict, *,
+                               local_verify: bool = True) -> list[bytes | None]:
+        """Concurrent fetch of all k+m shard slots; None per missing shard
+        (reference read_ec_block's fan-out, mod.rs:1110-1150)."""
         k = int(block["ec_data_shards"])
         m = int(block["ec_parity_shards"])
         locations = block["locations"]
-        original = int(block.get("original_size") or block.get("size") or 0)
 
         async def fetch(i: int) -> bytes | None:
             addr = locations[i] if i < len(locations) else ""
             if not addr:
                 return None
-            local = await self._read_local(addr, block["block_id"], 0, 0)
+            local = await self._read_local(addr, block["block_id"], 0, 0,
+                                           verify=local_verify)
             if local is not None:
                 return local
             try:
@@ -584,7 +595,15 @@ class Client:
                 logger.warning("EC shard %d fetch failed: %s", i, e.message)
                 return None
 
-        shards = list(await asyncio.gather(*(fetch(i) for i in range(k + m))))
+        return list(await asyncio.gather(*(fetch(i) for i in range(k + m))))
+
+    async def _read_ec_block(self, block: dict) -> bytes:
+        """Concurrent shard fetch; concat fast path when all data shards
+        arrive, RS decode otherwise (reference read_ec_block mod.rs:1110-1165)."""
+        k = int(block["ec_data_shards"])
+        m = int(block["ec_parity_shards"])
+        original = int(block.get("original_size") or block.get("size") or 0)
+        shards = await self._fetch_ec_shards(block)
         if all(s is not None for s in shards[:k]):
             return b"".join(shards[:k])[:original]  # type: ignore[arg-type]
         try:

@@ -46,6 +46,10 @@ class DeviceBlock:
     #: on a tunneled TPU.
     pending_crc: jax.Array | None = None
     expected_crc: int | None = None
+    #: source block metadata + target device, kept so a failed lazy verify
+    #: can be retried through the host-verified fetch path (see confirm).
+    source: dict | None = None
+    device: object | None = None
 
 
 class HbmReader:
@@ -56,20 +60,125 @@ class HbmReader:
     # ------------------------------------------------------------ per block
 
     async def read_block_to_device(self, block: dict, device,
-                                   verify: bool | str = True) -> DeviceBlock:
+                                   verify: bool | str = True, *,
+                                   safe_local: bool = False) -> DeviceBlock:
         """``verify``: False = no check; True = eager (syncs this block's
         device CRC now); ``"lazy"`` = dispatch the on-device check but defer
         the (expensive on a tunneled TPU) host sync to a later batched
-        ``confirm`` call."""
-        data = await self.client._read_block_range(block, 0, 0) \
-            if not block.get("ec_data_shards") else \
-            await self.client._read_ec_block(block)
+        ``confirm`` call.
+
+        ``safe_local``: force the host-verified short-circuit path (used by
+        the corruption-retry; normally the on-device check subsumes it)."""
+        try:
+            db = await self._read_block_inner(block, device, verify,
+                                              safe_local)
+        except DfsError as e:
+            # The fast path trusts the device CRC end-to-end; a mismatch —
+            # checksum OR shard-length (a truncated local shard file that
+            # an unverified pread returns as-is) — may be a corrupt LOCAL
+            # replica that the host-verified path would have excluded
+            # (falling through to healthy replicas / parity reconstruction,
+            # and triggering chunkserver self-repair). Retry once through
+            # that path before declaring the block lost.
+            if safe_local or "mismatch" not in str(e):
+                raise
+            try:
+                db = await self._read_block_inner(block, device, verify,
+                                                  True)
+            except DfsError as e2:
+                raise DfsError(
+                    f"on-device checksum mismatch for block "
+                    f"{block['block_id']} (verified-path retry failed: {e2})"
+                ) from None
+        db.source = block
+        db.device = device
+        return db
+
+    async def _read_block_inner(self, block: dict, device,
+                                verify: bool | str,
+                                safe_local: bool) -> DeviceBlock:
+        if block.get("ec_data_shards"):
+            words, size = await self._ec_block_to_device(
+                block, device, verify, safe_local
+            )
+            return await self._finish_block(block, words, size, verify)
+        # When the on-device CRC fold will verify this block end-to-end, a
+        # short-circuit local read skips the redundant host sidecar pass
+        # (the device check subsumes it; bit-rot surfaces at confirm()).
+        device_verify = bool(verify) and bool(block.get("checksum_crc32c"))
+        data = await self.client._read_block_range(
+            block, 0, 0, local_verify=safe_local or not device_verify
+        )
         # Off the event loop: device_put blocks for the whole host->HBM
         # transfer (tens of ms per MiB on a tunneled TPU) and would stall
         # the gRPC fetches of every other in-flight block.
         words = await asyncio.to_thread(
             lambda: jax.device_put(bytes_to_words(data), device)
         )
+        return await self._finish_block(block, words, len(data), verify)
+
+    async def _ec_block_to_device(self, block: dict, device,
+                                  verify: bool | str = True,
+                                  safe_local: bool = False):
+        """EC block → device words. All data shards present: host concat +
+        one upload (the fast path). Degraded: upload the k surviving shards
+        and reconstruct ON DEVICE with the constant-matrix Pallas GF(2^8)
+        matmul (rs_decode_device) — the repair matmul runs where the data
+        lands instead of on the host CPU."""
+        from tpudfs.tpu.rs_pallas import pad_shard_len, rs_decode_device
+
+        k = int(block["ec_data_shards"])
+        m = int(block["ec_parity_shards"])
+        size = int(block.get("original_size") or block.get("size") or 0)
+        device_verify = bool(verify) and bool(block.get("checksum_crc32c"))
+        shards = await self.client._fetch_ec_shards(
+            block, local_verify=safe_local or not device_verify
+        )
+        if all(s is not None for s in shards[:k]):
+            data = b"".join(shards[:k])[:size]  # type: ignore[arg-type]
+            words = await asyncio.to_thread(
+                lambda: jax.device_put(bytes_to_words(data), device)
+            )
+            return words, size
+        present = tuple(i for i, s in enumerate(shards) if s is not None)
+        if len(present) < k:
+            raise DfsError(
+                f"EC block {block['block_id']}: only {len(present)} of "
+                f"{k}+{m} shards available"
+            )
+        use = present[:k]
+        slen = len(shards[use[0]])  # type: ignore[arg-type]
+        padded = pad_shard_len(slen)
+        stack = np.zeros((k, padded), dtype=np.uint8)
+        for r, idx in enumerate(use):
+            row = np.frombuffer(shards[idx], dtype=np.uint8)  # type: ignore[arg-type]
+            if len(row) != slen:
+                raise DfsError(
+                    f"EC block {block['block_id']}: shard length mismatch"
+                )
+            stack[r, :slen] = row
+        avail = await asyncio.to_thread(
+            lambda: jax.device_put(stack, device)
+        )
+
+        def reconstruct():
+            recon = rs_decode_device(avail, k, m, use)  # (k, padded)
+            nchunks = -(-size // CHECKSUM_CHUNK_SIZE) or 1
+            need = nchunks * CHECKSUM_CHUNK_SIZE
+            flat = recon[:, :slen].reshape(-1)
+            if flat.shape[0] < need:
+                flat = jnp.pad(flat, (0, need - flat.shape[0]))
+            # Shard zero-padding means flat[size:] is zeros, so the slice
+            # to the chunk grid is exact (bytes_to_words pads the same way).
+            return jax.lax.bitcast_convert_type(
+                flat[:need].reshape(nchunks, WORDS_PER_CHUNK, 4), jnp.uint32
+            )
+
+        words = await asyncio.to_thread(reconstruct)
+        return words, size
+
+    async def _finish_block(self, block: dict, words: jax.Array, size: int,
+                            verify: bool | str) -> DeviceBlock:
         # verified means "an on-device CRC check ran and passed" — a block
         # with no recorded checksum was NOT verified.
         verified = False
@@ -77,7 +186,7 @@ class HbmReader:
         expected: int | None = None
         if verify and block.get("checksum_crc32c"):
             expected = int(block["checksum_crc32c"])
-            if len(data) % CHECKSUM_CHUNK_SIZE == 0:
+            if size % CHECKSUM_CHUNK_SIZE == 0:
                 # Device fold: whole-block CRC without any chunk readback
                 # (and no host->device scalar upload — compare on host).
                 crc = block_crc_device(words)
@@ -93,19 +202,23 @@ class HbmReader:
                 # device result to defer), so it must raise here: confirm()
                 # only inspects pending_crc and would silently pass it.
                 verified = await asyncio.to_thread(
-                    self._verify_host_tail_block, words, len(data), expected
+                    self._verify_host_tail_block, words, size, expected
                 )
             if pending is None and not verified:
                 raise DfsError(
                     f"on-device checksum mismatch for block {block['block_id']}"
                 )
-        return DeviceBlock(block["block_id"], words, len(data), verified,
+        return DeviceBlock(block["block_id"], words, size, verified,
                            pending_crc=pending, expected_crc=expected)
 
-    async def confirm(self, blocks: list[DeviceBlock]) -> None:
+    async def confirm(self, blocks: list[DeviceBlock], *,
+                      retry: bool = True) -> None:
         """Resolve every lazy verification with ONE device→host sync.
 
-        Raises DfsError naming each failed block; marks the rest verified.
+        A failed block is retried once through the host-verified fetch path
+        (``retry=False`` disables) — a corrupt local replica gets excluded
+        there in favor of healthy replicas / parity reconstruction. Raises
+        DfsError naming each unrecoverable block; marks the rest verified.
         """
         pend = [b for b in blocks if b.pending_crc is not None]
         if not pend:
@@ -127,10 +240,24 @@ class HbmReader:
             b.pending_crc = None
             b.verified = int(crc) == b.expected_crc
             if not b.verified:
-                bad.append(b.block_id)
-        if bad:
+                bad.append(b)
+        unrecovered = []
+        for b in bad:
+            if retry and b.source is not None and b.device is not None:
+                try:
+                    nb = await self.read_block_to_device(
+                        b.source, b.device, verify=True, safe_local=True
+                    )
+                except DfsError:
+                    unrecovered.append(b.block_id)
+                    continue
+                b.array, b.size, b.verified = nb.array, nb.size, nb.verified
+            else:
+                unrecovered.append(b.block_id)
+        if unrecovered:
             raise DfsError(
-                "on-device checksum mismatch for blocks: " + ", ".join(bad)
+                "on-device checksum mismatch for blocks: "
+                + ", ".join(unrecovered)
             )
 
     @staticmethod

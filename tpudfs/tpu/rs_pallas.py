@@ -31,24 +31,30 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpudfs.common.erasure import encode_matrix, gf_mul
+from tpudfs.common.erasure import _matrix_invert, encode_matrix, gf_mul
 from tpudfs.tpu import on_tpu
 
 _LANE = 128
 _TILE = 8 * 1024  # bytes of shard length per grid step
 
 
-@lru_cache(maxsize=16)
-def coef_bits(k: int, m: int) -> tuple:
-    """Nested tuple [m][k][8]: coef_bits[p][d][j] = G[k+p, d] * 2^j in GF(2^8)."""
-    gen = encode_matrix(k, m)[k:]  # parity rows
+def _matrix_bits(mat_flat: tuple, rows: int, cols: int) -> tuple:
+    """Nested tuple [rows][cols][8]: bits[r][c][j] = mat[r, c] * 2^j in
+    GF(2^8) — the compile-time constants of one constant-matrix GF matmul."""
     return tuple(
         tuple(
-            tuple(gf_mul(int(gen[p, d]), 1 << j) for j in range(8))
-            for d in range(k)
+            tuple(gf_mul(int(mat_flat[r * cols + c]), 1 << j) for j in range(8))
+            for c in range(cols)
         )
-        for p in range(m)
+        for r in range(rows)
     )
+
+
+@lru_cache(maxsize=16)
+def coef_bits(k: int, m: int) -> tuple:
+    """Constants of the parity rows G[k:] (the encode matmul)."""
+    gen = encode_matrix(k, m)[k:]  # parity rows
+    return _matrix_bits(tuple(int(x) for x in gen.flatten()), m, k)
 
 
 def pad_shard_len(n: int) -> int:
@@ -89,9 +95,11 @@ def _parity_rows(words: jnp.ndarray, coefs: tuple) -> jnp.ndarray:
     return jnp.concatenate(parities, axis=0)
 
 
-@lru_cache(maxsize=16)
-def _rs_pallas_fn(k: int, m: int, interpret: bool):
-    coefs = coef_bits(k, m)
+@lru_cache(maxsize=128)
+def _gf_pallas_fn(coefs: tuple, interpret: bool):
+    """Pallas kernel applying the constant GF(2^8) matrix encoded by
+    ``coefs`` ((rows, cols) bit-plane constants) to (cols, W) uint32 words."""
+    rows, cols = len(coefs), len(coefs[0])
 
     def kernel(words_ref, out_ref):
         out_ref[:] = _parity_rows(words_ref[:], coefs)
@@ -103,18 +111,22 @@ def _rs_pallas_fn(k: int, m: int, interpret: bool):
         grid = pl.cdiv(W, tile)
         return pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct((m, W), jnp.uint32),
+            out_shape=jax.ShapeDtypeStruct((rows, W), jnp.uint32),
             grid=(grid,),
             in_specs=[
-                pl.BlockSpec((k, tile), lambda i: (0, i),
+                pl.BlockSpec((cols, tile), lambda i: (0, i),
                              memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i),
+            out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i),
                                    memory_space=pltpu.VMEM),
             interpret=interpret,
         )(words)
 
     return run
+
+
+def _rs_pallas_fn(k: int, m: int, interpret: bool):
+    return _gf_pallas_fn(coef_bits(k, m), interpret)
 
 
 def _pack_words(data_shards: jax.Array) -> jax.Array:
@@ -141,6 +153,54 @@ def rs_encode_device(data_shards: jax.Array, k: int, m: int, *,
     else:
         out = _parity_rows(words, coef_bits(k, m))
     return _unpack_words(out)
+
+
+def gf_matmul_device(mat, shards: jax.Array, *,
+                     use_pallas: bool | None = None) -> jax.Array:
+    """``out[r] = xor_c mat[r, c] * shards[c]`` over GF(2^8), on device.
+
+    ``mat`` ((rows, cols) uint8, a host value) is baked into the compiled
+    kernel as bit-plane constants — the device twin of erasure._gf_matmul
+    (native/gf256.cc). ``shards`` is (cols, L) uint8 with L a multiple of
+    128; jittable in ``shards`` (one compile per distinct matrix)."""
+    mat = np.asarray(mat, dtype=np.uint8)
+    rows, cols = mat.shape
+    coefs = _matrix_bits(tuple(int(x) for x in mat.flatten()), rows, cols)
+    if use_pallas is None:
+        use_pallas = on_tpu()
+    words = _pack_words(shards)
+    if use_pallas:
+        out = _gf_pallas_fn(coefs, not on_tpu())(words)
+    else:
+        out = _parity_rows(words, coefs)
+    return _unpack_words(out)
+
+
+@lru_cache(maxsize=256)
+def decode_matrix(k: int, m: int, present: tuple) -> np.ndarray:
+    """(k, k) GF(2^8) matrix mapping the first k PRESENT shards (rows
+    ``present[:k]`` of the code word, in index order) back to the k data
+    shards — the inverse the host reconstruct() builds per erasure pattern
+    (erasure.py reconstruct; reference chunkserver.rs:503-640)."""
+    rows = list(present)[:k]
+    if len(rows) < k:
+        raise ValueError(f"need {k} present shards, have {len(rows)}")
+    return _matrix_invert(encode_matrix(k, m)[rows])
+
+
+def rs_decode_device(avail: jax.Array, k: int, m: int, present: tuple, *,
+                     use_pallas: bool | None = None) -> jax.Array:
+    """Reconstruct the k data shards ON DEVICE from any k survivors.
+
+    ``avail``: (k, L) uint8 — the shards at code-word indices
+    ``present[:k]`` (sorted ascending), L a multiple of 128. Returns the
+    (k, L) data shards, bit-exact with the host ``erasure.reconstruct``.
+    The per-erasure-pattern inverse is a compile-time constant, so each
+    observed failure pattern costs one XLA compile and then runs at encode
+    speed — degraded reads never leave the accelerator."""
+    return gf_matmul_device(
+        decode_matrix(k, m, tuple(present)), avail, use_pallas=use_pallas
+    )
 
 
 def rs_encode_jax(data: bytes, k: int, m: int, **kw) -> list[bytes]:
