@@ -257,18 +257,18 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     # Warm up kernels + compile caches without any D2H (not the CS block
     # cache: it holds CS_CACHE_BLOCKS blocks; the sweeps touch FILES).
     warm = await reader.read_file_to_device_blocks("/bench/f0000", verify="lazy")
+    grpc_files = min(48, FILES)
     # Pre-compile the confirm stack for the final batched verdict fetch
     # (built and executed, NOT fetched). Count BLOCKS, not files: the final
     # confirm batch is every sweep's blocks plus the warm-up's.
     reader.warm_confirm(
-        warm[0], (FILES + min(48, FILES)) * len(warm) + len(warm)
+        warm[0], (FILES + grpc_files) * len(warm) + len(warm)
     )
 
     # ---- remote read path: short-circuit disabled — what a non-colocated
     # client gets over gRPC. Verification is dispatched in-window (the CRC
     # folds are part of the measured work), resolved by the final confirm.
     client.local_reads = False
-    grpc_files = min(48, FILES)
     grpc_blocks: list = []
 
     async def read_remote(i):

@@ -31,6 +31,7 @@ async def run() -> None:
 
     from tpudfs.client.client import Client
     from tpudfs.common.rpc import RpcClient
+    from tpudfs.tpu.crc32c_pallas import bytes_to_words
     from tpudfs.tpu.hbm_reader import HbmReader
 
     tmp = tempfile.TemporaryDirectory(prefix="tpudfs-prof-")
@@ -89,14 +90,25 @@ async def run() -> None:
         print(f"disk : {dt:6.3f}s  {FILES * len(data) / dt / 1e9:6.3f} GB/s")
 
         async def h2d_one(i):
+            # Mirror the "full" stage minus the CRC dispatch: unverified
+            # fetch (local_verify=False, same as verify="lazy" would use)
+            # + device_put — so full-h2d isolates the device fold cost.
             async with sem:
-                return await reader.read_file_to_device_blocks(
-                    f"/p/f{i:04d}", verify=False
-                )
+                meta = metas[i]
+                out = []
+                for b in meta["blocks"]:
+                    data = await client._read_block_range(
+                        b, 0, 0, local_verify=False
+                    )
+                    out.append(await asyncio.to_thread(
+                        lambda d=data: jax.device_put(
+                            bytes_to_words(d), device)
+                    ))
+                return out
 
         t0 = time.perf_counter()
         blocks = await asyncio.gather(*(h2d_one(i) for i in range(FILES)))
-        jax.block_until_ready([b.array for bl in blocks for b in bl])
+        jax.block_until_ready([a for bl in blocks for a in bl])
         dt = time.perf_counter() - t0
         print(f"h2d  : {dt:6.3f}s  {FILES * len(data) / dt / 1e9:6.3f} GB/s")
 

@@ -125,18 +125,31 @@ def test_read_index_linearizable():
 
 
 def test_read_index_blocked_by_partition():
-    """A leader cut off from the quorum must NOT serve reads (stale-read
-    prevention — the scenario ReadIndex exists for)."""
+    """A leader cut off from the quorum must NOT serve reads once its lease
+    has lapsed (stale-read prevention — the scenario ReadIndex exists for).
+    Within the lease window a read IS safe: vote stickiness keeps any new
+    leader from existing before the lease expires (see the lease tests)."""
     c = SimCluster(3, seed=8)
     lead = c.wait_for_leader()
     c.propose_and_commit({"v": 1})
     lead = c.leader()
     others = [nid for nid in c.ids if nid != lead.node_id]
     c.partition([lead.node_id], others)
-    effects = lead.core.read_index("stale-read", c.now)
-    c._process_effects(lead, effects)
-    c.run(1.0)
+    # Let the lease lapse WITHOUT advancing the whole cluster (the other
+    # side would elect; we want the old leader still leader, lease dead).
+    lease_gone = lead.core._lease_until + 0.001
+    while c.now < lease_gone:
+        c.step()
+        if lead.core.role != Role.LEADER:
+            break
+    if lead.core.role == Role.LEADER:
+        effects = lead.core.read_index("stale-read", c.now)
+        c._process_effects(lead, effects)
+        c.run(1.0)
     assert lead.read_ready == []  # never confirmed
+    # Check-quorum: the quorum-less leader eventually steps down entirely.
+    c.run(1.0)
+    assert lead.core.role != Role.LEADER
 
 
 def test_snapshot_compaction_and_follower_catchup():
@@ -302,3 +315,131 @@ def test_propose_batch_not_leader_raises():
     )
     with pytest.raises(NotLeaderError):
         follower.core.propose_batch([{"op": "x"}], c.now)
+
+
+# ------------------------------------------------------------ leader leases
+
+
+def test_lease_read_skips_quorum_roundtrip():
+    """With a fresh heartbeat-quorum lease, read_index answers immediately
+    with NO network round (Raft §6.4.1; the reference always pays the
+    quorum round-trip, simple_raft.rs:1863-1887)."""
+    from tpudfs.raft.core import ReadReady, Send
+
+    c = SimCluster(3, seed=20)
+    c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    lead = c.leader()
+    assert lead.core.lease_valid(c.now)
+    effects = lead.core.read_index("lr", c.now)
+    ready = [e for e in effects if isinstance(e, ReadReady)]
+    assert ready and ready[0].read_index >= 1
+    assert not [e for e in effects if isinstance(e, Send)], \
+        "lease read must not broadcast"
+
+
+def test_lease_never_overlaps_next_leader():
+    """The lease-safety invariant itself: partition the leader, record its
+    lease expiry, and verify no other node becomes leader before it."""
+    c = SimCluster(3, seed=21)
+    c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    old = c.leader()
+    others = [nid for nid in c.ids if nid != old.node_id]
+    c.partition([old.node_id], others)
+    lease_until = old.core._lease_until
+    assert lease_until > c.now  # lease was live at partition time
+    new_leader_at = None
+    for _ in range(400):
+        c.step()
+        for nid in others:
+            n = c.nodes[nid]
+            if n.core.role == Role.LEADER:
+                new_leader_at = c.now
+                break
+        if new_leader_at is not None:
+            break
+    assert new_leader_at is not None, "healthy side must elect eventually"
+    assert new_leader_at >= lease_until, (
+        f"new leader at {new_leader_at} inside old lease {lease_until}"
+    )
+
+
+def test_vote_stickiness_refuses_then_allows():
+    """A follower in contact with its leader refuses a (non-transfer) vote;
+    the same request succeeds for a leadership-transfer election."""
+    c = SimCluster(3, seed=22)
+    lead = c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    follower = next(n for n in c.nodes.values()
+                    if n.core.role == Role.FOLLOWER)
+    msg = {
+        "type": "request_vote",
+        "term": follower.core.term + 1,
+        "candidate_id": "candidate-x",
+        "last_log_index": 10_000,
+        "last_log_term": 10_000,
+    }
+    from tpudfs.raft.core import Send
+
+    effects = follower.core.handle_message(dict(msg), c.now)
+    sends = [e for e in effects if isinstance(e, Send)]
+    assert sends and sends[-1].msg["vote_granted"] is False
+    msg["transfer"] = True
+    msg["term"] = follower.core.term + 1
+    effects = follower.core.handle_message(dict(msg), c.now)
+    sends = [e for e in effects if isinstance(e, Send)]
+    assert sends and sends[-1].msg["vote_granted"] is True
+    del lead
+
+
+def test_lease_void_after_leader_transfer_fires():
+    """Once TimeoutNow is sent, the old leader must never serve lease reads
+    again this term — the transfer election bypasses vote stickiness."""
+    c = SimCluster(3, seed=23)
+    c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    lead = c.leader()
+    target = next(nid for nid in c.ids if nid != lead.node_id)
+    effects = lead.core.transfer_leadership(target, c.now)
+    c._process_effects(lead, effects)
+    assert not lead.core.lease_valid(c.now)
+    c.run(1.0)
+    assert c.nodes[target].core.role == Role.LEADER
+
+
+def test_single_node_lease_always_valid():
+    c = SimCluster(1, seed=24)
+    lead = c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    c.run(0.2)
+    assert lead.core.lease_valid(c.now)
+
+
+def test_lease_safe_across_follower_restart():
+    """A follower restarting inside the old leader's lease window must not
+    enable an early election: stickiness state re-initializes to 'heard a
+    leader just now', so the lease still cannot overlap a new leader."""
+    c = SimCluster(3, seed=25)
+    c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    old = c.leader()
+    others = [nid for nid in c.ids if nid != old.node_id]
+    c.partition([old.node_id], others)
+    lease_until = old.core._lease_until
+    assert lease_until > c.now
+    # Restart a healthy-side follower inside the lease window — before the
+    # fix its _last_leader_contact reset let it vote immediately.
+    c.crash(others[0])
+    c.restart(others[0])
+    new_leader_at = None
+    for _ in range(600):
+        c.step()
+        if any(c.nodes[nid].core.role == Role.LEADER for nid in others):
+            new_leader_at = c.now
+            break
+    assert new_leader_at is not None
+    assert new_leader_at >= lease_until, (
+        f"restarted follower enabled a leader at {new_leader_at} inside "
+        f"old lease {lease_until}"
+    )

@@ -224,6 +224,17 @@ class Timings:
     #: incrementing its term, so a partitioned node cannot inflate terms
     #: and depose a healthy leader when its partition heals.
     prevote: bool = True
+    #: Leader-lease reads (Raft §6.4.1 / etcd lease read; the reference has
+    #: only quorum ReadIndex): a heartbeat-quorum ack for a round sent at
+    #: time t proves no new leader can be elected before
+    #: t + election_min (followers refuse votes within election_min of
+    #: leader contact — see vote stickiness in _on_request_vote), so reads
+    #: until t + election_min*(1 - clock_drift_bound) skip the quorum
+    #: round-trip entirely. Only honored when ``prevote`` is also on.
+    lease_reads: bool = True
+    #: Upper bound assumed on relative clock RATE drift between nodes over
+    #: one election timeout (monotonic clocks; absolute offsets cancel out).
+    clock_drift_bound: float = 0.1
 
 
 # ---------------------------------------------------------------------------
@@ -277,7 +288,16 @@ class RaftCore:
         # per-peer highest acked seq, pending reads.
         self._probe_seq = 0
         self._peer_ack_seq: dict[str, int] = {}
-        self._pending_reads: list[dict] = []  # {id, index, seq}
+        self._pending_reads: list[dict] = []  # {id, index, seq, lease?}
+        # Leader-lease machinery: send time per probe round, the lease
+        # expiry, the last instant a quorum was provably reachable (for
+        # check-quorum step-down), and whether a TimeoutNow was fired this
+        # leadership (transfer elections bypass vote stickiness, so the
+        # lease argument is void once one is in flight).
+        self._probe_sent_at: dict[int, float] = {}
+        self._lease_until = float("-inf")
+        self._quorum_contact = now
+        self._transfer_fired = False
         # Membership-change machinery.
         self._catchup: dict | None = None  # {node, rounds_left, last_match}
         self._transfer_target: str | None = None
@@ -287,7 +307,13 @@ class RaftCore:
         # non-binding and never touch term/voted_for.
         self._prevote_term: int | None = None
         self._prevotes: set[str] = set()
-        self._last_leader_contact = float("-inf")
+        # Initialized to NOW, not -inf: a restarted node must conservatively
+        # assume it heard from a leader just before the crash, else its
+        # reset stickiness window lets a new leader be elected inside an
+        # old leader's still-valid lease (stale read). Costs at most one
+        # election_min of vote refusal after boot — elections start no
+        # earlier than that anyway (_election_deadline below).
+        self._last_leader_contact = now
 
         self._election_deadline = now + self._election_timeout()
         self._heartbeat_due = now
@@ -363,8 +389,21 @@ class RaftCore:
         if self.role == Role.LEADER:
             if self._transfer_target and now >= self._transfer_deadline:
                 self._transfer_target = None  # transfer timed out; resume
+            if self.config.has_quorum({self.node_id}):
+                # Single-voter config: the leader alone is the quorum.
+                self._quorum_contact = now
+                self._lease_until = max(
+                    self._lease_until, now + self._lease_duration()
+                )
+            elif now - self._quorum_contact > 2 * self.timings.election_max:
+                # Check-quorum (etcd): a leader that cannot reach a quorum
+                # steps down instead of heartbeat-pinning followers forever
+                # — with vote stickiness, a send-only-partitioned leader
+                # would otherwise block elections indefinitely.
+                return effects + self._step_down(self.term, now)
             if now >= self._heartbeat_due:
                 self._heartbeat_due = now + self.timings.heartbeat
+                self._new_probe_round(now)
                 effects += self._broadcast_append()
             if len(self.log) > self.timings.snapshot_threshold and \
                     self.last_applied >= self.log_start:
@@ -409,7 +448,7 @@ class RaftCore:
             effects += self._start_election(now)
         return effects
 
-    def _start_election(self, now: float) -> list:
+    def _start_election(self, now: float, transfer: bool = False) -> list:
         self.role = Role.CANDIDATE
         self._prevote_term = None
         self._prevotes = set()
@@ -428,6 +467,9 @@ class RaftCore:
                     "candidate_id": self.node_id,
                     "last_log_index": self.last_index,
                     "last_log_term": self.last_term,
+                    # Transfer elections bypass vote stickiness: the old
+                    # leader asked for this election itself.
+                    "transfer": transfer,
                 })
             )
         if self.config.has_quorum(self.votes):  # single-node cluster
@@ -443,11 +485,15 @@ class RaftCore:
         self.match_index = {p: 0 for p in self.config.all_nodes()}
         self._peer_ack_seq = {p: 0 for p in self.config.all_nodes()}
         self._pending_reads = []
+        self._lease_until = float("-inf")  # no lease until own-term quorum
+        self._quorum_contact = now
+        self._transfer_fired = False
         self._heartbeat_due = now + self.timings.heartbeat
         effects: list = [BecameLeader(self.term)]
         # Commit-barrier no-op so this term can commit prior-term entries
         # and ReadIndex is immediately safe once it commits.
         effects += self._append_local({"_noop": True})
+        self._new_probe_round(now)
         effects += self._broadcast_append()
         return effects
 
@@ -465,6 +511,8 @@ class RaftCore:
         self._pending_reads = []
         self._catchup = None
         self._transfer_target = None
+        self._transfer_fired = False
+        self._lease_until = float("-inf")
         self._election_deadline = now + self._election_timeout()
         if was_leader:
             effects.append(SteppedDown(self.term))
@@ -504,6 +552,10 @@ class RaftCore:
             cfg = self._config_of(entry)
             if cfg is not None:
                 self.config = cfg
+                # Quorum membership changed: a lease earned under the old
+                # config must not survive into the new one (joint consensus
+                # makes this redundant in theory; keep it belt-and-braces).
+                self._lease_until = float("-inf")
             entries.append(entry)
         effects: list = [AppendLog(tuple(entries))]
         # Single-node: may commit immediately.
@@ -512,10 +564,61 @@ class RaftCore:
 
     # ------------------------------------------------------------- ReadIndex
 
+    def _new_probe_round(self, now: float) -> None:
+        """Open a heartbeat round: bump the probe seq and record its send
+        time. An ack for seq >= s proves the follower received a message
+        sent no earlier than ``_probe_sent_at[s]`` — the foundation both of
+        the leader lease and of check-quorum."""
+        self._probe_seq += 1
+        self._probe_sent_at[self._probe_seq] = now
+
+    def _lease_duration(self) -> float:
+        return self.timings.election_min * \
+            (1.0 - self.timings.clock_drift_bound)
+
+    def _update_lease(self, now: float) -> None:
+        """Extend the lease from the newest probe round a quorum has acked:
+        every acked follower reset its election timer no earlier than that
+        round's send time, and (vote stickiness) refuses non-transfer votes
+        for election_min after — so no new leader can exist before
+        sent + election_min, drift margin deducted."""
+        if self.role != Role.LEADER:
+            return
+        for s in sorted(set(self._peer_ack_seq.values()), reverse=True):
+            if s <= 0:
+                continue
+            supporters = {self.node_id} | {
+                p for p, q in self._peer_ack_seq.items() if q >= s
+            }
+            if not self.config.has_quorum(supporters):
+                continue
+            sent = self._probe_sent_at.get(s)
+            if sent is not None:
+                self._quorum_contact = max(self._quorum_contact, sent)
+                self._lease_until = max(
+                    self._lease_until, sent + self._lease_duration()
+                )
+                for old in [x for x in self._probe_sent_at if x < s]:
+                    del self._probe_sent_at[old]
+            return
+
+    def lease_valid(self, now: float) -> bool:
+        """True iff a lease read may skip the heartbeat-quorum round-trip."""
+        return (
+            self.role == Role.LEADER
+            and self.timings.lease_reads
+            and self.timings.prevote  # stickiness alone doesn't gate
+            and self._transfer_target is None
+            and not self._transfer_fired
+            and now < self._lease_until
+        )
+
     def read_index(self, request_id: Any, now: float) -> list:
         """Linearizable read barrier (reference simple_raft.rs:1863-1887):
         capture commit_index, then confirm leadership with a heartbeat quorum;
-        ReadReady fires once confirmed AND last_applied has caught up.
+        ReadReady fires once confirmed AND last_applied has caught up. When
+        the leader lease is valid the quorum round-trip is skipped entirely
+        (Raft §6.4.1) — same linearizability, one network round cheaper.
 
         A fresh leader must first commit an entry of its own term (Raft §8 /
         §6.4): until then its commit_index may lag the true cluster commit
@@ -523,12 +626,16 @@ class RaftCore:
         ``_check_reads`` once the current-term no-op commits."""
         if self.role != Role.LEADER:
             raise NotLeaderError(self.leader_id)
-        index = (
-            self.commit_index
-            if self.term_at(self.commit_index) == self.term
-            else None
-        )
-        self._probe_seq += 1
+        own_term_committed = self.term_at(self.commit_index) == self.term
+        if own_term_committed and self.lease_valid(now):
+            index = self.commit_index
+            if self.last_applied >= index:
+                return [ReadReady(request_id, index)]
+            read = {"id": request_id, "index": index, "seq": 0, "lease": True}
+            self._pending_reads.append(read)
+            return []
+        index = self.commit_index if own_term_committed else None
+        self._new_probe_round(now)
         read = {"id": request_id, "index": index, "seq": self._probe_seq}
         self._pending_reads.append(read)
         effects = self._broadcast_append()
@@ -544,6 +651,14 @@ class RaftCore:
         effects: list = []
         remaining: list[dict] = []
         for read in self._pending_reads:
+            if read.get("lease"):
+                # Lease read: index was fixed under a valid lease; it only
+                # waits for the state machine to catch up, never for acks.
+                if self.last_applied >= read["index"]:
+                    effects.append(ReadReady(read["id"], read["index"]))
+                else:
+                    remaining.append(read)
+                continue
             if read["index"] is None:
                 if not own_term_committed:
                     remaining.append(read)
@@ -719,7 +834,16 @@ class RaftCore:
 
     def _on_request_vote(self, msg: dict, now: float) -> list:
         granted = False
-        if int(msg["term"]) >= self.term:
+        # Vote stickiness (etcd check-quorum companion; load-bearing for
+        # leader leases): a node that heard from a live leader within the
+        # minimum election timeout refuses to elect a new one — except for
+        # leadership-transfer elections, which the old leader itself
+        # initiated (and which permanently void its lease, _transfer_fired).
+        sticky = (
+            not msg.get("transfer")
+            and now - self._last_leader_contact < self.timings.election_min
+        )
+        if int(msg["term"]) >= self.term and not sticky:
             up_to_date = (
                 int(msg["last_log_term"]) > self.last_term
                 or (
@@ -842,6 +966,7 @@ class RaftCore:
         seq = int(msg.get("seq", 0))
         if seq > self._peer_ack_seq.get(peer, 0):
             self._peer_ack_seq[peer] = seq
+            self._update_lease(now)
         effects: list = []
         if msg["success"]:
             match = int(msg["match_index"])
@@ -854,6 +979,8 @@ class RaftCore:
             # Leader transfer: fire TimeoutNow once the target caught up
             # (reference initiate_leader_transfer, simple_raft.rs:2740-2813).
             if self._transfer_target == peer and match >= self.last_index:
+                self._transfer_fired = True  # lease void until next term
+                self._lease_until = float("-inf")
                 effects.append(Send(peer, {"type": "timeout_now", "term": self.term}))
             # Keep streaming if the follower is still behind.
             if self.next_index[peer] <= self.last_index:
@@ -913,6 +1040,7 @@ class RaftCore:
         seq = int(msg.get("seq", 0))
         if seq > self._peer_ack_seq.get(peer, 0):
             self._peer_ack_seq[peer] = seq
+            self._update_lease(now)
         self.match_index[peer] = max(self.match_index.get(peer, 0), last)
         self.next_index[peer] = last + 1
         effects = self._advance_commit()
@@ -929,7 +1057,7 @@ class RaftCore:
             return []
         if not self.is_voter or self.role == Role.LEADER:
             return []
-        return self._start_election(now)
+        return self._start_election(now, transfer=True)
 
     # ------------------------------------------------------------ membership
 
@@ -1031,6 +1159,8 @@ class RaftCore:
         self._transfer_target = target
         self._transfer_deadline = now + timeout
         if self.match_index.get(target, 0) >= self.last_index:
+            self._transfer_fired = True  # lease void until next term
+            self._lease_until = float("-inf")
             return [Send(target, {"type": "timeout_now", "term": self.term})]
         return self._send_append(target)
 

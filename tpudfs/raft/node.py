@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Callable
 
@@ -65,6 +66,12 @@ class RaftNode:
         self.node_id = node_id
         self.storage = RaftStorage(data_dir)
         term, voted_for, log, snap = self.storage.load()
+        if timings is None and \
+                os.environ.get("TPUDFS_LEASE_READS", "1") == "0":
+            # Ops escape hatch: force every linearizable read through the
+            # heartbeat-quorum ReadIndex path (e.g. on hosts with suspect
+            # monotonic clocks, where the lease drift bound may not hold).
+            timings = Timings(lease_reads=False)
         self.core = RaftCore(
             node_id,
             Config(voters=frozenset(peers) | {node_id}),
