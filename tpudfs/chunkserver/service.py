@@ -230,18 +230,17 @@ class ChunkServer:
                     "replicas_written": 0,
                 }
 
-        try:
-            await asyncio.to_thread(self.store.write, block_id, data)
-        except (OSError, ValueError) as e:
-            return {"success": False, "error_message": str(e), "replicas_written": 0}
-        self.cache.invalidate(block_id)
-
-        replicas_written = 1
+        # Local write and downstream forward run CONCURRENTLY (HDFS-style
+        # pipelining; the reference writes locally first and only then
+        # forwards, chunkserver.rs:777-825, serializing three disk writes
+        # along the chain). Every hop verifies the in-flight CRC above, so
+        # forwarding before the local fsync completes cannot propagate
+        # corruption; the reply still waits for both, so acks keep their
+        # meaning. Downstream failure is logged, not propagated — the
+        # master's healer repairs under-replication.
         next_servers = list(req.get("next_servers") or [])
+        forward_task = None
         if next_servers:
-            # Synchronous chain forward; downstream failure is logged, not
-            # propagated — the master's healer repairs under-replication
-            # (reference chunkserver.rs:777-825).
             forward = {
                 "block_id": block_id,
                 "data": data,
@@ -249,10 +248,28 @@ class ChunkServer:
                 "expected_crc32c": expected,
                 "master_term": int(req.get("master_term", 0)),
             }
+            forward_task = asyncio.create_task(self.client.call(
+                next_servers[0], SERVICE, "ReplicateBlock", forward,
+                timeout=30.0,
+            ))
+
+        local_err: str | None = None
+        try:
+            await asyncio.to_thread(self.store.write, block_id, data)
+        except (OSError, ValueError) as e:
+            local_err = str(e)
+        except BaseException:
+            # Abnormal exit (handler cancellation at server stop, unexpected
+            # store error): don't orphan the forward RPC task.
+            if forward_task is not None:
+                forward_task.cancel()
+            raise
+        self.cache.invalidate(block_id)
+
+        replicas_written = 0 if local_err else 1
+        if forward_task is not None:
             try:
-                resp = await self.client.call(
-                    next_servers[0], SERVICE, "ReplicateBlock", forward, timeout=30.0
-                )
+                resp = await forward_task
                 if resp.get("success"):
                     replicas_written += int(resp.get("replicas_written", 0))
                 else:
@@ -261,9 +278,16 @@ class ChunkServer:
                         next_servers[0], resp.get("error_message"),
                     )
             except RpcError as e:
-                logger.error("failed to replicate to %s: %s", next_servers[0], e.message)
+                logger.error("failed to replicate to %s: %s",
+                             next_servers[0], e.message)
+        if local_err:
+            # Downstream copies (if any) stay; the healer reconciles the
+            # replica count. The writing client sees the local failure.
+            return {"success": False, "error_message": local_err,
+                    "replicas_written": replicas_written}
 
-        return {"success": True, "error_message": "", "replicas_written": replicas_written}
+        return {"success": True, "error_message": "",
+                "replicas_written": replicas_written}
 
     # ------------------------------------------------------------- read path
 
