@@ -262,57 +262,60 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     # (built and executed, NOT fetched). Count BLOCKS, not files: the final
     # confirm batch is every sweep's blocks plus the warm-up's.
     reader.warm_confirm(
-        warm[0], (FILES + grpc_files) * len(warm) + len(warm)
+        warm[0], (2 * FILES + grpc_files) * len(warm) + len(warm)
     )
+
+    async def timed_sweep(items, read_fn):
+        """Shared sweep harness: sem-gated concurrent per-item reads, one
+        block_until_ready over every array AND pending CRC (transfer +
+        on-device fold complete — no readback; see Timing protocol)."""
+        blocks: list = []
+
+        async def one(item):
+            async with sem:
+                bs = await read_fn(item)
+                blocks.extend(bs)
+                return sum(b.size for b in bs)
+
+        t0 = time.perf_counter()
+        sizes = await asyncio.gather(*(one(it) for it in items))
+        jax.block_until_ready([b.array for b in blocks]
+                              + [b.pending_crc for b in blocks
+                                 if b.pending_crc is not None])
+        return blocks, sum(sizes) / (time.perf_counter() - t0) / 1e9
 
     # ---- remote read path: short-circuit disabled — what a non-colocated
     # client gets over gRPC. Verification is dispatched in-window (the CRC
     # folds are part of the measured work), resolved by the final confirm.
     client.local_reads = False
-    grpc_blocks: list = []
-
-    async def read_remote(i):
-        async with sem:
-            blocks = await reader.read_file_to_device_blocks(
-                f"/bench/f{i:04d}", verify="lazy"
-            )
-            grpc_blocks.extend(blocks)
-            return sum(b.size for b in blocks)
-
-    t0 = time.perf_counter()
-    sizes_g = await asyncio.gather(*(read_remote(i) for i in range(grpc_files)))
-    jax.block_until_ready([b.array for b in grpc_blocks]
-                          + [b.pending_crc for b in grpc_blocks
-                             if b.pending_crc is not None])
-    grpc_gbps = sum(sizes_g) / (time.perf_counter() - t0) / 1e9
+    grpc_blocks, grpc_gbps = await timed_sweep(
+        range(grpc_files),
+        lambda i: reader.read_file_to_device_blocks(
+            f"/bench/f{i:04d}", verify="lazy"),
+    )
     client.local_reads = True
 
     # ---- primary read path: short-circuit (client colocated with the
     # chunkservers — the north-star topology): verified pread off the
-    # replica's disk, no gRPC byte shuffle. The timed window covers fetch
-    # + device_put + the on-device CRC fold of every block, synchronized
-    # with block_until_ready; the verdict readback happens once, after all
-    # timed windows (see Timing protocol).
-    all_blocks: list = []
-
-    async def read_one(i):
-        async with sem:
-            blocks = await reader.read_file_to_device_blocks(
-                f"/bench/f{i:04d}", verify="lazy"
-            )
-            all_blocks.extend(blocks)
-            return sum(b.size for b in blocks)
-
+    # replica's disk, no gRPC byte shuffle.
     local_before = client.local_read_blocks
-    t0 = time.perf_counter()
-    sizes = await asyncio.gather(*(read_one(i) for i in range(FILES)))
-    jax.block_until_ready([b.array for b in all_blocks]
-                          + [b.pending_crc for b in all_blocks
-                             if b.pending_crc is not None])
-    wall = time.perf_counter() - t0
-    total = sum(sizes)
-    achieved = total / wall / 1e9
+    all_blocks, achieved = await timed_sweep(
+        range(FILES),
+        lambda i: reader.read_file_to_device_blocks(
+            f"/bench/f{i:04d}", verify="lazy"),
+    )
     local_blocks = client.local_read_blocks - local_before
+
+    # ---- warm infeed sweep: the steady-state training-infeed pattern. The
+    # immutable block layout is cached ONCE outside the window (exactly how
+    # the grain infeed reads, via read_meta_range) and colocated replicas
+    # go through the one-thread-hop fast path; on-device CRC still runs.
+    metas = await asyncio.gather(
+        *(client.get_file_info(f"/bench/f{i:04d}") for i in range(FILES))
+    )
+    warm_blocks, warm_gbps = await timed_sweep(
+        metas, lambda m: reader.read_meta_blocks_fast(m, device)
+    )
 
     # ---- on-chip benches: pure device compute (H2D warm-up only), still
     # ahead of the first D2H so their inputs upload at full speed.
@@ -322,10 +325,11 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     # ---- end of timed windows: ONE batched verdict fetch resolves every
     # lazy verification (the process's first D2H), then assert.
     t0 = time.perf_counter()
-    await reader.confirm(all_blocks + grpc_blocks + warm)
+    await reader.confirm(all_blocks + grpc_blocks + warm_blocks + warm)
     confirm_s = time.perf_counter() - t0
     assert all(b.verified for b in all_blocks)
     assert all(b.verified for b in grpc_blocks)
+    assert all(b.verified for b in warm_blocks)
     assert np.asarray(ici_oks).all(), "ICI write step verification failed"
     assert (np.asarray(ec_acks) == 1).all(), "EC scatter verification failed"
 
@@ -350,6 +354,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "unit": "GB/s",
         "vs_baseline": round(achieved / target, 3) if target else 0.0,
         "grpc_read_GBps": round(grpc_gbps, 3),
+        "warm_infeed_read_GBps": round(warm_gbps, 3),
         "local_read_blocks": local_blocks,
         "confirm_s": round(confirm_s, 3),
         "write_pipeline_GBps": round(write_gbps, 3),
@@ -400,6 +405,10 @@ def main() -> None:
         fell_back = True
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    if requested_cpu or fell_back:
+        # The env var alone is NOT enough: the preloaded axon TPU plugin
+        # still wins the backend race (and hangs when the tunnel is
+        # wedged) unless the platform is forced before first backend use.
         import jax
 
         jax.config.update("jax_platforms", "cpu")

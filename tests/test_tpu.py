@@ -625,3 +625,73 @@ async def test_hbm_reader_retries_corrupt_local_replica_lazy(tmp_path):
         assert got == data
     finally:
         await c.stop()
+
+
+# ------------------------------------------------- warm infeed fast path
+
+
+async def test_read_meta_blocks_fast_roundtrip(tmp_path):
+    """Cached-meta fast path: after one normal read primes the local-store
+    probes, read_meta_blocks_fast returns verified blocks with no master
+    round-trip, bit-identical to the file."""
+    data = _rand(6 * 64 * 1024, seed=30)
+    c, client = await _cluster_with_files(tmp_path, [("/wf/a", data)])
+    try:
+        reader = HbmReader(client, jax.devices()[:1])
+        meta = await client.get_file_info("/wf/a")
+        prime = await reader.read_file_to_device_blocks("/wf/a",
+                                                        verify="lazy")
+        await reader.confirm(prime)
+        before = client.local_read_blocks
+        blocks = await reader.read_meta_blocks_fast(meta)
+        await reader.confirm(blocks)
+        assert all(b.verified for b in blocks)
+        got = b"".join(device_array_to_bytes(b.array, b.size) for b in blocks)
+        assert got == data
+        # the fast path bypasses client._read_local (no counter bump) but
+        # must not have gone to the master or chunkserver RPCs either
+        assert client.local_read_blocks == before
+    finally:
+        await c.stop()
+
+
+async def test_read_meta_blocks_fast_rot_failover(tmp_path):
+    """Bit-rot under the fast path resolves through the confirm retry."""
+    data = _rand(16 * 512, seed=31)
+    c, client = await _cluster_with_files(tmp_path, [("/wf/b", data)])
+    try:
+        reader = HbmReader(client, jax.devices()[:1])
+        meta = await client.get_file_info("/wf/b")
+        prime = await reader.read_file_to_device_blocks("/wf/b",
+                                                        verify="lazy")
+        await reader.confirm(prime)
+        await _corrupt_first_replica(c, client, "/wf/b")
+        blocks = await reader.read_meta_blocks_fast(meta)
+        await reader.confirm(blocks)
+        assert all(b.verified for b in blocks)
+        got = b"".join(device_array_to_bytes(b.array, b.size) for b in blocks)
+        assert got == data
+    finally:
+        await c.stop()
+
+
+async def test_read_meta_blocks_fast_tail_rot_failover(tmp_path):
+    """A NON-512-aligned (tail) block verifies eagerly even under lazy
+    mode; rot in the colocated replica must fall back through the general
+    path's retry instead of failing the sweep."""
+    data = _rand(5 * 512 + 100, seed=32)  # single unaligned block
+    c, client = await _cluster_with_files(tmp_path, [("/wf/c", data)])
+    try:
+        reader = HbmReader(client, jax.devices()[:1])
+        meta = await client.get_file_info("/wf/c")
+        prime = await reader.read_file_to_device_blocks("/wf/c",
+                                                        verify="lazy")
+        await reader.confirm(prime)
+        await _corrupt_first_replica(c, client, "/wf/c")
+        blocks = await reader.read_meta_blocks_fast(meta)
+        await reader.confirm(blocks)
+        assert all(b.verified for b in blocks)
+        got = b"".join(device_array_to_bytes(b.array, b.size) for b in blocks)
+        assert got == data
+    finally:
+        await c.stop()
