@@ -25,6 +25,12 @@
 //        checksum mismatch, TPUDFS_ENOMETA (-200003) when the sidecar file
 //        is absent, or -errno on I/O failure. expected_chunk=0 skips the
 //        store-chunk-size cross-check.
+//   int64_t tpudfs_block_write_staged(...same as tpudfs_block_write...);
+//     -> writes <path>.tmp files WITHOUT fsync/rename — group-commit
+//        staging; publish with renames + tpudfs_syncfs afterwards.
+//   int64_t tpudfs_syncfs(const char* path);
+//     -> syncfs(2) on the filesystem containing path (one syscall makes a
+//        whole staged batch durable), or -errno.
 
 #include <cerrno>
 #include <cstdint>
@@ -48,9 +54,9 @@ constexpr char kMagic[4] = {'T', 'P', 'U', 'M'};
 constexpr uint16_t kVersion = 1;
 constexpr size_t kHeader = 16;  // 4s + u16 + u16 + u32 + u32
 
-// Durable publish: write whole buffer to <path>.tmp, fsync, rename.
-int64_t write_durable(const std::string& path, const uint8_t* data,
-                      uint64_t len) {
+// Write whole buffer to <path>.tmp; fsync iff `durable`.
+int64_t write_tmp(const std::string& path, const uint8_t* data, uint64_t len,
+                  bool durable) {
   std::string tmp = path + ".tmp";
   int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
   if (fd < 0) return -errno;
@@ -66,13 +72,22 @@ int64_t write_durable(const std::string& path, const uint8_t* data,
     }
     done += static_cast<uint64_t>(n);
   }
-  if (::fsync(fd) != 0) {
+  if (durable && ::fsync(fd) != 0) {
     int e = errno;
     ::close(fd);
     ::unlink(tmp.c_str());
     return -e;
   }
   ::close(fd);
+  return 0;
+}
+
+// Durable publish: write whole buffer to <path>.tmp, fsync, rename.
+int64_t write_durable(const std::string& path, const uint8_t* data,
+                      uint64_t len) {
+  int64_t rc = write_tmp(path, data, len, /*durable=*/true);
+  if (rc != 0) return rc;
+  std::string tmp = path + ".tmp";
   if (::rename(tmp.c_str(), path.c_str()) != 0) return -errno;
   return 0;
 }
@@ -95,11 +110,11 @@ uint32_t get_u32(const uint8_t* p) {
 
 }  // namespace
 
-extern "C" {
+namespace {
 
-int64_t tpudfs_block_write(const char* data_path, const char* meta_path,
-                           const uint8_t* data, uint64_t len, uint32_t chunk,
-                           uint32_t* out_crcs) {
+int64_t block_write_impl(const char* data_path, const char* meta_path,
+                         const uint8_t* data, uint64_t len, uint32_t chunk,
+                         uint32_t* out_crcs, bool staged) {
   if (chunk == 0) return kBadMeta;
   uint64_t n = (len + chunk - 1) / chunk;
   std::vector<uint8_t> meta(kHeader + n * 4);
@@ -115,11 +130,46 @@ int64_t tpudfs_block_write(const char* data_path, const char* meta_path,
     put_u32(meta.data() + kHeader + i * 4, c);
     if (out_crcs) out_crcs[i] = c;
   }
-  int64_t rc = write_durable(data_path, data, len);
-  if (rc != 0) return rc;
-  rc = write_durable(meta_path, meta.data(), meta.size());
+  int64_t rc;
+  if (staged) {
+    rc = write_tmp(data_path, data, len, /*durable=*/false);
+    if (rc != 0) return rc;
+    rc = write_tmp(meta_path, meta.data(), meta.size(), /*durable=*/false);
+  } else {
+    rc = write_durable(data_path, data, len);
+    if (rc != 0) return rc;
+    rc = write_durable(meta_path, meta.data(), meta.size());
+  }
   if (rc != 0) return rc;
   return static_cast<int64_t>(n);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t tpudfs_block_write(const char* data_path, const char* meta_path,
+                           const uint8_t* data, uint64_t len, uint32_t chunk,
+                           uint32_t* out_crcs) {
+  return block_write_impl(data_path, meta_path, data, len, chunk, out_crcs,
+                          /*staged=*/false);
+}
+
+int64_t tpudfs_block_write_staged(const char* data_path,
+                                  const char* meta_path, const uint8_t* data,
+                                  uint64_t len, uint32_t chunk,
+                                  uint32_t* out_crcs) {
+  return block_write_impl(data_path, meta_path, data, len, chunk, out_crcs,
+                          /*staged=*/true);
+}
+
+int64_t tpudfs_syncfs(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  int rc = ::syncfs(fd);
+  int e = errno;
+  ::close(fd);
+  return rc == 0 ? 0 : -e;
 }
 
 int64_t tpudfs_block_read_verify(const char* data_path, const char* meta_path,

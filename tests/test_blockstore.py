@@ -164,3 +164,92 @@ def test_read_verified_fallback_matches_native(store, monkeypatch):
     native_result = store.read_verified("fb", 200, 900)
     monkeypatch.setattr(native, "get_lib", lambda: None)
     assert store.read_verified("fb", 200, 900) == native_result
+
+
+# ------------------------------------------------------------ group commit
+
+
+def test_write_staged_publish_batch_roundtrip(tmp_path):
+    from tpudfs.common.checksum import crc32c_chunks
+
+    store = BlockStore(tmp_path / "hot", owner=True)
+    datas = {f"b{i}": bytes([i]) * (1000 + i) for i in range(5)}
+    for bid, data in datas.items():
+        crcs = store.write_staged(bid, data)
+        assert (crcs == crc32c_chunks(data)).all()
+        assert not store.exists(bid)  # staged, not yet visible
+    store.publish_staged_batch(list(datas) + ["b0"])  # dup id tolerated
+    for bid, data in datas.items():
+        assert store.read_verified(bid) == data
+
+
+def test_staged_discard_and_boot_cleanup(tmp_path):
+    store = BlockStore(tmp_path / "hot", owner=True)
+    store.write_staged("gone", b"x" * 100)
+    store.discard_staged("gone")
+    assert not list((tmp_path / "hot").glob("*.tmp"))
+    store.write_staged("orphan", b"y" * 100)
+    # Non-owner view (a client's short-circuit store) must NOT clean up...
+    BlockStore(tmp_path / "hot")
+    assert list((tmp_path / "hot").glob("*.tmp"))
+    # ...while the owning chunkserver's restart does.
+    BlockStore(tmp_path / "hot", owner=True)
+    assert not list((tmp_path / "hot").glob("*.tmp"))
+
+
+async def test_group_committer_batches_and_acks(tmp_path):
+    import asyncio
+
+    from tpudfs.chunkserver.service import GroupCommitter
+
+    store = BlockStore(tmp_path / "hot", owner=True)
+    calls: list[list[str]] = []
+    orig = store.publish_staged_batch
+    store.publish_staged_batch = lambda ids: (calls.append(list(ids)),
+                                              orig(ids))[1]
+    gc = GroupCommitter(store)
+    datas = {f"g{i}": bytes([i]) * 2048 for i in range(8)}
+    await asyncio.gather(*(gc.write(b, d) for b, d in datas.items()))
+    for bid, data in datas.items():
+        assert store.read_verified(bid) == data
+    # Concurrent writes coalesced into fewer publish batches.
+    assert sum(len(c) for c in calls) == len(datas)
+    assert len(calls) < len(datas)
+
+
+def test_publish_batch_isolates_failures(tmp_path):
+    """One unrenameable entry must not poison the batch: the rest publish
+    durably and the failure comes back per-id."""
+    store = BlockStore(tmp_path / "hot", owner=True)
+    for i in range(3):
+        store.write_staged(f"p{i}", bytes([i]) * 512)
+    (tmp_path / "hot" / "p1.tmp").unlink()  # sabotage one entry
+    failed = store.publish_staged_batch(["p0", "p1", "p2"])
+    assert [bid for bid, _ in failed] == ["p1"]
+    assert store.read_verified("p0") == bytes([0]) * 512
+    assert store.read_verified("p2") == bytes([2]) * 512
+
+
+def test_discard_staged_rejects_traversal(tmp_path):
+    store = BlockStore(tmp_path / "hot", owner=True)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        store.discard_staged("../../evil")
+
+
+async def test_group_committer_serializes_same_block(tmp_path):
+    import asyncio
+
+    from tpudfs.chunkserver.service import GroupCommitter
+
+    store = BlockStore(tmp_path / "hot", owner=True)
+    gc = GroupCommitter(store)
+    a = b"A" * 4096
+    b = b"B" * 4096
+    # Many concurrent writes to ONE block id: all must ack, the store must
+    # hold a complete, verified copy from one of them (never a tear).
+    await asyncio.gather(*(gc.write("same", a if i % 2 else b)
+                           for i in range(10)))
+    got = store.read_verified("same")
+    assert got in (a, b)

@@ -37,7 +37,7 @@ def parse_args(argv=None):
 
 
 async def amain(args) -> None:
-    store = BlockStore(args.data_dir, args.cold_dir)
+    store = BlockStore(args.data_dir, args.cold_dir, owner=True)
     masters = [m for m in args.masters.split(",") if m]
     configs = [c for c in args.config_servers.split(",") if c]
     stls, ctls = tls_from_args(args)

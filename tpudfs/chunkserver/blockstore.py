@@ -58,13 +58,24 @@ def _check_block_id(block_id: str) -> None:
 
 class BlockStore:
     def __init__(self, hot_dir: str | Path, cold_dir: str | Path | None = None,
-                 chunk_size: int = CHECKSUM_CHUNK_SIZE):
+                 chunk_size: int = CHECKSUM_CHUNK_SIZE, *,
+                 owner: bool = False):
         self.hot_dir = Path(hot_dir)
         self.cold_dir = Path(cold_dir) if cold_dir else None
         self.chunk_size = chunk_size
         self.hot_dir.mkdir(parents=True, exist_ok=True)
         if self.cold_dir:
             self.cold_dir.mkdir(parents=True, exist_ok=True)
+        if owner:
+            # A crash between staging and publish leaves orphan .tmp files —
+            # never valid state, safe for the OWNING chunkserver to drop at
+            # boot. Non-owner stores (a client's short-circuit view of a
+            # LIVE chunkserver directory) must never touch them: they may
+            # be another process's in-flight staged writes.
+            for d in (self.hot_dir, self.cold_dir):
+                if d is not None:
+                    for stale in d.glob("*.tmp"):
+                        stale.unlink(missing_ok=True)
 
     # -- paths --------------------------------------------------------------
 
@@ -112,6 +123,103 @@ class BlockStore:
     @staticmethod
     def _write_durable(path: Path, data: bytes) -> None:
         write_durable(path, data)
+
+    # -- group commit -------------------------------------------------------
+
+    def write_staged(self, block_id: str, data: bytes) -> np.ndarray:
+        """Stage block + sidecar as ``.tmp`` files WITHOUT fsync or rename —
+        step 1 of group commit. Returns the per-chunk CRCs. Durability and
+        visibility come from ``publish_staged_batch``."""
+        _check_block_id(block_id)
+        path = self.hot_dir / block_id
+        lib = native.get_lib()
+        if lib is not None and hasattr(lib, "tpudfs_block_write_staged"):
+            n = (len(data) + self.chunk_size - 1) // self.chunk_size
+            out = np.empty(n, dtype="<u4")
+            rc = lib.tpudfs_block_write_staged(
+                str(path).encode(), str(self._meta_path(path)).encode(),
+                data, len(data), self.chunk_size,
+                out.ctypes.data if n else None,
+            )
+            if rc < 0:
+                raise OSError(-rc, os.strerror(int(-rc)), str(path))
+            return out.astype(np.uint32)
+        checksums = crc32c_chunks(data, self.chunk_size)
+        with open(f"{path}.tmp", "wb") as f:
+            f.write(data)
+        mp = self._meta_path(path)
+        with open(f"{mp}.tmp", "wb") as f:
+            f.write(self._encode_meta(checksums))
+        return checksums
+
+    def publish_staged_batch(self, block_ids: list[str]) -> list[tuple[str, str]]:
+        """Step 2 of group commit: ONE filesystem sync makes every staged
+        ``.tmp`` in the batch durable, renames publish them, and a second
+        sync persists the renames — two syncs amortized over the whole
+        batch instead of two fsyncs per file. A single-entry batch takes
+        the targeted per-file fsync path instead (a filesystem-wide sync
+        would couple an idle-cluster write's latency to unrelated dirty
+        data). A crash between the renames and the final sync can lose or
+        tear un-acked publications; boot cleanup plus sidecar verification
+        treats those as absent/corrupt, which the healer repairs — the ack
+        is only sent after this returns.
+
+        Returns ``[(block_id, error)]`` for entries that failed to publish;
+        every OTHER entry is durable when this returns (the final sync runs
+        regardless of individual failures)."""
+        ids = list(dict.fromkeys(block_ids))
+        for bid in ids:
+            _check_block_id(bid)
+        if not ids:
+            return []
+        if len(ids) == 1:
+            try:
+                self._publish_one_durable(ids[0])
+            except OSError as e:
+                return [(ids[0], str(e))]
+            return []
+        failed: list[tuple[str, str]] = []
+        self._syncfs()
+        for bid in ids:
+            path = self.hot_dir / bid
+            mp = self._meta_path(path)
+            try:
+                os.rename(f"{path}.tmp", path)
+                os.rename(f"{mp}.tmp", mp)
+            except OSError as e:
+                # One bad entry must not poison the batch: record it and
+                # keep publishing the rest.
+                failed.append((bid, str(e)))
+        self._syncfs()
+        return failed
+
+    def _publish_one_durable(self, block_id: str) -> None:
+        """Targeted publish of one staged block: fsync both tmp files,
+        then rename — the fused-write durability without a fs-wide sync."""
+        path = self.hot_dir / block_id
+        for p in (path, self._meta_path(path)):
+            tmp = f"{p}.tmp"
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            os.rename(tmp, p)
+
+    def discard_staged(self, block_id: str) -> None:
+        _check_block_id(block_id)
+        path = self.hot_dir / block_id
+        for p in (Path(f"{path}.tmp"), Path(f"{self._meta_path(path)}.tmp")):
+            p.unlink(missing_ok=True)
+
+    def _syncfs(self) -> None:
+        lib = native.get_lib()
+        if lib is not None and hasattr(lib, "tpudfs_syncfs"):
+            rc = lib.tpudfs_syncfs(str(self.hot_dir).encode())
+            if rc < 0:
+                raise OSError(-rc, os.strerror(int(-rc)), str(self.hot_dir))
+        else:
+            os.sync()
 
     def _encode_meta(self, checksums: np.ndarray) -> bytes:
         header = _META_HEADER.pack(

@@ -110,6 +110,16 @@ def get_lib() -> ctypes.CDLL | None:
         # engine: checksum/GF math still work, block ops use the fallback.
         logger.warning("native library has no block I/O engine; "
                        "using Python block path")
+    try:
+        lib.tpudfs_block_write_staged.restype = ctypes.c_int64
+        lib.tpudfs_block_write_staged.argtypes = \
+            list(lib.tpudfs_block_write.argtypes)
+        lib.tpudfs_syncfs.restype = ctypes.c_int64
+        lib.tpudfs_syncfs.argtypes = [ctypes.c_char_p]
+    except AttributeError:
+        # Prebuilt library predating group-commit staging; per-block
+        # durable writes still work.
+        pass
     lib.tpudfs_gf256_mul.restype = ctypes.c_uint8
     lib.tpudfs_gf256_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
     lib.tpudfs_gf256_mul_slice.restype = None
