@@ -26,8 +26,10 @@
 //        is absent, or -errno on I/O failure. expected_chunk=0 skips the
 //        store-chunk-size cross-check.
 //   int64_t tpudfs_block_write_staged(...same as tpudfs_block_write...);
-//     -> writes <path>.tmp files WITHOUT fsync/rename — group-commit
-//        staging; publish with renames + tpudfs_syncfs afterwards.
+//     -> writes data_path/meta_path EXACTLY AS GIVEN, no fsync/rename —
+//        group-commit staging: the caller passes its own per-writer tmp
+//        paths (unique names, so concurrent same-block stagers can never
+//        truncate each other) and publishes with renames + tpudfs_syncfs.
 //   int64_t tpudfs_syncfs(const char* path);
 //     -> syncfs(2) on the filesystem containing path (one syscall makes a
 //        whole staged batch durable), or -errno.
@@ -54,10 +56,9 @@ constexpr char kMagic[4] = {'T', 'P', 'U', 'M'};
 constexpr uint16_t kVersion = 1;
 constexpr size_t kHeader = 16;  // 4s + u16 + u16 + u32 + u32
 
-// Write whole buffer to <path>.tmp; fsync iff `durable`.
-int64_t write_tmp(const std::string& path, const uint8_t* data, uint64_t len,
+// Write whole buffer to exactly `tmp`; fsync iff `durable`.
+int64_t write_tmp(const std::string& tmp, const uint8_t* data, uint64_t len,
                   bool durable) {
-  std::string tmp = path + ".tmp";
   int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
   if (fd < 0) return -errno;
   uint64_t done = 0;
@@ -85,9 +86,9 @@ int64_t write_tmp(const std::string& path, const uint8_t* data, uint64_t len,
 // Durable publish: write whole buffer to <path>.tmp, fsync, rename.
 int64_t write_durable(const std::string& path, const uint8_t* data,
                       uint64_t len) {
-  int64_t rc = write_tmp(path, data, len, /*durable=*/true);
-  if (rc != 0) return rc;
   std::string tmp = path + ".tmp";
+  int64_t rc = write_tmp(tmp, data, len, /*durable=*/true);
+  if (rc != 0) return rc;
   if (::rename(tmp.c_str(), path.c_str()) != 0) return -errno;
   return 0;
 }

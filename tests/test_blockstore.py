@@ -174,27 +174,40 @@ def test_write_staged_publish_batch_roundtrip(tmp_path):
 
     store = BlockStore(tmp_path / "hot", owner=True)
     datas = {f"b{i}": bytes([i]) * (1000 + i) for i in range(5)}
-    for bid, data in datas.items():
-        crcs = store.write_staged(bid, data)
+    entries = []
+    for i, (bid, data) in enumerate(datas.items()):
+        crcs = store.write_staged(bid, data, f"tok{i}")
+        entries.append((bid, f"tok{i}"))
         assert (crcs == crc32c_chunks(data)).all()
         assert not store.exists(bid)  # staged, not yet visible
-    store.publish_staged_batch(list(datas) + ["b0"])  # dup id tolerated
+    store.publish_staged_batch(entries)
     for bid, data in datas.items():
         assert store.read_verified(bid) == data
 
 
+def test_write_staged_same_block_tokens_never_collide(tmp_path):
+    """Concurrent same-block stagers own private tmp files; last publish
+    wins with a complete data+sidecar pair."""
+    store = BlockStore(tmp_path / "hot", owner=True)
+    a, b = b"A" * 4096, b"B" * 5120
+    store.write_staged("x", a, "aaaa")
+    store.write_staged("x", b, "bbbb")  # must not touch aaaa's files
+    store.publish_staged_batch([("x", "aaaa"), ("x", "bbbb")])
+    assert store.read_verified("x") == b
+
+
 def test_staged_discard_and_boot_cleanup(tmp_path):
     store = BlockStore(tmp_path / "hot", owner=True)
-    store.write_staged("gone", b"x" * 100)
-    store.discard_staged("gone")
-    assert not list((tmp_path / "hot").glob("*.tmp"))
-    store.write_staged("orphan", b"y" * 100)
+    store.write_staged("gone", b"x" * 100, "t1")
+    store.discard_staged("gone", "t1")
+    assert not list((tmp_path / "hot").glob("*.tmp-*"))
+    store.write_staged("orphan", b"y" * 100, "t2")
     # Non-owner view (a client's short-circuit store) must NOT clean up...
     BlockStore(tmp_path / "hot")
-    assert list((tmp_path / "hot").glob("*.tmp"))
+    assert list((tmp_path / "hot").glob("*.tmp-*"))
     # ...while the owning chunkserver's restart does.
     BlockStore(tmp_path / "hot", owner=True)
-    assert not list((tmp_path / "hot").glob("*.tmp"))
+    assert not list((tmp_path / "hot").glob("*.tmp-*"))
 
 
 async def test_group_committer_batches_and_acks(tmp_path):
@@ -222,9 +235,10 @@ def test_publish_batch_isolates_failures(tmp_path):
     durably and the failure comes back per-id."""
     store = BlockStore(tmp_path / "hot", owner=True)
     for i in range(3):
-        store.write_staged(f"p{i}", bytes([i]) * 512)
-    (tmp_path / "hot" / "p1.tmp").unlink()  # sabotage one entry
-    failed = store.publish_staged_batch(["p0", "p1", "p2"])
+        store.write_staged(f"p{i}", bytes([i]) * 512, f"t{i}")
+    (tmp_path / "hot" / "p1.tmp-t1").unlink()  # sabotage one entry
+    failed = store.publish_staged_batch([("p0", "t0"), ("p1", "t1"),
+                                         ("p2", "t2")])
     assert [bid for bid, _ in failed] == ["p1"]
     assert store.read_verified("p0") == bytes([0]) * 512
     assert store.read_verified("p2") == bytes([2]) * 512
@@ -235,7 +249,9 @@ def test_discard_staged_rejects_traversal(tmp_path):
     import pytest as _pytest
 
     with _pytest.raises(ValueError):
-        store.discard_staged("../../evil")
+        store.discard_staged("../../evil", "tok")
+    with _pytest.raises(ValueError):
+        store.discard_staged("ok", "../trav")
 
 
 async def test_group_committer_serializes_same_block(tmp_path):

@@ -732,3 +732,77 @@ async def test_unsigned_trailer_requires_signed_announce(tmp_path):
         assert "signed header" in ei.value.message
     finally:
         await c.stop()
+
+
+# --------------------------------------------------------- user metadata
+
+
+async def test_user_metadata_roundtrip_and_copy(tmp_path):
+    """x-amz-meta-* headers persist with the object and come back on GET
+    and HEAD (reference handlers.rs:985-1010,1060-1080); CopyObject
+    propagates them by default and replaces them under
+    x-amz-metadata-directive: REPLACE."""
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b1"))
+        r = await gw.handle(req(
+            "PUT", "/b1/meta.bin", body=b"payload",
+            headers={"x-amz-meta-owner": "alice",
+                     "X-Amz-Meta-Rev": "7",
+                     "x-ignored": "nope"},
+        ))
+        assert r.status == 200
+        for method in ("GET", "HEAD"):
+            r = await gw.handle(req(method, "/b1/meta.bin"))
+            assert r.status == 200
+            assert r.headers.get("x-amz-meta-owner") == "alice"
+            assert r.headers.get("x-amz-meta-rev") == "7"
+            assert "x-ignored" not in r.headers
+
+        # COPY (default): user metadata travels with the object.
+        r = await gw.handle(req(
+            "PUT", "/b1/copy.bin",
+            headers={"x-amz-copy-source": "/b1/meta.bin"},
+        ))
+        assert r.status == 200
+        r = await gw.handle(req("HEAD", "/b1/copy.bin"))
+        assert r.headers.get("x-amz-meta-owner") == "alice"
+
+        # REPLACE: only the new headers stick.
+        r = await gw.handle(req(
+            "PUT", "/b1/copy2.bin",
+            headers={"x-amz-copy-source": "/b1/meta.bin",
+                     "x-amz-metadata-directive": "REPLACE",
+                     "x-amz-meta-fresh": "yes"},
+        ))
+        assert r.status == 200
+        r = await gw.handle(req("HEAD", "/b1/copy2.bin"))
+        assert r.headers.get("x-amz-meta-fresh") == "yes"
+        assert "x-amz-meta-owner" not in r.headers
+
+        # Overwriting without metadata clears it.
+        await gw.handle(req("PUT", "/b1/meta.bin", body=b"v2"))
+        r = await gw.handle(req("HEAD", "/b1/meta.bin"))
+        assert "x-amz-meta-owner" not in r.headers
+    finally:
+        await c.stop()
+
+
+async def test_user_metadata_limits_and_directive_validation(tmp_path):
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b1"))
+        r = await gw.handle(req(
+            "PUT", "/b1/big.bin", body=b"x",
+            headers={"x-amz-meta-blob": "v" * 3000},
+        ))
+        assert r.status == 400 and b"MetadataTooLarge" in r.body
+        await gw.handle(req("PUT", "/b1/src.bin", body=b"x"))
+        r = await gw.handle(req(
+            "PUT", "/b1/dst.bin",
+            headers={"x-amz-copy-source": "/b1/src.bin",
+                     "x-amz-metadata-directive": "REPLACE_ALL"},
+        ))
+        assert r.status == 400 and b"InvalidArgument" in r.body
+    finally:
+        await c.stop()

@@ -67,15 +67,17 @@ class BlockStore:
         if self.cold_dir:
             self.cold_dir.mkdir(parents=True, exist_ok=True)
         if owner:
-            # A crash between staging and publish leaves orphan .tmp files —
-            # never valid state, safe for the OWNING chunkserver to drop at
-            # boot. Non-owner stores (a client's short-circuit view of a
-            # LIVE chunkserver directory) must never touch them: they may
-            # be another process's in-flight staged writes.
+            # A crash between staging and publish leaves orphan .tmp /
+            # .tmp-<token> files — never valid state, safe for the OWNING
+            # chunkserver to drop at boot. Non-owner stores (a client's
+            # short-circuit view of a LIVE chunkserver directory) must
+            # never touch them: they may be another process's in-flight
+            # staged writes.
             for d in (self.hot_dir, self.cold_dir):
                 if d is not None:
-                    for stale in d.glob("*.tmp"):
-                        stale.unlink(missing_ok=True)
+                    for pattern in ("*.tmp", "*.tmp-*"):
+                        for stale in d.glob(pattern):
+                            stale.unlink(missing_ok=True)
 
     # -- paths --------------------------------------------------------------
 
@@ -126,66 +128,78 @@ class BlockStore:
 
     # -- group commit -------------------------------------------------------
 
-    def write_staged(self, block_id: str, data: bytes) -> np.ndarray:
-        """Stage block + sidecar as ``.tmp`` files WITHOUT fsync or rename —
-        step 1 of group commit. Returns the per-chunk CRCs. Durability and
-        visibility come from ``publish_staged_batch``."""
+    def _staged_paths(self, block_id: str, token: str) -> tuple[Path, Path]:
         _check_block_id(block_id)
+        if not token.isalnum():
+            raise ValueError(f"invalid staging token: {token!r}")
         path = self.hot_dir / block_id
+        return (Path(f"{path}.tmp-{token}"),
+                Path(f"{self._meta_path(path)}.tmp-{token}"))
+
+    def write_staged(self, block_id: str, data: bytes,
+                     token: str) -> np.ndarray:
+        """Stage block + sidecar as PER-WRITER ``.tmp-<token>`` files
+        WITHOUT fsync or rename — step 1 of group commit. Unique names mean
+        concurrent stagers of the same block (retries, recovery racing a
+        client write) can never truncate each other's files; whichever
+        publish renames last wins with a complete data+sidecar pair.
+        Returns the per-chunk CRCs; durability and visibility come from
+        ``publish_staged_batch``."""
+        dtmp, mtmp = self._staged_paths(block_id, token)
         lib = native.get_lib()
         if lib is not None and hasattr(lib, "tpudfs_block_write_staged"):
             n = (len(data) + self.chunk_size - 1) // self.chunk_size
             out = np.empty(n, dtype="<u4")
             rc = lib.tpudfs_block_write_staged(
-                str(path).encode(), str(self._meta_path(path)).encode(),
+                str(dtmp).encode(), str(mtmp).encode(),
                 data, len(data), self.chunk_size,
                 out.ctypes.data if n else None,
             )
             if rc < 0:
-                raise OSError(-rc, os.strerror(int(-rc)), str(path))
+                raise OSError(-rc, os.strerror(int(-rc)), str(dtmp))
             return out.astype(np.uint32)
         checksums = crc32c_chunks(data, self.chunk_size)
-        with open(f"{path}.tmp", "wb") as f:
+        with open(dtmp, "wb") as f:
             f.write(data)
-        mp = self._meta_path(path)
-        with open(f"{mp}.tmp", "wb") as f:
+        with open(mtmp, "wb") as f:
             f.write(self._encode_meta(checksums))
         return checksums
 
-    def publish_staged_batch(self, block_ids: list[str]) -> list[tuple[str, str]]:
-        """Step 2 of group commit: ONE filesystem sync makes every staged
-        ``.tmp`` in the batch durable, renames publish them, and a second
-        sync persists the renames — two syncs amortized over the whole
-        batch instead of two fsyncs per file. A single-entry batch takes
-        the targeted per-file fsync path instead (a filesystem-wide sync
-        would couple an idle-cluster write's latency to unrelated dirty
-        data). A crash between the renames and the final sync can lose or
-        tear un-acked publications; boot cleanup plus sidecar verification
-        treats those as absent/corrupt, which the healer repairs — the ack
-        is only sent after this returns.
+    def publish_staged_batch(
+        self, entries: list[tuple[str, str]],
+    ) -> list[tuple[str, str]]:
+        """Step 2 of group commit for ``(block_id, token)`` entries: ONE
+        filesystem sync makes every staged file in the batch durable,
+        renames publish them, and a second sync persists the renames — two
+        syncs amortized over the whole batch instead of two fsyncs per
+        file. A single-entry batch takes the targeted per-file fsync path
+        instead (a filesystem-wide sync would couple an idle-cluster
+        write's latency to unrelated dirty data). A crash between the
+        renames and the final sync can lose or tear un-acked publications;
+        boot cleanup plus sidecar verification treats those as
+        absent/corrupt, which the healer repairs — the ack is only sent
+        after this returns.
 
         Returns ``[(block_id, error)]`` for entries that failed to publish;
         every OTHER entry is durable when this returns (the final sync runs
         regardless of individual failures)."""
-        ids = list(dict.fromkeys(block_ids))
-        for bid in ids:
-            _check_block_id(bid)
-        if not ids:
+        if not entries:
             return []
-        if len(ids) == 1:
+        if len(entries) == 1:
+            bid, token = entries[0]
             try:
-                self._publish_one_durable(ids[0])
+                self._publish_one_durable(bid, token)
             except OSError as e:
-                return [(ids[0], str(e))]
+                return [(bid, str(e))]
             return []
         failed: list[tuple[str, str]] = []
         self._syncfs()
-        for bid in ids:
+        for bid, token in entries:
+            dtmp, mtmp = self._staged_paths(bid, token)
             path = self.hot_dir / bid
-            mp = self._meta_path(path)
             try:
-                os.rename(f"{path}.tmp", path)
-                os.rename(f"{mp}.tmp", mp)
+                os.rename(dtmp, path)
+                os.rename(mtmp, self._meta_path(path))
             except OSError as e:
                 # One bad entry must not poison the batch: record it and
                 # keep publishing the rest.
@@ -193,23 +207,21 @@ class BlockStore:
         self._syncfs()
         return failed
 
-    def _publish_one_durable(self, block_id: str) -> None:
+    def _publish_one_durable(self, block_id: str, token: str) -> None:
         """Targeted publish of one staged block: fsync both tmp files,
         then rename — the fused-write durability without a fs-wide sync."""
+        dtmp, mtmp = self._staged_paths(block_id, token)
         path = self.hot_dir / block_id
-        for p in (path, self._meta_path(path)):
-            tmp = f"{p}.tmp"
+        for tmp, final in ((dtmp, path), (mtmp, self._meta_path(path))):
             fd = os.open(tmp, os.O_RDONLY)
             try:
                 os.fsync(fd)
             finally:
                 os.close(fd)
-            os.rename(tmp, p)
+            os.rename(tmp, final)
 
-    def discard_staged(self, block_id: str) -> None:
-        _check_block_id(block_id)
-        path = self.hot_dir / block_id
-        for p in (Path(f"{path}.tmp"), Path(f"{self._meta_path(path)}.tmp")):
+    def discard_staged(self, block_id: str, token: str) -> None:
+        for p in self._staged_paths(block_id, token):
             p.unlink(missing_ok=True)
 
     def _syncfs(self) -> None:

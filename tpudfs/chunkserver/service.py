@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import uuid
 from collections import OrderedDict
 
 import grpc
@@ -87,46 +88,43 @@ class GroupCommitter:
 
     def __init__(self, store: BlockStore):
         self.store = store
-        self._pending: list[tuple[str, asyncio.Future]] = []
+        self._pending: list[tuple[str, str, asyncio.Future]] = []
         self._task: asyncio.Task | None = None
-        #: block_id -> publish future of the write currently staged or
-        #: publishing: same-block writes MUST serialize across the whole
-        #: stage->publish window (both share the one ``<path>.tmp``; a
-        #: concurrent re-stage would truncate a fully staged file while
-        #: the drain loop publishes it).
-        self._inflight: dict[str, asyncio.Future] = {}
+        self._closed = False
 
     async def write(self, block_id: str, data: bytes) -> None:
-        while (prev := self._inflight.get(block_id)) is not None:
-            try:
-                await asyncio.shield(prev)
-            except Exception:
-                pass  # the earlier writer saw its own error
-        loop = asyncio.get_running_loop()
-        fut: asyncio.Future = loop.create_future()
-        self._inflight[block_id] = fut
-
-        def _done(f: asyncio.Future) -> None:
-            if self._inflight.get(block_id) is f:
-                self._inflight.pop(block_id, None)
-            if not f.cancelled():
-                f.exception()  # mark retrieved: the writer may be gone
-
-        fut.add_done_callback(_done)
+        """Stage under a PRIVATE ``.tmp-<token>`` name (a cancelled or
+        concurrent same-block writer can never truncate another's staged
+        file — the uncancellable staging thread only ever touches its own
+        token's paths), then wait for the drain loop to publish the batch.
+        Cancellation mid-staging leaves an orphan tmp (boot cleanup);
+        cancellation mid-publish lets the publish finish (shielded)."""
+        if self._closed:
+            raise OSError("chunkserver stopping")
+        token = uuid.uuid4().hex
         try:
-            await asyncio.to_thread(self.store.write_staged, block_id, data)
-        except BaseException:
-            if not fut.done():
-                fut.set_result(None)  # release same-block waiters
+            await asyncio.to_thread(
+                self.store.write_staged, block_id, data, token
+            )
+        except asyncio.CancelledError:
+            # The thread may still be writing its private tmp; it cannot
+            # be unlinked safely here — boot cleanup handles orphans.
             raise
-        self._pending.append((block_id, fut))
+        except BaseException:
+            await asyncio.to_thread(self.store.discard_staged,
+                                    block_id, token)
+            raise
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(
+            lambda f: None if f.cancelled() else f.exception()
+        )  # mark retrieved: the writer may have been cancelled away
+        self._pending.append((block_id, token, fut))
         if self._task is None or self._task.done():
             self._task = asyncio.create_task(self._drain())
-        # Even if THIS coroutine gets cancelled here, fut stays in the
-        # drain batch and resolves (releasing same-block waiters).
-        await fut
+        await asyncio.shield(fut)
 
     async def stop(self) -> None:
+        self._closed = True
         task = self._task
         if task is not None and not task.done():
             task.cancel()
@@ -134,6 +132,13 @@ class GroupCommitter:
                 await task
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
+        # Writes staged during the cancelled publish (or after): fail them
+        # out rather than leaving their writers parked forever.
+        batch, self._pending = self._pending, []
+        for bid, token, fut in batch:
+            if not fut.done():
+                fut.set_exception(OSError("chunkserver stopping"))
+            self.store.discard_staged(bid, token)
 
     async def _drain(self) -> None:
         while self._pending:
@@ -141,13 +146,13 @@ class GroupCommitter:
             try:
                 failed = await asyncio.to_thread(
                     self.store.publish_staged_batch,
-                    [bid for bid, _ in batch],
+                    [(bid, token) for bid, token, _ in batch],
                 )
             except BaseException as e:
                 # Resolve EVERY future before propagating anything —
                 # cancellation included — or the swapped-out batch's
                 # writers would hang forever.
-                for bid, fut in batch:
+                for bid, _token, fut in batch:
                     if not fut.done():
                         fut.set_exception(
                             OSError(f"group commit failed for {bid}: {e}")
@@ -156,7 +161,7 @@ class GroupCommitter:
                     continue
                 raise
             failmap = dict(failed)
-            for bid, fut in batch:
+            for bid, _token, fut in batch:
                 if fut.done():
                     continue
                 if bid in failmap:

@@ -307,12 +307,15 @@ class Client:
     async def create_file(self, path: str, data: bytes,
                           ec: tuple[int, int] | None = None,
                           etag: str | None = None,
-                          overwrite: bool = False) -> None:
+                          overwrite: bool = False,
+                          attrs: dict | None = None) -> None:
         """Write ``data`` to ``path`` (reference create_file_from_buffer
         mod.rs:225-494; EC variant mod.rs:496-677). ``etag`` overrides the
         stored ETag (the S3 gateway stores plaintext/multipart ETags that
         differ from the md5 of the stored bytes); ``overwrite`` atomically
-        replaces an existing file in the CreateFile command itself."""
+        replaces an existing file in the CreateFile command itself;
+        ``attrs`` attaches small application key-values to the file
+        metadata (the gateway's x-amz-meta-* user metadata)."""
         k, m = ec or (0, 0)
         _, master = await self._execute("CreateFile", {
             "path": path, "ec_data_shards": k, "ec_parity_shards": m,
@@ -320,7 +323,7 @@ class Client:
         }, path=path, retry_benign=("ALREADY_EXISTS",))
         try:
             await self._write_blocks_and_complete(path, data, master, k, m,
-                                                  etag)
+                                                  etag, attrs)
         except IndeterminateError:
             raise
         except DfsError as e:
@@ -332,7 +335,8 @@ class Client:
 
     async def _write_blocks_and_complete(self, path: str, data: bytes,
                                          master: str, k: int, m: int,
-                                         etag: str | None) -> None:
+                                         etag: str | None,
+                                         attrs: dict | None = None) -> None:
         # Stick to the creating master for read-your-writes (mod.rs:256-266).
         sticky = [master] + [a for a in self._masters_for(path) if a != master]
         block_checksums = []
@@ -365,13 +369,16 @@ class Client:
             offset += len(piece) if piece else 1
             if not piece:
                 break
-        await self._execute("CompleteFile", {
+        req = {
             "path": path,
             "size": len(data),
             "etag_md5": etag if etag is not None
             else hashlib.md5(data).hexdigest(),
             "block_checksums": block_checksums,
-        }, masters=sticky)
+        }
+        if attrs:
+            req["attrs"] = dict(attrs)
+        await self._execute("CompleteFile", req, masters=sticky)
 
     async def _write_replicated_block(self, block_id: str, data: bytes,
                                       servers: list[str], term: int) -> None:

@@ -480,6 +480,7 @@ class Master:
             "path": req["path"],
             "size": int(req["size"]),
             "etag_md5": req.get("etag_md5", ""),
+            "attrs": req.get("attrs") or {},
             "created_at_ms": int(req.get("created_at_ms") or now_ms()),
             "block_checksums": req.get("block_checksums") or [],
         })

@@ -73,6 +73,11 @@ class FileMetadata:
     access_count: int = 0
     moved_to_cold_at_ms: int = 0
     complete: bool = False
+    #: Small application key-values set at CompleteFile (the S3 gateway's
+    #: x-amz-meta-* user metadata; replaces the reference's extra ``.meta``
+    #: DFS file per object, handlers.rs:985-1010 — one replicated command
+    #: instead of a second file round-trip).
+    attrs: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         d = self.__dict__.copy()
@@ -297,6 +302,8 @@ class MasterState:
             raise ValueError(f"file not found: {path}")
         f.size = int(cmd["size"])
         f.etag_md5 = cmd.get("etag_md5", "")
+        if cmd.get("attrs"):
+            f.attrs = dict(cmd["attrs"])
         if cmd.get("created_at_ms"):
             f.created_at_ms = int(cmd["created_at_ms"])
         by_id = {b.block_id: b for b in f.blocks}

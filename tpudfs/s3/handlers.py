@@ -80,6 +80,12 @@ def _err(code: str, message: str, status: int, resource: str = "") -> S3Response
     return S3Response(status=status, body=body)
 
 
+class UserMetadataTooLarge(ValueError):
+    def __init__(self, total: int):
+        super().__init__(f"user metadata is {total} bytes; the limit is 2048")
+        self.total = total
+
+
 def no_such_bucket(bucket: str) -> S3Response:
     return _err("NoSuchBucket", "The specified bucket does not exist", 404, bucket)
 
@@ -253,13 +259,14 @@ class S3Handlers:
     # ------------------------------------------------------------- objects
 
     async def _publish(self, bucket: str, path: str, body: bytes,
-                       etag: str | None) -> None:
+                       etag: str | None,
+                       attrs: dict | None = None) -> None:
         """Atomic S3 PUT semantics: upload to a hidden temp key, then
         replace-rename into place in one replicated command. The old object
         stays readable during the upload and survives an upload failure; a
         crash leaves only a temp orphan."""
         tmp = f"/{bucket}/{TMP_PREFIX}{uuid.uuid4().hex}"
-        await self.client.create_file(tmp, body, etag=etag)
+        await self.client.create_file(tmp, body, etag=etag, attrs=attrs)
         try:
             await self.client.rename_file(tmp, path, replace=True)
         except DfsError:
@@ -269,17 +276,49 @@ class S3Handlers:
                 pass
             raise
 
-    async def put_object(self, bucket: str, key: str, body: bytes) -> S3Response:
+    @staticmethod
+    def _user_meta_from_headers(headers: dict | None) -> dict:
+        """x-amz-meta-* request headers → file attrs (reference
+        handlers.rs:985-1000 keeps them in a JSON ``.meta`` DFS file; here
+        they ride the CompleteFile command as metadata attrs). Raises
+        MetadataTooLarge past AWS's 2 KB cap — attrs are replicated master
+        state, so untrusted input must not grow it unboundedly."""
+        meta = {
+            k.lower(): v for k, v in (headers or {}).items()
+            if k.lower().startswith("x-amz-meta-")
+        }
+        total = sum(len(k) - len("x-amz-meta-") + len(v)
+                    for k, v in meta.items())
+        if total > 2048:
+            raise UserMetadataTooLarge(total)
+        return meta
+
+    @staticmethod
+    def _user_meta_headers(meta: dict) -> dict:
+        return {
+            k: v for k, v in (meta.get("attrs") or {}).items()
+            if k.startswith("x-amz-meta-")
+        }
+
+    async def put_object(self, bucket: str, key: str, body: bytes,
+                         headers: dict | None = None,
+                         attrs: dict | None = None) -> S3Response:
         if not await self.bucket_exists(bucket):
             return no_such_bucket(bucket)
+        if attrs is None:
+            try:
+                attrs = self._user_meta_from_headers(headers)
+            except UserMetadataTooLarge as e:
+                return _err("MetadataTooLarge", str(e), 400, key)
         etag = hashlib.md5(body).hexdigest()
         if self.sse is not None:
             body = self.sse.encrypt(body)
-        await self._publish(bucket, self.obj_path(bucket, key), body, etag)
-        headers = {"ETag": f'"{etag}"'}
+        await self._publish(bucket, self.obj_path(bucket, key), body, etag,
+                            attrs=attrs)
+        resp_headers = {"ETag": f'"{etag}"'}
         if self.sse is not None:
-            headers["x-amz-server-side-encryption"] = "AES256"
-        return S3Response(headers=headers)
+            resp_headers["x-amz-server-side-encryption"] = "AES256"
+        return S3Response(headers=resp_headers)
 
     async def get_object(self, bucket: str, key: str,
                          range_header: str = "") -> S3Response:
@@ -292,6 +331,7 @@ class S3Handlers:
             "ETag": f'"{etag}"',
             "Last-Modified": xt.iso8601(int(meta.get("created_at_ms") or 0)),
             "Accept-Ranges": "bytes",
+            **self._user_meta_headers(meta),
         }
         total = self._plain_size(meta)
         rng = _parse_range(range_header, total)
@@ -328,6 +368,7 @@ class S3Handlers:
             "Content-Length": str(self._plain_size(meta)),
             "Last-Modified": xt.iso8601(int(meta.get("created_at_ms") or 0)),
             "Accept-Ranges": "bytes",
+            **self._user_meta_headers(meta),
         }
         return S3Response(headers=headers)
 
@@ -404,13 +445,28 @@ class S3Handlers:
             data = data[lo:hi + 1]
         return data, src_meta
 
-    async def copy_object(self, bucket: str, key: str,
-                          copy_source: str) -> S3Response:
+    async def copy_object(self, bucket: str, key: str, copy_source: str,
+                          headers: dict | None = None) -> S3Response:
         got = await self._read_copy_source(copy_source)
         if isinstance(got, S3Response):
             return got
         data, src_meta = got
-        resp = await self.put_object(bucket, key, data)
+        directive = next(
+            (v for k, v in (headers or {}).items()
+             if k.lower() == "x-amz-metadata-directive"), "COPY"
+        ).upper()
+        if directive not in ("COPY", "REPLACE"):
+            return _err("InvalidArgument",
+                        f"invalid x-amz-metadata-directive: {directive}",
+                        400, key)
+        if directive == "REPLACE":
+            try:
+                attrs = self._user_meta_from_headers(headers)
+            except UserMetadataTooLarge as e:
+                return _err("MetadataTooLarge", str(e), 400, key)
+        else:  # COPY (the S3 default): source object's user metadata moves
+            attrs = self._user_meta_headers(src_meta)
+        resp = await self.put_object(bucket, key, data, attrs=attrs)
         if resp.status != 200:
             return resp
         etag = resp.headers.get("ETag", "").strip('"')

@@ -253,8 +253,10 @@ class Gateway:
                                            part_number, body)
             copy_source = req.header("x-amz-copy-source")
             if copy_source:
-                return await h.copy_object(bucket, key, copy_source)
-            return await h.put_object(bucket, key, body)
+                return await h.copy_object(bucket, key, copy_source,
+                                           headers=req.headers)
+            return await h.put_object(bucket, key, body,
+                                      headers=req.headers)
         if req.method == "GET":
             if "uploadId" in q:
                 return await h.list_parts(bucket, key, q["uploadId"])
