@@ -443,3 +443,63 @@ def test_lease_safe_across_follower_restart():
         f"restarted follower enabled a leader at {new_leader_at} inside "
         f"old lease {lease_until}"
     )
+
+
+def test_lease_invariant_under_random_faults():
+    """Fuzz the lease-safety invariant: under random partitions, crashes,
+    restarts, and message drops, (a) at most ONE node ever holds a valid
+    lease, and (b) the lease holder is always the highest-term live leader
+    (an old leader may linger leaderish briefly, but never with a lease
+    while a successor leads)."""
+    import random as _random
+
+    for seed in (101, 202, 303):
+        c = SimCluster(5, seed=seed)
+        rng = _random.Random(seed)
+        c.wait_for_leader()
+        crashed: list[str] = []
+        for step in range(2500):
+            c.step()
+            if step % 200 == 100:
+                action = rng.choice(["partition", "heal", "crash", "drop"])
+                if action == "partition":
+                    ids = list(c.ids)
+                    rng.shuffle(ids)
+                    cut = rng.randrange(1, len(ids))
+                    c.partition(ids[:cut], ids[cut:])
+                elif action == "heal":
+                    c.heal()
+                    c.drop_rate = 0.0
+                elif action == "crash" and len(crashed) < 2:
+                    alive = [n for n in c.ids if n not in crashed]
+                    victim = rng.choice(alive)
+                    c.crash(victim)
+                    crashed.append(victim)
+                elif action == "drop":
+                    c.drop_rate = 0.3
+                if crashed and rng.random() < 0.5:
+                    c.restart(crashed.pop(0))
+            holders = [
+                n for n in c.nodes.values()
+                if n.core.role == Role.LEADER and n.core.lease_valid(c.now)
+            ]
+            assert len(holders) <= 1, (
+                f"seed {seed} step {step}: two lease holders "
+                f"{[h.node_id for h in holders]}"
+            )
+            if holders:
+                max_leader_term = max(
+                    n.core.term for n in c.nodes.values()
+                    if n.core.role == Role.LEADER
+                )
+                assert holders[0].core.term == max_leader_term, (
+                    f"seed {seed} step {step}: lease holder "
+                    f"{holders[0].node_id}@{holders[0].core.term} is not "
+                    f"the highest-term leader ({max_leader_term})"
+                )
+        # Liveness: after healing everything, a leader re-emerges.
+        c.heal()
+        c.drop_rate = 0.0
+        while crashed:
+            c.restart(crashed.pop())
+        c.wait_for_leader()

@@ -695,3 +695,36 @@ async def test_read_meta_blocks_fast_tail_rot_failover(tmp_path):
         assert got == data
     finally:
         await c.stop()
+
+
+# ------------------------------------------- sharded metadata plane → HBM
+
+
+async def test_hbm_reader_across_shards(tmp_path):
+    """The TPU reader rides the full sharded metadata plane: files whose
+    keys live on DIFFERENT range shards (REDIRECT protocol, per-shard
+    masters) all land in device memory verified — P5 on top of P3
+    (SURVEY.md §2.6)."""
+    from tests.test_cross_shard import ShardedCluster
+
+    c = await ShardedCluster(tmp_path).start()
+    try:
+        client = c.client
+        files = {}
+        for seed, path in ((41, "/a/left.bin"), (42, "/z/right.bin")):
+            data = _rand(24 * 512, seed=seed)
+            await client.create_file(path, data)
+            files[path] = data
+        assert c.master_of("/a/left.bin") is not c.master_of("/z/right.bin")
+        reader = HbmReader(client, jax.devices()[:2])
+        for path, data in files.items():
+            blocks = await reader.read_file_to_device_blocks(path,
+                                                             verify="lazy")
+            await reader.confirm(blocks)
+            assert all(b.verified for b in blocks)
+            got = b"".join(
+                device_array_to_bytes(b.array, b.size) for b in blocks
+            )
+            assert got == data
+    finally:
+        await c.stop()

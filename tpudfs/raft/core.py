@@ -576,7 +576,7 @@ class RaftCore:
         return self.timings.election_min * \
             (1.0 - self.timings.clock_drift_bound)
 
-    def _update_lease(self, now: float) -> None:
+    def _update_lease(self) -> None:
         """Extend the lease from the newest probe round a quorum has acked:
         every acked follower reset its election timer no earlier than that
         round's send time, and (vote stickiness) refuses non-transfer votes
@@ -966,7 +966,7 @@ class RaftCore:
         seq = int(msg.get("seq", 0))
         if seq > self._peer_ack_seq.get(peer, 0):
             self._peer_ack_seq[peer] = seq
-            self._update_lease(now)
+            self._update_lease()
         effects: list = []
         if msg["success"]:
             match = int(msg["match_index"])
@@ -1040,7 +1040,7 @@ class RaftCore:
         seq = int(msg.get("seq", 0))
         if seq > self._peer_ack_seq.get(peer, 0):
             self._peer_ack_seq[peer] = seq
-            self._update_lease(now)
+            self._update_lease()
         self.match_index[peer] = max(self.match_index.get(peer, 0), last)
         self.next_index[peer] = last + 1
         effects = self._advance_commit()
