@@ -1201,8 +1201,8 @@ class RaftCore:
 
     # ------------------------------------------------------------- inspection
 
-    def status(self) -> dict:
-        return {
+    def status(self, now: float | None = None) -> dict:
+        d = {
             "node_id": self.node_id,
             "role": self.role.value,
             "term": self.term,
@@ -1214,3 +1214,8 @@ class RaftCore:
             "config": self.config.to_dict(),
             "snapshot_index": self.snapshot.last_index if self.snapshot else 0,
         }
+        if now is not None and self.role == Role.LEADER:
+            d["lease_valid"] = self.lease_valid(now)
+            d["lease_remaining_s"] = round(max(0.0, self._lease_until - now), 4)
+            d["quorum_contact_age_s"] = round(max(0.0, now - self._quorum_contact), 4)
+        return d

@@ -146,8 +146,9 @@ class RaftNode:
         return self.status()
 
     def status(self) -> dict:
-        """Introspection (the reference's /raft/state, bin/master.rs:261-278)."""
-        return self.core.status()
+        """Introspection (the reference's /raft/state, bin/master.rs:261-278)
+        plus lease/check-quorum health on leaders."""
+        return self.core.status(self._now())
 
     # ------------------------------------------------------------ public API
 

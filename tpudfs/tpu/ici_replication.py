@@ -25,6 +25,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -203,6 +204,101 @@ class EcShardScatter:
         holds shard j of host (d - j) mod n at row j — per-host verify
         bit, and the psum'd ack count."""
         return self._fn(words)
+
+
+class EcShardGather:
+    """Pod-level degraded read — the inverse of EcShardScatter: each host
+    ``ppermute``-gathers its codeword's k+m shards back over ICI and
+    RS-decodes around a FAILED device entirely on the accelerators (the
+    host path would fetch surviving shards over gRPC and decode on CPU,
+    client.py _read_ec_block / reference mod.rs:1110-1165).
+
+    Which shard index the failed device held differs PER HOST (device
+    (i+j) mod n holds host i's shard j), so every host needs a different
+    decode matrix — incompatible with compile-time constants inside one
+    SPMD program. The matrices are therefore computed host-side per
+    failure pattern and ride in as sharded (n, k, k+m)/(n, k, k) inputs,
+    applied on device by the runtime bit-plane GF matmul
+    (rs_pallas.gf_matmul_runtime): ONE compiled program serves every
+    failure pattern, including none."""
+
+    def __init__(self, mesh: Mesh, k: int, m: int, axis: str | None = None):
+        n = mesh.devices.size
+        if n > 1 and k + m > n:
+            # Same guard as EcShardScatter: on a smaller mesh a single
+            # device holds MULTIPLE shards of one codeword, so one failure
+            # exceeds what excluding one shard index can repair.
+            raise ValueError(f"RS({k},{m}) gather needs {k + m} devices, "
+                             f"mesh has {n}")
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.k, self.m = k, m
+        self._fn = self._build()
+        #: failed-index -> sharded (n, k, k+m) matrix, cached on device so
+        #: repeat degraded reads around the same failure are transfer-free.
+        self._mats: dict[int | None, jax.Array] = {}
+
+    def _matrices(self, failed: int | None) -> jax.Array:
+        """Per-host (k, k+m) decode-and-select matrices, on device: the
+        decode inverse composed with the one-hot survivor selection
+        (column j gets dec's column for present-rank of j; excluded shard
+        columns stay zero, so garbage from the failed device is ignored
+        by the GF multiply itself)."""
+        cached = self._mats.get(failed)
+        if cached is not None:
+            return cached
+        from tpudfs.tpu.rs_pallas import decode_matrix
+
+        n = self.mesh.devices.size
+        k, m = self.k, self.m
+        mats = np.zeros((n, k, k + m), dtype=np.uint8)
+        for i in range(n):
+            j0 = (failed - i) % n if failed is not None else None
+            present = [j for j in range(k + m) if j != j0][:k]
+            dec = decode_matrix(k, m, tuple(present))
+            for rank, j in enumerate(present):
+                mats[i, :, j] = dec[:, rank]
+        out = jax.device_put(
+            jnp.asarray(mats), NamedSharding(self.mesh, P(self.axis))
+        )
+        self._mats[failed] = out
+        return out
+
+    def _build(self):
+        from tpudfs.tpu.rs_pallas import gf_matmul_runtime
+
+        axis, k, m = self.axis, self.k, self.m
+        mesh = self.mesh
+        n = mesh.devices.size
+
+        def step(local_shards, mats):
+            # local_shards: (k+m, S, 128) — row j = shard j of host
+            # (d - j) mod n. Send row j back to its owner: src -> src - j.
+            received = []
+            for j in range(k + m):
+                perm = [(s, (s - j) % n) for s in range(n)]
+                received.append(
+                    jax.lax.ppermute(local_shards[j], axis, perm)
+                )
+            rows = jnp.stack(received)  # (k+m, S, 128): MY codeword
+            S = rows.shape[1]
+            data = gf_matmul_runtime(
+                mats[0], rows.reshape(k + m, S * WORDS_PER_CHUNK)
+            )
+            return data.reshape(k, S, WORDS_PER_CHUNK)
+
+        spec = P(self.axis)
+        return jax.jit(shard_map(
+            step, mesh=mesh, in_specs=(spec, spec),
+            out_specs=spec, check_vma=False,
+        ))
+
+    def gather(self, shards: jax.Array, failed: int | None = None) -> jax.Array:
+        """``shards``: EcShardScatter's (n*(k+m), S, 128) layout. Returns
+        (n*k, S, 128): each host's k reconstructed DATA shards, bit-exact
+        with its original encoding even when device ``failed``'s rows are
+        garbage (any single device loss is within RS(k,m>=1) tolerance)."""
+        return self._fn(shards, self._matrices(failed))
 
 
 def replicated_write_step(mesh: Mesh, replication: int = 3,

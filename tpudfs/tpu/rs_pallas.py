@@ -176,6 +176,48 @@ def gf_matmul_device(mat, shards: jax.Array, *,
     return _unpack_words(out)
 
 
+def _xtimes(words: jnp.ndarray) -> list[jnp.ndarray]:
+    """[words * 2^j for j in 0..7] over GF(2^8), on uint32-packed bytes:
+    xtime(x) = (x << 1) ^ (0x1D if x & 0x80) per byte, with the multiply
+    trick keeping carries inside byte lanes ((hi >> 7) has only byte-LSBs
+    set, and 0x1D fits a byte, so the uint32 product never crosses)."""
+    xs = [words]
+    cur = words
+    for _ in range(7):
+        hi = cur & jnp.uint32(0x80808080)
+        lo = cur & jnp.uint32(0x7F7F7F7F)
+        cur = (lo << jnp.uint32(1)) ^ ((hi >> jnp.uint32(7)) * jnp.uint32(0x1D))
+        xs.append(cur)
+    return xs
+
+
+def gf_matmul_runtime(mat: jax.Array, words: jnp.ndarray) -> jnp.ndarray:
+    """``out[r] = xor_c mat[r, c] * words[c]`` over GF(2^8) with a RUNTIME
+    coefficient matrix (a traced jax value), unlike gf_matmul_device whose
+    matrix is a compile-time constant. ``mat`` is (r, c) uint8, ``words``
+    is (c, W) uint32-packed bytes. The multiply decomposes over the bit
+    planes of each coefficient: c*x = XOR_j bit_j(c) * (x * 2^j), with the
+    x*2^j ladder shared across rows — 8 xtime steps + r*c*8 selects, all
+    vectorized over W. One compiled program serves EVERY coefficient
+    matrix, which is what makes per-host decode matrices viable inside one
+    SPMD program (each failure pattern would otherwise need its own
+    compile)."""
+    r, c = mat.shape
+    if words.shape[0] != c:
+        raise ValueError(f"matrix is {r}x{c} but words has {words.shape[0]} rows")
+    ladders = [_xtimes(words[ci]) for ci in range(c)]
+    rows = []
+    for ri in range(r):
+        acc = jnp.zeros(words.shape[1:], jnp.uint32)
+        for ci in range(c):
+            coef = mat[ri, ci].astype(jnp.uint32)
+            for j in range(8):
+                bit = ((coef >> jnp.uint32(j)) & jnp.uint32(1)).astype(bool)
+                acc = acc ^ jnp.where(bit, ladders[ci][j], jnp.uint32(0))
+        rows.append(acc)
+    return jnp.stack(rows)
+
+
 @lru_cache(maxsize=256)
 def decode_matrix(k: int, m: int, present: tuple) -> np.ndarray:
     """(k, k) GF(2^8) matrix mapping the first k PRESENT shards (rows
