@@ -192,3 +192,67 @@ def test_joint_config_from_snapshot_still_finalizes():
         and Config.from_dict(e.command["_config"]).joint is False
     ]
     assert final_cfgs, "cluster stuck in joint consensus after compaction"
+
+
+def test_malformed_peer_messages_are_rejected_without_state_damage():
+    """Garbage peer input (wrong types, missing fields, malformed entries/
+    snapshots) must be dropped BEFORE any state mutation — an exception
+    mid-handler would tear the core (e.g. log truncated without its
+    TruncateLog effect). The reference gets this from protobuf; our
+    msgpack envelope needs the explicit check."""
+    import random as _random
+
+    from tests.raft_sim import SimCluster
+
+    c = SimCluster(3, seed=77)
+    lead = c.wait_for_leader()
+    c.propose_and_commit({"v": 1})
+    rng = _random.Random(7)
+    follower = next(n for n in c.nodes.values() if n is not lead)
+    garbage = [
+        None, 42, "hi", [], {},
+        {"type": "nope", "term": 10**9},           # unknown type, huge term
+        {"type": "append_entries"},                 # missing fields
+        {"type": "append_entries", "term": "9", "leader_id": "x",
+         "prev_log_index": 0, "prev_log_term": 0, "leader_commit": 0},
+        {"type": "append_entries", "term": 1, "leader_id": "x",
+         "prev_log_index": 0, "prev_log_term": 0, "leader_commit": 0,
+         "entries": [{"bogus": True}]},
+        {"type": "append_entries", "term": 1, "leader_id": "x",
+         "prev_log_index": 0, "prev_log_term": 0, "leader_commit": 0,
+         "entries": "not-a-list"},
+        {"type": "install_snapshot", "term": 1, "leader_id": "x",
+         "snapshot": {"last_index": "xx"}},
+        {"type": "request_vote", "term": None, "candidate_id": "x",
+         "last_log_index": 0, "last_log_term": 0},
+        {"type": "append_entries_response", "term": 1, "from": "x",
+         "success": True, "match_index": "lots"},
+    ]
+    for node in (lead, follower):
+        before = (node.core.term, node.core.role, node.core.last_index,
+                  node.core.commit_index)
+        for msg in garbage:
+            assert node.core.handle_message(msg, c.now) == []
+        assert (node.core.term, node.core.role, node.core.last_index,
+                node.core.commit_index) == before
+    # Random structural fuzz over EVERY required field name (valid-ish
+    # values mixed in so handler-reaching messages actually occur): never
+    # raises, and the cluster still commits afterwards.
+    all_fields = sorted({f for req in type(lead.core)._REQUIRED.values()
+                         for f in req} | {"entries", "seq",
+                                          "conflict_index"})
+    pool = [0, 1, -5, "s", None, [], {}, True, 2**40, "n0",
+            [{"index": 1, "term": 1, "command": {}}], [{"bogus": 1}],
+            {"last_index": 1, "last_term": 1,
+             "config": {"voters": ["n0"]}, "data": b""},
+            {"last_index": "x"}, {"voters": 5}]
+    types = list(type(lead.core)._REQUIRED) + ["x"]
+    for _ in range(1500):
+        msg = {"type": rng.choice(types)}
+        for f in all_fields:
+            if rng.random() < 0.6:
+                msg[f] = rng.choice(pool)
+        lead.core.handle_message(msg, c.now)
+        follower.core.handle_message(msg, c.now)
+    c.run(1.0)
+    c.propose_and_commit({"v": 2})

@@ -767,7 +767,79 @@ class RaftCore:
 
     # -------------------------------------------------------- message intake
 
+    #: Fields each message type must carry, with the types the handlers
+    #: index without further checks. Malformed peer input must be rejected
+    #: BEFORE any state mutation: an exception mid-handler would leave the
+    #: core half-updated (e.g. a truncated log whose TruncateLog effect
+    #: never reached storage). The reference gets this for free from
+    #: protobuf; msgpack-over-gRPC needs an explicit envelope check.
+    _REQUIRED: dict = {
+        "pre_vote": ("term", "candidate_id", "last_log_index",
+                     "last_log_term"),
+        "pre_vote_response": ("term", "from", "vote_granted"),
+        "request_vote": ("term", "candidate_id", "last_log_index",
+                         "last_log_term"),
+        "request_vote_response": ("term", "from", "vote_granted"),
+        "append_entries": ("term", "leader_id", "prev_log_index",
+                           "prev_log_term", "leader_commit"),
+        "append_entries_response": ("term", "from", "success",
+                                    "match_index"),
+        "install_snapshot": ("term", "leader_id", "snapshot"),
+        "install_snapshot_response": ("term", "from", "last_index"),
+        "timeout_now": (),
+    }
+    _INT_FIELDS = ("term", "prev_log_index", "prev_log_term",
+                   "leader_commit", "last_log_index", "last_log_term",
+                   "match_index", "seq", "conflict_index", "last_index")
+
+    def _valid_message(self, msg: Any) -> bool:
+        if not isinstance(msg, dict):
+            return False
+        required = self._REQUIRED.get(msg.get("type"))
+        if required is None:
+            return False
+        if any(f not in msg for f in required):
+            return False
+        for f in self._INT_FIELDS:
+            if f in msg and not isinstance(msg[f], int):
+                return False
+        for f in ("from", "leader_id", "candidate_id"):
+            # Handlers use these as dict/set keys and Send targets: they
+            # must be strings (an unhashable value would raise mid-handler).
+            if f in msg and not isinstance(msg[f], str):
+                return False
+        if msg["type"] == "append_entries":
+            entries = msg.get("entries") or []
+            if not isinstance(entries, list):
+                return False
+            for e in entries:
+                if not isinstance(e, dict) \
+                        or not isinstance(e.get("index"), int) \
+                        or not isinstance(e.get("term"), int) \
+                        or "command" not in e:
+                    return False
+        if msg["type"] == "install_snapshot":
+            snap = msg["snapshot"]
+            if not isinstance(snap, dict) \
+                    or not isinstance(snap.get("last_index"), int) \
+                    or not isinstance(snap.get("last_term"), int) \
+                    or not isinstance(snap.get("config"), dict) \
+                    or "data" not in snap:
+                return False
+            cfg = snap["config"]
+            groups = [cfg.get("voters"), cfg.get("voters_old"),
+                      cfg.get("learners")]
+            for g in groups:
+                if g is None:
+                    continue
+                if not isinstance(g, list) \
+                        or any(not isinstance(x, str) for x in g):
+                    return False
+        return True
+
     def handle_message(self, msg: dict, now: float) -> list:
+        if not self._valid_message(msg):
+            return []
         mtype = msg["type"]
         term = int(msg.get("term", 0))
         effects: list = []
@@ -786,9 +858,7 @@ class RaftCore:
             "install_snapshot": self._on_install_snapshot,
             "install_snapshot_response": self._on_install_snapshot_response,
             "timeout_now": self._on_timeout_now,
-        }.get(mtype)
-        if handler is None:
-            return effects
+        }[mtype]
         return effects + handler(msg, now)
 
     def _on_pre_vote(self, msg: dict, now: float) -> list:
