@@ -806,3 +806,31 @@ async def test_user_metadata_limits_and_directive_validation(tmp_path):
         assert r.status == 400 and b"InvalidArgument" in r.body
     finally:
         await c.stop()
+
+
+async def test_multipart_user_metadata_applies_to_final_object(tmp_path):
+    """Metadata from CreateMultipartUpload lands on the assembled object
+    (AWS semantics; the reference drops MPU user metadata)."""
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b1"))
+        r = await gw.handle(req("POST", "/b1/mp.bin",
+                                query=[("uploads", "")],
+                                headers={"x-amz-meta-source": "mpu"}))
+        assert r.status == 200
+        upload_id = r.body.decode().split("<UploadId>")[1].split("<")[0]
+        part = b"p" * 300_000
+        r = await gw.handle(req("PUT", "/b1/mp.bin",
+                                query=[("uploadId", upload_id),
+                                       ("partNumber", "1")], body=part))
+        etag = r.headers["ETag"].strip('"')
+        done = (f'<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>'
+                f'<ETag>"{etag}"</ETag></Part></CompleteMultipartUpload>')
+        r = await gw.handle(req("POST", "/b1/mp.bin",
+                                query=[("uploadId", upload_id)],
+                                body=done.encode()))
+        assert r.status == 200, r.body
+        r = await gw.handle(req("HEAD", "/b1/mp.bin"))
+        assert r.headers.get("x-amz-meta-source") == "mpu"
+    finally:
+        await c.stop()

@@ -480,13 +480,22 @@ class S3Handlers:
     def _part_path(bucket: str, upload_id: str, part_number: int) -> str:
         return f"/{bucket}/{MPU_PREFIX}{upload_id}/{part_number:05d}"
 
-    async def initiate_multipart(self, bucket: str, key: str) -> S3Response:
+    async def initiate_multipart(self, bucket: str, key: str,
+                                 headers: dict | None = None) -> S3Response:
         if not await self.bucket_exists(bucket):
             return no_such_bucket(bucket)
+        try:
+            attrs = self._user_meta_from_headers(headers)
+        except UserMetadataTooLarge as e:
+            return _err("MetadataTooLarge", str(e), 400, key)
         upload_id = uuid.uuid4().hex
-        # Record the target key so complete doesn't trust the client's path.
+        # Record the target key so complete doesn't trust the client's path;
+        # user metadata given at initiate rides the record's attrs and is
+        # applied to the assembled object (AWS semantics — the reference
+        # drops MPU user metadata entirely).
         await self.client.create_file(
-            f"/{bucket}/{MPU_PREFIX}{upload_id}/key", key.encode()
+            f"/{bucket}/{MPU_PREFIX}{upload_id}/key", key.encode(),
+            attrs=attrs,
         )
         return S3Response(body=xt.initiate_multipart_upload(
             bucket, key, upload_id
@@ -562,9 +571,17 @@ class S3Handlers:
             return _err("MalformedXML", "could not parse CompleteMultipartUpload", 400)
         if not requested:
             return _err("InvalidRequest", "no parts in request", 400)
+        key_rec = f"/{bucket}/{MPU_PREFIX}{upload_id}/key"
+        # One metadata fetch serves both the recorded key bytes and the
+        # initiate-time user metadata — no second round trip, and no
+        # window where a concurrent abort could drop attrs but not bytes.
+        key_meta = await self.client.get_file_info(key_rec)
+        if key_meta is None:
+            return _err("NoSuchUpload", "upload does not exist", 404)
+        attrs = dict(key_meta.get("attrs") or {})
         try:
-            recorded_key = (await self.client.get_file(
-                f"/{bucket}/{MPU_PREFIX}{upload_id}/key"
+            recorded_key = (await self.client.read_meta_range(
+                key_meta, 0, int(key_meta["size"])
             )).decode("utf-8")
         except DfsError:
             return _err("NoSuchUpload", "upload does not exist", 404)
@@ -602,7 +619,8 @@ class S3Handlers:
         etag = f"{hashlib.md5(digests).hexdigest()}-{len(requested)}"
         if self.sse is not None:
             data = self.sse.encrypt(data)
-        await self._publish(bucket, self.obj_path(bucket, key), data, etag)
+        await self._publish(bucket, self.obj_path(bucket, key), data, etag,
+                            attrs=attrs)
         await self._abort_multipart_files(bucket, upload_id)
         return S3Response(body=xt.complete_multipart_upload_result(
             f"/{bucket}/{key}", bucket, key, etag

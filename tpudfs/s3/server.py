@@ -231,7 +231,8 @@ class Gateway:
         h = self.handlers
         if req.method == "POST":
             if "uploads" in q:
-                return await h.initiate_multipart(bucket, key)
+                return await h.initiate_multipart(bucket, key,
+                                                  headers=req.headers)
             if "uploadId" in q:
                 return await h.complete_multipart(bucket, key, q["uploadId"], body)
         if req.method == "PUT":
