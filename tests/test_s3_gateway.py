@@ -834,3 +834,43 @@ async def test_multipart_user_metadata_applies_to_final_object(tmp_path):
         assert r.headers.get("x-amz-meta-source") == "mpu"
     finally:
         await c.stop()
+
+
+async def test_concurrent_put_get_atomic_publish(tmp_path):
+    """Replace-rename publish must give readers EXACTLY one complete
+    version under concurrent overwrites of the same key — never a torn or
+    mixed object (the property the hidden-tmp + rename design exists for)."""
+    c, gw = await _gateway(tmp_path)
+    try:
+        await gw.handle(req("PUT", "/b1"))
+        payloads = [bytes([i]) * 50_000 for i in range(6)]
+        await gw.handle(req("PUT", "/b1/hot.bin", body=payloads[0]))
+        stop = False
+        seen: list[bytes] = []
+
+        async def writer(i):
+            for p in payloads:
+                r = await gw.handle(req("PUT", "/b1/hot.bin", body=p))
+                assert r.status == 200
+
+        async def reader():
+            while not stop:
+                r = await gw.handle(req("GET", "/b1/hot.bin"))
+                assert r.status == 200, r.body
+                seen.append(r.body)
+
+        import asyncio
+
+        readers = [asyncio.create_task(reader()) for _ in range(2)]
+        await asyncio.gather(*(writer(i) for i in range(3)))
+        stop = True
+        await asyncio.gather(*readers)
+        assert len(seen) >= 5
+        valid = set(payloads)
+        for body in seen:
+            assert body in valid, (
+                f"torn read: len {len(body)}, "
+                f"first/last byte {body[:1]}/{body[-1:]}"
+            )
+    finally:
+        await c.stop()
