@@ -848,7 +848,7 @@ async def test_concurrent_put_get_atomic_publish(tmp_path):
         stop = False
         seen: list[bytes] = []
 
-        async def writer(i):
+        async def writer():
             for p in payloads:
                 r = await gw.handle(req("PUT", "/b1/hot.bin", body=p))
                 assert r.status == 200
@@ -862,9 +862,13 @@ async def test_concurrent_put_get_atomic_publish(tmp_path):
         import asyncio
 
         readers = [asyncio.create_task(reader()) for _ in range(2)]
-        await asyncio.gather(*(writer(i) for i in range(3)))
-        stop = True
-        await asyncio.gather(*readers)
+        try:
+            await asyncio.gather(*(writer() for _ in range(3)))
+        finally:
+            # A writer failure must still unwind the readers, or their
+            # never-retrieved exceptions bury the real one at loop close.
+            stop = True
+            await asyncio.gather(*readers, return_exceptions=True)
         assert len(seen) >= 5
         valid = set(payloads)
         for body in seen:

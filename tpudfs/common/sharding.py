@@ -177,13 +177,22 @@ class ShardMap:
         if bisect.bisect_right(self._range_ends, start) != eidx:
             return False  # spans an existing boundary
         owner = self._range_ids[eidx]
-        if self._range_ends[eidx] == end:
+        takes_top = self._range_ends[eidx] == end
+        start_boundary_exists = eidx > 0 and self._range_ends[eidx - 1] == start
+        keeps_lower_flank = bool(start) and not start_boundary_exists
+        if takes_top and not keeps_lower_flank \
+                and self._range_ids.count(owner) == 1:
+            # The carve would consume the owner's ONLY range outright,
+            # orphaning it in the registry (still listed, owning nothing,
+            # un-mergeable forever). A whole-range transfer is a rename,
+            # not a carve — refuse.
+            return False
+        if takes_top:
             # Carve reaches the range's top boundary: re-own it.
             self._range_ids[eidx] = new_shard_id
         else:
             self._insert_range(end, new_shard_id)
-        start_boundary_exists = eidx > 0 and self._range_ends[eidx - 1] == start
-        if start and not start_boundary_exists:
+        if keeps_lower_flank:
             self._insert_range(start, owner)
         self._peers[new_shard_id] = list(peers)
         self.version += 1
@@ -218,12 +227,29 @@ class ShardMap:
         return True
 
     def rebalance_boundary(self, old_key: str, new_key: str) -> bool:
-        """Shift a range boundary (reference sharding.rs:251-260)."""
+        """Shift a range boundary (reference sharding.rs:251-260).
+
+        Refuses moves that would break the map's invariants (the reference
+        does not guard these; a bad RebalanceShard admin call there leaves
+        keys unroutable cluster-wide): the terminal RANGE_MAX boundary is
+        what makes coverage total and cannot move, ``new_key`` must not
+        collide with an existing boundary (duplicate ends make lookup
+        ambiguous), and a zero-or-beyond-keyspace boundary is meaningless."""
         if self.strategy != "range":
+            return False
+        if old_key == RANGE_MAX or not new_key or new_key >= RANGE_MAX \
+                or new_key in self._range_ends:
             return False
         try:
             idx = self._range_ends.index(old_key)
         except ValueError:
+            return False
+        # The move must stay BETWEEN the neighboring boundaries: jumping
+        # past a neighbor would silently reassign intervals of shards the
+        # caller never named (a boundary shift, not an ownership shuffle).
+        prev_end = self._range_ends[idx - 1] if idx > 0 else ""
+        next_end = self._range_ends[idx + 1]  # exists: old_key != RANGE_MAX
+        if not (prev_end < new_key < next_end):
             return False
         shard_id = self._range_ids[idx]
         del self._range_ends[idx]
