@@ -103,3 +103,24 @@ def test_combine_chunks_with_prefix_and_empty():
     crcs = crc32c_chunks(b)
     assert crc32c_combine_chunks(crcs, CHECKSUM_CHUNK_SIZE, crc=crc32c(a)) == crc32c(a + b)
     assert crc32c_combine_chunks([], CHECKSUM_CHUNK_SIZE, crc=123) == 123
+
+
+def test_crc_combine_and_native_equivalence_fuzz():
+    """crc32c(a || b) == combine(crc(a), crc(b), len(b)) for random
+    splits, and the native engine agrees with the pure-Python table path
+    on every input."""
+    import random
+
+    from tpudfs.common import checksum
+
+    rng = random.Random(13)
+    for _ in range(40):
+        n = rng.randrange(0, 5000)
+        data = rng.randbytes(n)
+        cut = rng.randrange(0, n + 1)
+        a, b = data[:cut], data[cut:]
+        whole = checksum.crc32c(data)
+        assert checksum.crc32c_combine(
+            checksum.crc32c(a), checksum.crc32c(b), len(b)
+        ) == whole
+        assert checksum._crc32c_numpy(data) == whole
