@@ -371,29 +371,38 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     }
 
 
-def _probe_tpu(timeout_s: float = 90.0) -> bool:
+def _probe_tpu(timeout_s: float = 90.0, attempts: int = 2,
+               retry_wait_s: float = 45.0) -> bool:
     """The tunneled TPU sometimes wedges so hard that jax.devices() never
     returns — probe it in a DISPOSABLE subprocess so the bench itself can't
     hang, and fall back to CPU (honestly labeled) when the device is gone:
-    a degraded JSON line beats a driver timeout with no data."""
+    a degraded JSON line beats a driver timeout with no data. Wedges are
+    sometimes transient, so one short retry is worth the wait before
+    conceding the whole run to the CPU."""
     import os
     import subprocess
     import sys
+    import time as _time
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return False
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, numpy as np\n"
-             "d = jax.devices()[0]\n"
-             "jax.block_until_ready(jax.device_put(np.zeros(1024), d))\n"
-             "print(d.platform)"],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-        return proc.returncode == 0 and "cpu" not in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt in range(attempts):
+        if attempt:
+            _time.sleep(retry_wait_s)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, numpy as np\n"
+                 "d = jax.devices()[0]\n"
+                 "jax.block_until_ready(jax.device_put(np.zeros(1024), d))\n"
+                 "print(d.platform)"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if proc.returncode == 0 and "cpu" not in proc.stdout:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+    return False
 
 
 def main() -> None:
