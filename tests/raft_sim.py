@@ -20,6 +20,7 @@ from tpudfs.raft.core import (
     AppendLog,
     BecameLeader,
     Config,
+    NotLeaderError,
     PersistHardState,
     RaftCore,
     ReadReady,
@@ -40,6 +41,13 @@ FAST = Timings(election_min=0.15, election_max=0.30, heartbeat=0.05,
 class SimNode:
     def __init__(self, node_id: str, config: Config, seed: int, now: float):
         self.node_id = node_id
+        #: Kept for restarts: production nodes re-derive the BOOT config
+        #: from their flags on every start (tpudfs/raft/node.py) — a
+        #: cluster whose membership never changed has no config entries in
+        #: its log, so restarting with an empty boot config would leave
+        #: the node permanently voterless (and, once every node has
+        #: cycled, the whole cluster unelectable).
+        self._boot_config = config
         self.core = RaftCore(
             node_id, config, timings=FAST, rng=random.Random(seed), now=now
         )
@@ -52,10 +60,12 @@ class SimNode:
         self.alive = True
 
     def restart(self, seed: int, now: float) -> None:
-        """Crash-recover from durable state only (volatile state lost)."""
+        """Crash-recover from durable state only (volatile state lost);
+        the boot config comes from "flags" as in production, superseded by
+        any log/snapshot config."""
         self.core = RaftCore(
             self.node_id,
-            Config(voters=frozenset()),  # superseded by log/snapshot config
+            self._boot_config,
             term=self.durable["term"],
             voted_for=self.durable["voted_for"],
             log=list(self.durable["log"]),
@@ -202,10 +212,21 @@ class SimCluster:
         raise AssertionError("no leader elected")
 
     def propose(self, command, timeout: float = 5.0) -> int:
-        lead = self.wait_for_leader()
-        index, effects = lead.core.propose(command, self.now)
-        self._process_effects(lead, effects)
-        return index
+        deadline = self.now + timeout
+        while True:
+            lead = self.wait_for_leader()
+            try:
+                index, effects = lead.core.propose(command, self.now)
+            except NotLeaderError:
+                # Mid-leadership-transfer the leader refuses proposals by
+                # design (reference parity); step until the transfer
+                # completes or times out, then retry.
+                if self.now >= deadline:
+                    raise
+                self.step()
+                continue
+            self._process_effects(lead, effects)
+            return index
 
     def propose_and_commit(self, command, timeout: float = 5.0) -> int:
         index = self.propose(command)

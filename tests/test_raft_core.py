@@ -461,7 +461,8 @@ def test_lease_invariant_under_random_faults():
         for step in range(2500):
             c.step()
             if step % 200 == 100:
-                action = rng.choice(["partition", "heal", "crash", "drop"])
+                action = rng.choice(["partition", "heal", "crash", "drop",
+                                     "transfer"])
                 if action == "partition":
                     ids = list(c.ids)
                     rng.shuffle(ids)
@@ -477,6 +478,20 @@ def test_lease_invariant_under_random_faults():
                     crashed.append(victim)
                 elif action == "drop":
                     c.drop_rate = 0.3
+                elif action == "transfer":
+                    lead = c.leader()
+                    cand = [n for n in c.ids
+                            if lead is not None and n != lead.node_id
+                            and n not in crashed]
+                    if cand:
+                        try:
+                            c._process_effects(
+                                lead,
+                                lead.core.transfer_leadership(
+                                    rng.choice(cand), c.now),
+                            )
+                        except Exception:
+                            pass
                 if crashed and rng.random() < 0.5:
                     c.restart(crashed.pop(0))
             holders = [
