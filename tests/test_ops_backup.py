@@ -188,3 +188,18 @@ def test_cli_presign_offline(monkeypatch, capsys):
     url = capsys.readouterr().out.strip()
     assert url.startswith("http://127.0.0.1:9000/b/k?")
     assert "X-Amz-Signature=" in url and "AKX" in url
+
+
+def test_lease_gauges_exported_for_leaders():
+    from tpudfs.common.ops_http import raft_gauges, render_metrics
+
+    follower = raft_gauges({"role": "follower", "term": 3})
+    assert "raft_lease_valid" not in follower
+    leader = raft_gauges({
+        "role": "leader", "term": 3, "lease_valid": True,
+        "lease_remaining_s": 1.25, "quorum_contact_age_s": 0.1,
+    })
+    assert leader["raft_lease_valid"] == 1
+    assert leader["raft_lease_remaining_seconds"] == 1.25
+    text = render_metrics("tpudfs_master", leader)
+    assert "tpudfs_master_raft_lease_valid 1" in text

@@ -30,7 +30,7 @@ def render_metrics(prefix: str, gauges: dict[str, float]) -> str:
 
 
 def raft_gauges(status: dict) -> dict[str, float]:
-    return {
+    g = {
         "raft_role": _ROLE_CODE.get(status.get("role", ""), 0),
         "raft_term": status.get("term", 0),
         "raft_commit_index": status.get("commit_index", 0),
@@ -38,6 +38,13 @@ def raft_gauges(status: dict) -> dict[str, float]:
         "raft_log_len": status.get("log_len", 0),
         "raft_snapshot_index": status.get("snapshot_index", 0),
     }
+    if "lease_valid" in status:  # leaders only
+        g["raft_lease_valid"] = 1 if status["lease_valid"] else 0
+        g["raft_lease_remaining_seconds"] = status.get(
+            "lease_remaining_s", 0.0)
+        g["raft_quorum_contact_age_seconds"] = status.get(
+            "quorum_contact_age_s", 0.0)
+    return g
 
 
 class OpsServer:
