@@ -209,7 +209,9 @@ class BlockStore:
 
     def _publish_one_durable(self, block_id: str, token: str) -> None:
         """Targeted publish of one staged block: fsync both tmp files,
-        then rename — the fused-write durability without a fs-wide sync."""
+        rename, then fsync the directory so the renames themselves are
+        durable before the caller acks — the fused-write durability
+        without a fs-wide sync."""
         dtmp, mtmp = self._staged_paths(block_id, token)
         path = self.hot_dir / block_id
         for tmp, final in ((dtmp, path), (mtmp, self._meta_path(path))):
@@ -219,6 +221,11 @@ class BlockStore:
             finally:
                 os.close(fd)
             os.rename(tmp, final)
+        dfd = os.open(self.hot_dir, os.O_RDONLY | os.O_DIRECTORY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     def discard_staged(self, block_id: str, token: str) -> None:
         for p in self._staged_paths(block_id, token):

@@ -143,11 +143,21 @@ class GroupCommitter:
     async def _drain(self) -> None:
         while self._pending:
             batch, self._pending = self._pending, []
+            publish = asyncio.ensure_future(asyncio.to_thread(
+                self.store.publish_staged_batch,
+                [(bid, token) for bid, token, _ in batch],
+            ))
+            cancelled = False
             try:
-                failed = await asyncio.to_thread(
-                    self.store.publish_staged_batch,
-                    [(bid, token) for bid, token, _ in batch],
-                )
+                try:
+                    failed = await asyncio.shield(publish)
+                except asyncio.CancelledError:
+                    # stop() cancelled us, but the publish thread cannot be
+                    # interrupted and usually completes durably — wait for
+                    # its REAL outcome so writers of a published batch are
+                    # acked instead of told "group commit failed".
+                    cancelled = True
+                    failed = await publish
             except BaseException as e:
                 # Resolve EVERY future before propagating anything —
                 # cancellation included — or the swapped-out batch's
@@ -157,7 +167,7 @@ class GroupCommitter:
                         fut.set_exception(
                             OSError(f"group commit failed for {bid}: {e}")
                         )
-                if isinstance(e, Exception):
+                if isinstance(e, Exception) and not cancelled:
                     continue
                 raise
             failmap = dict(failed)
@@ -170,6 +180,8 @@ class GroupCommitter:
                     )
                 else:
                     fut.set_result(None)
+            if cancelled:
+                raise asyncio.CancelledError
 
 
 class ChunkServer:

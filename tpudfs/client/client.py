@@ -58,6 +58,13 @@ class IndeterminateError(DfsError):
     this as a crash op, not a definite failure."""
 
 
+class ChecksumMismatchError(DfsError):
+    """Fetched data failed an integrity check (end-to-end CRC, on-device
+    fold, or a shard shape that implies a truncated/corrupt local replica).
+    Readers catch this TYPE — never the message text — to decide whether a
+    verified-path retry against healthy replicas is worthwhile."""
+
+
 class Client:
     def __init__(
         self,
@@ -495,7 +502,7 @@ class Client:
             data = await self._read_block_range(block, 0, 0)
         expected = int(block.get("checksum_crc32c") or 0)
         if expected and crc32c(data) != expected:
-            raise DfsError(
+            raise ChecksumMismatchError(
                 f"end-to-end checksum mismatch for block {block['block_id']}"
             )
         return data

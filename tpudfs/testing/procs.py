@@ -25,15 +25,36 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+# Bound at import: preexec_fn runs between fork and exec, where imports or
+# dlopen in a multithreaded parent (JAX starts threads) can deadlock the
+# child — the post-fork hook must only CALL the pre-resolved symbol.
+try:
+    import ctypes as _ctypes
+
+    _PRCTL = _ctypes.CDLL(None).prctl
+except Exception:  # non-Linux / no libc — best-effort only
+    _PRCTL = None
+
+
+def _die_with_parent() -> None:
+    """PR_SET_PDEATHSIG: the kernel SIGTERMs the child when its parent dies.
+    A SIGKILLed harness (driver timeout) never runs atexit/terminate_all —
+    without this, orphaned master/chunkserver processes keep time-sharing
+    the single bench core for hours and silently poison later benchmarks."""
+    if _PRCTL is not None:
+        _PRCTL(1, 15)  # PR_SET_PDEATHSIG=1, SIGTERM=15
+
+
 def spawn(procs: list[subprocess.Popen], name: str, logdir: pathlib.Path,
           mod: str, *args: str, env: dict | None = None) -> subprocess.Popen:
     """Start ``python -m mod`` appended to ``procs``, stdout+stderr to
-    ``logdir/name.log``."""
+    ``logdir/name.log``. The child dies with this process (PDEATHSIG)."""
     with open(logdir / f"{name}.log", "w") as log:
         p = subprocess.Popen(
             [sys.executable, "-m", mod, *args],
             env={**os.environ, "PYTHONPATH": str(REPO), **(env or {})},
             stdout=log, stderr=subprocess.STDOUT,
+            preexec_fn=_die_with_parent,
         )
     procs.append(p)
     return p

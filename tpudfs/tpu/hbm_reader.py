@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpudfs.client.client import Client, DfsError
+from tpudfs.client.client import ChecksumMismatchError, Client, DfsError
 from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE, crc32c_combine
 from tpudfs.tpu.crc32c_pallas import (
     WORDS_PER_CHUNK,
@@ -72,7 +72,7 @@ class HbmReader:
         try:
             db = await self._read_block_inner(block, device, verify,
                                               safe_local)
-        except DfsError as e:
+        except ChecksumMismatchError as e:
             # The fast path trusts the device CRC end-to-end; a mismatch —
             # checksum OR shard-length (a truncated local shard file that
             # an unverified pread returns as-is) — may be a corrupt LOCAL
@@ -80,7 +80,7 @@ class HbmReader:
             # (falling through to healthy replicas / parity reconstruction,
             # and triggering chunkserver self-repair). Retry once through
             # that path before declaring the block lost.
-            if safe_local or "mismatch" not in str(e):
+            if safe_local:
                 raise
             try:
                 db = await self._read_block_inner(block, device, verify,
@@ -153,7 +153,7 @@ class HbmReader:
         for r, idx in enumerate(use):
             row = np.frombuffer(shards[idx], dtype=np.uint8)  # type: ignore[arg-type]
             if len(row) != slen:
-                raise DfsError(
+                raise ChecksumMismatchError(
                     f"EC block {block['block_id']}: shard length mismatch"
                 )
             stack[r, :slen] = row
@@ -205,7 +205,7 @@ class HbmReader:
                     self._verify_host_tail_block, words, size, expected
                 )
             if pending is None and not verified:
-                raise DfsError(
+                raise ChecksumMismatchError(
                     f"on-device checksum mismatch for block {block['block_id']}"
                 )
         return DeviceBlock(block["block_id"], words, size, verified,

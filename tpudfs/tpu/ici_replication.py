@@ -298,6 +298,15 @@ class EcShardGather:
         (n*k, S, 128): each host's k reconstructed DATA shards, bit-exact
         with its original encoding even when device ``failed``'s rows are
         garbage (any single device loss is within RS(k,m>=1) tolerance)."""
+        if failed is not None and self.mesh.devices.size == 1:
+            # A 1-device mesh holds EVERY shard of the codeword on the
+            # "failed" device — excluding one shard index there decodes
+            # from rows the caller just declared garbage. n=1 is the
+            # replication-degenerate layout; only failed=None is sound.
+            raise ValueError(
+                "failed=<index> is meaningless on a 1-device mesh: the "
+                "single device holds every shard of the codeword"
+            )
         return self._fn(shards, self._matrices(failed))
 
 
