@@ -48,10 +48,17 @@ FILES = 128
 BLOCK_MB = 1
 CS_CACHE_BLOCKS = 8  # << FILES so the read phase cannot ride the LRU cache
 # Measured on the single-core bench host: 4-6 concurrent read streams beat
-# 12 (beyond ~6, thread/GIL scheduling churn on one core outweighs overlap).
-# Writes keep the reference harness's concurrency 10 (dfs_cli.rs:579-631)
-# so write_pipeline_GBps stays comparable across rounds.
+# 12 on the per-block gRPC path (beyond ~6, thread/GIL scheduling churn on
+# one core outweighs overlap). The FUSED local path inverts this: per-block
+# Python work is tiny (requests just stage into combiner rounds), so more
+# in-flight files = denser rounds — 32 measured best. Writes keep the
+# reference harness's concurrency 10 (dfs_cli.rs:579-631) so
+# write_pipeline_GBps stays comparable across rounds.
 READ_CONCURRENCY = 6
+FUSED_READ_CONCURRENCY = 32
+#: Fused round cap (blocks). Kept at 16 so the batched-CRC bucket set is
+#: {1,2,4,8,16} — five warm-up compiles, bounded on real TPU.
+BATCH_READS = 16
 WRITE_CONCURRENCY = 10
 ICI_STEP_MB = 8
 ICI_REPS = 16
@@ -230,7 +237,6 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     data = np.random.default_rng(0).integers(
         0, 256, BLOCK_MB << 20, dtype=np.uint8
     ).tobytes()
-    sem = asyncio.Semaphore(READ_CONCURRENCY)
     wsem = asyncio.Semaphore(WRITE_CONCURRENCY)
 
     async def put(i):
@@ -244,7 +250,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     write_gbps = FILES * len(data) / write_wall / 1e9
 
     device = jax.devices()[0]
-    reader = HbmReader(client, [device])
+    reader = HbmReader(client, [device], batch_reads=BATCH_READS)
 
     # See the module docstring's "Timing protocol": NO device->host
     # transfer happens before or inside any timed window below — the first
@@ -256,19 +262,19 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
 
     # Warm up kernels + compile caches without any D2H (not the CS block
     # cache: it holds CS_CACHE_BLOCKS blocks; the sweeps touch FILES).
+    # warm_batches pre-compiles every fused-round CRC bucket (device-verify
+    # platforms only; the host-verify CPU fallback dispatches none).
+    reader.warm_batches((BLOCK_MB << 20) // 512)
     warm = await reader.read_file_to_device_blocks("/bench/f0000", verify="lazy")
     grpc_files = min(48, FILES)
-    # Pre-compile the confirm stack for the final batched verdict fetch
-    # (built and executed, NOT fetched). Count BLOCKS, not files: the final
-    # confirm batch is every sweep's blocks plus the warm-up's.
-    reader.warm_confirm(
-        warm[0], (2 * FILES + grpc_files) * len(warm) + len(warm)
-    )
 
-    async def timed_sweep(items, read_fn):
+    async def timed_sweep(items, read_fn, concurrency=READ_CONCURRENCY):
         """Shared sweep harness: sem-gated concurrent per-item reads, one
-        block_until_ready over every array AND pending CRC (transfer +
-        on-device fold complete — no readback; see Timing protocol)."""
+        block_until_ready over every block's sync set — per-block arrays
+        and 0-d CRCs on the unfused path, whole-round batch arrays and CRC
+        vectors on the fused one (transfer + on-device fold complete — no
+        readback; see Timing protocol)."""
+        sem = asyncio.Semaphore(concurrency)
         blocks: list = []
 
         async def one(item):
@@ -279,9 +285,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
 
         t0 = time.perf_counter()
         sizes = await asyncio.gather(*(one(it) for it in items))
-        jax.block_until_ready([b.array for b in blocks]
-                              + [b.pending_crc for b in blocks
-                                 if b.pending_crc is not None])
+        jax.block_until_ready([x for b in blocks for x in b.sync_arrays])
         return blocks, sum(sizes) / (time.perf_counter() - t0) / 1e9
 
     # ---- remote read path: short-circuit disabled — what a non-colocated
@@ -294,17 +298,29 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
             f"/bench/f{i:04d}", verify="lazy"),
     )
     client.local_reads = True
+    # Pre-compile the confirm stack for the final batched verdict fetch
+    # (built and executed, NOT fetched): only unfused blocks carry per-block
+    # 0-d CRCs now — fused rounds resolve through their batch vectors.
+    sample = next((b for b in grpc_blocks if b.pending_crc is not None), None)
+    if sample is not None:
+        reader.warm_confirm(sample, len(grpc_blocks) + len(warm))
 
     # ---- primary read path: short-circuit (client colocated with the
     # chunkservers — the north-star topology): verified pread off the
     # replica's disk, no gRPC byte shuffle.
     local_before = client.local_read_blocks
+    comb_before = sum(c.blocks for c in reader._combiners.values())
     all_blocks, achieved = await timed_sweep(
         range(FILES),
         lambda i: reader.read_file_to_device_blocks(
             f"/bench/f{i:04d}", verify="lazy"),
+        concurrency=FUSED_READ_CONCURRENCY,
     )
-    local_blocks = client.local_read_blocks - local_before
+    # Fused rounds bypass client._read_local, so count combiner-served
+    # blocks alongside the classic short-circuit counter.
+    local_blocks = (client.local_read_blocks - local_before
+                    + sum(c.blocks for c in reader._combiners.values())
+                    - comb_before)
 
     # ---- warm infeed sweep: the steady-state training-infeed pattern. The
     # immutable block layout is cached ONCE outside the window (exactly how
@@ -314,7 +330,8 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         *(client.get_file_info(f"/bench/f{i:04d}") for i in range(FILES))
     )
     warm_blocks, warm_gbps = await timed_sweep(
-        metas, lambda m: reader.read_meta_blocks_fast(m, device)
+        metas, lambda m: reader.read_meta_blocks_fast(m, device),
+        concurrency=FUSED_READ_CONCURRENCY,
     )
 
     # ---- on-chip benches: pure device compute (H2D warm-up only), still

@@ -164,6 +164,64 @@ int64_t tpudfs_block_write_staged(const char* data_path,
                           /*staged=*/true);
 }
 
+// Batched unverified reads: pread N whole block files into one contiguous
+// caller buffer (slot i at out + i*stride), releasing the GIL for the WHOLE
+// batch — one ctypes call replaces N rounds of Python open/fstat/pread plus
+// N thread-pool hops. Verification is the caller's business: the TPU read
+// path checks the on-device CRC fold against the recorded whole-block
+// checksum, so a host-side CRC pass here would be redundant work on the
+// single bench core. sizes[i] = bytes read, or -errno for that slot (other
+// slots still proceed). Returns the number of slots read without error.
+int64_t tpudfs_blocks_read(const char** paths, uint64_t n, uint64_t stride,
+                           uint8_t* out, int64_t* sizes) {
+  int64_t ok = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    uint8_t* dst = out + i * stride;
+    int fd = ::open(paths[i], O_RDONLY);
+    if (fd < 0) {
+      sizes[i] = -errno;
+      continue;
+    }
+    uint64_t done = 0;
+    int64_t err = 0;
+    while (done < stride) {
+      ssize_t r = ::pread(fd, dst + done, stride - done, done);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        err = -errno;
+        break;
+      }
+      if (r == 0) break;  // EOF: block shorter than stride
+      done += static_cast<uint64_t>(r);
+    }
+    ::close(fd);
+    if (err != 0) {
+      sizes[i] = err;
+    } else {
+      sizes[i] = static_cast<int64_t>(done);
+      ok++;
+    }
+  }
+  return ok;
+}
+
+// Fused variant: additionally computes each slot's WHOLE-block CRC32C
+// (hardware-accelerated where available) so a host-verified batched read is
+// one native call — the CPU-fallback twin of the on-device batch CRC fold
+// (the caller compares crcs[i] against the CompleteFile-recorded checksum).
+int64_t tpudfs_blocks_read_crc(const char** paths, uint64_t n,
+                               uint64_t stride, uint8_t* out, int64_t* sizes,
+                               uint32_t* crcs) {
+  int64_t ok = tpudfs_blocks_read(paths, n, stride, out, sizes);
+  for (uint64_t i = 0; i < n; i++) {
+    crcs[i] = sizes[i] > 0
+                  ? tpudfs_crc32c(0, out + i * stride,
+                                  static_cast<uint64_t>(sizes[i]))
+                  : 0;
+  }
+  return ok;
+}
+
 int64_t tpudfs_syncfs(const char* path) {
   int fd = ::open(path, O_RDONLY);
   if (fd < 0) return -errno;

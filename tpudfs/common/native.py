@@ -111,6 +111,27 @@ def get_lib() -> ctypes.CDLL | None:
         logger.warning("native library has no block I/O engine; "
                        "using Python block path")
     try:
+        lib.tpudfs_blocks_read.restype = ctypes.c_int64
+        lib.tpudfs_blocks_read.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.tpudfs_blocks_read_crc.restype = ctypes.c_int64
+        lib.tpudfs_blocks_read_crc.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+    except AttributeError:
+        # Prebuilt library predating the batched read engine.
+        pass
+    try:
         lib.tpudfs_block_write_staged.restype = ctypes.c_int64
         lib.tpudfs_block_write_staged.argtypes = \
             list(lib.tpudfs_block_write.argtypes)

@@ -159,6 +159,11 @@ class Master:
         )
         self.tx = TransactionManager(self)
         self._tasks: set[asyncio.Task] = set()
+        #: Coalesced access-stats (see _note_access): path -> (at_ms, count)
+        #: pending since the last batched proposal.
+        self._access_pending: dict[str, tuple[int, int]] = {}
+        self._access_flusher: asyncio.Task | None = None
+        self.access_stats_flush_s = 0.5
 
     # --------------------------------------------------------------- wiring
 
@@ -229,10 +234,11 @@ class Master:
                 self._spawn(self._loop(self._intervals["split_detector"],
                                        self.run_split_detector))
 
-    def _spawn(self, coro) -> None:
+    def _spawn(self, coro) -> asyncio.Task:
         task = asyncio.create_task(coro)
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
+        return task
 
     async def _loop(self, interval: float, fn) -> None:
         while True:
@@ -494,17 +500,39 @@ class Master:
         if f is None:
             return {"found": False, "metadata": None}
         # Fire-and-forget access-stats update for tiering
-        # (reference master.rs:2190-2209).
-        self._spawn(self._update_access_stats(req["path"]))
+        # (reference master.rs:2190-2209) — coalesced: under a read-heavy
+        # infeed, a Raft proposal per GetFileInfo makes the metadata plane
+        # pay one log append per read; pending updates flush as ONE
+        # replicated command per window instead.
+        self._note_access(req["path"])
         return {"found": True, "metadata": f.to_dict()}
 
-    async def _update_access_stats(self, path: str) -> None:
-        try:
-            await self.raft.propose(
-                {"op": "update_access_stats", "path": path, "at_ms": now_ms()}
-            )
-        except (NotLeaderError, ValueError):
-            pass
+    def _note_access(self, path: str) -> None:
+        at, count = self._access_pending.get(path, (0, 0))
+        self._access_pending[path] = (now_ms(), count + 1)
+        if self._access_flusher is None or self._access_flusher.done():
+            self._access_flusher = self._spawn(self._flush_access_stats())
+
+    async def _flush_access_stats(self) -> None:
+        # Loop until a window stays empty: accesses noted while a propose
+        # was in flight land in the fresh dict, and _note_access won't
+        # spawn a second flusher while this one is alive — exiting after
+        # one window would strand them until the next read.
+        while True:
+            await asyncio.sleep(self.access_stats_flush_s)
+            pending, self._access_pending = self._access_pending, {}
+            if not pending:
+                return
+            try:
+                await self.raft.propose({
+                    "op": "update_access_stats_batch",
+                    "updates": [
+                        [path, at, count]
+                        for path, (at, count) in pending.items()
+                    ],
+                })
+            except (NotLeaderError, ValueError):
+                return
 
     async def rpc_delete_file(self, req: dict) -> dict:
         self._check_safe_mode()

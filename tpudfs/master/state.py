@@ -360,6 +360,19 @@ class MasterState:
             f.access_count += 1
         return {"success": True}
 
+    def _apply_update_access_stats_batch(self, cmd: dict):
+        """Coalesced access-stats: one replicated command per flush window
+        instead of one per read (the reference proposes per read,
+        master.rs:2190-2209; stats are advisory tiering inputs, so
+        batching loses nothing). ``counts`` preserves how many reads each
+        path saw within the window."""
+        for path, at_ms, count in cmd["updates"]:
+            f = self.files.get(path)
+            if f is not None:
+                f.last_access_ms = int(at_ms)
+                f.access_count += int(count)
+        return {"success": True}
+
     def _apply_move_to_cold(self, cmd: dict):
         self.check_not_migrating(cmd["path"])
         f = self.files.get(cmd["path"])

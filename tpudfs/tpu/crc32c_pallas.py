@@ -172,6 +172,31 @@ def block_crc_device(words: jax.Array) -> jax.Array:
     return jax.lax.reduce(contrib, np.uint32(0), jax.lax.bitwise_xor, (0, 1))
 
 
+@partial(jax.jit, static_argnames=("nblocks",))
+def batch_block_crc_device(words: jax.Array, nblocks: int) -> jax.Array:
+    """Whole-block CRC32C of ``nblocks`` equal-chunk-count blocks laid out
+    contiguously in ONE (nblocks*cpb, 128) device array -> (nblocks,) uint32.
+
+    The batched twin of :func:`block_crc_device`: one Pallas launch CRCs the
+    whole batch's chunk grid, then the GF(2) combine-fold runs per block with
+    a shared (cpb, 32) table. On a tunneled TPU each dispatch costs ~ms, so
+    folding a 32-block batch in one program instead of 32 is what makes
+    per-block verification free at batch scale (VERDICT r2 item 1b).
+    """
+    from tpudfs.common.checksum import combine_fold_table
+
+    total = words.shape[0]
+    if total == 0 or nblocks == 0:
+        return jnp.zeros((nblocks,), jnp.uint32)
+    cpb = total // nblocks
+    crcs = crc32c_chunks_device(words).reshape(nblocks, cpb)
+    d = jnp.asarray(combine_fold_table(CHECKSUM_CHUNK_SIZE, cpb))  # (cpb, 32)
+    bits = ((crcs[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)[None, None, :])
+            & jnp.uint32(1)) != 0
+    contrib = jnp.where(bits, d[None, :, :], jnp.uint32(0))
+    return jax.lax.reduce(contrib, np.uint32(0), jax.lax.bitwise_xor, (1, 2))
+
+
 def verify_block_device(words: jax.Array, expected: jax.Array) -> jax.Array:
     """Jittable full-block verify: True iff every chunk CRC matches.
 

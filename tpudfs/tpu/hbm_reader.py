@@ -15,7 +15,6 @@ the "chunk read into TPU HBM" path of BASELINE.json.
 from __future__ import annotations
 
 import asyncio
-from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -32,30 +31,103 @@ from tpudfs.tpu.crc32c_pallas import (
 )
 
 
-@dataclass
 class DeviceBlock:
-    block_id: str
-    array: jax.Array  # (chunks, 128) uint32 words on one device
-    size: int  # unpadded byte length
-    verified: bool
-    #: lazy mode: 0-d device uint32 (the on-device whole-block CRC fold),
-    #: resolved against expected_crc by HbmReader.confirm with ONE host sync
-    #: per batch; None once resolved or in eager/no-verify modes. The
-    #: comparison happens on the HOST — an eager per-block `== expected`
-    #: would upload a scalar per block, and small transfers cost 10-50 ms
-    #: on a tunneled TPU.
-    pending_crc: jax.Array | None = None
-    expected_crc: int | None = None
-    #: source block metadata + target device, kept so a failed lazy verify
-    #: can be retried through the host-verified fetch path (see confirm).
-    source: dict | None = None
-    device: object | None = None
+    """One block's words on one device — either its own (chunks, 128) array
+    or a slice-on-demand view into a fused :class:`~tpudfs.tpu.read_combiner.
+    DeviceBatch` (the batched read path). ``pending_crc``/``batch_pending``
+    mark lazy verification: the 0-d (or batch-vector) on-device CRC fold is
+    resolved against ``expected_crc`` by :meth:`HbmReader.confirm` with ONE
+    host sync per confirm call. The comparison happens on the HOST — an
+    eager per-block ``== expected`` would upload a scalar per block, and
+    small transfers cost 10-50 ms on a tunneled TPU."""
+
+    def __init__(self, block_id: str, array: jax.Array | None, size: int,
+                 verified: bool, *, pending_crc: jax.Array | None = None,
+                 expected_crc: int | None = None, source: dict | None = None,
+                 device: object | None = None, batch=None,
+                 batch_index: int = 0, batch_pending: bool = False):
+        self.block_id = block_id
+        self._array = array
+        self.size = size  # unpadded byte length
+        self.verified = verified
+        self.pending_crc = pending_crc
+        self.expected_crc = expected_crc
+        #: source block metadata + target device, kept so a failed lazy
+        #: verify can be retried through the host-verified fetch path.
+        self.source = source
+        self.device = device
+        #: fused-round fields (read_combiner): the DeviceBatch this block
+        #: rides in, its row index there, and whether its verdict is still
+        #: unresolved in the batch's (n,) CRC vector.
+        self.batch = batch
+        self.batch_index = batch_index
+        self.batch_pending = batch_pending
+
+    @property
+    def array(self) -> jax.Array:
+        """(chunks, 128) uint32 words. Batched blocks materialize their
+        slice of the round lazily — slicing dispatches a device op, so the
+        hot infeed path synchronizes on :attr:`sync_arrays` instead and
+        only consumers that need per-block arrays pay for the slice."""
+        if self._array is None and self.batch is not None:
+            self._array = self.batch.block_words(self.batch_index)
+        return self._array
+
+    @array.setter
+    def array(self, value: jax.Array) -> None:
+        self._array = value
+        self.batch = None
+
+    @property
+    def sync_arrays(self) -> list:
+        """Device values a completion wait must cover for this block —
+        WITHOUT materializing per-block slices of a fused batch."""
+        if self.batch is not None and self._array is None:
+            out = [self.batch.words]
+            if self.batch.crcs is not None:
+                out.append(self.batch.crcs)
+            return out
+        out = [self._array]
+        if self.pending_crc is not None:
+            out.append(self.pending_crc)
+        return out
 
 
 class HbmReader:
-    def __init__(self, client: Client, devices: list | None = None):
+    def __init__(self, client: Client, devices: list | None = None, *,
+                 batch_reads: int = 0):
         self.client = client
         self.devices = list(devices) if devices is not None else jax.devices()
+        #: >0 enables the fused read path (read_combiner.ReadCombiner, one
+        #: per device, max_batch=batch_reads) for lazily-verified local
+        #: reads; 0 keeps every block on the per-block path.
+        self.batch_reads = batch_reads
+        self._combiners: dict = {}
+
+    def _combiner(self, device):
+        c = self._combiners.get(device)
+        if c is None:
+            from tpudfs.tpu.read_combiner import ReadCombiner
+
+            c = ReadCombiner(self.client, device, max_batch=self.batch_reads)
+            self._combiners[device] = c
+        return c
+
+    async def _try_batched(self, block: dict, device,
+                           verify: bool | str) -> DeviceBlock | None:
+        """Fused-round read when enabled and the block qualifies (lazy
+        verify, colocated replica, chunk-aligned). None -> per-block path."""
+        if not self.batch_reads or verify != "lazy" or \
+                not self.client.local_reads:
+            return None
+        return await self._combiner(device).read(block)
+
+    def warm_batches(self, cpb: int) -> None:
+        """Pre-compile every fused-round CRC bucket on every device (H2D
+        only) so no XLA compile lands in a timed window."""
+        if self.batch_reads:
+            for device in self.devices:
+                self._combiner(device).warm(cpb)
 
     # ------------------------------------------------------------ per block
 
@@ -69,6 +141,10 @@ class HbmReader:
 
         ``safe_local``: force the host-verified short-circuit path (used by
         the corruption-retry; normally the on-device check subsumes it)."""
+        if not safe_local:
+            db = await self._try_batched(block, device, verify)
+            if db is not None:
+                return db
         try:
             db = await self._read_block_inner(block, device, verify,
                                               safe_local)
@@ -213,32 +289,67 @@ class HbmReader:
 
     async def confirm(self, blocks: list[DeviceBlock], *,
                       retry: bool = True) -> None:
-        """Resolve every lazy verification with ONE device→host sync.
+        """Resolve every lazy verification with ONE device→host sync —
+        per-block 0-d CRCs (stacked) and fused-round CRC vectors
+        (read_combiner.DeviceBatch) ride the same transfer.
 
         A failed block is retried once through the host-verified fetch path
         (``retry=False`` disables) — a corrupt local replica gets excluded
         there in favor of healthy replicas / parity reconstruction. Raises
         DfsError naming each unrecoverable block; marks the rest verified.
         """
-        pend = [b for b in blocks if b.pending_crc is not None]
-        if not pend:
+        singles = [b for b in blocks if b.pending_crc is not None]
+        batched = [b for b in blocks if b.batch_pending and b.batch is not None]
+        if not singles and not batched:
             return
+        # Unresolved batches, deduped by identity, in first-seen order.
+        groups: list = []
+        for b in batched:
+            if b.batch.resolved is None and \
+                    not any(g is b.batch for g in groups):
+                groups.append(b.batch)
         # CRCs may live on different devices; gather them onto one device
         # (free when everything is already there) so ONE transfer resolves
-        # the whole batch, then compare host-side. The stack is padded to a
-        # power-of-two length: jnp.stack compiles per input count, and an
-        # unbounded family of batch sizes would put a fresh XLA compile on
-        # the hot path of every differently-sized confirm.
+        # the whole confirm call, then compare host-side. The singles stack
+        # is padded to a power-of-two length: jnp.stack compiles per input
+        # count, and an unbounded family of batch sizes would put a fresh
+        # XLA compile on the hot path of every differently-sized confirm.
         home = self.devices[0]
-        crcs = [jax.device_put(b.pending_crc, home) for b in pend]
-        crcs += [crcs[0]] * (self._confirm_bucket(len(pend)) - len(pend))
-        got = await asyncio.to_thread(
-            lambda: np.asarray(jnp.stack(crcs))[:len(pend)]
-        )
+        parts = []
+        nsingles = len(singles)
+        if singles:
+            crcs = [jax.device_put(b.pending_crc, home) for b in singles]
+            crcs += [crcs[0]] * (self._confirm_bucket(nsingles) - nsingles)
+            parts.append(jnp.stack(crcs))
+        for g in groups:
+            parts.append(jax.device_put(g.crcs, home))
+        if parts:
+            got = await asyncio.to_thread(
+                lambda: np.asarray(
+                    jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+                )
+            )
+        else:
+            # Every batch here was resolved by an earlier confirm call
+            # (blocks of one fused round confirmed file-by-file) — nothing
+            # to transfer, verdicts come from the cached resolutions.
+            got = np.empty(0, dtype=np.uint32)
         bad = []
-        for b, crc in zip(pend, got):
+        for i, b in enumerate(singles):
             b.pending_crc = None
-            b.verified = int(crc) == b.expected_crc
+            b.verified = int(got[i]) == b.expected_crc
+            if not b.verified:
+                bad.append(b)
+        off = self._confirm_bucket(nsingles) if singles else 0
+        for g in groups:
+            g.resolved = got[off : off + g.nblocks]
+            g.crcs = None
+            off += g.nblocks
+        for b in batched:
+            b.batch_pending = False
+            b.verified = (
+                int(b.batch.resolved[b.batch_index]) == b.expected_crc
+            )
             if not b.verified:
                 bad.append(b)
         unrecovered = []
@@ -312,6 +423,9 @@ class HbmReader:
         device = device or self.devices[0]
 
         async def fast_or_slow(block: dict) -> DeviceBlock:
+            db = await self._try_batched(block, device, verify)
+            if db is not None:
+                return db
             store = None
             if self.client.local_reads and not block.get("ec_data_shards"):
                 for addr in block.get("locations") or []:

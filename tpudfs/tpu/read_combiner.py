@@ -1,0 +1,371 @@
+"""Batched DFS→HBM reads: a read-side group commit for the infeed hot path.
+
+Round-2 profiling (scripts/read_profile.py, BENCH_NOTES.md) put the read
+ceiling at per-block host overhead, not device bandwidth: every 1 MiB block
+paid its own ``asyncio.to_thread`` hops, its own ``jax.device_put`` dispatch,
+and its own CRC-kernel launch — each costing ~ms on a tunneled TPU where the
+raw transfer itself is <1 ms. This module amortizes all three the same way
+``GroupCommitter`` amortizes fsyncs on the write side: concurrent per-file
+readers STAGE block requests, and a two-stage drain pipeline fuses each
+round into
+
+1. ONE native multi-block pread into one contiguous host buffer
+   (``tpudfs_blocks_read``, native/blockio.cc — GIL released for the whole
+   batch),
+2. ONE ``jax.device_put`` of that buffer, and
+3. ONE batched CRC dispatch (``batch_block_crc_device``) whose (n,) result
+   is compared host-side in the caller's existing one-sync ``confirm``.
+
+The two stages are separate tasks connected by a small queue, so round
+``i+1``'s disk reads overlap round ``i``'s host→HBM transfer (both release
+the GIL). Rounds form naturally: whatever accumulated while the previous
+round was in flight ships next — no artificial batching delay.
+
+Round sizes are bucketed to powers of two (≤ ``max_batch``) so the batched
+CRC program compiles a handful of times, not once per arrival pattern —
+an unbounded shape family would put a fresh XLA compile (~20-40 s on TPU)
+on the hot path. ``warm()`` pre-compiles every bucket with H2D-only traffic.
+
+Blocks that don't fit the fused path — EC-striped, unchecksummed,
+non-chunk-aligned, no colocated replica, or a short/failed pread (tiering
+move, truncation) — fall back to the caller's general per-block path, which
+handles RPC fan-out, degraded EC reads, and corruption retry.
+
+Reference parity note: this accelerates the concurrent block fan-out of
+dfs/client/src/mod.rs:880-916 (P5 in SURVEY.md §2.6); verification semantics
+are unchanged — the on-device fold is still checked against the CompleteFile
+whole-block CRC (chunkserver.rs:182-190 at-rest chunk CRCs feed the same
+recorded value).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from tpudfs.common import native
+from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE
+from tpudfs.tpu.crc32c_pallas import WORDS_PER_CHUNK, batch_block_crc_device
+
+logger = logging.getLogger(__name__)
+
+#: Largest fused round, in blocks. 32 x 1 MiB = 32 MiB per device_put.
+DEFAULT_MAX_BATCH = 32
+
+
+@dataclass
+class DeviceBatch:
+    """One fused round living on device: ``words`` holds ``nblocks``
+    consecutive blocks of ``cpb`` chunks each; ``crcs`` is the (nblocks,)
+    on-device whole-block CRC fold, resolved lazily (``resolved``) by the
+    reader's batched confirm with one device→host transfer per confirm
+    call covering every batch."""
+
+    words: jax.Array  # (nblocks * cpb, 128) uint32
+    crcs: jax.Array | None  # (nblocks,) uint32, on device
+    cpb: int
+    nblocks: int
+    resolved: np.ndarray | None = None
+
+    def block_words(self, i: int) -> jax.Array:
+        return self.words[i * self.cpb : (i + 1) * self.cpb]
+
+
+@dataclass
+class _Req:
+    block: dict
+    path: str
+    cpb: int
+    size: int
+    fut: asyncio.Future = field(default=None)  # created on the running loop
+
+
+_FALLBACK = object()  # resolve-to-slow-path sentinel
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Largest power of two ≤ min(n, cap) — the round size actually taken."""
+    n = min(n, cap)
+    return 1 << (n.bit_length() - 1)
+
+
+class ReadCombiner:
+    def __init__(self, client, device, *, max_batch: int = DEFAULT_MAX_BATCH,
+                 host_verify: bool | None = None):
+        self.client = client
+        self.device = device
+        self.max_batch = max_batch
+        #: Where the whole-block CRC runs. On a real TPU the device fold is
+        #: free for the host (the chip computes it; one batched sync at
+        #: confirm). On the CPU backend "the device" IS the single host
+        #: core, and XLA's 32-pass GF(2) formulation measures ~0.27 GB/s
+        #: there — so the CPU fallback verifies INSIDE the fused native
+        #: read (tpudfs_blocks_read_crc, hardware CRC32C) and blocks arrive
+        #: already verified.
+        if host_verify is None:
+            host_verify = getattr(device, "platform", "cpu") != "tpu"
+        self.host_verify = host_verify
+        self._pending: list[_Req] = []
+        self._read_task: asyncio.Task | None = None
+        self._upload_task: asyncio.Task | None = None
+        self._queue: asyncio.Queue | None = None
+        #: rounds fused / blocks served (observability + tests).
+        self.rounds = 0
+        self.blocks = 0
+
+    # ------------------------------------------------------------- staging
+
+    async def read(self, block: dict):
+        """Stage one block; returns a lazily-verified DeviceBlock riding a
+        DeviceBatch, or None when the block must take the general path."""
+        size = int(block.get("size") or 0)
+        if (
+            block.get("ec_data_shards")
+            or not block.get("checksum_crc32c")
+            or size <= 0
+            or size % CHECKSUM_CHUNK_SIZE != 0
+        ):
+            return None
+        store = None
+        for addr in block.get("locations") or []:
+            if not addr:
+                continue
+            s = await self.client._local_store(addr)
+            if s is not None:
+                store = s
+                break
+        if store is None:
+            return None
+        try:
+            path = store.block_path(block["block_id"])
+        except ValueError:
+            return None
+        req = _Req(block=block, path=str(path),
+                   cpb=size // CHECKSUM_CHUNK_SIZE, size=size,
+                   fut=asyncio.get_running_loop().create_future())
+        # Mark retrieved even when the awaiting reader is cancelled away.
+        req.fut.add_done_callback(
+            lambda f: None if f.cancelled() else f.exception()
+        )
+        self._pending.append(req)
+        self._ensure_running()
+        result = await asyncio.shield(req.fut)
+        if result is _FALLBACK:
+            return None
+        return result
+
+    def _ensure_running(self) -> None:
+        if self._read_task is None or self._read_task.done():
+            self._queue = asyncio.Queue(maxsize=2)
+            self._read_task = asyncio.create_task(self._read_stage())
+            self._upload_task = asyncio.create_task(
+                self._upload_stage(self._queue)
+            )
+
+    # ------------------------------------------------------- stage 1: disk
+
+    async def _read_stage(self) -> None:
+        queue = self._queue
+        aborted = True
+        try:
+            while self._pending:
+                # One round: the leading request's chunk count picks the
+                # uniform-geometry group (mixed sizes only split rounds,
+                # they are never dropped).
+                cpb = self._pending[0].cpb
+                uniform = [r for r in self._pending if r.cpb == cpb]
+                take = _bucket(len(uniform), self.max_batch)
+                reqs = uniform[:take]
+                taken = set(map(id, reqs))
+                self._pending = [
+                    r for r in self._pending if id(r) not in taken
+                ]
+                try:
+                    buf, ok, crcs = await asyncio.to_thread(
+                        self._fill_buffer, reqs
+                    )
+                except asyncio.CancelledError:
+                    self._fail_out(reqs)
+                    raise
+                except Exception as e:
+                    # One bad round (allocation failure, I/O blowup) must
+                    # not kill the stage: route its blocks to the general
+                    # per-block path and keep draining.
+                    logger.warning("fused read round failed (%s); "
+                                   "falling back %d blocks", e, len(reqs))
+                    for r in reqs:
+                        if not r.fut.done():
+                            r.fut.set_result(_FALLBACK)
+                    continue
+                if crcs is not None:
+                    # Host-verified round: a CRC mismatch here is a corrupt
+                    # LOCAL replica — route it to the general path, whose
+                    # verified retry excludes this replica, reads a healthy
+                    # one, and triggers chunkserver self-repair.
+                    for i, r in enumerate(reqs):
+                        if ok[i] and int(crcs[i]) != int(
+                                r.block["checksum_crc32c"]):
+                            logger.warning(
+                                "fused read: CRC mismatch on local replica "
+                                "of %s; falling back", r.block["block_id"])
+                            ok[i] = False
+                good = [r for r, o in zip(reqs, ok) if o]
+                for r, o in zip(reqs, ok):
+                    if not o and not r.fut.done():
+                        r.fut.set_result(_FALLBACK)
+                if good:
+                    # Compact rows when some slots fell back, preserving
+                    # request order (row i belongs to good[i]).
+                    if len(good) < len(reqs):
+                        rows = np.concatenate([
+                            buf[i * cpb : (i + 1) * cpb]
+                            for i, o in enumerate(ok) if o
+                        ])
+                    else:
+                        rows = buf
+                    await queue.put((good, rows, cpb, crcs is not None))
+            aborted = False
+        finally:
+            # Synchronously (no await since the empty-pending check) clear
+            # the task slot BEFORE the suspending sentinel put: a request
+            # staged while we drain out must see done-and-restartable state
+            # from _ensure_running, not a live task that will never serve it.
+            # On abnormal exit (cancellation) the still-pending requests are
+            # ours (no new generation can have started while the task slot
+            # was occupied) and would otherwise await forever.
+            self._read_task = None
+            if aborted:
+                self._fail_out(self._pending)
+                self._pending = []
+            await queue.put(None)
+
+    def _fail_out(self, reqs: list[_Req]) -> None:
+        for r in reqs:
+            if not r.fut.done():
+                r.fut.set_exception(
+                    RuntimeError("read combiner shut down mid-request")
+                )
+
+    def _fill_buffer(
+        self, reqs: list[_Req],
+    ) -> tuple[np.ndarray, list[bool], np.ndarray | None]:
+        """Worker thread: pread every request's file into one contiguous
+        (n*cpb, 128) uint32 buffer — native engine when available (one
+        GIL-free call for the whole round), per-file Python otherwise.
+        In ``host_verify`` mode also returns each slot's whole-block CRC
+        (fused into the same native call)."""
+        import ctypes
+
+        cpb = reqs[0].cpb
+        stride = cpb * CHECKSUM_CHUNK_SIZE
+        buf = np.empty((len(reqs) * cpb, WORDS_PER_CHUNK), dtype="<u4")
+        lib = native.get_lib()
+        if lib is not None and hasattr(lib, "tpudfs_blocks_read"):
+            paths = (ctypes.c_char_p * len(reqs))(
+                *(r.path.encode() for r in reqs)
+            )
+            sizes = np.empty(len(reqs), dtype=np.int64)
+            crcs = None
+            if self.host_verify and hasattr(lib, "tpudfs_blocks_read_crc"):
+                crcs = np.empty(len(reqs), dtype=np.uint32)
+                lib.tpudfs_blocks_read_crc(
+                    paths, len(reqs), stride,
+                    buf.ctypes.data, sizes.ctypes.data, crcs.ctypes.data,
+                )
+            else:
+                lib.tpudfs_blocks_read(
+                    paths, len(reqs), stride,
+                    buf.ctypes.data, sizes.ctypes.data,
+                )
+            return (buf, [int(s) == r.size for s, r in zip(sizes, reqs)],
+                    crcs)
+        from tpudfs.common.checksum import crc32c
+
+        ok = []
+        crcs = np.zeros(len(reqs), dtype=np.uint32) if self.host_verify \
+            else None
+        flat = buf.reshape(-1).view(np.uint8)
+        for i, r in enumerate(reqs):
+            try:
+                with open(r.path, "rb") as f:
+                    data = f.read(stride)
+            except OSError:
+                ok.append(False)
+                continue
+            if len(data) != r.size:
+                ok.append(False)
+                continue
+            flat[i * stride : (i + 1) * stride] = np.frombuffer(
+                data, dtype=np.uint8
+            )
+            if crcs is not None:
+                crcs[i] = crc32c(data)
+            ok.append(True)
+        return buf, ok, crcs
+
+    # ----------------------------------------------------- stage 2: device
+
+    async def _upload_stage(self, queue: asyncio.Queue) -> None:
+        from tpudfs.tpu.hbm_reader import DeviceBlock
+
+        while True:
+            item = await queue.get()
+            if item is None:
+                return
+            reqs, rows, cpb, host_verified = item
+            try:
+                words = await asyncio.to_thread(
+                    jax.device_put, rows, self.device
+                )
+                crcs = None if host_verified else \
+                    batch_block_crc_device(words, len(reqs))
+            except asyncio.CancelledError:
+                self._fail_out(reqs)
+                raise
+            except Exception as e:
+                # A failed upload must not kill the consumer — with it gone
+                # the producer would block forever on the full queue and
+                # every later read would hang. Fall this round back to the
+                # per-block path (where a genuinely broken device surfaces
+                # its own error) and keep consuming.
+                logger.warning("fused upload failed (%s); falling back "
+                               "%d blocks", e, len(reqs))
+                for r in reqs:
+                    if not r.fut.done():
+                        r.fut.set_result(_FALLBACK)
+                continue
+            batch = DeviceBatch(words=words, crcs=crcs, cpb=cpb,
+                                nblocks=len(reqs))
+            self.rounds += 1
+            self.blocks += len(reqs)
+            for i, r in enumerate(reqs):
+                db = DeviceBlock(
+                    r.block["block_id"], None, r.size, host_verified,
+                    expected_crc=int(r.block["checksum_crc32c"]),
+                    source=r.block, device=self.device,
+                    batch=batch, batch_index=i,
+                    batch_pending=not host_verified,
+                )
+                if not r.fut.done():
+                    r.fut.set_result(db)
+
+    # -------------------------------------------------------------- warmup
+
+    def warm(self, cpb: int) -> None:
+        """Pre-compile every bucket's batched-CRC program with H2D-only
+        traffic (device_put of zeros + dispatch + completion wait, no
+        readback) so no XLA compile lands inside a timed window.
+        Host-verified rounds dispatch no device CRC — nothing to warm."""
+        if self.host_verify:
+            return
+        b = 1
+        while b <= self.max_batch:
+            z = jax.device_put(
+                np.zeros((b * cpb, WORDS_PER_CHUNK), dtype="<u4"), self.device
+            )
+            jax.block_until_ready(batch_block_crc_device(z, b))
+            b <<= 1
