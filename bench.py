@@ -265,7 +265,12 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     # warm_batches pre-compiles every fused-round CRC bucket (device-verify
     # platforms only; the host-verify CPU fallback dispatches none).
     reader.warm_batches((BLOCK_MB << 20) // 512)
+    # Warm the PER-BLOCK path (block_crc_device compile + gRPC read) with
+    # short-circuit off — the fused path no longer exercises it, and
+    # without this the gRPC sweep pays the XLA compile in its window.
+    client.local_reads = False
     warm = await reader.read_file_to_device_blocks("/bench/f0000", verify="lazy")
+    client.local_reads = True
     grpc_files = min(48, FILES)
 
     async def timed_sweep(items, read_fn, concurrency=READ_CONCURRENCY):

@@ -224,3 +224,44 @@ async def test_short_circuit_disabled(tmp_path):
                    for cs in c.chunkservers) > 0  # RPC path exercised
     finally:
         await c.stop()
+
+
+# ------------------------------------------------ metadata coalescing (r3)
+
+
+async def test_meta_coalescing_concurrent_gets(tmp_path):
+    """Concurrent get_file_info calls fuse into BatchGetFileInfo rounds but
+    keep per-path semantics: correct metadata per file, None for missing."""
+    c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
+    try:
+        datas = {f"/mc/f{i}": _rand(10_000 + i, 60 + i) for i in range(12)}
+        for p, d in datas.items():
+            await client.create_file(p, d)
+        paths = list(datas) + ["/mc/missing"]
+        metas = await asyncio.gather(
+            *(client.get_file_info(p) for p in paths))
+        for p, m in zip(paths[:-1], metas[:-1]):
+            assert m is not None and m["size"] == len(datas[p]), p
+        assert metas[-1] is None
+        # And with coalescing off, same answers.
+        client.meta_coalescing = False
+        metas2 = await asyncio.gather(
+            *(client.get_file_info(p) for p in paths))
+        assert [m and m["size"] for m in metas2] == \
+            [m and m["size"] for m in metas]
+    finally:
+        await c.stop()
+
+
+async def test_meta_coalescing_sequential_gets(tmp_path):
+    """Non-concurrent callers (batch of one) still resolve correctly."""
+    c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
+    try:
+        data = _rand(5_000, 71)
+        await client.create_file("/mc/solo", data)
+        for _ in range(3):
+            m = await client.get_file_info("/mc/solo")
+            assert m is not None and m["size"] == len(data)
+        assert await client.get_file_info("/mc/nope") is None
+    finally:
+        await c.stop()

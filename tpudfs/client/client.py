@@ -115,6 +115,12 @@ class Client:
         self._local_probe_lock = asyncio.Lock()
         #: Blocks served via the short-circuit path (observability/tests).
         self.local_read_blocks = 0
+        #: Transparent coalescing of concurrent get_file_info calls into
+        #: BatchGetFileInfo RPCs (see get_file_info).
+        self.meta_coalescing = True
+        self._meta_pending: list[tuple[str, asyncio.Future]] = []
+        self._meta_drainer: asyncio.Task | None = None
+        self._meta_tasks: set[asyncio.Task] = set()
 
     def _dial(self, addr: str) -> str:
         return self.host_aliases.get(addr, addr)
@@ -434,8 +440,101 @@ class Client:
     # ------------------------------------------------------------- read path
 
     async def get_file_info(self, path: str) -> dict | None:
+        """File metadata, transparently coalescing CONCURRENT callers into
+        BatchGetFileInfo RPCs (one master round-trip, one ReadIndex/lease
+        barrier, one msgpack envelope for the whole batch). Callers keep
+        per-path semantics; batching only fuses the transport — under a
+        read-heavy infeed the metadata plane otherwise pays a full RPC
+        (~0.7 ms of the single bench core) per file. Disable with
+        ``meta_coalescing=False`` for strict per-call RPCs."""
+        if not self.meta_coalescing:
+            return await self._get_file_info_single(path)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(
+            lambda f: None if f.cancelled() else f.exception()
+        )
+        self._meta_pending.append((path, fut))
+        if self._meta_drainer is None or self._meta_drainer.done():
+            self._meta_drainer = asyncio.create_task(self._drain_meta())
+        return await asyncio.shield(fut)
+
+    async def _get_file_info_single(self, path: str) -> dict | None:
         resp, _ = await self._execute("GetFileInfo", {"path": path}, path=path)
         return resp["metadata"] if resp.get("found") else None
+
+    async def _drain_meta(self) -> None:
+        """Coalescer drain: rounds form naturally from whatever staged while
+        the previous batch RPC was in flight (same pattern as the TPU read
+        combiner). Paths are grouped by routing target set — different
+        shards never share a batch."""
+        aborted = True
+        try:
+            while self._meta_pending:
+                batch = self._meta_pending[:64]
+                self._meta_pending = self._meta_pending[64:]
+                groups: dict[tuple, list] = {}
+                for path, fut in batch:
+                    key = tuple(self._masters_for(path) or ())
+                    groups.setdefault(key, []).append((path, fut))
+                for items in groups.values():
+                    await self._run_meta_batch(items)
+            aborted = False
+        finally:
+            self._meta_drainer = None
+            if aborted:
+                for _path, fut in self._meta_pending:
+                    if not fut.done():
+                        fut.set_exception(
+                            DfsError("metadata coalescer shut down")
+                        )
+                self._meta_pending = []
+
+    async def _run_meta_batch(self, items: list) -> None:
+        try:
+            resp, _ = await self._execute(
+                "BatchGetFileInfo", {"paths": [p for p, _ in items]},
+                path=items[0][0],
+            )
+            results = resp.get("results") or []
+        except BaseException as e:
+            # Cancellation included: this batch was already sliced off
+            # _meta_pending, so the drainer's abort cleanup can't reach
+            # these futures — resolve them here or their shielded callers
+            # hang forever.
+            for _path, fut in items:
+                if not fut.done():
+                    fut.set_exception(
+                        DfsError(f"batched metadata fetch failed: {e!r}")
+                    )
+            if not isinstance(e, Exception):
+                raise
+            return
+        for i, (path, fut) in enumerate(items):
+            r = results[i] if i < len(results) else {"retry": True}
+            if r.get("retry"):
+                # This shard couldn't serve the path (redirect /
+                # migration); re-issue individually through the full
+                # retry machinery. Keep a strong reference — the loop
+                # holds tasks only weakly and a GC'd task would strand
+                # the caller's future.
+                task = asyncio.create_task(self._meta_fallback(path, fut))
+                self._meta_tasks.add(task)
+                task.add_done_callback(self._meta_tasks.discard)
+            elif not fut.done():
+                fut.set_result(r["metadata"] if r.get("found") else None)
+
+    async def _meta_fallback(self, path: str, fut: asyncio.Future) -> None:
+        try:
+            result = await self._get_file_info_single(path)
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(
+                    e if isinstance(e, Exception)
+                    else DfsError("metadata fetch cancelled")
+                )
+            return
+        if not fut.done():
+            fut.set_result(result)
 
     async def get_file(self, path: str) -> bytes:
         """Concurrent block fan-out + reorder (reference mod.rs:856-917)."""

@@ -170,6 +170,7 @@ class Master:
     def handlers(self) -> dict:
         return {
             "GetFileInfo": self.rpc_get_file_info,
+            "BatchGetFileInfo": self.rpc_batch_get_file_info,
             "CreateFile": self.rpc_create_file,
             "DeleteFile": self.rpc_delete_file,
             "AllocateBlock": self.rpc_allocate_block,
@@ -506,6 +507,32 @@ class Master:
         # replicated command per window instead.
         self._note_access(req["path"])
         return {"found": True, "metadata": f.to_dict()}
+
+    async def rpc_batch_get_file_info(self, req: dict) -> dict:
+        """Coalesced GetFileInfo: ONE ReadIndex/lease barrier covers the
+        whole batch. Linearizability per caller is preserved — every
+        coalesced invocation happens-before the barrier and returns after
+        it, so the barrier is a valid linearization point for each. Paths
+        this shard can't serve (REDIRECT/unavailable) get a per-path
+        ``retry`` marker — the client re-issues those individually through
+        its full retry/redirect machinery — so one misrouted path can't
+        fail a whole batch."""
+        await self._linearizable_read()
+        results = []
+        for path in req.get("paths") or []:
+            try:
+                self._check_shard_ownership(path)
+            except RpcError as e:
+                results.append({"retry": True, "why": e.message})
+                continue
+            f = self.state.get_file(path)
+            self.monitor.record(path, f.size if f else 0)
+            if f is None:
+                results.append({"found": False, "metadata": None})
+            else:
+                self._note_access(path)
+                results.append({"found": True, "metadata": f.to_dict()})
+        return {"results": results}
 
     def _note_access(self, path: str) -> None:
         at, count = self._access_pending.get(path, (0, 0))

@@ -324,11 +324,18 @@ class HbmReader:
         for g in groups:
             parts.append(jax.device_put(g.crcs, home))
         if parts:
-            got = await asyncio.to_thread(
-                lambda: np.asarray(
-                    jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-                )
-            )
+            # One D2H wave: start every part's async host copy, then
+            # collect and concatenate on the HOST. No jnp.concatenate —
+            # that would compile a fresh XLA program for each distinct
+            # (singles, groups...) shape combination, and this runs right
+            # inside the caller's verdict-fetch window.
+            def fetch() -> np.ndarray:
+                for p in parts:
+                    p.copy_to_host_async()
+                return np.concatenate([np.asarray(p) for p in parts]) \
+                    if len(parts) > 1 else np.asarray(parts[0])
+
+            got = await asyncio.to_thread(fetch)
         else:
             # Every batch here was resolved by an earlier confirm call
             # (blocks of one fused round confirmed file-by-file) — nothing

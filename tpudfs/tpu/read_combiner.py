@@ -227,7 +227,20 @@ class ReadCombiner:
                         ])
                     else:
                         rows = buf
-                    await queue.put((good, rows, cpb, crcs is not None))
+                    # Ship in power-of-two sub-rounds: a compacted count
+                    # (15 after one dropped slot) would otherwise dispatch
+                    # a CRC shape warm() never compiled — a fresh XLA
+                    # compile mid-infeed on TPU. Full buckets pass through
+                    # in one iteration.
+                    off = 0
+                    while off < len(good):
+                        take = 1 << ((len(good) - off).bit_length() - 1)
+                        await queue.put((
+                            good[off : off + take],
+                            rows[off * cpb : (off + take) * cpb],
+                            cpb, crcs is not None,
+                        ))
+                        off += take
             aborted = False
         finally:
             # Synchronously (no await since the empty-pending check) clear
