@@ -31,6 +31,8 @@ from collections import OrderedDict
 
 import grpc
 
+from tpudfs.common import blocknet
+from tpudfs.common.blocknet import BlockConnPool
 from tpudfs.common.checksum import crc32c
 from tpudfs.common.erasure import encode as ec_encode, reconstruct
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer, ServerTls
@@ -215,6 +217,11 @@ class ChunkServer:
         self._ec_converting: set[str] = set()
         self._tasks: set[asyncio.Task] = set()
         self._server: RpcServer | None = None
+        self._blockport = None
+        self.data_port = 0
+        #: pooled raw-TCP data plane for CS<->CS block payloads (forwarding,
+        #: recovery, EC shard distribution); falls back to gRPC per peer.
+        self.blocks = BlockConnPool(tls=self.client.tls)
         self.committer = GroupCommitter(store)
 
     # ------------------------------------------------------------------ RPC
@@ -226,7 +233,12 @@ class ChunkServer:
             "ReplicateBlock": self.rpc_replicate_block,
             "LocalAccess": self.rpc_local_access,
             "Stats": self.rpc_stats,
+            "DataPort": self.rpc_data_port,
         }
+
+    async def rpc_data_port(self, req: dict) -> dict:
+        """Blockport discovery (tpudfs.common.blocknet): port 0 = none."""
+        return {"port": self.data_port}
 
     async def rpc_local_access(self, req: dict) -> dict:
         """Short-circuit local-read handshake (the HDFS short-circuit idea,
@@ -270,11 +282,20 @@ class ChunkServer:
         server.add_service(SERVICE, self.handlers())
         await server.start()
         self._server = server
+        if blocknet.enabled():
+            # Bulk data plane beside the gRPC listener, same TLS material.
+            self._blockport = blocknet.BlockPortServer({
+                "WriteBlock": self.rpc_write_block,
+                "ReplicateBlock": self.rpc_replicate_block,
+                "ReadBlock": self.rpc_read_block,
+            }, tls=tls)
+            self.data_port = await self._blockport.start(host)
         if not self.address:
             self.address = server.address
         if scrubber:
             self._spawn(self.run_scrubber())
-        logger.info("chunkserver listening on %s", self.address)
+        logger.info("chunkserver listening on %s (blockport %s)",
+                    self.address, self.data_port or "off")
         return self.address
 
     def _spawn(self, coro) -> asyncio.Task:
@@ -288,6 +309,10 @@ class ChunkServer:
             t.cancel()
         self._tasks.clear()
         await self.committer.stop()
+        if self._blockport is not None:
+            await self._blockport.stop()
+            self._blockport = None
+        await self.blocks.close()
         if self._server:
             await self._server.stop()
             self._server = None
@@ -359,9 +384,9 @@ class ChunkServer:
                 "expected_crc32c": expected,
                 "master_term": int(req.get("master_term", 0)),
             }
-            forward_task = asyncio.create_task(self.client.call(
-                next_servers[0], SERVICE, "ReplicateBlock", forward,
-                timeout=30.0,
+            forward_task = asyncio.create_task(self.blocks.call(
+                self.client, next_servers[0], SERVICE, "ReplicateBlock",
+                forward, timeout=30.0,
             ))
 
         local_err: str | None = None
@@ -523,8 +548,8 @@ class ChunkServer:
             if not loc or loc == self.address:
                 continue
             try:
-                resp = await self.client.call(
-                    loc, SERVICE, "ReadBlock",
+                resp = await self.blocks.call(
+                    self.client, loc, SERVICE, "ReadBlock",
                     {"block_id": block_id, "offset": 0, "length": 0}, timeout=30.0,
                 )
             except RpcError as e:
@@ -614,8 +639,8 @@ class ChunkServer:
                 except OSError as e:
                     return f"local shard write failed: {e}"
             try:
-                resp = await self.client.call(
-                    target, SERVICE, "ReplicateBlock",
+                resp = await self.blocks.call(
+                    self.client, target, SERVICE, "ReplicateBlock",
                     {
                         "block_id": new_block_id,
                         "data": shards[i],
@@ -685,8 +710,8 @@ class ChunkServer:
         except BlockNotFoundError:
             return f"block {block_id} not found locally"
         try:
-            resp = await self.client.call(
-                target_addr, SERVICE, "ReplicateBlock",
+            resp = await self.blocks.call(
+                self.client, target_addr, SERVICE, "ReplicateBlock",
                 {
                     "block_id": block_id,
                     "data": data,
@@ -719,8 +744,8 @@ class ChunkServer:
 
         async def fetch(i: int, addr: str) -> tuple[int, bytes | None]:
             try:
-                resp = await self.client.call(
-                    addr, SERVICE, "ReadBlock",
+                resp = await self.blocks.call(
+                    self.client, addr, SERVICE, "ReadBlock",
                     {"block_id": block_id, "offset": 0, "length": 0}, timeout=30.0,
                 )
                 return i, resp["data"]

@@ -30,6 +30,7 @@ import os
 import uuid
 from pathlib import Path
 
+from tpudfs.common.blocknet import BlockConnPool
 from tpudfs.common.checksum import crc32c
 from tpudfs.common.erasure import decode as ec_decode
 from tpudfs.common.erasure import encode as ec_encode
@@ -121,6 +122,9 @@ class Client:
         self._meta_pending: list[tuple[str, asyncio.Future]] = []
         self._meta_drainer: asyncio.Task | None = None
         self._meta_tasks: set[asyncio.Task] = set()
+        #: Raw-TCP bulk data plane for block payloads (common/blocknet);
+        #: per-peer discovery with transparent gRPC fallback.
+        self.block_pool = BlockConnPool(tls=self.rpc.tls)
 
     def _dial(self, addr: str) -> str:
         return self.host_aliases.get(addr, addr)
@@ -209,8 +213,22 @@ class Client:
         return data
 
     async def close(self) -> None:
+        await self.block_pool.close()
         if self._owns_rpc:
             await self.rpc.close()
+
+    async def _data_call(self, addr: str, method: str, req: dict,
+                         timeout: float) -> dict:
+        """Block-payload RPC to a chunkserver: blockport when the peer
+        advertises one, gRPC otherwise. Aliased routes (host_aliases — the
+        Docker/FaultProxy indirections) stay on gRPC so an interposer on
+        the gRPC address can't be bypassed by the data side channel."""
+        dialed = self._dial(addr)
+        if dialed != addr:
+            return await self.rpc.call(dialed, CS, method, req,
+                                       timeout=timeout)
+        return await self.block_pool.call(self.rpc, addr, CS, method, req,
+                                          timeout=timeout)
 
     # ----------------------------------------------------------- shard map
 
@@ -300,7 +318,8 @@ class Client:
                     continue
                 logger.debug("rpc %s to %s failed: %s", method, target, e.message)
                 if e.code.name in ("INVALID_ARGUMENT", "NOT_FOUND",
-                                   "ALREADY_EXISTS", "DATA_LOSS", "OUT_OF_RANGE"):
+                                   "ALREADY_EXISTS", "DATA_LOSS",
+                                   "OUT_OF_RANGE", "UNIMPLEMENTED"):
                     if indeterminate and e.code.name in retry_benign:
                         # The op we resent already applied on a prior attempt.
                         return {"success": True, "retry_resolved": True}, target
@@ -395,7 +414,7 @@ class Client:
 
     async def _write_replicated_block(self, block_id: str, data: bytes,
                                       servers: list[str], term: int) -> None:
-        resp = await self.rpc.call(self._dial(servers[0]), CS, "WriteBlock", {
+        resp = await self._data_call(servers[0], "WriteBlock", {
             "block_id": block_id,
             "data": data,
             "next_servers": servers[1:],
@@ -423,7 +442,7 @@ class Client:
         shards = ec_encode(data, k, m)
 
         async def write_shard(i: int) -> None:
-            resp = await self.rpc.call(self._dial(servers[i]), CS, "WriteBlock", {
+            resp = await self._data_call(servers[i], "WriteBlock", {
                 "block_id": block_id,
                 "data": shards[i],
                 "next_servers": [],
@@ -476,8 +495,12 @@ class Client:
                 for path, fut in batch:
                     key = tuple(self._masters_for(path) or ())
                     groups.setdefault(key, []).append((path, fut))
-                for items in groups.values():
-                    await self._run_meta_batch(items)
+                # Concurrent per-group RPCs: one slow/down shard's retry
+                # loop must not head-of-line-block the other shards.
+                await asyncio.gather(
+                    *(self._run_meta_batch(items)
+                      for items in groups.values())
+                )
             aborted = False
         finally:
             self._meta_drainer = None
@@ -496,6 +519,25 @@ class Client:
                 path=items[0][0],
             )
             results = resp.get("results") or []
+        except DfsError as e:
+            # Pre-batch master (rolling upgrade): fall every path back to
+            # the per-path RPC and stop coalescing against this cluster.
+            # (grpc's generic handler words a missing method "Method not
+            # found!"; UNIMPLEMENTED is fatal-not-retried in _execute.)
+            if "unimplemented" in str(e).lower() or \
+                    "method not found" in str(e).lower():
+                self.meta_coalescing = False
+                for path, fut in items:
+                    task = asyncio.create_task(self._meta_fallback(path, fut))
+                    self._meta_tasks.add(task)
+                    task.add_done_callback(self._meta_tasks.discard)
+                return
+            for _path, fut in items:
+                if not fut.done():
+                    fut.set_exception(
+                        DfsError(f"batched metadata fetch failed: {e!r}")
+                    )
+            return
         except BaseException as e:
             # Cancellation included: this batch was already sliced off
             # _meta_pending, so the drainer's abort cleanup can't reach
@@ -631,8 +673,8 @@ class Client:
         req = {"block_id": block["block_id"], "offset": offset, "length": length}
 
         async def read_from(addr: str) -> bytes:
-            resp = await self.rpc.call(self._dial(addr), CS, "ReadBlock", req,
-                                       timeout=max(self.rpc_timeout, 60.0))
+            resp = await self._data_call(addr, "ReadBlock", req,
+                                         timeout=max(self.rpc_timeout, 60.0))
             return resp["data"]
 
         errors: list[str] = []
@@ -698,8 +740,8 @@ class Client:
             if local is not None:
                 return local
             try:
-                resp = await self.rpc.call(
-                    self._dial(addr), CS, "ReadBlock",
+                resp = await self._data_call(
+                    addr, "ReadBlock",
                     {"block_id": block["block_id"], "offset": 0, "length": 0},
                     timeout=max(self.rpc_timeout, 60.0),
                 )

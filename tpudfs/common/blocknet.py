@@ -1,0 +1,314 @@
+"""Raw-TCP bulk data plane ("blockport") for block payloads.
+
+The reference pushes block bytes through tonic gRPC (compiled Rust, where
+HTTP/2 framing is cheap — chunkserver.rs:722-1087). This build's control
+plane is Python, and gRPC there measures ~2.3 ms of the single bench core
+per 1 MiB unary message — more CPU than the durable write it carries. Bulk
+block payloads therefore ride a dedicated length-framed TCP protocol on a
+separate listener (asyncio streams, ~1.1 ms per 1 MiB on the same host,
+measured both-endpoints-on-one-core), while EVERY control RPC — and any
+peer that doesn't advertise a blockport — stays on the msgpack-gRPC
+substrate. This is the DCN half of the SURVEY §2.6 transport split; the
+colocated half is ICI collectives (tpu/ici_replication.py).
+
+Frame, both directions::
+
+    u32 header_len | msgpack(header) | u64 payload_len | payload bytes
+
+Request header: ``{"m": <method>, **fields}``; the payload carries what the
+gRPC twin would put in ``req["data"]``. Response header ``{"ok": True,
+**fields}`` (payload = ``resp["data"]`` for reads) or ``{"ok": False,
+"code": <grpc StatusCode name>, "message": str}`` — errors re-raise as
+RpcError so caller retry logic is transport-agnostic.
+
+Discovery: callers resolve a peer's blockport once via the ``DataPort``
+gRPC method (negative-cached when absent, so pre-blockport peers keep
+working over gRPC). Aliased addresses (``Client.host_aliases`` — the
+Docker/FaultProxy indirections) DELIBERATELY stay on gRPC: a fault proxy
+interposed on the gRPC address must not be bypassed by a side-channel
+data connection.
+
+TLS parity: the blockport wraps the same certificate material as the gRPC
+listeners (ServerTls/ClientTls), including mTLS client-cert requirements.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import ssl
+import struct
+
+import grpc
+import msgpack
+
+from tpudfs.common.rpc import ClientTls, RpcClient, RpcError, ServerTls
+
+logger = logging.getLogger(__name__)
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_MAX_HEADER = 1 << 20
+_MAX_PAYLOAD = 100 * 1024 * 1024  # parity with MAX_MESSAGE_BYTES
+
+
+def enabled() -> bool:
+    return os.environ.get("TPUDFS_BLOCKPORT", "1") != "0"
+
+
+def _pack_frame(header: dict, payload) -> list[bytes]:
+    """``payload=None`` means "no data field"; ``b""`` is a real, empty
+    data field (an empty block is valid DFS content) — the ``_d`` header
+    flag keeps the two distinguishable across the wire."""
+    if payload is not None:
+        header["_d"] = 1
+    h = msgpack.packb(header, use_bin_type=True)
+    out = [_U32.pack(len(h)), h, _U64.pack(len(payload) if payload else 0)]
+    if payload:
+        out.append(payload)
+    return out
+
+
+async def _read_frame(r: asyncio.StreamReader) -> tuple[dict, bytes]:
+    hlen = _U32.unpack(await r.readexactly(4))[0]
+    if hlen > _MAX_HEADER:
+        raise ConnectionError(f"blockport header too large: {hlen}")
+    header = msgpack.unpackb(await r.readexactly(hlen), raw=False,
+                             strict_map_key=False)
+    plen = _U64.unpack(await r.readexactly(8))[0]
+    if plen > _MAX_PAYLOAD:
+        raise ConnectionError(f"blockport payload too large: {plen}")
+    payload = await r.readexactly(plen) if plen else b""
+    return header, payload
+
+
+class BlockPortServer:
+    """Framed-TCP front over the same async handlers the gRPC service
+    registers — the payload rides outside msgpack, everything else is
+    identical (handlers see ``req["data"]``, reads return ``resp["data"]``)."""
+
+    def __init__(self, handlers: dict, tls: ServerTls | None = None):
+        self.handlers = handlers
+        self._tls = tls
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int = 0
+        #: live connections; closed at stop() — wait_closed() would
+        #: otherwise block on peers' POOLED (idle but open) connections.
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        ctx = None
+        if self._tls is not None:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self._tls.cert_path, self._tls.key_path)
+            if self._tls.ca_path:
+                ctx.load_verify_locations(self._tls.ca_path)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+        self._server = await asyncio.start_server(
+            self._handle, host, port, ssl=ctx
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, r: asyncio.StreamReader,
+                      w: asyncio.StreamWriter) -> None:
+        self._conns.add(w)
+        try:
+            while True:
+                try:
+                    header, payload = await _read_frame(r)
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        ConnectionResetError):
+                    return
+                method = header.pop("m", "")
+                fn = self.handlers.get(method)
+                if fn is None:
+                    w.writelines(_pack_frame(
+                        {"ok": False, "code": "UNIMPLEMENTED",
+                         "message": f"no blockport method {method!r}"}, None))
+                    await w.drain()
+                    continue
+                req = header
+                if req.pop("_d", 0):
+                    req["data"] = payload
+                try:
+                    resp = await fn(req)
+                except RpcError as e:
+                    w.writelines(_pack_frame(
+                        {"ok": False, "code": e.code.name,
+                         "message": e.message}, None))
+                    await w.drain()
+                    continue
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("blockport handler %s failed", method)
+                    w.writelines(_pack_frame(
+                        {"ok": False, "code": "INTERNAL",
+                         "message": "internal error"}, None))
+                    await w.drain()
+                    continue
+                out = dict(resp)
+                data = out.pop("data", None) if "data" in out else None
+                out["ok"] = True
+                w.writelines(_pack_frame(out, data))
+                await w.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            self._conns.discard(w)
+            w.close()
+
+
+class BlockConnPool:
+    """Per-address pooled blockport client with gRPC-probed discovery and
+    transparent gRPC fallback.
+
+    ``call(rpc, addr, method, req)`` sends over the peer's blockport when
+    one is advertised (``DataPort`` probe, cached; failures negative-cached
+    for 30 s) and over ``rpc`` otherwise — so every caller keeps exactly
+    one code path and legacy/faulted peers degrade gracefully."""
+
+    #: idle connections kept per peer; extras close on release.
+    MAX_IDLE_PER_PEER = 8
+
+    def __init__(self, tls: ClientTls | None = None):
+        self._tls = tls
+        self._free: dict[str, list] = {}
+        #: addr -> (port | None). None = peer has no blockport (final) —
+        #: probe transport errors get a retry deadline instead.
+        self._ports: dict[str, int | None] = {}
+        self._retry_at: dict[str, float] = {}
+        #: in-flight DataPort probes, shared so a concurrent first burst
+        #: fires ONE probe per peer instead of one per caller.
+        self._probes: dict[str, asyncio.Task] = {}
+        self._ssl_ctx: ssl.SSLContext | None = None
+        if tls is not None:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.load_verify_locations(tls.ca_path)
+            ctx.check_hostname = False
+            if tls.cert_path and tls.key_path:
+                ctx.load_cert_chain(tls.cert_path, tls.key_path)
+            self._ssl_ctx = ctx
+
+    async def _data_port(self, rpc: RpcClient, addr: str,
+                         service: str) -> int | None:
+        if addr in self._ports:
+            return self._ports[addr]
+        now = asyncio.get_running_loop().time()
+        if self._retry_at.get(addr, 0) > now:
+            return None
+        probe = self._probes.get(addr)
+        if probe is None:
+            probe = asyncio.create_task(self._probe(rpc, addr, service))
+            self._probes[addr] = probe
+            probe.add_done_callback(
+                lambda _t, a=addr: self._probes.pop(a, None)
+            )
+        try:
+            return await asyncio.shield(probe)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return None
+
+    async def _probe(self, rpc: RpcClient, addr: str,
+                     service: str) -> int | None:
+        now = asyncio.get_running_loop().time()
+        try:
+            resp = await rpc.call(addr, service, "DataPort", {}, timeout=5.0)
+            port = int(resp.get("port") or 0) or None
+        except RpcError as e:
+            if e.code == grpc.StatusCode.UNIMPLEMENTED:
+                self._ports[addr] = None  # pre-blockport peer: final
+            else:
+                self._retry_at[addr] = now + 30.0
+            return None
+        self._ports[addr] = port
+        return port
+
+    async def call(self, rpc: RpcClient, addr: str, service: str,
+                   method: str, req: dict, timeout: float = 30.0) -> dict:
+        """Blockport when advertised, gRPC otherwise. ``req["data"]`` (if
+        any) travels as the raw payload frame."""
+        port = None
+        if enabled():
+            port = await self._data_port(rpc, addr, service)
+        if port is None:
+            return await rpc.call(addr, service, method, req, timeout=timeout)
+        host = addr.rsplit(":", 1)[0]
+        try:
+            return await asyncio.wait_for(
+                self._call_blockport(f"{host}:{port}", method, req),
+                timeout=timeout,
+            )
+        except RpcError:
+            raise
+        except asyncio.TimeoutError:
+            raise RpcError(grpc.StatusCode.DEADLINE_EXCEEDED,
+                           f"blockport call to {host}:{port} timed out") \
+                from None
+        except (OSError, ConnectionError, asyncio.IncompleteReadError,
+                ValueError, msgpack.exceptions.UnpackException) as e:
+            # Connection-level OR framing failure (a corrupt/desynced frame
+            # surfaces as an unpack error): drop the cached port so the
+            # next call re-probes (the peer may have restarted on a new
+            # port), and surface the same UNAVAILABLE the gRPC path would
+            # so caller failover loops keep working.
+            self._ports.pop(addr, None)
+            self._retry_at[addr] = asyncio.get_running_loop().time() + 5.0
+            raise RpcError(grpc.StatusCode.UNAVAILABLE,
+                           f"blockport {host}:{port}: {e!r}") from None
+
+    async def _call_blockport(self, hostport: str, method: str,
+                              req: dict) -> dict:
+        conn = None
+        free = self._free.setdefault(hostport, [])
+        while free:
+            conn = free.pop()
+            if conn[1].is_closing():
+                conn = None
+                continue
+            break
+        if conn is None:
+            host, port = hostport.rsplit(":", 1)
+            conn = await asyncio.open_connection(
+                host, int(port), ssl=self._ssl_ctx
+            )
+        r, w = conn
+        try:
+            header = {k: v for k, v in req.items() if k != "data"}
+            header["m"] = method
+            w.writelines(_pack_frame(header, req.get("data")))
+            await w.drain()
+            resp, payload = await _read_frame(r)
+        except BaseException:
+            w.close()
+            raise
+        if len(free) < self.MAX_IDLE_PER_PEER:
+            free.append(conn)
+        else:
+            w.close()
+        has_data = resp.pop("_d", 0)
+        if not resp.pop("ok", False):
+            code = getattr(grpc.StatusCode, str(resp.get("code")),
+                           grpc.StatusCode.INTERNAL)
+            raise RpcError(code, str(resp.get("message") or ""))
+        if has_data:
+            resp["data"] = payload
+        return resp
+
+    async def close(self) -> None:
+        for conns in self._free.values():
+            for _r, w in conns:
+                w.close()
+        self._free.clear()

@@ -265,7 +265,8 @@ class RpcClient:
     """
 
     def __init__(self, tls: ClientTls | None = None):
-        self._tls = tls
+        #: public so sibling transports (blocknet) reuse the same material
+        self.tls = tls
         self._channels: dict[str, grpc.aio.Channel] = {}
         # Multicallables are not free to build (serializer plumbing per
         # call); cache one per (addr, service, method).
@@ -280,14 +281,14 @@ class RpcClient:
             ch = self._channels.get(addr)
             if ch is not None:
                 return ch
-            if self._tls is not None:
-                with open(self._tls.ca_path, "rb") as f:
+            if self.tls is not None:
+                with open(self.tls.ca_path, "rb") as f:
                     root = f.read()
                 cert = key = None
-                if self._tls.cert_path and self._tls.key_path:
-                    with open(self._tls.cert_path, "rb") as f:
+                if self.tls.cert_path and self.tls.key_path:
+                    with open(self.tls.cert_path, "rb") as f:
                         cert = f.read()
-                    with open(self._tls.key_path, "rb") as f:
+                    with open(self.tls.key_path, "rb") as f:
                         key = f.read()
                 creds = grpc.ssl_channel_credentials(
                     root_certificates=root, private_key=key, certificate_chain=cert
