@@ -1,0 +1,912 @@
+// Native data-plane engine: the blockport protocol served without Python.
+//
+// The TPU-host twin of the reference's compiled Rust chunkserver hot path
+// (WriteBlock / ReplicateBlock / ReadBlock, dfs/chunkserver/src/
+// chunkserver.rs:722-1087) — and the "unwired io_uring pool, done right"
+// from SURVEY §2.2: on the single-core bench host the Python asyncio
+// handler costs more per 1 MiB hop than the durable write itself, so the
+// chunkserver starts this engine (a small threaded TCP server) on its
+// blockport and the whole chain — in-flight CRC verify (hardware CRC32C),
+// group-committed durable staging, downstream forward, ack aggregation —
+// runs in C++. Python keeps every control path: heartbeats, healing,
+// recovery, scrubbing, fencing-term distribution, and the gRPC fallback
+// handlers (which remain byte-compatible with this engine's on-disk
+// format — native/blockio.cc's staged sidecar layout).
+//
+// Wire protocol: identical to tpudfs/common/blocknet.py —
+//   u32 header_len | msgpack(header) | u64 payload_len | payload
+// with the "_d" header flag marking a real (possibly empty) data field.
+// Methods: WriteBlock, ReplicateBlock (same handling), ReadBlock; others
+// answer UNIMPLEMENTED so a client can fall back.
+//
+// Chain forwarding needs no discovery here: the sender resolves every
+// chain member's data port (blocknet probe) and passes "next_data_ports"
+// beside "next_servers"; a 0 port means "skip the forward, let the healer
+// repair" (same degraded contract as a dead tail).
+//
+// Python integration (ctypes, tpudfs/common/native.py):
+//   int64_t  tpudfs_dataplane_start(host, hot_dir, cold_dir, chunk_size,
+//                                   port, threads) -> handle or -errno
+//   int32_t  tpudfs_dataplane_port(handle)
+//   void     tpudfs_dataplane_set_term(handle, term)   // from heartbeats
+//   uint64_t tpudfs_dataplane_term(handle)             // learned from reqs
+//   int64_t  tpudfs_dataplane_take_bad(handle, buf, cap) // '\n'-joined ids
+//   void     tpudfs_dataplane_stats(handle, uint64_t out[4])
+//                                   // writes, reads, forwards, errors
+//   int64_t  tpudfs_dataplane_stop(handle)
+//
+// Fencing parity: reference chunkserver.rs:732-743 — requests carrying a
+// stale master term are rejected FAILED_PRECONDITION; newer terms are
+// learned (and exposed to Python, which merges them into its own view).
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <set>
+#include <string>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <chrono>
+#include <fcntl.h>
+#include <memory>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+uint32_t tpudfs_crc32c(uint32_t crc, const uint8_t* buf, size_t len);
+int64_t tpudfs_block_write_staged(const char* data_path,
+                                  const char* meta_path, const uint8_t* data,
+                                  uint64_t len, uint32_t chunk,
+                                  uint32_t* out_crcs);
+int64_t tpudfs_block_read_verify(const char* data_path, const char* meta_path,
+                                 uint64_t offset, uint64_t length,
+                                 uint8_t* out, int verify,
+                                 uint32_t expected_chunk);
+int64_t tpudfs_syncfs(const char* path);
+}
+
+namespace {
+
+constexpr int64_t kCorrupt = -200002;
+constexpr uint64_t kMaxHeader = 1 << 20;
+constexpr uint64_t kMaxPayload = 100ull * 1024 * 1024;
+
+// ----------------------------------------------------------- msgpack mini
+
+struct Value {
+  enum Kind { NIL, BOOL, INT, STR, ASTR, AINT } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  std::string s;
+  std::vector<std::string> astr;
+  std::vector<int64_t> aint;
+};
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint8_t u8() {
+    if (p >= end) { ok = false; return 0; }
+    return *p++;
+  }
+  uint64_t be(int n) {
+    uint64_t v = 0;
+    for (int k = 0; k < n; k++) v = (v << 8) | u8();
+    return v;
+  }
+  bool bytes(size_t n, std::string* out) {
+    if (static_cast<size_t>(end - p) < n) { ok = false; return false; }
+    out->assign(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return true;
+  }
+};
+
+bool parse_str(Reader& r, std::string* out) {
+  uint8_t t = r.u8();
+  size_t n;
+  if ((t & 0xe0) == 0xa0) n = t & 0x1f;
+  else if (t == 0xd9) n = r.be(1);
+  else if (t == 0xda) n = r.be(2);
+  else if (t == 0xdb) n = r.be(4);
+  else if (t == 0xc4) n = r.be(1);   // bin accepted for str slots
+  else if (t == 0xc5) n = r.be(2);
+  else if (t == 0xc6) n = r.be(4);
+  else { r.ok = false; return false; }
+  return r.bytes(n, out);
+}
+
+bool parse_int(Reader& r, int64_t* out) {
+  uint8_t t = r.u8();
+  if (t <= 0x7f) { *out = t; return r.ok; }
+  if (t >= 0xe0) { *out = static_cast<int8_t>(t); return r.ok; }
+  switch (t) {
+    case 0xcc: *out = static_cast<int64_t>(r.be(1)); return r.ok;
+    case 0xcd: *out = static_cast<int64_t>(r.be(2)); return r.ok;
+    case 0xce: *out = static_cast<int64_t>(r.be(4)); return r.ok;
+    case 0xcf: *out = static_cast<int64_t>(r.be(8)); return r.ok;
+    case 0xd0: *out = static_cast<int8_t>(r.be(1)); return r.ok;
+    case 0xd1: *out = static_cast<int16_t>(r.be(2)); return r.ok;
+    case 0xd2: *out = static_cast<int32_t>(r.be(4)); return r.ok;
+    case 0xd3: *out = static_cast<int64_t>(r.be(8)); return r.ok;
+    default: r.ok = false; return false;
+  }
+}
+
+// Parse one value of the limited shapes our headers use.
+bool parse_value(Reader& r, Value* v) {
+  if (r.p >= r.end) { r.ok = false; return false; }
+  uint8_t t = *r.p;
+  if (t == 0xc0) { r.u8(); v->kind = Value::NIL; return true; }
+  if (t == 0xc2 || t == 0xc3) {
+    r.u8();
+    v->kind = Value::BOOL;
+    v->b = (t == 0xc3);
+    return true;
+  }
+  if (t <= 0x7f || t >= 0xcc) {
+    if (t <= 0x7f || (t >= 0xcc && t <= 0xd3) || t >= 0xe0) {
+      v->kind = Value::INT;
+      return parse_int(r, &v->i);
+    }
+  }
+  if ((t & 0xe0) == 0xa0 || t == 0xd9 || t == 0xda || t == 0xdb ||
+      t == 0xc4 || t == 0xc5 || t == 0xc6) {
+    v->kind = Value::STR;
+    return parse_str(r, &v->s);
+  }
+  size_t n;
+  if ((t & 0xf0) == 0x90) { r.u8(); n = t & 0x0f; }
+  else if (t == 0xdc) { r.u8(); n = r.be(2); }
+  else if (t == 0xdd) { r.u8(); n = r.be(4); }
+  else { r.ok = false; return false; }
+  // Array of strings or ints (peek first element; empty -> ASTR).
+  if (n == 0) { v->kind = Value::ASTR; return true; }
+  if (r.p >= r.end) { r.ok = false; return false; }
+  uint8_t et = *r.p;
+  if (et <= 0x7f || et >= 0xcc) {
+    v->kind = Value::AINT;
+    v->aint.resize(n);
+    for (size_t k = 0; k < n; k++)
+      if (!parse_int(r, &v->aint[k])) return false;
+    return true;
+  }
+  v->kind = Value::ASTR;
+  v->astr.resize(n);
+  for (size_t k = 0; k < n; k++)
+    if (!parse_str(r, &v->astr[k])) return false;
+  return true;
+}
+
+bool parse_header(const uint8_t* buf, size_t len,
+                  std::map<std::string, Value>* out) {
+  Reader r{buf, buf + len};
+  uint8_t t = r.u8();
+  size_t n;
+  if ((t & 0xf0) == 0x80) n = t & 0x0f;
+  else if (t == 0xde) n = r.be(2);
+  else if (t == 0xdf) n = r.be(4);
+  else return false;
+  for (size_t k = 0; k < n; k++) {
+    std::string key;
+    if (!parse_str(r, &key)) return false;
+    Value v;
+    if (!parse_value(r, &v)) return false;
+    (*out)[key] = std::move(v);
+  }
+  return r.ok;
+}
+
+struct Writer {
+  std::string out;
+  void raw(uint8_t b) { out.push_back(static_cast<char>(b)); }
+  void be(uint64_t v, int n) {
+    for (int k = n - 1; k >= 0; k--) raw((v >> (8 * k)) & 0xff);
+  }
+  void str(const std::string& s) {
+    if (s.size() < 32) raw(0xa0 | s.size());
+    else if (s.size() < 256) { raw(0xd9); be(s.size(), 1); }
+    else { raw(0xda); be(s.size(), 2); }
+    out += s;
+  }
+  void uint(uint64_t v) {
+    if (v < 128) raw(static_cast<uint8_t>(v));
+    else if (v < 256) { raw(0xcc); be(v, 1); }
+    else if (v < 65536) { raw(0xcd); be(v, 2); }
+    else if (v <= 0xffffffffull) { raw(0xce); be(v, 4); }
+    else { raw(0xcf); be(v, 8); }
+  }
+  void boolean(bool b) { raw(b ? 0xc3 : 0xc2); }
+  void map_head(size_t n) {
+    if (n < 16) raw(0x80 | n);
+    else { raw(0xde); be(n, 2); }
+  }
+  void astr(const std::vector<std::string>& v) {
+    if (v.size() < 16) raw(0x90 | v.size());
+    else { raw(0xdc); be(v.size(), 2); }
+    for (const auto& s : v) str(s);
+  }
+  void aint(const std::vector<int64_t>& v) {
+    if (v.size() < 16) raw(0x90 | v.size());
+    else { raw(0xdc); be(v.size(), 2); }
+    for (int64_t x : v) uint(static_cast<uint64_t>(x < 0 ? 0 : x));
+  }
+};
+
+// ------------------------------------------------------------- socket io
+
+bool read_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_frame(int fd, const std::string& header, const uint8_t* payload,
+                uint64_t plen) {
+  // Length prefixes are little-endian ("<I"/"<Q") — x86-64 is LE.
+  uint32_t hl = static_cast<uint32_t>(header.size());
+  if (!write_all(fd, &hl, 4)) return false;
+  if (!write_all(fd, header.data(), header.size())) return false;
+  if (!write_all(fd, &plen, 8)) return false;
+  if (plen && !write_all(fd, payload, plen)) return false;
+  return true;
+}
+
+bool recv_frame(int fd, std::map<std::string, Value>* header,
+                std::vector<uint8_t>* payload) {
+  uint32_t hl;
+  if (!read_exact(fd, &hl, 4)) return false;
+  if (hl > kMaxHeader) return false;
+  std::vector<uint8_t> hbuf(hl);
+  if (!read_exact(fd, hbuf.data(), hl)) return false;
+  uint64_t pl;
+  if (!read_exact(fd, &pl, 8)) return false;
+  if (pl > kMaxPayload) return false;
+  payload->resize(pl);
+  if (pl && !read_exact(fd, payload->data(), pl)) return false;
+  return parse_header(hbuf.data(), hl, header);
+}
+
+// --------------------------------------------------------------- engine
+
+struct CommitEntry {
+  std::string data_tmp, meta_tmp, data_final, meta_final;
+  bool done = false;
+  bool failed = false;
+  std::string error;
+};
+
+class Engine {
+ public:
+  Engine(std::string host, std::string hot, std::string cold,
+         uint32_t chunk, int threads)
+      : host_(std::move(host)), hot_(std::move(hot)),
+        cold_(std::move(cold)), chunk_(chunk), nthreads_(threads) {}
+
+  int64_t start(uint16_t port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -errno;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    // Bind the same interface the gRPC listener uses (resolve names via
+    // getaddrinfo) so the advertised data port is reachable wherever the
+    // control port is.
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    if (!host_.empty() && host_ != "localhost") {
+      if (::inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) {
+        addrinfo hints{};
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        addrinfo* res = nullptr;
+        if (::getaddrinfo(host_.c_str(), nullptr, &hints, &res) == 0 &&
+            res != nullptr) {
+          addr.sin_addr =
+              reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+          ::freeaddrinfo(res);
+        }
+      }
+    }
+    addr.sin_port = htons(port);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      int e = errno;
+      ::close(listen_fd_);
+      return -e;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    running_.store(true);
+    commit_thread_ = std::thread([this] { commit_loop(); });
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return port_;
+  }
+
+  void stop() {
+    running_.store(false);
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // Connection threads are detached; the shutdowns above unblock their
+    // recvs. Wait (bounded) for the active count to drain.
+    for (int i = 0; i < 200 && active_.load() > 0; i++)
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    commit_cv_.notify_all();
+    if (commit_thread_.joinable()) commit_thread_.join();
+  }
+
+  int32_t port() const { return port_; }
+  void set_term(uint64_t t) {
+    uint64_t cur = term_.load();
+    while (t > cur && !term_.compare_exchange_weak(cur, t)) {
+    }
+  }
+  uint64_t term() const { return term_.load(); }
+
+  int64_t take_bad(char* buf, uint64_t cap) {
+    // Drain as many WHOLE ids as fit; the rest stay for the next poll —
+    // an oversized backlog must never wedge reporting.
+    std::lock_guard<std::mutex> g(bad_mu_);
+    std::string joined;
+    auto it = bad_.begin();
+    while (it != bad_.end()) {
+      size_t need = joined.size() + (joined.empty() ? 0 : 1) + it->size() + 1;
+      if (need > cap) break;
+      if (!joined.empty()) joined += '\n';
+      joined += *it;
+      it = bad_.erase(it);
+    }
+    if (joined.empty() && !bad_.empty())
+      return -static_cast<int64_t>(bad_.begin()->size() + 1);
+    std::memcpy(buf, joined.c_str(), joined.size() + 1);
+    return static_cast<int64_t>(joined.size());
+  }
+
+  void stats(uint64_t out[4]) const {
+    out[0] = writes_.load();
+    out[1] = reads_.load();
+    out[2] = forwards_.load();
+    out[3] = errors_.load();
+  }
+
+ private:
+  // ------------------------------------------------------------- accept
+
+  void accept_loop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        return;  // listener closed
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> g(conns_mu_);
+        conns_.insert(fd);
+      }
+      active_.fetch_add(1);
+      std::thread([this, fd] {
+        conn_loop(fd);
+        {
+          std::lock_guard<std::mutex> g2(conns_mu_);
+          conns_.erase(fd);
+        }
+        ::close(fd);
+        active_.fetch_sub(1);
+      }).detach();
+    }
+  }
+
+  void conn_loop(int fd) {
+    // Per-connection cache of downstream chain sockets.
+    std::map<std::string, int> downstream;
+    while (running_.load()) {
+      std::map<std::string, Value> h;
+      std::vector<uint8_t> payload;
+      if (!recv_frame(fd, &h, &payload)) break;
+      const std::string method = h.count("m") ? h["m"].s : "";
+      bool has_data = h.count("_d") && h["_d"].i;
+      if (method == "WriteBlock" || method == "ReplicateBlock") {
+        handle_write(fd, h, has_data ? &payload : nullptr, &downstream);
+      } else if (method == "ReadBlock") {
+        handle_read(fd, h);
+      } else {
+        respond_err(fd, "UNIMPLEMENTED",
+                    "no native blockport method " + method);
+      }
+    }
+    for (auto& kv : downstream) close_downstream(kv.second);
+  }
+
+  void close_downstream(int dfd) {
+    {
+      std::lock_guard<std::mutex> g(conns_mu_);
+      conns_.erase(dfd);
+    }
+    ::close(dfd);
+  }
+
+  // ------------------------------------------------------------ replies
+
+  void respond_err(int fd, const std::string& code, const std::string& msg) {
+    errors_.fetch_add(1);
+    Writer w;
+    w.map_head(3);
+    w.str("ok");
+    w.boolean(false);
+    w.str("code");
+    w.str(code);
+    w.str("message");
+    w.str(msg);
+    send_frame(fd, w.out, nullptr, 0);
+  }
+
+  void respond_write(int fd, bool success, const std::string& err,
+                     int64_t replicas) {
+    Writer w;
+    w.map_head(4);
+    w.str("ok");
+    w.boolean(true);
+    w.str("success");
+    w.boolean(success);
+    w.str("error_message");
+    w.str(err);
+    w.str("replicas_written");
+    w.uint(static_cast<uint64_t>(replicas));
+    send_frame(fd, w.out, nullptr, 0);
+  }
+
+  // -------------------------------------------------------------- write
+
+  void handle_write(int fd, std::map<std::string, Value>& h,
+                    std::vector<uint8_t>* data,
+                    std::map<std::string, int>* downstream) {
+    writes_.fetch_add(1);
+    const std::string block_id =
+        h.count("block_id") ? h["block_id"].s : "";
+    if (block_id.empty() || block_id[0] == '.' ||
+        block_id.find('/') != std::string::npos || data == nullptr) {
+      respond_err(fd, "INVALID_ARGUMENT", "bad block id or missing data");
+      return;
+    }
+    uint64_t req_term =
+        h.count("master_term") ? static_cast<uint64_t>(h["master_term"].i) : 0;
+    uint64_t known = term_.load();
+    if (req_term > 0 && req_term < known) {
+      respond_err(fd, "FAILED_PRECONDITION",
+                  "Stale master term: request has " +
+                      std::to_string(req_term) + " but known term is " +
+                      std::to_string(known));
+      return;
+    }
+    if (req_term > known) set_term(req_term);
+
+    uint64_t expected =
+        h.count("expected_crc32c")
+            ? static_cast<uint64_t>(h["expected_crc32c"].i)
+            : 0;
+    if (expected != 0) {
+      uint32_t actual = tpudfs_crc32c(0, data->data(), data->size());
+      if (actual != static_cast<uint32_t>(expected)) {
+        respond_write(fd, false,
+                      "Checksum mismatch: expected " +
+                          std::to_string(expected) + ", actual " +
+                          std::to_string(actual),
+                      0);
+        return;
+      }
+    }
+
+    // Kick the downstream forward BEFORE the local durable write (the
+    // overlapped pipeline the Python handler uses; in-flight CRC above
+    // means forwarding can't propagate corruption).
+    std::vector<std::string> next =
+        h.count("next_servers") ? h["next_servers"].astr
+                                : std::vector<std::string>{};
+    std::vector<int64_t> next_ports =
+        h.count("next_data_ports") ? h["next_data_ports"].aint
+                                   : std::vector<int64_t>{};
+    int fwd_fd = -1;
+    std::string fwd_err;
+    if (!next.empty()) {
+      int64_t port = !next_ports.empty() ? next_ports[0] : 0;
+      if (port <= 0) {
+        fwd_err = "downstream " + next[0] + " has no data port";
+      } else {
+        std::string host = next[0].substr(0, next[0].rfind(':'));
+        std::string key = host + ":" + std::to_string(port);
+        fwd_fd = forward_request(downstream, key, host,
+                                 static_cast<uint16_t>(port), h, next,
+                                 next_ports, *data, &fwd_err);
+      }
+    }
+
+    // Stage + group commit (ack only after durable).
+    std::string err;
+    bool ok = stage_and_commit(block_id, *data, &err);
+
+    int64_t replicas = ok ? 1 : 0;
+    if (fwd_fd >= 0) {
+      forwards_.fetch_add(1);
+      std::map<std::string, Value> fh;
+      std::vector<uint8_t> fp;
+      if (recv_frame(fwd_fd, &fh, &fp) && fh.count("ok") && fh["ok"].b &&
+          fh.count("success") && fh["success"].b) {
+        replicas += fh.count("replicas_written") ? fh["replicas_written"].i : 0;
+      } else {
+        // Downstream failure: drop the cached socket (unknown state).
+        for (auto it = downstream->begin(); it != downstream->end(); ++it) {
+          if (it->second == fwd_fd) {
+            close_downstream(fwd_fd);
+            downstream->erase(it);
+            break;
+          }
+        }
+      }
+    }
+    if (!ok) {
+      respond_write(fd, false, err, replicas);
+      return;
+    }
+    respond_write(fd, true, fwd_err, replicas);
+  }
+
+  int forward_request(std::map<std::string, int>* downstream,
+                      const std::string& key, const std::string& host,
+                      uint16_t port, std::map<std::string, Value>& h,
+                      const std::vector<std::string>& next,
+                      const std::vector<int64_t>& next_ports,
+                      const std::vector<uint8_t>& data, std::string* err) {
+    int dfd = -1;
+    auto it = downstream->find(key);
+    if (it != downstream->end()) dfd = it->second;
+    if (dfd < 0) {
+      dfd = dial(host, port);
+      if (dfd < 0) {
+        *err = "dial " + key + " failed";
+        return -1;
+      }
+      (*downstream)[key] = dfd;
+      // Registered so stop() can shutdown a thread blocked on the
+      // downstream ack recv (up to SO_RCVTIMEO otherwise — long past
+      // stop()'s drain window, a use-after-free).
+      std::lock_guard<std::mutex> g(conns_mu_);
+      conns_.insert(dfd);
+    }
+    Writer w;
+    w.map_head(7);
+    w.str("m");
+    w.str("ReplicateBlock");
+    w.str("_d");
+    w.uint(1);
+    w.str("block_id");
+    w.str(h["block_id"].s);
+    w.str("next_servers");
+    w.astr(std::vector<std::string>(next.begin() + 1, next.end()));
+    w.str("next_data_ports");
+    w.aint(next_ports.size() > 1
+               ? std::vector<int64_t>(next_ports.begin() + 1,
+                                      next_ports.end())
+               : std::vector<int64_t>{});
+    w.str("expected_crc32c");
+    w.uint(h.count("expected_crc32c")
+               ? static_cast<uint64_t>(h["expected_crc32c"].i)
+               : 0);
+    w.str("master_term");
+    w.uint(h.count("master_term") ? static_cast<uint64_t>(h["master_term"].i)
+                                  : 0);
+    if (!send_frame(dfd, w.out, data.data(), data.size())) {
+      close_downstream(dfd);
+      downstream->erase(key);
+      *err = "forward to " + key + " failed";
+      return -1;
+    }
+    return dfd;
+  }
+
+  static int dial(const std::string& host, uint16_t port) {
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      // Hostname-addressed peer (the asyncio path resolves these too).
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (::getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+          res == nullptr)
+        return -1;
+      addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      ::freeaddrinfo(res);
+    }
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      ::close(fd);
+      return -1;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    timeval tv{30, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    return fd;
+  }
+
+  bool stage_and_commit(const std::string& block_id,
+                        const std::vector<uint8_t>& data, std::string* err) {
+    uint64_t token = token_seq_.fetch_add(1);
+    std::string base = hot_ + "/" + block_id;
+    auto entry = std::make_shared<CommitEntry>();
+    entry->data_tmp = base + ".tmp-n" + std::to_string(token);
+    entry->meta_tmp = base + ".meta.tmp-n" + std::to_string(token);
+    entry->data_final = base;
+    entry->meta_final = base + ".meta";
+    int64_t rc = tpudfs_block_write_staged(
+        entry->data_tmp.c_str(), entry->meta_tmp.c_str(), data.data(),
+        data.size(), chunk_, nullptr);
+    if (rc < 0) {
+      *err = "stage failed: errno " + std::to_string(-rc);
+      return false;
+    }
+    std::unique_lock<std::mutex> lk(commit_mu_);
+    commit_queue_.push_back(entry);
+    commit_cv_.notify_one();
+    commit_done_cv_.wait(lk, [&] { return entry->done || !running_.load(); });
+    if (!entry->done) {
+      *err = "engine stopping";
+      return false;
+    }
+    if (entry->failed) {
+      *err = entry->error;
+      return false;
+    }
+    return true;
+  }
+
+  void commit_loop() {
+    std::unique_lock<std::mutex> lk(commit_mu_);
+    while (running_.load() || !commit_queue_.empty()) {
+      if (commit_queue_.empty()) {
+        commit_cv_.wait_for(lk, std::chrono::milliseconds(50));
+        continue;
+      }
+      std::deque<std::shared_ptr<CommitEntry>> batch;
+      batch.swap(commit_queue_);
+      lk.unlock();
+      // One filesystem sync makes every staged file durable, renames
+      // publish, a second sync persists the renames (the group-commit
+      // batch path of tpudfs/chunkserver/blockstore.py).
+      tpudfs_syncfs(hot_.c_str());
+      for (auto& e : batch) {
+        if (::rename(e->data_tmp.c_str(), e->data_final.c_str()) != 0 ||
+            ::rename(e->meta_tmp.c_str(), e->meta_final.c_str()) != 0) {
+          e->failed = true;
+          e->error = "publish rename failed: " +
+                     std::string(::strerror(errno));
+        }
+      }
+      tpudfs_syncfs(hot_.c_str());
+      lk.lock();
+      for (auto& e : batch) e->done = true;
+      commit_done_cv_.notify_all();
+    }
+    // Drain-out on stop: wake any stragglers.
+    for (auto& e : commit_queue_) e->done = false;
+    commit_done_cv_.notify_all();
+  }
+
+  // --------------------------------------------------------------- read
+
+  void handle_read(int fd, std::map<std::string, Value>& h) {
+    reads_.fetch_add(1);
+    const std::string block_id =
+        h.count("block_id") ? h["block_id"].s : "";
+    if (block_id.empty() || block_id[0] == '.' ||
+        block_id.find('/') != std::string::npos) {
+      respond_err(fd, "INVALID_ARGUMENT", "bad block id");
+      return;
+    }
+    uint64_t offset =
+        h.count("offset") ? static_cast<uint64_t>(h["offset"].i) : 0;
+    uint64_t length =
+        h.count("length") ? static_cast<uint64_t>(h["length"].i) : 0;
+    std::string data_path = hot_ + "/" + block_id;
+    struct stat st;
+    if (::stat(data_path.c_str(), &st) != 0) {
+      if (!cold_.empty()) {
+        data_path = cold_ + "/" + block_id;
+        if (::stat(data_path.c_str(), &st) != 0) {
+          respond_err(fd, "NOT_FOUND", "Block not found");
+          return;
+        }
+      } else {
+        respond_err(fd, "NOT_FOUND", "Block not found");
+        return;
+      }
+    }
+    uint64_t total = static_cast<uint64_t>(st.st_size);
+    if (length == 0) length = total > offset ? total - offset : 0;
+    if (offset >= total && !(offset == 0 && total == 0)) {
+      respond_err(fd, "OUT_OF_RANGE",
+                  "Offset " + std::to_string(offset) +
+                      " exceeds block size " + std::to_string(total));
+      return;
+    }
+    uint64_t want = std::min(length, total - offset);
+    std::vector<uint8_t> buf(want);
+    std::string meta_path = data_path + ".meta";
+    int64_t rc = tpudfs_block_read_verify(
+        data_path.c_str(), meta_path.c_str(), offset, want,
+        buf.data(), 1, chunk_);
+    if (rc == kCorrupt || rc < -200000) {
+      // Corrupt or unreadable sidecar: flag for Python (heartbeat
+      // bad-block report + recovery), serve the raw bytes for partial
+      // reads (chunkserver.rs:893-911 parity) but fail full reads — the
+      // caller's replica failover handles those.
+      {
+        std::lock_guard<std::mutex> g(bad_mu_);
+        bad_.insert(block_id);
+      }
+      bool full = offset == 0 && want == total;
+      if (full) {
+        respond_err(fd, "DATA_LOSS",
+                    "Data corruption detected on native read");
+        return;
+      }
+      rc = tpudfs_block_read_verify(data_path.c_str(), meta_path.c_str(),
+                                    offset, want, buf.data(), 0, chunk_);
+      if (rc < 0) {
+        respond_err(fd, "INTERNAL", "read failed after verify failure");
+        return;
+      }
+    } else if (rc < 0) {
+      respond_err(fd, rc == -ENOENT ? "NOT_FOUND" : "INTERNAL",
+                  rc == -ENOENT ? "Block not found"
+                                : "native read error " + std::to_string(-rc));
+      return;
+    }
+    Writer w;
+    w.map_head(4);
+    w.str("ok");
+    w.boolean(true);
+    w.str("_d");
+    w.uint(1);
+    w.str("bytes_read");
+    w.uint(static_cast<uint64_t>(rc));
+    w.str("total_size");
+    w.uint(total);
+    send_frame(fd, w.out, buf.data(), static_cast<uint64_t>(rc));
+  }
+
+  std::string host_, hot_, cold_;
+  uint32_t chunk_;
+  int nthreads_;
+  int listen_fd_ = -1;
+  int32_t port_ = 0;
+  std::atomic<bool> running_{false};
+  std::atomic<uint64_t> term_{0};
+  std::atomic<uint64_t> token_seq_{1};
+  std::atomic<uint64_t> writes_{0}, reads_{0}, forwards_{0}, errors_{0};
+  std::thread accept_thread_, commit_thread_;
+  std::atomic<int> active_{0};
+  std::mutex conns_mu_;
+  std::set<int> conns_;
+  std::mutex commit_mu_;
+  std::condition_variable commit_cv_, commit_done_cv_;
+  std::deque<std::shared_ptr<CommitEntry>> commit_queue_;
+  std::mutex bad_mu_;
+  std::set<std::string> bad_;
+};
+
+std::mutex g_engines_mu;
+std::vector<Engine*> g_engines;
+
+Engine* get_engine(int64_t h) {
+  std::lock_guard<std::mutex> g(g_engines_mu);
+  if (h < 0 || static_cast<size_t>(h) >= g_engines.size()) return nullptr;
+  return g_engines[h];
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t tpudfs_dataplane_start(const char* host, const char* hot_dir,
+                               const char* cold_dir, uint32_t chunk_size,
+                               uint16_t port, int threads) {
+  auto* e = new Engine(host ? host : "", hot_dir,
+                       cold_dir ? cold_dir : "", chunk_size, threads);
+  int64_t rc = e->start(port);
+  if (rc < 0) {
+    delete e;
+    return rc;
+  }
+  std::lock_guard<std::mutex> g(g_engines_mu);
+  g_engines.push_back(e);
+  return static_cast<int64_t>(g_engines.size() - 1);
+}
+
+int32_t tpudfs_dataplane_port(int64_t h) {
+  Engine* e = get_engine(h);
+  return e ? e->port() : 0;
+}
+
+void tpudfs_dataplane_set_term(int64_t h, uint64_t term) {
+  Engine* e = get_engine(h);
+  if (e) e->set_term(term);
+}
+
+uint64_t tpudfs_dataplane_term(int64_t h) {
+  Engine* e = get_engine(h);
+  return e ? e->term() : 0;
+}
+
+int64_t tpudfs_dataplane_take_bad(int64_t h, char* buf, uint64_t cap) {
+  Engine* e = get_engine(h);
+  return e ? e->take_bad(buf, cap) : -1;
+}
+
+void tpudfs_dataplane_stats(int64_t h, uint64_t out[4]) {
+  Engine* e = get_engine(h);
+  if (e) e->stats(out);
+  else out[0] = out[1] = out[2] = out[3] = 0;
+}
+
+int64_t tpudfs_dataplane_stop(int64_t h) {
+  Engine* e = get_engine(h);
+  if (!e) return -1;
+  e->stop();
+  {
+    std::lock_guard<std::mutex> g(g_engines_mu);
+    g_engines[h] = nullptr;
+  }
+  delete e;
+  return 0;
+}
+
+}  // extern "C"

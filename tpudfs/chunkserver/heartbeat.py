@@ -82,6 +82,8 @@ class HeartbeatLoop:
         else:
             masters = self.static_masters or self.cs.master_addrs
         self.cs.master_addrs = list(masters)
+        # Native data-plane findings join the same report/recovery pipeline.
+        self.cs.poll_native_bad_blocks()
         stats = await asyncio.to_thread(self.cs.store.stats)
         # Snapshot (don't drain) bad blocks: they are only cleared once at
         # least one master has actually received the report.

@@ -31,7 +31,7 @@ from collections import OrderedDict
 
 import grpc
 
-from tpudfs.common import blocknet
+from tpudfs.common import blocknet, native
 from tpudfs.common.blocknet import BlockConnPool
 from tpudfs.common.checksum import crc32c
 from tpudfs.common.erasure import encode as ec_encode, reconstruct
@@ -218,6 +218,7 @@ class ChunkServer:
         self._tasks: set[asyncio.Task] = set()
         self._server: RpcServer | None = None
         self._blockport = None
+        self._native_dp: int | None = None
         self.data_port = 0
         #: pooled raw-TCP data plane for CS<->CS block payloads (forwarding,
         #: recovery, EC shard distribution); falls back to gRPC per peer.
@@ -283,13 +284,35 @@ class ChunkServer:
         await server.start()
         self._server = server
         if blocknet.enabled():
-            # Bulk data plane beside the gRPC listener, same TLS material.
-            self._blockport = blocknet.BlockPortServer({
-                "WriteBlock": self.rpc_write_block,
-                "ReplicateBlock": self.rpc_replicate_block,
-                "ReadBlock": self.rpc_read_block,
-            }, tls=tls)
-            self.data_port = await self._blockport.start(host)
+            # Preferred data plane: the C++ engine (native/dataplane.cc) —
+            # the whole write chain (CRC, group-committed durable staging,
+            # forward, ack aggregation) and verified reads run without
+            # Python. Falls back to the asyncio blockport when the native
+            # library is unavailable, or when TLS is configured (the
+            # native engine is plaintext-only; asyncio wraps the certs).
+            lib = native.get_lib()
+            if tls is None and lib is not None and \
+                    hasattr(lib, "tpudfs_dataplane_start"):
+                handle = lib.tpudfs_dataplane_start(
+                    host.encode(),
+                    str(self.store.hot_dir).encode(),
+                    str(self.store.cold_dir or "").encode(),
+                    self.store.chunk_size, 0, 4,
+                )
+                if handle >= 0:
+                    self._native_dp = handle
+                    self.data_port = lib.tpudfs_dataplane_port(handle)
+                    lib.tpudfs_dataplane_set_term(handle, self.known_term)
+                else:
+                    logger.warning("native dataplane failed to start (%d); "
+                                   "using asyncio blockport", handle)
+            if self._native_dp is None:
+                self._blockport = blocknet.BlockPortServer({
+                    "WriteBlock": self.rpc_write_block,
+                    "ReplicateBlock": self.rpc_replicate_block,
+                    "ReadBlock": self.rpc_read_block,
+                }, tls=tls)
+                self.data_port = await self._blockport.start(host)
         if not self.address:
             self.address = server.address
         if scrubber:
@@ -309,6 +332,13 @@ class ChunkServer:
             t.cancel()
         self._tasks.clear()
         await self.committer.stop()
+        if self._native_dp is not None:
+            lib = native.get_lib()
+            if lib is not None:
+                await asyncio.to_thread(
+                    lib.tpudfs_dataplane_stop, self._native_dp
+                )
+            self._native_dp = None
         if self._blockport is not None:
             await self._blockport.stop()
             self._blockport = None
@@ -323,7 +353,10 @@ class ChunkServer:
 
     def _check_term(self, req_term: int) -> str | None:
         """Epoch fencing (reference chunkserver.rs:732-743). Returns an error
-        string for stale terms; learns newer terms."""
+        string for stale terms; learns newer terms. The native data-plane
+        engine keeps its own atomic view (learned from its requests), so
+        both directions sync here: its term merges in, ours pushes out."""
+        self._sync_native_term()
         if req_term > 0 and req_term < self.known_term:
             return (
                 f"Stale master term: request has {req_term} "
@@ -331,11 +364,49 @@ class ChunkServer:
             )
         if req_term > self.known_term:
             self.known_term = req_term
+            self._push_native_term()
         return None
 
     def observe_term(self, term: int) -> None:
         if term > self.known_term:
             self.known_term = term
+        self._push_native_term()
+
+    def _sync_native_term(self) -> None:
+        if self._native_dp is not None:
+            lib = native.get_lib()
+            if lib is not None:
+                t = int(lib.tpudfs_dataplane_term(self._native_dp))
+                if t > self.known_term:
+                    self.known_term = t
+
+    def _push_native_term(self) -> None:
+        if self._native_dp is not None:
+            lib = native.get_lib()
+            if lib is not None:
+                lib.tpudfs_dataplane_set_term(self._native_dp,
+                                              self.known_term)
+
+    def poll_native_bad_blocks(self) -> None:
+        """Drain the native engine's corrupt-read findings into the same
+        bad-block pipeline the Python read path feeds (heartbeat report +
+        background recovery)."""
+        if self._native_dp is None:
+            return
+        lib = native.get_lib()
+        if lib is None:
+            return
+        import ctypes
+
+        buf = ctypes.create_string_buffer(65536)
+        n = lib.tpudfs_dataplane_take_bad(self._native_dp, buf, len(buf))
+        if n <= 0:
+            return
+        for bid in buf.raw[:n].decode().split("\n"):
+            if bid and bid not in self.pending_bad_blocks:
+                self.pending_bad_blocks.add(bid)
+                self.cache.invalidate(bid)
+                self._spawn(self._recover_silently(bid))
 
     # ------------------------------------------------------------ write path
 
@@ -377,10 +448,19 @@ class ChunkServer:
         next_servers = list(req.get("next_servers") or [])
         forward_task = None
         if next_servers:
+            # Resolve the remaining chain's data ports so a native engine
+            # downstream can keep forwarding without its own discovery.
+            # The request may already carry them (native-aware senders do).
+            ports = list(req.get("next_data_ports") or [])
+            if len(ports) != len(next_servers):
+                ports = await self.blocks.data_ports(
+                    self.client, next_servers, SERVICE
+                )
             forward = {
                 "block_id": block_id,
                 "data": data,
                 "next_servers": next_servers[1:],
+                "next_data_ports": ports[1:],
                 "expected_crc32c": expected,
                 "master_term": int(req.get("master_term", 0)),
             }
@@ -449,7 +529,15 @@ class ChunkServer:
         if full_read:
             cached = self.cache.get(block_id)
             if cached is not None:
-                return {"data": cached, "bytes_read": len(cached), "total_size": total}
+                data, sig = cached
+                # Freshness check: the native data-plane engine (and peer
+                # recovery) publishes blocks without going through this
+                # process's cache-invalidation calls — a stale entry must
+                # lose to the on-disk file it shadows.
+                if sig == self._block_sig(block_id):
+                    return {"data": data, "bytes_read": len(data),
+                            "total_size": total}
+                self.cache.invalidate(block_id)
 
         if not full_read:
             # Fused pread + touched-chunk verify (native engine when built);
@@ -467,6 +555,10 @@ class ChunkServer:
                     self.store.read, block_id, offset, bytes_to_read
                 )
         else:
+            # Signature BEFORE the read: a block republished between the
+            # pread and a post-read stat would cache stale bytes under the
+            # new file's signature forever.
+            sig = self._block_sig(block_id)
             data = await asyncio.to_thread(
                 self.store.read, block_id, offset, bytes_to_read
             )
@@ -480,6 +572,7 @@ class ChunkServer:
                     raise RpcError.data_loss(
                         f"Data corruption detected: {e}. Recovery failed: {err}"
                     ) from None
+                sig = self._block_sig(block_id)
                 data = await asyncio.to_thread(
                     self.store.read, block_id, 0, bytes_to_read
                 )
@@ -491,8 +584,29 @@ class ChunkServer:
                     ) from None
 
         if full_read:
-            self.cache.put(block_id, data)
+            self.cache.put(block_id, (data, sig))
         return {"data": data, "bytes_read": len(data), "total_size": total}
+
+    def data_plane_stats(self) -> dict:
+        """Native engine counters (zeros when it isn't running)."""
+        out = {"writes": 0, "reads": 0, "forwards": 0, "errors": 0}
+        if self._native_dp is not None:
+            lib = native.get_lib()
+            if lib is not None:
+                import ctypes
+
+                vals = (ctypes.c_uint64 * 4)()
+                lib.tpudfs_dataplane_stats(self._native_dp, vals)
+                out = {"writes": vals[0], "reads": vals[1],
+                       "forwards": vals[2], "errors": vals[3]}
+        return out
+
+    def _block_sig(self, block_id: str) -> tuple | None:
+        try:
+            st = os.stat(self.store.block_path(block_id))
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
 
     def ops_gauges(self) -> dict[str, float]:
         """Gauges for /metrics (reference bin/chunkserver.rs:381-428

@@ -349,13 +349,18 @@ class Client:
         ``attrs`` attaches small application key-values to the file
         metadata (the gateway's x-amz-meta-* user metadata)."""
         k, m = ec or (0, 0)
-        _, master = await self._execute("CreateFile", {
+        resp, master = await self._execute("CreateFile", {
             "path": path, "ec_data_shards": k, "ec_parity_shards": m,
-            "overwrite": overwrite,
+            "overwrite": overwrite, "first_block": True,
         }, path=path, retry_benign=("ALREADY_EXISTS",))
+        # Fused first-block allocation (one master round-trip); absent on
+        # alloc_error, retried resends, or pre-fusion masters — the
+        # per-block AllocateBlock loop covers those.
+        first_alloc = resp if resp.get("block") else None
         try:
             await self._write_blocks_and_complete(path, data, master, k, m,
-                                                  etag, attrs)
+                                                  etag, attrs,
+                                                  first_alloc=first_alloc)
         except IndeterminateError:
             raise
         except DfsError as e:
@@ -368,7 +373,9 @@ class Client:
     async def _write_blocks_and_complete(self, path: str, data: bytes,
                                          master: str, k: int, m: int,
                                          etag: str | None,
-                                         attrs: dict | None = None) -> None:
+                                         attrs: dict | None = None,
+                                         first_alloc: dict | None = None,
+                                         ) -> None:
         # Stick to the creating master for read-your-writes (mod.rs:256-266).
         sticky = [master] + [a for a in self._masters_for(path) if a != master]
         block_checksums = []
@@ -377,24 +384,28 @@ class Client:
             piece = data[offset : offset + self.block_size]
             if not piece and offset > 0:
                 break
-            alloc, _ = await self._execute(
-                "AllocateBlock", {"path": path}, masters=sticky
-            )
+            if first_alloc is not None:
+                alloc, first_alloc = first_alloc, None
+            else:
+                alloc, _ = await self._execute(
+                    "AllocateBlock", {"path": path}, masters=sticky
+                )
             block = alloc["block"]
             servers = alloc["chunk_server_addresses"]
             term = int(alloc.get("master_term") or 0)
             if not servers:
                 raise DfsError("no chunk servers available")
+            piece_crc = crc32c(piece)
             if k > 0:
                 await self._write_ec_block(block["block_id"], piece, servers,
                                            k, m, term)
             else:
                 await self._write_replicated_block(
-                    block["block_id"], piece, servers, term
+                    block["block_id"], piece, servers, term, crc=piece_crc
                 )
             block_checksums.append({
                 "block_id": block["block_id"],
-                "checksum_crc32c": crc32c(piece),
+                "checksum_crc32c": piece_crc,
                 "actual_size": len(piece),
                 "original_size": len(piece) if k > 0 else 0,
             })
@@ -413,14 +424,35 @@ class Client:
         await self._execute("CompleteFile", req, masters=sticky)
 
     async def _write_replicated_block(self, block_id: str, data: bytes,
-                                      servers: list[str], term: int) -> None:
-        resp = await self._data_call(servers[0], "WriteBlock", {
+                                      servers: list[str], term: int,
+                                      crc: int | None = None) -> None:
+        req = {
             "block_id": block_id,
             "data": data,
             "next_servers": servers[1:],
-            "expected_crc32c": crc32c(data),
+            "expected_crc32c": crc if crc is not None else crc32c(data),
             "master_term": term,
-        }, timeout=max(self.rpc_timeout, 60.0))
+        }
+        timeout = max(self.rpc_timeout, 60.0)
+        if self._dial(servers[0]) == servers[0]:
+            # Resolve the whole chain's data ports up front: a native
+            # data-plane first hop can only forward to blockports, so the
+            # fused path engages IFF every member advertises one —
+            # otherwise the gRPC handler chain forwards hop-by-hop with
+            # per-hop transport choice.
+            ports = await self.block_pool.data_ports(self.rpc, servers, CS)
+            if all(ports):
+                req["next_data_ports"] = ports[1:]
+                resp = await self.block_pool.call(
+                    self.rpc, servers[0], CS, "WriteBlock", req,
+                    timeout=timeout,
+                )
+            else:
+                resp = await self.rpc.call(servers[0], CS, "WriteBlock",
+                                           req, timeout=timeout)
+        else:
+            resp = await self.rpc.call(self._dial(servers[0]), CS,
+                                       "WriteBlock", req, timeout=timeout)
         if not resp.get("success"):
             raise DfsError(f"write failed: {resp.get('error_message')}")
         written = int(resp.get("replicas_written") or 0)

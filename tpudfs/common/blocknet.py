@@ -236,6 +236,19 @@ class BlockConnPool:
         self._ports[addr] = port
         return port
 
+    async def data_ports(self, rpc: RpcClient, addrs: list[str],
+                         service: str) -> list[int]:
+        """Resolve every address's blockport concurrently; 0 = none.
+        Chain writers attach the result as ``next_data_ports`` so a native
+        data-plane engine (native/dataplane.cc) can forward hop-to-hop
+        without its own discovery."""
+        if not enabled() or not addrs:
+            return [0] * len(addrs)
+        ports = await asyncio.gather(
+            *(self._data_port(rpc, a, service) for a in addrs)
+        )
+        return [int(p or 0) for p in ports]
+
     async def call(self, rpc: RpcClient, addr: str, service: str,
                    method: str, req: dict, timeout: float = 30.0) -> dict:
         """Blockport when advertised, gRPC otherwise. ``req["data"]`` (if

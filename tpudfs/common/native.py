@@ -141,6 +141,31 @@ def get_lib() -> ctypes.CDLL | None:
         # Prebuilt library predating group-commit staging; per-block
         # durable writes still work.
         pass
+    try:
+        lib.tpudfs_dataplane_start.restype = ctypes.c_int64
+        lib.tpudfs_dataplane_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint32, ctypes.c_uint16, ctypes.c_int,
+        ]
+        lib.tpudfs_dataplane_port.restype = ctypes.c_int32
+        lib.tpudfs_dataplane_port.argtypes = [ctypes.c_int64]
+        lib.tpudfs_dataplane_set_term.restype = None
+        lib.tpudfs_dataplane_set_term.argtypes = [ctypes.c_int64,
+                                                  ctypes.c_uint64]
+        lib.tpudfs_dataplane_term.restype = ctypes.c_uint64
+        lib.tpudfs_dataplane_term.argtypes = [ctypes.c_int64]
+        lib.tpudfs_dataplane_take_bad.restype = ctypes.c_int64
+        lib.tpudfs_dataplane_take_bad.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.tpudfs_dataplane_stats.restype = None
+        lib.tpudfs_dataplane_stats.argtypes = [ctypes.c_int64,
+                                               ctypes.c_void_p]
+        lib.tpudfs_dataplane_stop.restype = ctypes.c_int64
+        lib.tpudfs_dataplane_stop.argtypes = [ctypes.c_int64]
+    except AttributeError:
+        # Prebuilt library predating the native data-plane engine.
+        pass
     lib.tpudfs_gf256_mul.restype = ctypes.c_uint8
     lib.tpudfs_gf256_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
     lib.tpudfs_gf256_mul_slice.restype = None

@@ -434,7 +434,19 @@ class Master:
             "created_at_ms": now_ms(),
             "overwrite": bool(req.get("overwrite")),
         })
-        return {"success": True}
+        if not req.get("first_block"):
+            return {"success": True}
+        # Fused create+allocate: the common single-client write path pays
+        # one master round-trip (and envelope) instead of two — the
+        # reference issues CreateFile then AllocateBlock separately
+        # (mod.rs:225-266). Allocation failures (no chunkservers yet)
+        # surface as alloc_error rather than failing the create, so the
+        # client can fall back to its per-block AllocateBlock retry loop.
+        try:
+            alloc = await self.rpc_allocate_block({"path": req["path"]})
+        except RpcError as e:
+            return {"success": True, "alloc_error": e.message}
+        return {"success": True, **alloc}
 
     async def rpc_allocate_block(self, req: dict) -> dict:
         self._check_safe_mode()
