@@ -26,10 +26,10 @@
 //
 // Python integration (ctypes, tpudfs/common/native.py):
 //   int64_t  tpudfs_dataplane_start(host, hot_dir, cold_dir, chunk_size,
-//                                   port, threads) -> handle or -errno
+//                                   port) -> handle or -errno
 //   int32_t  tpudfs_dataplane_port(handle)
-//   void     tpudfs_dataplane_set_term(handle, term)   // from heartbeats
-//   uint64_t tpudfs_dataplane_term(handle)             // learned from reqs
+//   void     tpudfs_dataplane_set_term(handle, shard, term) // heartbeats
+//   uint64_t tpudfs_dataplane_term(handle, shard)      // learned from reqs
 //   int64_t  tpudfs_dataplane_take_bad(handle, buf, cap) // '\n'-joined ids
 //   void     tpudfs_dataplane_stats(handle, uint64_t out[4])
 //                                   // writes, reads, forwards, errors
@@ -37,7 +37,9 @@
 //
 // Fencing parity: reference chunkserver.rs:732-743 — requests carrying a
 // stale master term are rejected FAILED_PRECONDITION; newer terms are
-// learned (and exposed to Python, which merges them into its own view).
+// learned per shard. Python pushes heartbeat-learned terms in
+// (set_term); terms this engine learns from requests reach Python only
+// through its own heartbeats (the term getter exists for tests).
 
 #include <arpa/inet.h>
 #include <atomic>
@@ -177,7 +179,10 @@ bool parse_value(Reader& r, Value* v) {
   if (n == 0) { v->kind = Value::ASTR; return true; }
   if (r.p >= r.end) { r.ok = false; return false; }
   uint8_t et = *r.p;
-  if (et <= 0x7f || et >= 0xcc) {
+  // Ints are fixint/uintN/intN ONLY — str8-32 (0xd9-0xdb) and bin
+  // (0xc4-0xc6) live above 0xcc too and must classify as strings (long
+  // FQDN-addressed peers encode as str8).
+  if (et <= 0x7f || (et >= 0xcc && et <= 0xd3) || et >= 0xe0) {
     v->kind = Value::AINT;
     v->aint.resize(n);
     for (size_t k = 0; k < n; k++)
@@ -315,9 +320,9 @@ struct CommitEntry {
 class Engine {
  public:
   Engine(std::string host, std::string hot, std::string cold,
-         uint32_t chunk, int threads)
+         uint32_t chunk)
       : host_(std::move(host)), hot_(std::move(hot)),
-        cold_(std::move(cold)), chunk_(chunk), nthreads_(threads) {}
+        cold_(std::move(cold)), chunk_(chunk) {}
 
   int64_t start(uint16_t port) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -361,7 +366,11 @@ class Engine {
     return port_;
   }
 
-  void stop() {
+  // Returns true when every connection thread has exited; false means a
+  // detached thread is still inside a handler (e.g. a slow disk stage) —
+  // the caller must then LEAK the engine rather than delete it out from
+  // under the thread.
+  bool stop() {
     running_.store(false);
     ::shutdown(listen_fd_, SHUT_RDWR);
     ::close(listen_fd_);
@@ -370,21 +379,29 @@ class Engine {
       for (int fd : conns_) ::shutdown(fd, SHUT_RDWR);
     }
     if (accept_thread_.joinable()) accept_thread_.join();
-    // Connection threads are detached; the shutdowns above unblock their
-    // recvs. Wait (bounded) for the active count to drain.
-    for (int i = 0; i < 200 && active_.load() > 0; i++)
+    // Connection threads are detached; the shutdowns above unblock socket
+    // waits immediately. Allow a generous window for in-flight disk work.
+    for (int i = 0; i < 1000 && active_.load() > 0; i++)
       std::this_thread::sleep_for(std::chrono::milliseconds(10));
     commit_cv_.notify_all();
     if (commit_thread_.joinable()) commit_thread_.join();
+    return active_.load() == 0;
   }
 
   int32_t port() const { return port_; }
-  void set_term(uint64_t t) {
-    uint64_t cur = term_.load();
-    while (t > cur && !term_.compare_exchange_weak(cur, t)) {
-    }
+
+  // Epoch fencing is scoped per issuing Raft group (shard): one shard's
+  // failover must not fence writes allocated by a different shard.
+  void set_term(const std::string& shard, uint64_t t) {
+    std::lock_guard<std::mutex> g(term_mu_);
+    uint64_t& cur = terms_[shard];
+    if (t > cur) cur = t;
   }
-  uint64_t term() const { return term_.load(); }
+  uint64_t term(const std::string& shard) {
+    std::lock_guard<std::mutex> g(term_mu_);
+    auto it = terms_.find(shard);
+    return it == terms_.end() ? 0 : it->second;
+  }
 
   int64_t take_bad(char* buf, uint64_t cap) {
     // Drain as many WHOLE ids as fit; the rest stay for the next poll —
@@ -515,7 +532,9 @@ class Engine {
     }
     uint64_t req_term =
         h.count("master_term") ? static_cast<uint64_t>(h["master_term"].i) : 0;
-    uint64_t known = term_.load();
+    const std::string shard =
+        h.count("master_shard") ? h["master_shard"].s : "";
+    uint64_t known = term(shard);
     if (req_term > 0 && req_term < known) {
       respond_err(fd, "FAILED_PRECONDITION",
                   "Stale master term: request has " +
@@ -523,7 +542,7 @@ class Engine {
                       std::to_string(known));
       return;
     }
-    if (req_term > known) set_term(req_term);
+    if (req_term > known) set_term(shard, req_term);
 
     uint64_t expected =
         h.count("expected_crc32c")
@@ -618,7 +637,7 @@ class Engine {
       conns_.insert(dfd);
     }
     Writer w;
-    w.map_head(7);
+    w.map_head(8);
     w.str("m");
     w.str("ReplicateBlock");
     w.str("_d");
@@ -639,6 +658,8 @@ class Engine {
     w.str("master_term");
     w.uint(h.count("master_term") ? static_cast<uint64_t>(h["master_term"].i)
                                   : 0);
+    w.str("master_shard");
+    w.str(h.count("master_shard") ? h["master_shard"].s : "");
     if (!send_frame(dfd, w.out, data.data(), data.size())) {
       close_downstream(dfd);
       downstream->erase(key);
@@ -825,11 +846,11 @@ class Engine {
 
   std::string host_, hot_, cold_;
   uint32_t chunk_;
-  int nthreads_;
   int listen_fd_ = -1;
   int32_t port_ = 0;
   std::atomic<bool> running_{false};
-  std::atomic<uint64_t> term_{0};
+  std::mutex term_mu_;
+  std::map<std::string, uint64_t> terms_;
   std::atomic<uint64_t> token_seq_{1};
   std::atomic<uint64_t> writes_{0}, reads_{0}, forwards_{0}, errors_{0};
   std::thread accept_thread_, commit_thread_;
@@ -858,9 +879,9 @@ extern "C" {
 
 int64_t tpudfs_dataplane_start(const char* host, const char* hot_dir,
                                const char* cold_dir, uint32_t chunk_size,
-                               uint16_t port, int threads) {
+                               uint16_t port) {
   auto* e = new Engine(host ? host : "", hot_dir,
-                       cold_dir ? cold_dir : "", chunk_size, threads);
+                       cold_dir ? cold_dir : "", chunk_size);
   int64_t rc = e->start(port);
   if (rc < 0) {
     delete e;
@@ -876,14 +897,15 @@ int32_t tpudfs_dataplane_port(int64_t h) {
   return e ? e->port() : 0;
 }
 
-void tpudfs_dataplane_set_term(int64_t h, uint64_t term) {
+void tpudfs_dataplane_set_term(int64_t h, const char* shard,
+                               uint64_t term) {
   Engine* e = get_engine(h);
-  if (e) e->set_term(term);
+  if (e) e->set_term(shard ? shard : "", term);
 }
 
-uint64_t tpudfs_dataplane_term(int64_t h) {
+uint64_t tpudfs_dataplane_term(int64_t h, const char* shard) {
   Engine* e = get_engine(h);
-  return e ? e->term() : 0;
+  return e ? e->term(shard ? shard : "") : 0;
 }
 
 int64_t tpudfs_dataplane_take_bad(int64_t h, char* buf, uint64_t cap) {
@@ -900,13 +922,19 @@ void tpudfs_dataplane_stats(int64_t h, uint64_t out[4]) {
 int64_t tpudfs_dataplane_stop(int64_t h) {
   Engine* e = get_engine(h);
   if (!e) return -1;
-  e->stop();
+  bool drained = e->stop();
   {
     std::lock_guard<std::mutex> g(g_engines_mu);
     g_engines[h] = nullptr;
   }
-  delete e;
-  return 0;
+  if (drained) {
+    delete e;
+    return 0;
+  }
+  // A connection thread is still alive inside the engine: leaking it is
+  // the only memory-safe option (shutdown already unblocked its sockets;
+  // it will exit soon and touch only still-valid memory).
+  return 1;
 }
 
 }  // extern "C"

@@ -128,6 +128,7 @@ def main() -> None:
     ap = argparse.ArgumentParser("tpudfs-run-all-tests")
     ap.add_argument("--skip-unit", action="store_true")
     ap.add_argument("--skip-live", action="store_true")
+    ap.add_argument("--skip-chaos", action="store_true")
     ap.add_argument("--topology",
                     default="deploy/topologies/two-shard-ha.json")
     ap.add_argument("--workload-ops", type=int, default=25)
@@ -142,6 +143,13 @@ def main() -> None:
             [sys.executable, "-m", "pytest", "tests/", "-x", "-q"])
     if not args.skip_live:
         live_cluster_tier(args.topology, args.workload_ops)
+    if not args.skip_chaos:
+        # Kill a chunkserver + the shard-0 leader mid-workload, partition
+        # shard-1's leader behind a real TCP proxy, then md5-verify and
+        # WGL-check (reference chaos_test.sh / network_partition_test.sh /
+        # linearizability_test.sh).
+        run("live chaos tier",
+            [sys.executable, "-u", "scripts/chaos_live.py", args.topology])
     print("\nALL TIERS PASSED")
 
 

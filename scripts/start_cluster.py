@@ -32,9 +32,15 @@ from tpudfs.testing import procs as procutil  # noqa: E402
 PROCS: list[subprocess.Popen] = []
 
 
+#: name -> {"pid": int, "addr": str} for the chaos harness's targeted kills.
+PROC_MAP: dict[str, dict] = {}
+
+
 def spawn(name: str, logdir: pathlib.Path, mod: str, *args: str,
-          env: dict | None = None) -> subprocess.Popen:
-    return procutil.spawn(PROCS, name, logdir, mod, *args, env=env)
+          env: dict | None = None, addr: str = "") -> subprocess.Popen:
+    p = procutil.spawn(PROCS, name, logdir, mod, *args, env=env)
+    PROC_MAP[name] = {"pid": p.pid, "addr": addr}
+    return p
 
 
 def free_port() -> int:
@@ -154,7 +160,7 @@ def main() -> None:
                   "--peers", ",".join(peers), "--shard-id", sid,
                   "--config-servers", cfg,
                   "--split-threshold-rps",
-                  str(topo["split_threshold_rps"]))
+                  str(topo["split_threshold_rps"]), addr=addr)
         for i, addr in enumerate(addrs):
             wait_ready(logdir, f"{sid}-m{i}")
             print(f"{sid}-m{i}     {addr}  "
@@ -176,7 +182,7 @@ def main() -> None:
               "--data-dir", str(root / f"cs{i}"),
               "--rack-id", f"rack-{i % topo['racks']}",
               "--masters", ",".join(all_masters), "--config-servers", cfg,
-              "--heartbeat-interval", "2")
+              "--heartbeat-interval", "2", addr=f"127.0.0.1:{port}")
         wait_ready(logdir, f"cs{i}")
         print(f"chunkserver{i}   127.0.0.1:{port}  "
               f"(ops http://127.0.0.1:{port + 1000})")
@@ -196,6 +202,7 @@ def main() -> None:
     print("logs:", logdir)
     if args.ready_file:
         endpoints["pids"] = [p.pid for p in PROCS]
+        endpoints["procs"] = PROC_MAP
         pathlib.Path(args.ready_file).write_text(json.dumps(endpoints))
     if args.no_wait:
         return

@@ -3,6 +3,7 @@ ICI chain replication with on-device verification, HBM reader against a live
 cluster, infeed, and the driver graft entry points."""
 
 import asyncio
+import os
 
 import jax
 import jax.numpy as jnp
@@ -954,3 +955,24 @@ async def test_fused_read_sync_arrays_no_slices(tmp_path):
         assert got == data
     finally:
         await c.stop()
+
+
+def test_ec_full_geometry_nine_device_mesh():
+    """RS(6,3) at its FULL k+m=9 shard-per-device geometry — scatter,
+    healthy gather, and degraded gather around a garbage device — runs in
+    a dedicated 12-virtual-device subprocess (the session's own mesh is
+    capped at 8; VERDICT r2 item 4)."""
+    import pathlib
+    import subprocess
+    import sys
+
+    child = pathlib.Path(__file__).with_name("ec_full_geometry_child.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, str(child)], env=env,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"child failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "OK" in proc.stdout

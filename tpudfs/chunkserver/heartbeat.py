@@ -112,7 +112,8 @@ class HeartbeatLoop:
             reported = True
             if resp.get("results_processed"):
                 results_delivered = True
-            self.cs.observe_term(int(resp.get("master_term", 0)))
+            self.cs.observe_term(int(resp.get("master_term", 0)),
+                                 str(resp.get("shard_id") or ""))
             for cmd in resp.get("commands") or []:
                 try:
                     err = await self.execute_command(cmd)
@@ -133,10 +134,13 @@ class HeartbeatLoop:
         Returns an error string, or None on success."""
         ctype = cmd.get("type")
         block_id = cmd.get("block_id", "")
-        self.cs.observe_term(int(cmd.get("master_term", 0)))
+        self.cs.observe_term(int(cmd.get("master_term", 0)),
+                             str(cmd.get("master_shard") or ""))
         if ctype == "REPLICATE":
             err = await self.cs.initiate_replication(
-                block_id, cmd["target_chunk_server_address"]
+                block_id, cmd["target_chunk_server_address"],
+                term=int(cmd.get("master_term", 0)),
+                shard=str(cmd.get("master_shard") or ""),
             )
         elif ctype == "RECONSTRUCT_EC_SHARD":
             err = await self.cs.reconstruct_ec_shard(

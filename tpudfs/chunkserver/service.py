@@ -207,9 +207,13 @@ class ChunkServer:
             cache_size = int(os.environ.get("BLOCK_CACHE_SIZE", DEFAULT_BLOCK_CACHE_SIZE))
         self.cache = _LruCache(cache_size)
         self.scrub_interval = scrub_interval
-        #: Highest master Raft term seen; stale-term writes are fenced off
-        #: (reference chunkserver.rs:40,732-743; learned from heartbeats too).
-        self.known_term = 0
+        #: Highest master Raft term seen PER SHARD; stale-term writes are
+        #: fenced off (reference chunkserver.rs:40,732-743, which keeps one
+        #: global term — but terms are per-Raft-group: one shard's failover
+        #: must not fence writes allocated by a different, healthy shard,
+        #: found by the live chaos tier). "" = requests/heartbeats that
+        #: carry no shard (legacy senders, spare masters).
+        self.known_terms: dict[str, int] = {}
         #: Corrupt blocks found by scrubber/reads, drained into heartbeats
         #: (reference pending_bad_blocks).
         self.pending_bad_blocks: set[str] = set()
@@ -297,12 +301,15 @@ class ChunkServer:
                     host.encode(),
                     str(self.store.hot_dir).encode(),
                     str(self.store.cold_dir or "").encode(),
-                    self.store.chunk_size, 0, 4,
+                    self.store.chunk_size, 0,
                 )
                 if handle >= 0:
                     self._native_dp = handle
                     self.data_port = lib.tpudfs_dataplane_port(handle)
-                    lib.tpudfs_dataplane_set_term(handle, self.known_term)
+                    for shard, term in self.known_terms.items():
+                        lib.tpudfs_dataplane_set_term(
+                            handle, shard.encode(), term
+                        )
                 else:
                     logger.warning("native dataplane failed to start (%d); "
                                    "using asyncio blockport", handle)
@@ -351,41 +358,40 @@ class ChunkServer:
 
     # ------------------------------------------------------------- fencing
 
-    def _check_term(self, req_term: int) -> str | None:
-        """Epoch fencing (reference chunkserver.rs:732-743). Returns an error
-        string for stale terms; learns newer terms. The native data-plane
-        engine keeps its own atomic view (learned from its requests), so
-        both directions sync here: its term merges in, ours pushes out."""
-        self._sync_native_term()
-        if req_term > 0 and req_term < self.known_term:
+    @property
+    def known_term(self) -> int:
+        """Max term across shards (metrics / back-compat observability)."""
+        return max(self.known_terms.values(), default=0)
+
+    def _check_term(self, req_term: int, shard: str = "") -> str | None:
+        """Per-shard epoch fencing (reference chunkserver.rs:732-743,
+        scoped to the issuing Raft group). Returns an error string for
+        stale terms; learns newer terms (and pushes them to the native
+        data-plane engine, which keeps its own per-shard view)."""
+        known = self.known_terms.get(shard, 0)
+        if req_term > 0 and req_term < known:
             return (
                 f"Stale master term: request has {req_term} "
-                f"but known term is {self.known_term}"
+                f"but known term is {known}"
             )
-        if req_term > self.known_term:
-            self.known_term = req_term
-            self._push_native_term()
+        if req_term > known:
+            self.known_terms[shard] = req_term
+            self._push_native_term(shard)
         return None
 
-    def observe_term(self, term: int) -> None:
-        if term > self.known_term:
-            self.known_term = term
-        self._push_native_term()
+    def observe_term(self, term: int, shard: str = "") -> None:
+        if term > self.known_terms.get(shard, 0):
+            self.known_terms[shard] = term
+            self._push_native_term(shard)
 
-    def _sync_native_term(self) -> None:
+    def _push_native_term(self, shard: str) -> None:
         if self._native_dp is not None:
             lib = native.get_lib()
             if lib is not None:
-                t = int(lib.tpudfs_dataplane_term(self._native_dp))
-                if t > self.known_term:
-                    self.known_term = t
-
-    def _push_native_term(self) -> None:
-        if self._native_dp is not None:
-            lib = native.get_lib()
-            if lib is not None:
-                lib.tpudfs_dataplane_set_term(self._native_dp,
-                                              self.known_term)
+                lib.tpudfs_dataplane_set_term(
+                    self._native_dp, shard.encode(),
+                    self.known_terms.get(shard, 0),
+                )
 
     def poll_native_bad_blocks(self) -> None:
         """Drain the native engine's corrupt-read findings into the same
@@ -417,7 +423,8 @@ class ChunkServer:
         return await self._write_and_forward(req)
 
     async def _write_and_forward(self, req: dict) -> dict:
-        stale = self._check_term(int(req.get("master_term", 0)))
+        stale = self._check_term(int(req.get("master_term", 0)),
+                                 str(req.get("master_shard") or ""))
         if stale:
             raise RpcError.failed_precondition(stale)
 
@@ -463,6 +470,7 @@ class ChunkServer:
                 "next_data_ports": ports[1:],
                 "expected_crc32c": expected,
                 "master_term": int(req.get("master_term", 0)),
+                "master_shard": str(req.get("master_shard") or ""),
             }
             forward_task = asyncio.create_task(self.blocks.call(
                 self.client, next_servers[0], SERVICE, "ReplicateBlock",
@@ -760,7 +768,7 @@ class ChunkServer:
                         "data": shards[i],
                         "next_servers": [],
                         "expected_crc32c": crc32c(shards[i]),
-                        "master_term": self.known_term,
+                        "master_term": 0,
                     },
                     timeout=30.0,
                 )
@@ -816,9 +824,13 @@ class ChunkServer:
                 last = e.message
         return None, last
 
-    async def initiate_replication(self, block_id: str, target_addr: str) -> str | None:
+    async def initiate_replication(self, block_id: str, target_addr: str,
+                                   term: int = 0,
+                                   shard: str = "") -> str | None:
         """Push a local block to ``target_addr`` (healer REPLICATE command,
-        reference chunkserver.rs:462-501)."""
+        reference chunkserver.rs:462-501). ``term``/``shard``: the
+        commanding master's epoch, forwarded so the target can fence a
+        deposed master's stale command."""
         try:
             data = await asyncio.to_thread(self.store.read, block_id)
         except BlockNotFoundError:
@@ -831,7 +843,8 @@ class ChunkServer:
                     "data": data,
                     "next_servers": [],
                     "expected_crc32c": 0,
-                    "master_term": self.known_term,
+                    "master_term": term,
+                    "master_shard": shard,
                 },
                 timeout=30.0,
             )

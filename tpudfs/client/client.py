@@ -395,13 +395,15 @@ class Client:
             term = int(alloc.get("master_term") or 0)
             if not servers:
                 raise DfsError("no chunk servers available")
+            shard = str(alloc.get("shard_id") or "")
             piece_crc = crc32c(piece)
             if k > 0:
                 await self._write_ec_block(block["block_id"], piece, servers,
-                                           k, m, term)
+                                           k, m, term, shard=shard)
             else:
                 await self._write_replicated_block(
-                    block["block_id"], piece, servers, term, crc=piece_crc
+                    block["block_id"], piece, servers, term, crc=piece_crc,
+                    shard=shard,
                 )
             block_checksums.append({
                 "block_id": block["block_id"],
@@ -425,34 +427,29 @@ class Client:
 
     async def _write_replicated_block(self, block_id: str, data: bytes,
                                       servers: list[str], term: int,
-                                      crc: int | None = None) -> None:
+                                      crc: int | None = None,
+                                      shard: str = "") -> None:
         req = {
             "block_id": block_id,
             "data": data,
             "next_servers": servers[1:],
             "expected_crc32c": crc if crc is not None else crc32c(data),
             "master_term": term,
+            "master_shard": shard,
         }
         timeout = max(self.rpc_timeout, 60.0)
         if self._dial(servers[0]) == servers[0]:
             # Resolve the whole chain's data ports up front: a native
             # data-plane first hop can only forward to blockports, so the
-            # fused path engages IFF every member advertises one —
-            # otherwise the gRPC handler chain forwards hop-by-hop with
-            # per-hop transport choice.
+            # chain-fused path engages IFF every member advertises one —
+            # otherwise _data_call still uses the FIRST hop's blockport
+            # (when present) and the handler chain forwards hop-by-hop
+            # with per-hop transport choice.
             ports = await self.block_pool.data_ports(self.rpc, servers, CS)
             if all(ports):
                 req["next_data_ports"] = ports[1:]
-                resp = await self.block_pool.call(
-                    self.rpc, servers[0], CS, "WriteBlock", req,
-                    timeout=timeout,
-                )
-            else:
-                resp = await self.rpc.call(servers[0], CS, "WriteBlock",
-                                           req, timeout=timeout)
-        else:
-            resp = await self.rpc.call(self._dial(servers[0]), CS,
-                                       "WriteBlock", req, timeout=timeout)
+        resp = await self._data_call(servers[0], "WriteBlock", req,
+                                     timeout=timeout)
         if not resp.get("success"):
             raise DfsError(f"write failed: {resp.get('error_message')}")
         written = int(resp.get("replicas_written") or 0)
@@ -466,7 +463,7 @@ class Client:
 
     async def _write_ec_block(self, block_id: str, data: bytes,
                               servers: list[str], k: int, m: int,
-                              term: int) -> None:
+                              term: int, shard: str = "") -> None:
         """One shard per chunkserver, written in parallel with per-shard CRCs
         (reference mod.rs:308-412)."""
         if len(servers) < k + m:
@@ -480,6 +477,7 @@ class Client:
                 "next_servers": [],
                 "expected_crc32c": crc32c(shards[i]),
                 "master_term": term,
+                "master_shard": shard,
             }, timeout=max(self.rpc_timeout, 60.0))
             if not resp.get("success"):
                 raise DfsError(

@@ -145,15 +145,17 @@ def get_lib() -> ctypes.CDLL | None:
         lib.tpudfs_dataplane_start.restype = ctypes.c_int64
         lib.tpudfs_dataplane_start.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_uint32, ctypes.c_uint16, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_uint16,
         ]
         lib.tpudfs_dataplane_port.restype = ctypes.c_int32
         lib.tpudfs_dataplane_port.argtypes = [ctypes.c_int64]
         lib.tpudfs_dataplane_set_term.restype = None
-        lib.tpudfs_dataplane_set_term.argtypes = [ctypes.c_int64,
-                                                  ctypes.c_uint64]
+        lib.tpudfs_dataplane_set_term.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+        ]
         lib.tpudfs_dataplane_term.restype = ctypes.c_uint64
-        lib.tpudfs_dataplane_term.argtypes = [ctypes.c_int64]
+        lib.tpudfs_dataplane_term.argtypes = [ctypes.c_int64,
+                                              ctypes.c_char_p]
         lib.tpudfs_dataplane_take_bad.restype = ctypes.c_int64
         lib.tpudfs_dataplane_take_bad.argtypes = [
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,

@@ -486,6 +486,9 @@ class Master:
             "ec_data_shards": k,
             "ec_parity_shards": m,
             "master_term": self.raft.core.term,
+            # Fencing epoch is (shard, term): chunkservers scope stale-term
+            # checks to the issuing Raft group.
+            "shard_id": self.state.shard_id,
         }
 
     async def rpc_complete_file(self, req: dict) -> dict:
@@ -691,10 +694,15 @@ class Master:
         commands = self.state.drain_commands(addr)
         for c in commands:
             c["master_term"] = term
+            c["master_shard"] = self.state.shard_id
         return {
             "success": True,
             "commands": commands,
             "master_term": term,
+            # Epoch fencing is scoped to the issuing Raft group: a term
+            # bump in one shard's failover must not fence writes allocated
+            # by a different, healthy shard.
+            "shard_id": self.state.shard_id,
             "results_processed": results_processed,
         }
 
