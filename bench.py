@@ -243,6 +243,18 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         async with wsem:
             await client.create_file(f"/bench/f{i:04d}", data)
 
+    # ---- metadata plane: creates/s at the reference harness config
+    # (100 files, concurrency 10, dfs_cli.rs:131-146) — empty files, so
+    # the number isolates the create -> allocate -> complete proposal
+    # path (WAL group commit + fused first-block allocation).
+    async def put_empty(i):
+        async with wsem:
+            await client.create_file(f"/bench/meta/m{i:03d}", b"")
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(put_empty(i) for i in range(100)))
+    meta_creates_per_s = 100 / (time.perf_counter() - t0)
+
     # ---- write side: 3x pipeline-replicated DFS writes (logical GB/s).
     t0 = time.perf_counter()
     await asyncio.gather(*(put(i) for i in range(FILES)))
@@ -380,6 +392,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "local_read_blocks": local_blocks,
         "confirm_s": round(confirm_s, 3),
         "write_pipeline_GBps": round(write_gbps, 3),
+        "meta_creates_per_s": round(meta_creates_per_s, 1),
         "ici_write_GBps": round(ici_write, 3),
         "ici_ec_scatter_GBps": round(ec_scatter, 3),
         "raw_infeed_GBps": round(raw, 3),

@@ -877,6 +877,11 @@ Engine* get_engine(int64_t h) {
 
 extern "C" {
 
+// Bumped on any signature/behavior change of the dataplane C ABI; the
+// Python loader refuses to bind mismatched prebuilt libraries
+// (TPUDFS_NATIVE_LIB) instead of calling with wrong arity.
+int64_t tpudfs_dataplane_abi(void) { return 2; }
+
 int64_t tpudfs_dataplane_start(const char* host, const char* hot_dir,
                                const char* cold_dir, uint32_t chunk_size,
                                uint16_t port) {

@@ -242,8 +242,12 @@ class ChunkServer:
         }
 
     async def rpc_data_port(self, req: dict) -> dict:
-        """Blockport discovery (tpudfs.common.blocknet): port 0 = none."""
-        return {"port": self.data_port}
+        """Blockport discovery (tpudfs.common.blocknet): port 0 = none.
+        ``native`` tells chain writers whether this blockport is the C++
+        engine — which forwards ONLY to blockports — or the asyncio
+        server, which re-resolves per hop and handles mixed chains."""
+        return {"port": self.data_port,
+                "native": self._native_dp is not None}
 
     async def rpc_local_access(self, req: dict) -> dict:
         """Short-circuit local-read handshake (the HDFS short-circuit idea,
@@ -295,8 +299,7 @@ class ChunkServer:
             # library is unavailable, or when TLS is configured (the
             # native engine is plaintext-only; asyncio wraps the certs).
             lib = native.get_lib()
-            if tls is None and lib is not None and \
-                    hasattr(lib, "tpudfs_dataplane_start"):
+            if tls is None and native.has_dataplane():
                 handle = lib.tpudfs_dataplane_start(
                     host.encode(),
                     str(self.store.hot_dir).encode(),
@@ -455,14 +458,14 @@ class ChunkServer:
         next_servers = list(req.get("next_servers") or [])
         forward_task = None
         if next_servers:
-            # Resolve the remaining chain's data ports so a native engine
-            # downstream can keep forwarding without its own discovery.
-            # The request may already carry them (native-aware senders do).
-            ports = list(req.get("next_data_ports") or [])
-            if len(ports) != len(next_servers):
-                ports = await self.blocks.data_ports(
-                    self.client, next_servers, SERVICE
-                )
+            # Transport choice for the next hop (same rule as the client's
+            # chain entry): a native-engine hop may carry the remaining
+            # chain IFF every member has a blockport; an asyncio blockport
+            # re-resolves per hop; otherwise gRPC — a mixed chain must
+            # never silently degrade to fewer replicas.
+            ports, hop_safe = await self.blocks.chain_info(
+                self.client, next_servers, SERVICE
+            )
             forward = {
                 "block_id": block_id,
                 "data": data,
@@ -472,10 +475,16 @@ class ChunkServer:
                 "master_term": int(req.get("master_term", 0)),
                 "master_shard": str(req.get("master_shard") or ""),
             }
-            forward_task = asyncio.create_task(self.blocks.call(
-                self.client, next_servers[0], SERVICE, "ReplicateBlock",
-                forward, timeout=30.0,
-            ))
+            if hop_safe:
+                forward_task = asyncio.create_task(self.blocks.call(
+                    self.client, next_servers[0], SERVICE, "ReplicateBlock",
+                    forward, timeout=30.0,
+                ))
+            else:
+                forward_task = asyncio.create_task(self.client.call(
+                    next_servers[0], SERVICE, "ReplicateBlock",
+                    forward, timeout=30.0,
+                ))
 
         local_err: str | None = None
         try:
@@ -711,6 +720,8 @@ class ChunkServer:
                     int(cmd["ec_data_shards"]),
                     int(cmd["ec_parity_shards"]),
                     list(cmd["targets"]),
+                    term=int(cmd.get("master_term", 0)),
+                    shard=str(cmd.get("master_shard") or ""),
                 )
                 if err:
                     logger.error("EC conversion of %s failed: %s",
@@ -728,6 +739,8 @@ class ChunkServer:
         data_shards: int,
         parity_shards: int,
         targets: list[str],
+        term: int = 0,
+        shard: str = "",
     ) -> str | None:
         """Migrate a replicated block to RS(k,m) shards (CONVERT_TO_EC
         command). Implements the data half of storage-tier EC conversion —
@@ -768,7 +781,8 @@ class ChunkServer:
                         "data": shards[i],
                         "next_servers": [],
                         "expected_crc32c": crc32c(shards[i]),
-                        "master_term": 0,
+                        "master_term": term,
+                        "master_shard": shard,
                     },
                     timeout=30.0,
                 )

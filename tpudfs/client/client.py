@@ -438,18 +438,28 @@ class Client:
             "master_shard": shard,
         }
         timeout = max(self.rpc_timeout, 60.0)
+        use_blockport = False
         if self._dial(servers[0]) == servers[0]:
-            # Resolve the whole chain's data ports up front: a native
-            # data-plane first hop can only forward to blockports, so the
-            # chain-fused path engages IFF every member advertises one —
-            # otherwise _data_call still uses the FIRST hop's blockport
-            # (when present) and the handler chain forwards hop-by-hop
-            # with per-hop transport choice.
-            ports = await self.block_pool.data_ports(self.rpc, servers, CS)
-            if all(ports):
-                req["next_data_ports"] = ports[1:]
-        resp = await self._data_call(servers[0], "WriteBlock", req,
-                                     timeout=timeout)
+            # Chain transport choice: the native data-plane engine forwards
+            # ONLY to blockports, so it may carry the chain IFF every
+            # member advertises one; an asyncio-blockport first hop
+            # re-resolves per hop (mixed chains fine); anything else goes
+            # gRPC so the handler chain picks transport hop-by-hop —
+            # a mixed chain must never silently degrade to fewer replicas.
+            ports, first_hop_safe = await self.block_pool.chain_info(
+                self.rpc, servers, CS
+            )
+            if first_hop_safe:
+                use_blockport = True
+                if all(ports):
+                    req["next_data_ports"] = ports[1:]
+        if use_blockport:
+            resp = await self.block_pool.call(
+                self.rpc, servers[0], CS, "WriteBlock", req, timeout=timeout
+            )
+        else:
+            resp = await self.rpc.call(self._dial(servers[0]), CS,
+                                       "WriteBlock", req, timeout=timeout)
         if not resp.get("success"):
             raise DfsError(f"write failed: {resp.get('error_message')}")
         written = int(resp.get("replicas_written") or 0)

@@ -187,6 +187,9 @@ class BlockConnPool:
         #: addr -> (port | None). None = peer has no blockport (final) —
         #: probe transport errors get a retry deadline instead.
         self._ports: dict[str, int | None] = {}
+        #: addr -> whether the advertised blockport is the native engine
+        #: (chain-forwards only to blockports; see chain_info()).
+        self._native: dict[str, bool] = {}
         self._retry_at: dict[str, float] = {}
         #: in-flight DataPort probes, shared so a concurrent first burst
         #: fires ONE probe per peer instead of one per caller.
@@ -234,6 +237,7 @@ class BlockConnPool:
                 self._retry_at[addr] = now + 30.0
             return None
         self._ports[addr] = port
+        self._native[addr] = bool(resp.get("native"))
         return port
 
     async def data_ports(self, rpc: RpcClient, addrs: list[str],
@@ -248,6 +252,20 @@ class BlockConnPool:
             *(self._data_port(rpc, a, service) for a in addrs)
         )
         return [int(p or 0) for p in ports]
+
+    async def chain_info(self, rpc: RpcClient, addrs: list[str],
+                         service: str) -> tuple[list[int], bool]:
+        """(ports, first_hop_safe): whether sending the CHAIN through the
+        first hop's blockport preserves full replication. The native
+        engine forwards only to blockports, so it needs the whole
+        remaining chain resolvable; the asyncio blockport (and the gRPC
+        handler) re-resolve per hop and handle mixed chains."""
+        ports = await self.data_ports(rpc, addrs, service)
+        if not ports or not ports[0]:
+            return ports, False
+        if all(ports):
+            return ports, True
+        return ports, not self._native.get(addrs[0], False)
 
     async def call(self, rpc: RpcClient, addr: str, service: str,
                    method: str, req: dict, timeout: float = 30.0) -> dict:

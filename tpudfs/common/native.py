@@ -142,6 +142,14 @@ def get_lib() -> ctypes.CDLL | None:
         # durable writes still work.
         pass
     try:
+        # The dataplane ABI has changed arity across versions; a prebuilt
+        # library (TPUDFS_NATIVE_LIB) that predates the current revision
+        # must be rejected outright — hasattr alone would bind the old
+        # symbols and call them with mismatched arguments.
+        lib.tpudfs_dataplane_abi.restype = ctypes.c_int64
+        lib.tpudfs_dataplane_abi.argtypes = []
+        if lib.tpudfs_dataplane_abi() != 2:
+            raise AttributeError("dataplane ABI mismatch")
         lib.tpudfs_dataplane_start.restype = ctypes.c_int64
         lib.tpudfs_dataplane_start.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
@@ -165,9 +173,12 @@ def get_lib() -> ctypes.CDLL | None:
                                                ctypes.c_void_p]
         lib.tpudfs_dataplane_stop.restype = ctypes.c_int64
         lib.tpudfs_dataplane_stop.argtypes = [ctypes.c_int64]
+        _dataplane_ok = True
     except AttributeError:
-        # Prebuilt library predating the native data-plane engine.
-        pass
+        # Prebuilt library predating (or ABI-mismatching) the native
+        # data-plane engine.
+        _dataplane_ok = False
+    lib.tpudfs_has_dataplane = _dataplane_ok
     lib.tpudfs_gf256_mul.restype = ctypes.c_uint8
     lib.tpudfs_gf256_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
     lib.tpudfs_gf256_mul_slice.restype = None
@@ -192,6 +203,12 @@ def get_lib() -> ctypes.CDLL | None:
 
 def have_native() -> bool:
     return get_lib() is not None
+
+
+def has_dataplane() -> bool:
+    """True when the loaded library carries the CURRENT data-plane ABI."""
+    lib = get_lib()
+    return lib is not None and getattr(lib, "tpudfs_has_dataplane", False)
 
 
 def has_blockio() -> bool:
