@@ -1,0 +1,203 @@
+"""Blockport data plane: protocol edges, fallback, and the native engine.
+
+Covers what the end-to-end suites only exercise implicitly: empty-payload
+framing, gRPC fallback when a peer has no blockport, per-shard fencing
+through the NATIVE engine, its corrupt-read flagging, and chain transport
+safety on mixed clusters (native first hop + blockport-less tail must not
+degrade replication).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tests.test_chunkserver import Cluster, _rand, _write
+from tpudfs.common import native
+from tpudfs.common.blocknet import BlockConnPool
+from tpudfs.common.checksum import crc32c
+from tpudfs.common.rpc import RpcError
+from tpudfs.chunkserver.service import SERVICE
+
+
+@pytest.fixture
+def cluster():
+    return Cluster()
+
+
+async def test_blockport_roundtrip_and_empty_payload(cluster, tmp_path):
+    await cluster.start_master()
+    cs = await cluster.add_cs(tmp_path, 0)
+    pool = BlockConnPool()
+    data = _rand(70_000, 1)
+    for payload in (data, b""):
+        bid = f"bp-{len(payload)}"
+        resp = await pool.call(cluster.client, cs.address, SERVICE,
+                               "WriteBlock", {
+                                   "block_id": bid, "data": payload,
+                                   "next_servers": [],
+                                   "expected_crc32c": crc32c(payload),
+                                   "master_term": 0,
+                               })
+        assert resp["success"] and resp["replicas_written"] == 1
+        back = await pool.call(cluster.client, cs.address, SERVICE,
+                               "ReadBlock", {"block_id": bid,
+                                             "offset": 0, "length": 0})
+        assert back["data"] == payload
+        assert back["total_size"] == len(payload)
+    await pool.close()
+    await cluster.stop()
+
+
+async def test_blockport_grpc_fallback_when_disabled(cluster, tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("TPUDFS_BLOCKPORT", "0")
+    await cluster.start_master()
+    cs = await cluster.add_cs(tmp_path, 0)
+    assert cs.data_port == 0  # no blockport at all
+    data = _rand(5000, 2)
+    resp = await _write(cluster.client, cs.address, "fb", data)
+    assert resp["success"]
+    pool = BlockConnPool()
+    back = await pool.call(cluster.client, cs.address, SERVICE, "ReadBlock",
+                           {"block_id": "fb", "offset": 0, "length": 0})
+    assert back["data"] == data  # transparently served over gRPC
+    await pool.close()
+    await cluster.stop()
+
+
+async def test_native_engine_running_and_counts(cluster, tmp_path):
+    if not native.has_dataplane():
+        pytest.skip("native dataplane unavailable")
+    await cluster.start_master()
+    cs = await cluster.add_cs(tmp_path, 0)
+    assert cs._native_dp is not None and cs.data_port > 0
+    pool = BlockConnPool()
+    data = _rand(33_000, 3)
+    await pool.call(cluster.client, cs.address, SERVICE, "WriteBlock", {
+        "block_id": "nat", "data": data, "next_servers": [],
+        "expected_crc32c": crc32c(data), "master_term": 0,
+    })
+    await pool.call(cluster.client, cs.address, SERVICE, "ReadBlock",
+                    {"block_id": "nat", "offset": 0, "length": 0})
+    stats = cs.data_plane_stats()
+    assert stats["writes"] >= 1 and stats["reads"] >= 1
+    # The engine's writes are visible to the Python store (same format).
+    assert cs.store.read("nat") == data
+    cs.store.verify_full("nat")
+    await pool.close()
+    await cluster.stop()
+
+
+async def test_native_engine_per_shard_fencing(cluster, tmp_path):
+    if not native.has_dataplane():
+        pytest.skip("native dataplane unavailable")
+    await cluster.start_master()
+    cs = await cluster.add_cs(tmp_path, 0)
+    pool = BlockConnPool()
+    data = _rand(4000, 4)
+
+    async def write(term, shard, bid):
+        return await pool.call(cluster.client, cs.address, SERVICE,
+                               "WriteBlock", {
+                                   "block_id": bid, "data": data,
+                                   "next_servers": [],
+                                   "expected_crc32c": crc32c(data),
+                                   "master_term": term,
+                                   "master_shard": shard,
+                               })
+
+    assert (await write(5, "shard-a", "f1"))["success"]
+    # Stale term in the SAME shard is fenced...
+    with pytest.raises(RpcError) as ei:
+        await write(3, "shard-a", "f2")
+    assert "Stale master term" in ei.value.message
+    # ...but a lower term in a DIFFERENT shard is fine (independent Raft
+    # groups — the chaos-tier regression).
+    assert (await write(2, "shard-b", "f3"))["success"]
+    # And Python-side fencing sees the native-learned epoch via its own
+    # observe path (push direction).
+    cs.observe_term(9, "shard-a")
+    with pytest.raises(RpcError):
+        await write(8, "shard-a", "f4")
+    await pool.close()
+    await cluster.stop()
+
+
+async def test_native_engine_corrupt_read_flags_bad_block(cluster, tmp_path):
+    if not native.has_dataplane():
+        pytest.skip("native dataplane unavailable")
+    await cluster.start_master()
+    cs = await cluster.add_cs(tmp_path, 0)
+    pool = BlockConnPool()
+    data = _rand(20_000, 5)
+    await pool.call(cluster.client, cs.address, SERVICE, "WriteBlock", {
+        "block_id": "rot", "data": data, "next_servers": [],
+        "expected_crc32c": crc32c(data), "master_term": 0,
+    })
+    # Bit-rot the stored file (sidecar untouched).
+    p = cs.store.block_path("rot")
+    raw = bytearray(p.read_bytes())
+    raw[123] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    with pytest.raises(RpcError) as ei:
+        await pool.call(cluster.client, cs.address, SERVICE, "ReadBlock",
+                        {"block_id": "rot", "offset": 0, "length": 0})
+    assert "corruption" in ei.value.message.lower()
+    cs.poll_native_bad_blocks()  # the heartbeat hook
+    assert "rot" in cs.pending_bad_blocks
+    await pool.close()
+    await cluster.stop()
+
+
+async def test_mixed_chain_keeps_full_replication(cluster, tmp_path,
+                                                  monkeypatch):
+    """Mixed chains must never silently degrade replication. Exercised on
+    the two hazard paths: (a) gRPC entry whose Python handler must route
+    the next (blockport-less) hop over gRPC, and (b) the CLIENT chain
+    entry — chain_info must refuse to hand a mixed chain to cs0's NATIVE
+    engine (which forwards only to blockports)."""
+    await cluster.start_master()
+    cs0 = await cluster.add_cs(tmp_path, 0)
+    monkeypatch.setenv("TPUDFS_BLOCKPORT", "0")
+    cs1 = await cluster.add_cs(tmp_path, 1)  # no blockport
+    monkeypatch.delenv("TPUDFS_BLOCKPORT")
+    cs2 = await cluster.add_cs(tmp_path, 2)
+    assert cs1.data_port == 0 and cs0.data_port > 0
+    data = _rand(60_000, 6)
+    resp = await _write(cluster.client, cs0.address, "mix", data,
+                        next_servers=[cs1.address, cs2.address])
+    assert resp["success"], resp
+    assert resp["replicas_written"] == 3, resp
+    for s in (cs0, cs1, cs2):
+        assert s.store.read("mix") == data
+
+    # (b) The client's chain entry: with cs0's native engine up front and
+    # a blockport-less member in the chain, _write_replicated_block must
+    # pick the gRPC entry (first_hop_safe False) — all replicas land.
+    from tpudfs.client.client import Client
+
+    client = Client(["127.0.0.1:1"], rpc_client=cluster.client)
+    ports, safe = await client.block_pool.chain_info(
+        cluster.client, [cs0.address, cs1.address, cs2.address], SERVICE
+    )
+    assert ports[0] > 0 and ports[1] == 0 and not safe
+    await client._write_replicated_block(
+        "mix2", data, [cs0.address, cs1.address, cs2.address], term=0
+    )
+    for s in (cs0, cs1, cs2):
+        assert s.store.read("mix2") == data
+    # All-blockport chains DO fuse through the native engine.
+    ports, safe = await client.block_pool.chain_info(
+        cluster.client, [cs0.address, cs2.address], SERVICE
+    )
+    assert safe and all(ports)
+    await client._write_replicated_block(
+        "mix3", data, [cs0.address, cs2.address], term=0
+    )
+    assert cs0.store.read("mix3") == data
+    assert cs2.store.read("mix3") == data
+    assert cs0.data_plane_stats()["forwards"] >= 1  # native chain engaged
+    await cluster.stop()

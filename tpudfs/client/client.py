@@ -218,13 +218,16 @@ class Client:
             await self.rpc.close()
 
     async def _data_call(self, addr: str, method: str, req: dict,
-                         timeout: float) -> dict:
+                         timeout: float, *,
+                         allow_blockport: bool = True) -> dict:
         """Block-payload RPC to a chunkserver: blockport when the peer
         advertises one, gRPC otherwise. Aliased routes (host_aliases — the
         Docker/FaultProxy indirections) stay on gRPC so an interposer on
-        the gRPC address can't be bypassed by the data side channel."""
+        the gRPC address can't be bypassed by the data side channel.
+        ``allow_blockport=False`` forces gRPC (chain writers use it when
+        the remaining chain isn't blockport-safe)."""
         dialed = self._dial(addr)
-        if dialed != addr:
+        if dialed != addr or not allow_blockport:
             return await self.rpc.call(dialed, CS, method, req,
                                        timeout=timeout)
         return await self.block_pool.call(self.rpc, addr, CS, method, req,
@@ -438,7 +441,7 @@ class Client:
             "master_shard": shard,
         }
         timeout = max(self.rpc_timeout, 60.0)
-        use_blockport = False
+        first_hop_safe = False
         if self._dial(servers[0]) == servers[0]:
             # Chain transport choice: the native data-plane engine forwards
             # ONLY to blockports, so it may carry the chain IFF every
@@ -449,17 +452,11 @@ class Client:
             ports, first_hop_safe = await self.block_pool.chain_info(
                 self.rpc, servers, CS
             )
-            if first_hop_safe:
-                use_blockport = True
-                if all(ports):
-                    req["next_data_ports"] = ports[1:]
-        if use_blockport:
-            resp = await self.block_pool.call(
-                self.rpc, servers[0], CS, "WriteBlock", req, timeout=timeout
-            )
-        else:
-            resp = await self.rpc.call(self._dial(servers[0]), CS,
-                                       "WriteBlock", req, timeout=timeout)
+            if first_hop_safe and all(ports):
+                req["next_data_ports"] = ports[1:]
+        resp = await self._data_call(servers[0], "WriteBlock", req,
+                                     timeout=timeout,
+                                     allow_blockport=first_hop_safe)
         if not resp.get("success"):
             raise DfsError(f"write failed: {resp.get('error_message')}")
         written = int(resp.get("replicas_written") or 0)

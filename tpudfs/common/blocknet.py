@@ -237,7 +237,11 @@ class BlockConnPool:
                 self._retry_at[addr] = now + 30.0
             return None
         self._ports[addr] = port
-        self._native[addr] = bool(resp.get("native"))
+        # FAIL CLOSED on version skew: a peer that advertises a blockport
+        # but predates the `native` field might still be the native engine
+        # (which forwards only to blockports) — treat it as such so mixed
+        # chains route around it instead of silently under-replicating.
+        self._native[addr] = bool(resp.get("native", port is not None))
         return port
 
     async def data_ports(self, rpc: RpcClient, addrs: list[str],
