@@ -1,0 +1,135 @@
+"""WebDataset-on-DFS training loop (BASELINE config 5, the WDS half).
+
+DFS tar shards -> DfsWdsSource (tar-header index, per-member range reads)
+-> grain shuffle/batch with a decode map -> sharded device batches ->
+pjit'd SGD on a small MLP classifier. Asserts the model actually LEARNS
+(train accuracy) — the bytes reaching the accelerators are the right
+samples with the right labels, through tar framing, DFS striping, and
+3x replication.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tests.test_master_service import MiniCluster
+from tpudfs.client.client import Client
+
+FEATURES = 32
+CLASSES = 4
+SAMPLES = 512
+BATCH = 64
+
+
+def _make_samples(rng, centers):
+    for i in range(SAMPLES):
+        cls = int(rng.integers(0, CLASSES))
+        x = (centers[cls] + 0.3 * rng.normal(size=FEATURES)).astype(
+            np.float32
+        )
+        yield {"__key__": f"{i:06d}", "img": x.tobytes(),
+               "cls": str(cls).encode()}
+
+
+async def test_wds_training_loop_learns(tmp_path):
+    pytest.importorskip("grain")
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudfs.tpu import grain_infeed as gi
+    from tpudfs.tpu.wds import DfsWdsSource, decode_sample, write_wds_shards
+
+    rng = np.random.default_rng(42)
+    centers = rng.normal(size=(CLASSES, FEATURES)).astype(np.float32) * 2.0
+
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        shards = await write_wds_shards(
+            client, "/wds/train", _make_samples(rng, centers),
+            shard_size_bytes=96 * 1024,  # several shards, several blocks
+        )
+        assert len(shards) >= 2, "want a multi-shard dataset"
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+        xsh = NamedSharding(mesh, P("data"))
+        repl = NamedSharding(mesh, P())
+
+        @jax.jit
+        def step(params, x, y):
+            def loss_fn(p):
+                h = jax.nn.relu(x @ p["w1"])
+                logits = h @ p["w2"]
+                onehot = jax.nn.one_hot(y, CLASSES)
+                return -jnp.mean(
+                    jnp.sum(jax.nn.log_softmax(logits) * onehot, -1)
+                )
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            return (
+                jax.tree.map(lambda p, gg: p - 0.3 * gg, params, g),
+                loss,
+            )
+
+        def run_training():
+            # Built and driven in a worker thread: the in-process cluster
+            # serves on the MAIN event loop, which must stay unblocked.
+            import grain
+
+            source = DfsWdsSource(list(c.masters), shards)
+            try:
+                assert len(source) == SAMPLES
+                # Spot-check tar framing end-to-end.
+                s0 = source[0]
+                assert s0["__key__"] == "000000"
+                x0, y0 = decode_sample(s0, image_shape=(FEATURES,))
+                assert x0.shape == (FEATURES,) and 0 <= int(y0) < CLASSES
+
+                ds = (
+                    grain.MapDataset.source(source)
+                    .shuffle(seed=7)
+                    .map(lambda s: decode_sample(s, image_shape=(FEATURES,)))
+                    .batch(BATCH)
+                )
+
+                k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+                params = {
+                    "w1": jax.device_put(
+                        jax.random.normal(k1, (FEATURES, 64)) * 0.1, repl),
+                    "w2": jax.device_put(
+                        jax.random.normal(k2, (64, CLASSES)) * 0.1, repl),
+                }
+                first = last = None
+                for _epoch in range(6):
+                    for xb, yb in ds:
+                        x = jax.device_put(jnp.asarray(xb), xsh)
+                        y = jax.device_put(jnp.asarray(yb), xsh)
+                        params, loss = step(params, x, y)
+                        if first is None:
+                            first = float(loss)
+                        last = float(loss)
+
+                # Accuracy on a fresh pass: labels rode the tar members.
+                correct = total = 0
+                for xb, yb in ds:
+                    h = jax.nn.relu(jnp.asarray(xb) @ params["w1"])
+                    pred = jnp.argmax(h @ params["w2"], axis=-1)
+                    correct += int(jnp.sum(pred == jnp.asarray(yb)))
+                    total += len(yb)
+                return first, last, correct, total
+            finally:
+                source.close()
+
+        first, last, correct, total = await asyncio.to_thread(run_training)
+        assert first is not None and last < first / 3, (first, last)
+        assert correct / total > 0.9, f"accuracy {correct}/{total}"
+    finally:
+        await c.stop()

@@ -91,7 +91,58 @@ class _ClientLoop:
             self._loop.close()
 
 
-class DfsRecordSource:
+class DfsSourceBase:
+    """Shared plumbing for DFS-backed grain sources: a lazily-built
+    per-process client/event-loop (pickle-safe for grain workers) and the
+    file-metadata prefetch. Subclasses implement ``_build_index`` and the
+    grain protocol."""
+
+    def __init__(self, master_addrs: Sequence[str],
+                 client_kwargs: dict | None = None):
+        self.master_addrs = list(master_addrs)
+        self.client_kwargs = dict(client_kwargs or {})
+        self._lock = threading.Lock()
+        self._cl: _ClientLoop | None = None
+
+    def _client_loop(self) -> _ClientLoop:
+        with self._lock:
+            if self._cl is None:
+                self._cl = _ClientLoop(self.master_addrs, self.client_kwargs)
+            return self._cl
+
+    def _fetch_metas(self, paths: Sequence[str]) -> list[dict]:
+        """File metadata for every path, failing on missing files."""
+        cl = self._client_loop()
+
+        async def metas(client: Client) -> list[dict]:
+            out = await asyncio.gather(
+                *(client.get_file_info(p) for p in paths)
+            )
+            for p, m in zip(paths, out):
+                if m is None:
+                    raise FileNotFoundError(f"DFS file not found: {p}")
+            return out
+
+        return cl.run(metas(cl.client))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._cl is not None:
+                self._cl.close()
+                self._cl = None
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_cl"] = None
+        state["_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+
+class DfsRecordSource(DfsSourceBase):
     """Grain ``RandomAccessDataSource`` over fixed-size records in DFS files.
 
     Each record is ``record_bytes`` consecutive bytes; file tails shorter
@@ -116,13 +167,10 @@ class DfsRecordSource:
                 f"record_bytes={record_bytes} is not a multiple of "
                 f"dtype {dtype} itemsize {itemsize}"
             )
-        self.master_addrs = list(master_addrs)
+        super().__init__(master_addrs, client_kwargs)
         self.paths = list(paths)
         self.record_bytes = int(record_bytes)
         self.dtype = dtype
-        self.client_kwargs = dict(client_kwargs or {})
-        self._lock = threading.Lock()
-        self._cl: _ClientLoop | None = None
         # (path, base_offset) per record, built once from file metadata.
         self._index: list[tuple[str, int]] = []
         # Immutable block layout per path, cached so record fetches skip the
@@ -136,47 +184,12 @@ class DfsRecordSource:
             self.close()
             raise
 
-    # ------------------------------------------------------------- plumbing
-
-    def _client_loop(self) -> _ClientLoop:
-        with self._lock:
-            if self._cl is None:
-                self._cl = _ClientLoop(self.master_addrs, self.client_kwargs)
-            return self._cl
-
     def _build_index(self) -> None:
-        cl = self._client_loop()
-
-        async def metas(client: Client) -> list[dict]:
-            out = await asyncio.gather(
-                *(client.get_file_info(p) for p in self.paths)
-            )
-            for p, m in zip(self.paths, out):
-                if m is None:
-                    raise FileNotFoundError(f"DFS file not found: {p}")
-            return out
-
-        for path, meta in zip(self.paths, cl.run(metas(cl.client))):
+        for path, meta in zip(self.paths, self._fetch_metas(self.paths)):
             self._metas[path] = meta
             for off in range(0, int(meta["size"]) - self.record_bytes + 1,
                              self.record_bytes):
                 self._index.append((path, off))
-
-    def close(self) -> None:
-        with self._lock:
-            if self._cl is not None:
-                self._cl.close()
-                self._cl = None
-
-    def __getstate__(self):
-        state = self.__dict__.copy()
-        state["_cl"] = None
-        state["_lock"] = None
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
-        self._lock = threading.Lock()
 
     # ------------------------------------------------------- grain protocol
 
