@@ -253,6 +253,37 @@ struct Writer {
 
 // ------------------------------------------------------------- socket io
 
+// Pinning socket buffers disables kernel autotuning and clamps to
+// net.core.{w,r}mem_max; only worth it when the caps allow >= 1 MiB —
+// then one sendmsg hands a whole block to the kernel instead of
+// trickling in lockstep with a (possibly same-core) reader.
+int sock_buf_size() {
+  static int cached = [] {
+    long w = 0, r = 0;
+    for (auto [path, out] : {std::pair<const char*, long*>{
+             "/proc/sys/net/core/wmem_max", &w},
+         std::pair<const char*, long*>{"/proc/sys/net/core/rmem_max", &r}}) {
+      FILE* f = ::fopen(path, "r");
+      if (f) {
+        if (::fscanf(f, "%ld", out) != 1) *out = 0;
+        ::fclose(f);
+      }
+    }
+    long cap = static_cast<long>(4 << 20);
+    if (w < cap) cap = w;
+    if (r < cap) cap = r;
+    return cap >= (1 << 20) ? static_cast<int>(cap) : 0;
+  }();
+  return cached;
+}
+
+void tune_buffers(int fd) {
+  int buf = sock_buf_size();
+  if (!buf) return;
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
 bool read_exact(int fd, void* buf, size_t n) {
   uint8_t* p = static_cast<uint8_t*>(buf);
   while (n) {
@@ -329,6 +360,7 @@ class Engine {
     if (listen_fd_ < 0) return -errno;
     int one = 1;
     ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    tune_buffers(listen_fd_);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     // Bind the same interface the gRPC listener uses (resolve names via
@@ -441,6 +473,7 @@ class Engine {
       }
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      tune_buffers(fd);
       {
         std::lock_guard<std::mutex> g(conns_mu_);
         conns_.insert(fd);
@@ -693,6 +726,7 @@ class Engine {
     }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    tune_buffers(fd);
     timeval tv{30, 0};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     return fd;

@@ -45,6 +45,37 @@ import msgpack
 
 from tpudfs.common.rpc import ClientTls, RpcClient, RpcError, ServerTls
 
+import socket as _socket
+
+
+def _read_cap(name: str) -> int:
+    try:
+        with open(f"/proc/sys/net/core/{name}") as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+#: Explicit socket buffers DISABLE kernel autotuning and clamp to
+#: net.core.{w,r}mem_max — a net loss on default-sysctl hosts (~208 KiB
+#: caps, autotuning would have grown past them). Only pin big buffers
+#: where the caps actually allow them (>= 1 MiB: one sendmsg lands a
+#: whole block instead of trickling in lockstep with a same-core
+#: reader); otherwise leave autotuning alone.
+_SOCK_BUF = min(4 << 20, _read_cap("wmem_max"), _read_cap("rmem_max"))
+if _SOCK_BUF < (1 << 20):
+    _SOCK_BUF = 0
+
+
+def _tune_socket(sock) -> None:
+    if not _SOCK_BUF:
+        return
+    try:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, _SOCK_BUF)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, _SOCK_BUF)
+    except OSError:
+        pass
+
 logger = logging.getLogger(__name__)
 
 _U32 = struct.Struct("<I")
@@ -122,6 +153,9 @@ class BlockPortServer:
     async def _handle(self, r: asyncio.StreamReader,
                       w: asyncio.StreamWriter) -> None:
         self._conns.add(w)
+        sock = w.get_extra_info("socket")
+        if sock is not None:
+            _tune_socket(sock)
         try:
             while True:
                 try:
@@ -319,6 +353,9 @@ class BlockConnPool:
             conn = await asyncio.open_connection(
                 host, int(port), ssl=self._ssl_ctx
             )
+            sock = conn[1].get_extra_info("socket")
+            if sock is not None:
+                _tune_socket(sock)
         r, w = conn
         try:
             header = {k: v for k, v in req.items() if k != "data"}
