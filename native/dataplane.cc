@@ -504,6 +504,8 @@ class Engine {
         handle_write(fd, h, has_data ? &payload : nullptr, &downstream);
       } else if (method == "ReadBlock") {
         handle_read(fd, h);
+      } else if (method == "ReadBlocks") {
+        handle_read_batch(fd, h);
       } else {
         respond_err(fd, "UNIMPLEMENTED",
                     "no native blockport method " + method);
@@ -876,6 +878,84 @@ class Engine {
     w.str("total_size");
     w.uint(total);
     send_frame(fd, w.out, buf.data(), static_cast<uint64_t>(rc));
+  }
+
+  // Batched verified full reads: header {"block_ids": [...]}; response
+  // header carries "sizes" (bytes per slot, -1 = missing/corrupt — the
+  // caller falls back per block) and the payload concatenates the
+  // successful blocks in request order. One frame replaces N round
+  // trips for a remote reader's fused round.
+  void handle_read_batch(int fd, std::map<std::string, Value>& h) {
+    const std::vector<std::string> ids =
+        h.count("block_ids") ? h["block_ids"].astr
+                             : std::vector<std::string>{};
+    std::vector<int64_t> sizes;
+    std::vector<uint8_t> payload;
+    sizes.reserve(ids.size());
+    constexpr size_t kMaxSlots = 256;
+    constexpr size_t kMaxBatchBytes = 96ull << 20;  // < 100 MiB frame caps
+    for (const auto& block_id : ids) {
+      reads_.fetch_add(1);
+      if (sizes.size() >= kMaxSlots || payload.size() >= kMaxBatchBytes) {
+        sizes.push_back(-1);  // over budget: caller falls back/re-requests
+        continue;
+      }
+      if (block_id.empty() || block_id[0] == '.' ||
+          block_id.find('/') != std::string::npos) {
+        sizes.push_back(-1);
+        continue;
+      }
+      std::string data_path = hot_ + "/" + block_id;
+      struct stat st;
+      if (::stat(data_path.c_str(), &st) != 0) {
+        bool found = false;
+        if (!cold_.empty()) {
+          data_path = cold_ + "/" + block_id;
+          found = ::stat(data_path.c_str(), &st) == 0;
+        }
+        if (!found) {
+          sizes.push_back(-1);
+          continue;
+        }
+      }
+      uint64_t total = static_cast<uint64_t>(st.st_size);
+      size_t base = payload.size();
+      if (base + total > kMaxBatchBytes) {
+        sizes.push_back(-1);
+        continue;
+      }
+      payload.resize(base + total);
+      int64_t rc = tpudfs_block_read_verify(
+          data_path.c_str(), (data_path + ".meta").c_str(), 0, total,
+          payload.data() + base, 1, chunk_);
+      if (rc < 0 || static_cast<uint64_t>(rc) != total) {
+        payload.resize(base);
+        sizes.push_back(-1);
+        if (rc <= -200000) {
+          std::lock_guard<std::mutex> g(bad_mu_);
+          bad_.insert(block_id);
+        }
+        continue;
+      }
+      sizes.push_back(static_cast<int64_t>(total));
+    }
+    Writer w;
+    w.map_head(3);
+    w.str("ok");
+    w.boolean(true);
+    w.str("_d");
+    w.uint(1);
+    w.str("sizes");
+    {
+      // Writer::aint clamps negatives to 0; hand-encode -1 slots.
+      if (sizes.size() < 16) w.raw(0x90 | sizes.size());
+      else { w.raw(0xdc); w.be(sizes.size(), 2); }
+      for (int64_t v : sizes) {
+        if (v < 0) w.raw(0xff);  // negative fixint -1
+        else w.uint(static_cast<uint64_t>(v));
+      }
+    }
+    send_frame(fd, w.out, payload.data(), payload.size());
   }
 
   std::string host_, hot_, cold_;

@@ -976,3 +976,48 @@ def test_ec_full_geometry_nine_device_mesh():
         f"child failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
     )
     assert "OK" in proc.stdout
+
+
+@pytest.mark.parametrize("host_verify", [True, False])
+async def test_fused_read_remote_rounds(tmp_path, host_verify):
+    """A NON-colocated client (short-circuit off) still gets fused rounds:
+    blocks group per origin chunkserver and ship as one ReadBlocks frame,
+    bit-exact in both verify placements."""
+    data = _rand(6 * 64 * 1024, seed=60)
+    c, client = await _cluster_with_files(tmp_path, [("/rf/a", data)])
+    try:
+        client.local_reads = False
+        reader = HbmReader(client, jax.devices()[:1], batch_reads=8)
+        comb = reader._combiner(reader.devices[0])
+        comb.host_verify = host_verify
+        blocks = await reader.read_file_to_device_blocks("/rf/a",
+                                                         verify="lazy")
+        assert comb.blocks >= 1, "remote fused rounds never engaged"
+        await reader.confirm(blocks)
+        assert all(b.verified for b in blocks)
+        got = b"".join(device_array_to_bytes(b.array, b.size)
+                       for b in blocks)
+        assert got == data
+    finally:
+        await c.stop()
+
+
+async def test_fused_read_remote_corrupt_slot_falls_back(tmp_path):
+    """A corrupt replica behind the remote fused round (server-side verify
+    marks the slot -1) falls back to the per-block path, which fails over
+    to a healthy replica."""
+    data = _rand(4 * 64 * 1024, seed=61)
+    c, client = await _cluster_with_files(tmp_path, [("/rf/rot", data)])
+    try:
+        client.local_reads = False
+        await _corrupt_first_replica(c, client, "/rf/rot")
+        reader = HbmReader(client, jax.devices()[:1], batch_reads=8)
+        blocks = await reader.read_file_to_device_blocks("/rf/rot",
+                                                         verify="lazy")
+        await reader.confirm(blocks)
+        assert all(b.verified for b in blocks)
+        got = b"".join(device_array_to_bytes(b.array, b.size)
+                       for b in blocks)
+        assert got == data
+    finally:
+        await c.stop()

@@ -239,7 +239,46 @@ class ChunkServer:
             "LocalAccess": self.rpc_local_access,
             "Stats": self.rpc_stats,
             "DataPort": self.rpc_data_port,
+            "ReadBlocks": self.rpc_read_blocks,
         }
+
+    #: rpc_read_blocks caps: slots per frame, and a payload budget under
+    #: the transports' 100 MiB limits. Slots past either cap return -1
+    #: (caller falls back / re-requests) instead of unbounded buffering.
+    READ_BATCH_MAX_SLOTS = 256
+    READ_BATCH_MAX_BYTES = 96 << 20
+
+    async def rpc_read_blocks(self, req: dict) -> dict:
+        """Batched full reads for a remote reader's fused round: one
+        frame/RPC instead of one per block. Per-slot ``sizes`` (-1 =
+        missing/corrupt/over-budget; caller falls back per block),
+        payload = the successful blocks concatenated in request order.
+        Reads go straight to the verified store path — the streaming
+        fused sweep must not wash the whole LRU block cache (nor copy
+        every block into it), and corruption surfaces as a -1 slot whose
+        per-block fallback triggers the usual recovery. The native
+        engine serves the same method on the blockport."""
+        sizes: list[int] = []
+        chunks: list[bytes] = []
+        total = 0
+        for block_id in req.get("block_ids") or []:
+            if len(sizes) >= self.READ_BATCH_MAX_SLOTS or                     total >= self.READ_BATCH_MAX_BYTES:
+                sizes.append(-1)
+                continue
+            try:
+                data = await asyncio.to_thread(
+                    self.store.read_verified, block_id
+                )
+            except (BlockNotFoundError, BlockCorruptionError, OSError):
+                sizes.append(-1)
+                continue
+            if total + len(data) > self.READ_BATCH_MAX_BYTES:
+                sizes.append(-1)
+                continue
+            chunks.append(data)
+            sizes.append(len(data))
+            total += len(data)
+        return {"sizes": sizes, "data": b"".join(chunks)}
 
     async def rpc_data_port(self, req: dict) -> dict:
         """Blockport discovery (tpudfs.common.blocknet): port 0 = none.
@@ -321,6 +360,7 @@ class ChunkServer:
                     "WriteBlock": self.rpc_write_block,
                     "ReplicateBlock": self.rpc_replicate_block,
                     "ReadBlock": self.rpc_read_block,
+                    "ReadBlocks": self.rpc_read_blocks,
                 }, tls=tls)
                 self.data_port = await self._blockport.start(host)
         if not self.address:

@@ -116,9 +116,9 @@ class HbmReader:
     async def _try_batched(self, block: dict, device,
                            verify: bool | str) -> DeviceBlock | None:
         """Fused-round read when enabled and the block qualifies (lazy
-        verify, colocated replica, chunk-aligned). None -> per-block path."""
-        if not self.batch_reads or verify != "lazy" or \
-                not self.client.local_reads:
+        verify, chunk-aligned; colocated replica OR a remote peer's
+        batched ReadBlocks frame). None -> per-block path."""
+        if not self.batch_reads or verify != "lazy":
             return None
         return await self._combiner(device).read(block)
 

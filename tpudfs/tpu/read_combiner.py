@@ -55,6 +55,10 @@ logger = logging.getLogger(__name__)
 
 #: Largest fused round, in blocks. 32 x 1 MiB = 32 MiB per device_put.
 DEFAULT_MAX_BATCH = 32
+#: Byte budget for one REMOTE round — comfortably under both transports'
+#: 100 MiB frame/message caps (blocknet._MAX_PAYLOAD, rpc MAX_MESSAGE_BYTES)
+#: including framing; oversized blocks simply round down to 1 per frame.
+REMOTE_ROUND_BYTES = 48 << 20
 
 
 @dataclass
@@ -78,9 +82,10 @@ class DeviceBatch:
 @dataclass
 class _Req:
     block: dict
-    path: str
+    path: str  # local store path ("" for remote rounds)
     cpb: int
     size: int
+    addr: str | None = None  # remote origin chunkserver (None = local)
     fut: asyncio.Future = field(default=None)  # created on the running loop
 
 
@@ -131,21 +136,31 @@ class ReadCombiner:
         ):
             return None
         store = None
-        for addr in block.get("locations") or []:
-            if not addr:
-                continue
-            s = await self.client._local_store(addr)
-            if s is not None:
-                store = s
-                break
-        if store is None:
-            return None
-        try:
-            path = store.block_path(block["block_id"])
-        except ValueError:
-            return None
-        req = _Req(block=block, path=str(path),
-                   cpb=size // CHECKSUM_CHUNK_SIZE, size=size,
+        if self.client.local_reads:
+            for addr in block.get("locations") or []:
+                if not addr:
+                    continue
+                s = await self.client._local_store(addr)
+                if s is not None:
+                    store = s
+                    break
+        path, remote = "", None
+        if store is not None:
+            try:
+                path = str(store.block_path(block["block_id"]))
+            except ValueError:
+                return None
+        else:
+            # No colocated replica: fuse over the wire instead — rounds
+            # group per origin chunkserver and ship as ONE ReadBlocks
+            # frame (_data_call keeps aliased routes on gRPC, so fault
+            # interposers still see the traffic).
+            remote = next((a for a in block.get("locations") or [] if a),
+                          None)
+            if remote is None:
+                return None
+        req = _Req(block=block, path=path,
+                   cpb=size // CHECKSUM_CHUNK_SIZE, size=size, addr=remote,
                    fut=asyncio.get_running_loop().create_future())
         # Mark retrieved even when the awaiting reader is cancelled away.
         req.fut.add_done_callback(
@@ -173,21 +188,32 @@ class ReadCombiner:
         aborted = True
         try:
             while self._pending:
-                # One round: the leading request's chunk count picks the
-                # uniform-geometry group (mixed sizes only split rounds,
-                # they are never dropped).
+                # One round: the leading request's (chunk count, origin)
+                # picks the group — uniform geometry, one source (local
+                # disk, or one remote peer's ReadBlocks frame). Mixed
+                # requests only split rounds, they are never dropped.
                 cpb = self._pending[0].cpb
-                uniform = [r for r in self._pending if r.cpb == cpb]
-                take = _bucket(len(uniform), self.max_batch)
+                origin = self._pending[0].addr
+                uniform = [r for r in self._pending
+                           if r.cpb == cpb and r.addr == origin]
+                cap = self.max_batch
+                if origin is not None:
+                    # One frame must fit the transports' 100 MiB caps.
+                    stride = cpb * CHECKSUM_CHUNK_SIZE
+                    cap = min(cap, max(1, REMOTE_ROUND_BYTES // stride))
+                take = _bucket(len(uniform), cap)
                 reqs = uniform[:take]
                 taken = set(map(id, reqs))
                 self._pending = [
                     r for r in self._pending if id(r) not in taken
                 ]
                 try:
-                    buf, ok, crcs = await asyncio.to_thread(
-                        self._fill_buffer, reqs
-                    )
+                    if origin is not None:
+                        buf, ok, crcs = await self._fetch_remote(reqs)
+                    else:
+                        buf, ok, crcs = await asyncio.to_thread(
+                            self._fill_buffer, reqs
+                        )
                 except asyncio.CancelledError:
                     self._fail_out(reqs)
                     raise
@@ -262,6 +288,69 @@ class ReadCombiner:
                 r.fut.set_exception(
                     RuntimeError("read combiner shut down mid-request")
                 )
+
+    async def _fetch_remote(
+        self, reqs: list[_Req],
+    ) -> tuple[np.ndarray, list[bool], np.ndarray | None]:
+        """One ReadBlocks frame to the round's origin chunkserver (served
+        by the native engine or the asyncio/gRPC handlers — the pool picks
+        the transport). Slots the peer couldn't serve fall back to the
+        general per-block path; in host-verify mode the received bytes are
+        re-checked end-to-end against the recorded whole-block CRCs."""
+        from tpudfs.common.rpc import RpcError
+
+        addr = reqs[0].addr
+        cpb = reqs[0].cpb
+        stride = cpb * CHECKSUM_CHUNK_SIZE
+        buf = np.empty((len(reqs) * cpb, WORDS_PER_CHUNK), dtype="<u4")
+        try:
+            # _data_call centralizes transport choice AND the
+            # aliased-routes-stay-on-gRPC rule (fault interposers see the
+            # traffic either way).
+            resp = await self.client._data_call(
+                addr, "ReadBlocks",
+                {"block_ids": [r.block["block_id"] for r in reqs]},
+                timeout=60.0,
+            )
+        except RpcError as e:
+            logger.debug("remote fused round to %s failed: %s", addr, e)
+            return buf, [False] * len(reqs), None
+        sizes = list(resp.get("sizes") or [])
+        data = resp.get("data") or b""
+        ok: list[bool] = []
+        flat = buf.reshape(-1).view(np.uint8)
+        pos = 0
+        for i, r in enumerate(reqs):
+            sz = sizes[i] if i < len(sizes) else -1
+            if sz is None or sz < 0:
+                ok.append(False)
+                continue
+            chunk = data[pos:pos + sz]
+            pos += sz
+            if sz != r.size or len(chunk) != sz:
+                ok.append(False)
+                continue
+            flat[i * stride:(i + 1) * stride] = np.frombuffer(
+                chunk, dtype=np.uint8
+            )
+            ok.append(True)
+        if not self.host_verify:
+            return buf, ok, None
+        crcs = await asyncio.to_thread(self._host_crcs, reqs, flat, ok)
+        return buf, ok, crcs
+
+    def _host_crcs(self, reqs: list[_Req], flat: np.ndarray,
+                   ok: list[bool]) -> np.ndarray:
+        from tpudfs.common.checksum import crc32c
+
+        stride = reqs[0].cpb * CHECKSUM_CHUNK_SIZE
+        out = np.zeros(len(reqs), dtype=np.uint32)
+        for i, r in enumerate(reqs):
+            if ok[i]:
+                out[i] = crc32c(
+                    flat[i * stride:(i + 1) * stride].tobytes()
+                )
+        return out
 
     def _fill_buffer(
         self, reqs: list[_Req],
