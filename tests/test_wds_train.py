@@ -133,3 +133,41 @@ async def test_wds_training_loop_learns(tmp_path):
         assert correct / total > 0.9, f"accuracy {correct}/{total}"
     finally:
         await c.stop()
+
+
+async def test_wds_writer_validation_and_multipart_ext(tmp_path):
+    """USTAR discipline is enforced at write time (dotted keys, >100-char
+    names rejected); multi-part extensions round-trip whole."""
+    from tpudfs.tpu.wds import DfsWdsSource, write_wds_shards
+
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        with pytest.raises(ValueError, match="must not contain"):
+            await write_wds_shards(client, "/wds/bad",
+                                   [{"__key__": "a.b", "img": b"x"}])
+        with pytest.raises(ValueError, match="USTAR"):
+            await write_wds_shards(client, "/wds/bad2",
+                                   [{"__key__": "k" * 101, "img": b"x"}])
+        shards = await write_wds_shards(client, "/wds/mp", [
+            {"__key__": "000", "img": b"A" * 100, "seg.png": b"B" * 50},
+            {"__key__": "001", "img": b"C" * 100, "seg.png": b"D" * 50},
+        ])
+
+        def check():
+            source = DfsWdsSource(list(c.masters), shards)
+            try:
+                assert len(source) == 2
+                s0, s1 = source[0], source[1]
+                assert s0["__key__"] == "000" and s0["seg.png"] == b"B" * 50
+                assert s1["__key__"] == "001" and s1["img"] == b"C" * 100
+            finally:
+                source.close()
+
+        await asyncio.to_thread(check)
+    finally:
+        await c.stop()
