@@ -1021,3 +1021,12 @@ async def test_fused_read_remote_corrupt_slot_falls_back(tmp_path):
         assert got == data
     finally:
         await c.stop()
+
+
+def test_graft_dryrun_full_geometry_nine_devices():
+    """dryrun at >= 9 devices runs the flagship one-RS(6,3)-shard-per-
+    device geometry (self-provisioned bootstrap mesh; the session's own
+    mesh caps at 8, so this exercises the driver branch end-to-end)."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(9)
