@@ -90,8 +90,10 @@ class MiniCluster:
     async def put_file(self, path, data, leader: Master):
         """Manual client write path (the real client library lands next)."""
         addr = leader.address
-        await self.call(addr, "CreateFile", {"path": path})
-        alloc = await self.call(addr, "AllocateBlock", {"path": path})
+        created = await self.call(addr, "CreateFile", {"path": path})
+        token = created.get("write_token") or ""
+        alloc = await self.call(addr, "AllocateBlock",
+                                {"path": path, "token": token})
         block = alloc["block"]
         servers = alloc["chunk_server_addresses"]
         resp = await self.client.call(
@@ -112,6 +114,7 @@ class MiniCluster:
                 "checksum_crc32c": crc32c(data),
                 "actual_size": len(data),
             }],
+            "token": token,
         })
         return block["block_id"], servers
 
@@ -195,10 +198,12 @@ async def test_allocate_errors(tmp_path):
         with pytest.raises(RpcError):  # no such file
             await c.call(leader.address, "AllocateBlock", {"path": "/nope"})
         # EC file needing 6 servers with only 2 available.
-        await c.call(leader.address, "CreateFile",
-                     {"path": "/e", "ec_data_shards": 4, "ec_parity_shards": 2})
+        r = await c.call(leader.address, "CreateFile",
+                         {"path": "/e", "ec_data_shards": 4,
+                          "ec_parity_shards": 2})
         with pytest.raises(RpcError) as ei:
-            await c.call(leader.address, "AllocateBlock", {"path": "/e"})
+            await c.call(leader.address, "AllocateBlock",
+                         {"path": "/e", "token": r.get("write_token") or ""})
         assert "chunkserver" in ei.value.message.lower()
     finally:
         await c.stop()
@@ -303,5 +308,66 @@ async def test_ha_masters_follower_redirect_and_failover(tmp_path):
         info = await c.call(new_leader.address, "GetFileInfo",
                             {"path": "/ha-file"})
         assert info["found"] and info["metadata"]["size"] == len(data)
+    finally:
+        await c.stop()
+
+
+async def test_concurrent_put_sessions_cannot_interleave(tmp_path):
+    """Write-session fencing (found by the live chaos tier): two clients
+    racing put sessions on one path — the second CreateFile replaces the
+    first writer's in-flight file, and the FIRST writer's AllocateBlock /
+    CompleteFile must then be rejected as a stale session. Without the
+    fence both sessions' blocks grafted onto one file (metadata size from
+    one writer, block list from both) and reads returned a torn value no
+    client ever wrote."""
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        m = leader.address
+        cl = c.client
+
+        r1 = await cl.call(m, "MasterService", "CreateFile",
+                           {"path": "/race", "first_block": True})
+        t1 = r1["write_token"]
+        assert t1 and r1.get("block"), r1
+        # Second writer races in before the first completes: replaces the
+        # in-flight file with its own session.
+        r2 = await cl.call(m, "MasterService", "CreateFile",
+                           {"path": "/race", "first_block": True})
+        t2 = r2["write_token"]
+        assert t2 and t2 != t1
+
+        # The FIRST session is now fenced off everywhere.
+        with pytest.raises(RpcError, match="stale write session"):
+            await cl.call(m, "MasterService", "AllocateBlock",
+                          {"path": "/race", "token": t1})
+        with pytest.raises(RpcError, match="stale write session"):
+            await cl.call(m, "MasterService", "CompleteFile",
+                          {"path": "/race", "size": 4, "etag_md5": "x",
+                           "block_checksums": [], "token": t1})
+
+        # The second session proceeds normally and owns the file alone.
+        b2 = r2["block"]
+        data = b"winner"
+        await cl.call(b2["locations"][0], "ChunkServerService", "WriteBlock",
+                      {"block_id": b2["block_id"], "data": data,
+                       "next_servers": b2["locations"][1:],
+                       "expected_crc32c": crc32c(data),
+                       "master_term": int(r2.get("master_term") or 0)})
+        await cl.call(m, "MasterService", "CompleteFile",
+                      {"path": "/race", "size": len(data), "etag_md5": "e",
+                       "block_checksums": [
+                           {"block_id": b2["block_id"],
+                            "checksum_crc32c": crc32c(data),
+                            "actual_size": len(data)}],
+                       "token": t2})
+        info = await cl.call(m, "MasterService", "GetFileInfo",
+                             {"path": "/race"})
+        meta = info["metadata"]
+        assert info["found"] and meta["size"] == len(data)
+        assert len(meta["blocks"]) == 1  # never both sessions' blocks
+        assert meta["blocks"][0]["block_id"] == b2["block_id"]
     finally:
         await c.stop()

@@ -361,9 +361,11 @@ class Client:
         # per-block AllocateBlock loop covers those.
         first_alloc = resp if resp.get("block") else None
         try:
-            await self._write_blocks_and_complete(path, data, master, k, m,
-                                                  etag, attrs,
-                                                  first_alloc=first_alloc)
+            await self._write_blocks_and_complete(
+                path, data, master, k, m, etag, attrs,
+                first_alloc=first_alloc,
+                token=str(resp.get("write_token") or ""),
+            )
         except IndeterminateError:
             raise
         except DfsError as e:
@@ -378,6 +380,7 @@ class Client:
                                          etag: str | None,
                                          attrs: dict | None = None,
                                          first_alloc: dict | None = None,
+                                         token: str = "",
                                          ) -> None:
         # Stick to the creating master for read-your-writes (mod.rs:256-266).
         sticky = [master] + [a for a in self._masters_for(path) if a != master]
@@ -391,7 +394,8 @@ class Client:
                 alloc, first_alloc = first_alloc, None
             else:
                 alloc, _ = await self._execute(
-                    "AllocateBlock", {"path": path}, masters=sticky
+                    "AllocateBlock", {"path": path, "token": token},
+                    masters=sticky,
                 )
             block = alloc["block"]
             servers = alloc["chunk_server_addresses"]
@@ -423,6 +427,7 @@ class Client:
             "etag_md5": etag if etag is not None
             else hashlib.md5(data).hexdigest(),
             "block_checksums": block_checksums,
+            "token": token,
         }
         if attrs:
             req["attrs"] = dict(attrs)

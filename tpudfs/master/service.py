@@ -426,6 +426,11 @@ class Master:
         self._check_migration_freeze(req["path"])
         self._check_tx_lock(req["path"])
         self.monitor.record(req["path"])
+        # Write-session token: minted here, replicated in the command (so
+        # apply is deterministic), enforced by the state machine on every
+        # AllocateBlock/CompleteFile of this file — two interleaved create
+        # sessions can never graft blocks onto each other's file.
+        token = uuid.uuid4().hex
         await self._propose({
             "op": "create_file",
             "path": req["path"],
@@ -433,9 +438,10 @@ class Master:
             "ec_parity_shards": int(req.get("ec_parity_shards") or 0),
             "created_at_ms": now_ms(),
             "overwrite": bool(req.get("overwrite")),
+            "token": token,
         })
         if not req.get("first_block"):
-            return {"success": True}
+            return {"success": True, "write_token": token}
         # Fused create+allocate: the common single-client write path pays
         # one master round-trip (and envelope) instead of two — the
         # reference issues CreateFile then AllocateBlock separately
@@ -443,10 +449,13 @@ class Master:
         # surface as alloc_error rather than failing the create, so the
         # client can fall back to its per-block AllocateBlock retry loop.
         try:
-            alloc = await self.rpc_allocate_block({"path": req["path"]})
+            alloc = await self.rpc_allocate_block(
+                {"path": req["path"], "token": token}
+            )
         except RpcError as e:
-            return {"success": True, "alloc_error": e.message}
-        return {"success": True, **alloc}
+            return {"success": True, "write_token": token,
+                    "alloc_error": e.message}
+        return {"success": True, "write_token": token, **alloc}
 
     async def rpc_allocate_block(self, req: dict) -> dict:
         self._check_safe_mode()
@@ -479,6 +488,7 @@ class Master:
             "locations": servers,
             "ec_data_shards": k,
             "ec_parity_shards": m,
+            "token": str(req.get("token") or ""),
         })
         return {
             "block": result["block"],
@@ -505,6 +515,7 @@ class Master:
             "attrs": req.get("attrs") or {},
             "created_at_ms": int(req.get("created_at_ms") or now_ms()),
             "block_checksums": req.get("block_checksums") or [],
+            "token": str(req.get("token") or ""),
         })
         return {"success": True}
 
@@ -521,7 +532,15 @@ class Master:
         # pay one log append per read; pending updates flush as ONE
         # replicated command per window instead.
         self._note_access(req["path"])
-        return {"found": True, "metadata": f.to_dict()}
+        return {"found": True, "metadata": self._public_meta(f)}
+
+    @staticmethod
+    def _public_meta(f) -> dict:
+        """Client-visible metadata: the live write-session token must not
+        leave the master (a reader who copied it could forge the fence)."""
+        d = f.to_dict()
+        d.pop("create_token", None)
+        return d
 
     async def rpc_batch_get_file_info(self, req: dict) -> dict:
         """Coalesced GetFileInfo: ONE ReadIndex/lease barrier covers the
@@ -546,7 +565,8 @@ class Master:
                 results.append({"found": False, "metadata": None})
             else:
                 self._note_access(path)
-                results.append({"found": True, "metadata": f.to_dict()})
+                results.append({"found": True,
+                                "metadata": self._public_meta(f)})
         return {"results": results}
 
     def _note_access(self, path: str) -> None:

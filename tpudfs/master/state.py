@@ -78,6 +78,14 @@ class FileMetadata:
     #: DFS file per object, handlers.rs:985-1010 — one replicated command
     #: instead of a second file round-trip).
     attrs: dict = field(default_factory=dict)
+    #: Write-session fencing (no reference equivalent — the live chaos
+    #: tier caught two concurrent put sessions interleaving create/
+    #: allocate/complete into one file holding BOTH writers' blocks, a
+    #: torn value under the WGL checker). Each CreateFile mints a token;
+    #: AllocateBlock/CompleteFile carrying a different session's token are
+    #: rejected AT APPLY TIME (the authoritative ordering point), so the
+    #: create that applied last owns the file exclusively.
+    create_token: str = ""
 
     def to_dict(self) -> dict:
         d = self.__dict__.copy()
@@ -260,12 +268,15 @@ class MasterState:
         path = cmd["path"]
         self.check_not_migrating(path)
         existing = self.files.get(path)
-        if existing is not None and existing.complete:
-            if not cmd.get("overwrite"):
-                raise ValueError(f"file already exists: {path}")
-            # Atomic S3-style overwrite: replace the metadata and queue the
-            # old blocks for deletion in ONE replicated command — no
-            # delete-then-create window where the object doesn't exist.
+        if existing is not None and existing.complete and \
+                not cmd.get("overwrite"):
+            raise ValueError(f"file already exists: {path}")
+        if existing is not None:
+            # Atomic S3-style overwrite — and the losing side of a create
+            # race (an INCOMPLETE in-flight file being replaced): either
+            # way the old metadata's blocks leave the namespace here, so
+            # their chunkserver data must be queued for deletion in the
+            # same replicated command or it leaks forever.
             for b in existing.blocks:
                 for loc in b.locations:
                     self.queue_command(
@@ -276,8 +287,24 @@ class MasterState:
             created_at_ms=int(cmd.get("created_at_ms") or 0),
             ec_data_shards=int(cmd.get("ec_data_shards") or 0),
             ec_parity_shards=int(cmd.get("ec_parity_shards") or 0),
+            create_token=str(cmd.get("token") or ""),
         )
         return {"success": True}
+
+    def _check_write_session(self, f: FileMetadata, cmd: dict) -> None:
+        token = str(cmd.get("token") or "")
+        if f.create_token and token != f.create_token:
+            # STRICT: a tokened file only accepts its own session — an
+            # EMPTY token is also rejected (a writer whose create resolved
+            # via the ALREADY_EXISTS retry heuristic never learned the
+            # file's token precisely because it cannot know whether the
+            # surviving file is its own; letting it write would re-open
+            # the torn-write race). Files from pre-fence snapshots
+            # (create_token == "") accept anything.
+            raise ValueError(
+                f"stale write session for {f.path}: the file was "
+                "created by another writer's session"
+            )
 
     def _apply_allocate_block(self, cmd: dict):
         path = cmd["path"]
@@ -285,6 +312,7 @@ class MasterState:
         f = self.files.get(path)
         if f is None:
             raise ValueError(f"file not found: {path}")
+        self._check_write_session(f, cmd)
         block = BlockInfo(
             block_id=cmd["block_id"],
             locations=list(cmd["locations"]),
@@ -300,6 +328,7 @@ class MasterState:
         f = self.files.get(path)
         if f is None:
             raise ValueError(f"file not found: {path}")
+        self._check_write_session(f, cmd)
         f.size = int(cmd["size"])
         f.etag_md5 = cmd.get("etag_md5", "")
         if cmd.get("attrs"):
