@@ -201,3 +201,26 @@ async def test_mixed_chain_keeps_full_replication(cluster, tmp_path,
     assert cs2.store.read("mix3") == data
     assert cs0.data_plane_stats()["forwards"] >= 1  # native chain engaged
     await cluster.stop()
+
+
+async def test_read_blocks_caps_budget(cluster, tmp_path):
+    """ReadBlocks slots beyond the count/byte budget return -1 (caller
+    falls back) instead of unbounded buffering."""
+    await cluster.start_master()
+    cs = await cluster.add_cs(tmp_path, 0)
+    data = _rand(2000, 7)
+    for i in range(3):
+        await _write(cluster.client, cs.address, f"cap{i}", data)
+    # Count cap: ask for more slots than allowed.
+    cs.READ_BATCH_MAX_SLOTS = 2
+    resp = await cs.rpc_read_blocks(
+        {"block_ids": ["cap0", "cap1", "cap2"]})
+    assert resp["sizes"] == [len(data), len(data), -1]
+    assert resp["data"] == data + data
+    # Byte cap: second slot would cross the budget.
+    cs.READ_BATCH_MAX_SLOTS = 256
+    cs.READ_BATCH_MAX_BYTES = len(data) + 10
+    resp = await cs.rpc_read_blocks(
+        {"block_ids": ["cap0", "cap1", "missing"]})
+    assert resp["sizes"] == [len(data), -1, -1]
+    await cluster.stop()

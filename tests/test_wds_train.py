@@ -171,3 +171,43 @@ async def test_wds_writer_validation_and_multipart_ext(tmp_path):
         await asyncio.to_thread(check)
     finally:
         await c.stop()
+
+
+async def test_wds_shards_on_ec_files(tmp_path):
+    """WDS shards stored ERASURE-CODED (RS(2,1)) read back sample-exact —
+    the tar indexer and per-sample range reads ride the EC read path."""
+    from tpudfs.tpu.wds import DfsWdsSource, write_wds_shards
+
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        rng = np.random.default_rng(5)
+        payloads = [rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+                    for _ in range(40)]
+        shards = await write_wds_shards(
+            client, "/wds/ec",
+            ({"__key__": f"{i:06d}", "img": p, "cls": b"1"}
+             for i, p in enumerate(payloads)),
+            shard_size_bytes=48 * 1024, ec=(2, 1),
+        )
+        meta = await client.get_file_info(shards[0])
+        assert meta["blocks"][0].get("ec_data_shards") == 2  # really EC
+
+        def check():
+            source = DfsWdsSource(list(c.masters), shards)
+            try:
+                assert len(source) == len(payloads)
+                for i in (0, 7, len(payloads) - 1):
+                    s = source[i]
+                    assert s["__key__"] == f"{i:06d}"
+                    assert s["img"] == payloads[i]
+            finally:
+                source.close()
+
+        await asyncio.to_thread(check)
+    finally:
+        await c.stop()
