@@ -667,8 +667,10 @@ class ChunkServer:
 
     def ops_gauges(self) -> dict[str, float]:
         """Gauges for /metrics (reference bin/chunkserver.rs:381-428
-        exports space/chunk-count)."""
+        exports space/chunk-count; the native data-plane counters are
+        this build's addition)."""
         stats = self.store.stats()
+        dp = self.data_plane_stats()
         return {
             "used_space_bytes": stats["used_space"],
             "available_space_bytes": stats["available_space"],
@@ -677,6 +679,10 @@ class ChunkServer:
             "cache_misses": self.cache.misses,
             "known_master_term": self.known_term,
             "pending_bad_blocks": len(self.pending_bad_blocks),
+            "dataplane_writes_total": dp["writes"],
+            "dataplane_reads_total": dp["reads"],
+            "dataplane_forwards_total": dp["forwards"],
+            "dataplane_errors_total": dp["errors"],
         }
 
     async def rpc_stats(self, _req: dict) -> dict:
