@@ -126,6 +126,26 @@ async def run() -> None:
         jax.block_until_ready(arrs)
         dt = time.perf_counter() - t0
         print(f"full : {dt:6.3f}s  {FILES * len(data) / dt / 1e9:6.3f} GB/s")
+
+        # Fused rounds (read_combiner): the production infeed path —
+        # native multi-pread + one device_put + one CRC per round.
+        fused_reader = HbmReader(client, [device], batch_reads=16)
+        fused_reader.warm_batches(len(data) // 512)
+        fsem = asyncio.Semaphore(32)
+
+        async def fused_one(i):
+            async with fsem:
+                return await fused_reader.read_file_to_device_blocks(
+                    f"/p/f{i:04d}", verify="lazy"
+                )
+
+        t0 = time.perf_counter()
+        blocks = await asyncio.gather(*(fused_one(i) for i in range(FILES)))
+        jax.block_until_ready(
+            [x for bl in blocks for b in bl for x in b.sync_arrays]
+        )
+        dt = time.perf_counter() - t0
+        print(f"fused: {dt:6.3f}s  {FILES * len(data) / dt / 1e9:6.3f} GB/s")
         await rpc.close()
     finally:
         from tpudfs.testing.procs import terminate_all
