@@ -1,0 +1,22 @@
+#!/bin/bash
+# Background TPU liveness probe: appends one line per probe to
+# /root/repo/tpu_probe.log every 10 min. Mutually exclusive with bench.py
+# via flock on /tmp/tpudfs-tpu.lock (bench holds it exclusively for its
+# whole run; we skip the probe rather than contend for the one TPU + the
+# one CPU core). A second loop instance exits instead of doubling probes.
+exec 9>/tmp/tpudfs-probe-loop.lock
+flock -n 9 || { echo "probe loop already running" >&2; exit 1; }
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(flock -n /tmp/tpudfs-tpu.lock timeout 60 python -c \
+        "import jax; d=jax.devices(); print(d[0].platform, len(d))" 2>&1)
+  rc=$?
+  if [ $rc -eq 0 ] && echo "$out" | grep -qi tpu; then
+    echo "$ts LIVE $out" >> /root/repo/tpu_probe.log
+  elif [ $rc -eq 1 ] && [ -z "$out" ]; then
+    echo "$ts SKIP bench holds the TPU lock" >> /root/repo/tpu_probe.log
+  else
+    echo "$ts WEDGED rc=$rc $(echo "$out" | tail -1 | cut -c1-120)" >> /root/repo/tpu_probe.log
+  fi
+  sleep 600
+done
