@@ -26,24 +26,38 @@
 //
 // Python integration (ctypes, tpudfs/common/native.py):
 //   int64_t  tpudfs_dataplane_start(host, hot_dir, cold_dir, chunk_size,
-//                                   port) -> handle or -errno
+//                                   port, cache_blocks) -> handle or -errno
 //   int32_t  tpudfs_dataplane_port(handle)
 //   void     tpudfs_dataplane_set_term(handle, shard, term) // heartbeats
 //   uint64_t tpudfs_dataplane_term(handle, shard)      // learned from reqs
+//   int64_t  tpudfs_dataplane_take_terms(handle, buf, cap)
+//                                   // "shard\tterm\n" dump, see below
 //   int64_t  tpudfs_dataplane_take_bad(handle, buf, cap) // '\n'-joined ids
-//   void     tpudfs_dataplane_stats(handle, uint64_t out[4])
-//                                   // writes, reads, forwards, errors
+//   void     tpudfs_dataplane_invalidate(handle, block_id) // cache drop
+//   void     tpudfs_dataplane_stats(handle, uint64_t out[6])
+//               // writes, reads, forwards, errors, cache_hits, cache_misses
 //   int64_t  tpudfs_dataplane_stop(handle)
 //
 // Fencing parity: reference chunkserver.rs:732-743 — requests carrying a
 // stale master term are rejected FAILED_PRECONDITION; newer terms are
-// learned per shard. Python pushes heartbeat-learned terms in
-// (set_term); terms this engine learns from requests reach Python only
-// through its own heartbeats (the term getter exists for tests).
+// learned per shard. Python pushes heartbeat-learned terms in (set_term)
+// and drains request-learned terms back out (take_terms, polled from the
+// heartbeat loop) so BOTH fencing planes converge — without the drain, a
+// deposed master's stale write arriving on the gRPC plane would still be
+// accepted until the next master heartbeat taught Python the new term.
+//
+// LRU block cache: full verified blocks, capacity in blocks (the native
+// twin of the Python service's _LruCache, reference chunkserver.rs:67-76
+// — without it the engine's hot read path re-reads + re-CRCs the disk on
+// every repeated remote read). Writes and corrupt-read findings
+// invalidate; Python invalidates through tpudfs_dataplane_invalidate on
+// its own delete / tiering-move / recovery paths.
 
+#include <algorithm>
 #include <arpa/inet.h>
 #include <atomic>
 #include <cerrno>
+#include <list>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -224,7 +238,8 @@ struct Writer {
   void str(const std::string& s) {
     if (s.size() < 32) raw(0xa0 | s.size());
     else if (s.size() < 256) { raw(0xd9); be(s.size(), 1); }
-    else { raw(0xda); be(s.size(), 2); }
+    else if (s.size() < 65536) { raw(0xda); be(s.size(), 2); }
+    else { raw(0xdb); be(s.size() & 0xffffffffull, 4); }  // str32
     out += s;
   }
   void uint(uint64_t v) {
@@ -351,9 +366,9 @@ struct CommitEntry {
 class Engine {
  public:
   Engine(std::string host, std::string hot, std::string cold,
-         uint32_t chunk)
+         uint32_t chunk, size_t cache_blocks)
       : host_(std::move(host)), hot_(std::move(hot)),
-        cold_(std::move(cold)), chunk_(chunk) {}
+        cold_(std::move(cold)), chunk_(chunk), cache_cap_(cache_blocks) {}
 
   int64_t start(uint16_t port) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -435,6 +450,21 @@ class Engine {
     return it == terms_.end() ? 0 : it->second;
   }
 
+  // Dump every (shard, term) pair as "shard\tterm\n" lines — the
+  // heartbeat loop polls this so request-learned terms reach the Python
+  // fencing plane too. Non-destructive (terms only ever grow; re-reading
+  // is idempotent). Returns bytes written, or -needed when cap is short.
+  int64_t take_terms(char* buf, uint64_t cap) {
+    std::lock_guard<std::mutex> g(term_mu_);
+    std::string joined;
+    for (const auto& kv : terms_)
+      joined += kv.first + "\t" + std::to_string(kv.second) + "\n";
+    if (joined.size() + 1 > cap)
+      return -static_cast<int64_t>(joined.size() + 1);
+    std::memcpy(buf, joined.c_str(), joined.size() + 1);
+    return static_cast<int64_t>(joined.size());
+  }
+
   int64_t take_bad(char* buf, uint64_t cap) {
     // Drain as many WHOLE ids as fit; the rest stay for the next poll —
     // an oversized backlog must never wedge reporting.
@@ -454,11 +484,70 @@ class Engine {
     return static_cast<int64_t>(joined.size());
   }
 
-  void stats(uint64_t out[4]) const {
+  void stats(uint64_t out[6]) const {
     out[0] = writes_.load();
     out[1] = reads_.load();
     out[2] = forwards_.load();
     out[3] = errors_.load();
+    out[4] = cache_hits_.load();
+    out[5] = cache_misses_.load();
+  }
+
+  // ------------------------------------------------------ LRU block cache
+
+  using CacheData = std::shared_ptr<std::vector<uint8_t>>;
+
+  CacheData cache_get(const std::string& id) {
+    if (!cache_cap_) return nullptr;
+    std::lock_guard<std::mutex> g(cache_mu_);
+    auto it = cache_map_.find(id);
+    if (it == cache_map_.end()) {
+      cache_misses_.fetch_add(1);
+      return nullptr;
+    }
+    cache_list_.splice(cache_list_.begin(), cache_list_, it->second);
+    cache_hits_.fetch_add(1);
+    return it->second->second;
+  }
+
+  void cache_put(const std::string& id, CacheData data) {
+    if (!cache_cap_) return;
+    std::lock_guard<std::mutex> g(cache_mu_);
+    auto it = cache_map_.find(id);
+    if (it != cache_map_.end()) {
+      it->second->second = std::move(data);
+      cache_list_.splice(cache_list_.begin(), cache_list_, it->second);
+      return;
+    }
+    cache_list_.emplace_front(id, std::move(data));
+    cache_map_[id] = cache_list_.begin();
+    while (cache_list_.size() > cache_cap_) {
+      cache_map_.erase(cache_list_.back().first);
+      cache_list_.pop_back();
+    }
+  }
+
+  void cache_invalidate(const std::string& id) {
+    if (!cache_cap_) return;
+    std::lock_guard<std::mutex> g(cache_mu_);
+    auto it = cache_map_.find(id);
+    if (it != cache_map_.end()) {
+      cache_list_.erase(it->second);
+      cache_map_.erase(it);
+    }
+  }
+
+  // Write-vs-read race guard for cache inserts: a block republished
+  // between the pread and the cache_put must NOT be cached from the old
+  // bytes (the concurrent writer's invalidate would land before our
+  // insert, pinning stale data until the next write). The publish is a
+  // rename (new inode), so re-statting and comparing (inode, mtime, size)
+  // from before the read detects it — the same signature discipline the
+  // Python service's cache uses (service.py _block_sig).
+  static bool same_sig(const struct stat& a, const struct stat& b) {
+    return a.st_ino == b.st_ino && a.st_size == b.st_size &&
+           a.st_mtim.tv_sec == b.st_mtim.tv_sec &&
+           a.st_mtim.tv_nsec == b.st_mtim.tv_nsec;
   }
 
  private:
@@ -619,9 +708,12 @@ class Engine {
       }
     }
 
-    // Stage + group commit (ack only after durable).
+    // Stage + group commit (ack only after durable). Any write attempt
+    // invalidates the cached copy — the publish rename may have replaced
+    // the bytes a cached reader would otherwise keep serving.
     std::string err;
     bool ok = stage_and_commit(block_id, *data, &err);
+    cache_invalidate(block_id);
 
     int64_t replicas = ok ? 1 : 0;
     if (fwd_fd >= 0) {
@@ -753,8 +845,31 @@ class Engine {
     std::unique_lock<std::mutex> lk(commit_mu_);
     commit_queue_.push_back(entry);
     commit_cv_.notify_one();
-    commit_done_cv_.wait(lk, [&] { return entry->done || !running_.load(); });
-    if (!entry->done) {
+    // Wake either when the commit loop resolved this entry, or when the
+    // engine is stopping AND the entry is still queued — in the latter
+    // case WE dequeue it (under the lock, so the loop can never also take
+    // it) and unlink the staged tmps, making "engine stopping" a DEFINITE
+    // failure. An entry already taken into an in-flight batch is past the
+    // point of no return (the loop drains its batch before exiting), so
+    // we keep waiting for its real verdict instead of reporting a write
+    // failure for data that durably published.
+    bool dequeued = false;
+    commit_done_cv_.wait(lk, [&] {
+      if (entry->done) return true;
+      if (!running_.load()) {
+        auto it = std::find(commit_queue_.begin(), commit_queue_.end(),
+                            entry);
+        if (it != commit_queue_.end()) {
+          commit_queue_.erase(it);
+          dequeued = true;
+          return true;
+        }
+      }
+      return false;
+    });
+    if (dequeued) {
+      ::unlink(entry->data_tmp.c_str());
+      ::unlink(entry->meta_tmp.c_str());
       *err = "engine stopping";
       return false;
     }
@@ -792,8 +907,8 @@ class Engine {
       for (auto& e : batch) e->done = true;
       commit_done_cv_.notify_all();
     }
-    // Drain-out on stop: wake any stragglers.
-    for (auto& e : commit_queue_) e->done = false;
+    // Drain-out on stop: wake any stragglers (they dequeue + unlink their
+    // own staged entries under the lock — see stage_and_commit).
     commit_done_cv_.notify_all();
   }
 
@@ -812,6 +927,32 @@ class Engine {
         h.count("offset") ? static_cast<uint64_t>(h["offset"].i) : 0;
     uint64_t length =
         h.count("length") ? static_cast<uint64_t>(h["length"].i) : 0;
+    // Cache first: a hit serves straight from memory (bytes were verified
+    // when cached; writes/corruption findings invalidate). Range reads
+    // slice the cached block.
+    if (CacheData cached = cache_get(block_id)) {
+      uint64_t total = cached->size();
+      if (offset >= total && !(offset == 0 && total == 0)) {
+        respond_err(fd, "OUT_OF_RANGE",
+                    "Offset " + std::to_string(offset) +
+                        " exceeds block size " + std::to_string(total));
+        return;
+      }
+      uint64_t want = length == 0 ? total - offset
+                                  : std::min(length, total - offset);
+      Writer w;
+      w.map_head(4);
+      w.str("ok");
+      w.boolean(true);
+      w.str("_d");
+      w.uint(1);
+      w.str("bytes_read");
+      w.uint(want);
+      w.str("total_size");
+      w.uint(total);
+      send_frame(fd, w.out, cached->data() + offset, want);
+      return;
+    }
     std::string data_path = hot_ + "/" + block_id;
     struct stat st;
     if (::stat(data_path.c_str(), &st) != 0) {
@@ -849,6 +990,7 @@ class Engine {
         std::lock_guard<std::mutex> g(bad_mu_);
         bad_.insert(block_id);
       }
+      cache_invalidate(block_id);
       bool full = offset == 0 && want == total;
       if (full) {
         respond_err(fd, "DATA_LOSS",
@@ -867,6 +1009,18 @@ class Engine {
                                 : "native read error " + std::to_string(-rc));
       return;
     }
+    CacheData keep;
+    if (rc >= 0 && offset == 0 && want == total) {
+      // Full block, freshly verified: cache for repeated readers — unless
+      // a concurrent publish replaced the file mid-read (see same_sig).
+      // Moving buf avoids a full-block copy on every miss; the response
+      // is sent from the cached vector.
+      struct stat st2;
+      if (::stat(data_path.c_str(), &st2) == 0 && same_sig(st, st2)) {
+        keep = std::make_shared<std::vector<uint8_t>>(std::move(buf));
+        cache_put(block_id, keep);
+      }
+    }
     Writer w;
     w.map_head(4);
     w.str("ok");
@@ -877,7 +1031,8 @@ class Engine {
     w.uint(static_cast<uint64_t>(rc));
     w.str("total_size");
     w.uint(total);
-    send_frame(fd, w.out, buf.data(), static_cast<uint64_t>(rc));
+    send_frame(fd, w.out, keep ? keep->data() : buf.data(),
+               static_cast<uint64_t>(rc));
   }
 
   // Batched verified full reads: header {"block_ids": [...]}; response
@@ -903,6 +1058,15 @@ class Engine {
       if (block_id.empty() || block_id[0] == '.' ||
           block_id.find('/') != std::string::npos) {
         sizes.push_back(-1);
+        continue;
+      }
+      if (CacheData cached = cache_get(block_id)) {
+        if (payload.size() + cached->size() > kMaxBatchBytes) {
+          sizes.push_back(-1);
+          continue;
+        }
+        payload.insert(payload.end(), cached->begin(), cached->end());
+        sizes.push_back(static_cast<int64_t>(cached->size()));
         continue;
       }
       std::string data_path = hot_ + "/" + block_id;
@@ -932,12 +1096,19 @@ class Engine {
         payload.resize(base);
         sizes.push_back(-1);
         if (rc <= -200000) {
-          std::lock_guard<std::mutex> g(bad_mu_);
-          bad_.insert(block_id);
+          {
+            std::lock_guard<std::mutex> g(bad_mu_);
+            bad_.insert(block_id);
+          }
+          cache_invalidate(block_id);
         }
         continue;
       }
       sizes.push_back(static_cast<int64_t>(total));
+      struct stat st2;  // skip caching when a publish raced the read
+      if (::stat(data_path.c_str(), &st2) == 0 && same_sig(st, st2))
+        cache_put(block_id, std::make_shared<std::vector<uint8_t>>(
+                                payload.begin() + base, payload.end()));
     }
     Writer w;
     w.map_head(3);
@@ -976,6 +1147,12 @@ class Engine {
   std::deque<std::shared_ptr<CommitEntry>> commit_queue_;
   std::mutex bad_mu_;
   std::set<std::string> bad_;
+  size_t cache_cap_;
+  std::mutex cache_mu_;
+  std::list<std::pair<std::string, CacheData>> cache_list_;  // front = MRU
+  std::map<std::string, std::list<std::pair<std::string, CacheData>>::iterator>
+      cache_map_;
+  std::atomic<uint64_t> cache_hits_{0}, cache_misses_{0};
 };
 
 std::mutex g_engines_mu;
@@ -994,13 +1171,14 @@ extern "C" {
 // Bumped on any signature/behavior change of the dataplane C ABI; the
 // Python loader refuses to bind mismatched prebuilt libraries
 // (TPUDFS_NATIVE_LIB) instead of calling with wrong arity.
-int64_t tpudfs_dataplane_abi(void) { return 2; }
+int64_t tpudfs_dataplane_abi(void) { return 3; }
 
 int64_t tpudfs_dataplane_start(const char* host, const char* hot_dir,
                                const char* cold_dir, uint32_t chunk_size,
-                               uint16_t port) {
+                               uint16_t port, uint64_t cache_blocks) {
   auto* e = new Engine(host ? host : "", hot_dir,
-                       cold_dir ? cold_dir : "", chunk_size);
+                       cold_dir ? cold_dir : "", chunk_size,
+                       static_cast<size_t>(cache_blocks));
   int64_t rc = e->start(port);
   if (rc < 0) {
     delete e;
@@ -1032,10 +1210,20 @@ int64_t tpudfs_dataplane_take_bad(int64_t h, char* buf, uint64_t cap) {
   return e ? e->take_bad(buf, cap) : -1;
 }
 
-void tpudfs_dataplane_stats(int64_t h, uint64_t out[4]) {
+int64_t tpudfs_dataplane_take_terms(int64_t h, char* buf, uint64_t cap) {
+  Engine* e = get_engine(h);
+  return e ? e->take_terms(buf, cap) : -1;
+}
+
+void tpudfs_dataplane_invalidate(int64_t h, const char* block_id) {
+  Engine* e = get_engine(h);
+  if (e && block_id) e->cache_invalidate(block_id);
+}
+
+void tpudfs_dataplane_stats(int64_t h, uint64_t out[6]) {
   Engine* e = get_engine(h);
   if (e) e->stats(out);
-  else out[0] = out[1] = out[2] = out[3] = 0;
+  else for (int i = 0; i < 6; i++) out[i] = 0;
 }
 
 int64_t tpudfs_dataplane_stop(int64_t h) {

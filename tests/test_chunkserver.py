@@ -183,7 +183,7 @@ def _corrupt_on_disk(cs: ChunkServer, block_id: str, byte_index: int = 10):
     raw = bytearray(path.read_bytes())
     raw[byte_index] ^= 0xFF
     path.write_bytes(bytes(raw))
-    cs.cache.invalidate(block_id)
+    cs.invalidate_cached(block_id)
 
 
 async def test_full_read_corruption_recovers_from_replica(cluster, tmp_path):
@@ -353,7 +353,7 @@ async def test_truncated_sidecar_is_corruption_not_crash(cluster, tmp_path):
         # Truncate the sidecar to 10 bytes — shorter than its header.
         meta = cs[0].store.block_path("blk").with_name("blk.meta")
         meta.write_bytes(meta.read_bytes()[:10])
-        cs[0].cache.invalidate("blk")
+        cs[0].invalidate_cached("blk")
         # Scrub must treat it as corruption (not abort) and heal from replica.
         corrupted = await cs[0].scrub_once()
         assert corrupted == ["blk"]

@@ -206,7 +206,7 @@ async def test_short_circuit_corruption_falls_back_and_detects(tmp_path):
         raw = bytearray(path.read_bytes())
         raw[100] ^= 0xFF
         path.write_bytes(bytes(raw))
-        victim.cache.invalidate(bid)
+        victim.invalidate_cached(bid)
         assert await client.get_file("/sc/bad.bin") == data
     finally:
         await c.stop()

@@ -89,7 +89,7 @@ async def test_ec_migration_end_to_end(tmp_path):
         addr = victim["locations"][-1]  # a parity or data shard
         cs = next(x for x in c.chunkservers if x.address == addr)
         cs.store.delete(victim["block_id"])
-        cs.cache.invalidate(victim["block_id"])
+        cs.invalidate_cached(victim["block_id"])
         assert await client.get_file("/cold/a.bin") == data
     finally:
         await c.stop()

@@ -162,7 +162,7 @@ async def test_hbm_reader_detects_tamper(tmp_path):
                 raw = bytearray(cs.store.read(bid))
                 raw[100] ^= 0xFF
                 cs.store.write(bid, bytes(raw))
-                cs.cache.invalidate(bid)
+                cs.invalidate_cached(bid)
         reader = HbmReader(client, jax.devices())
         with pytest.raises(DfsError) as ei:
             await reader.read_file_to_device_blocks("/t/bad")
@@ -392,7 +392,7 @@ async def test_hbm_reader_lazy_confirm_detects_tamper(tmp_path):
                 raw = bytearray(cs.store.read(bid))
                 raw[4000] ^= 0x10
                 cs.store.write(bid, bytes(raw))
-                cs.cache.invalidate(bid)
+                cs.invalidate_cached(bid)
         reader = HbmReader(client, jax.devices())
         blocks = await reader.read_file_to_device_blocks("/t/lazybad", verify="lazy")
         with pytest.raises(DfsError) as ei:
@@ -420,7 +420,7 @@ async def test_hbm_reader_lazy_tail_block_raises_eagerly(tmp_path):
                 raw = bytearray(cs.store.read(bid))
                 raw[-1] ^= 0x01
                 cs.store.write(bid, bytes(raw))
-                cs.cache.invalidate(bid)
+                cs.invalidate_cached(bid)
         with pytest.raises(DfsError):
             await reader.read_file_to_device_blocks("/t/tail", verify="lazy")
     finally:
@@ -566,7 +566,7 @@ async def test_hbm_reader_ec_degraded_detects_corrupt_shard(tmp_path):
                 raw = bytearray(cs.store.read(bid))
                 raw[10] ^= 0xFF
                 cs.store.write(bid, bytes(raw))
-                cs.cache.invalidate(bid)
+                cs.invalidate_cached(bid)
                 victims += 1
         assert victims == 1
         reader = HbmReader(client, jax.devices())
@@ -593,7 +593,7 @@ async def _corrupt_first_replica(c, client, path):
             raw = bytearray(p.read_bytes())
             raw[42] ^= 0xFF
             p.write_bytes(bytes(raw))
-            cs.cache.invalidate(bid)
+            cs.invalidate_cached(bid)
             return
     raise AssertionError("first replica holder not found")
 

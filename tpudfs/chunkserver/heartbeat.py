@@ -82,8 +82,10 @@ class HeartbeatLoop:
         else:
             masters = self.static_masters or self.cs.master_addrs
         self.cs.master_addrs = list(masters)
-        # Native data-plane findings join the same report/recovery pipeline.
+        # Native data-plane findings join the same report/recovery pipeline,
+        # and blockport-learned fencing terms flow back to the Python plane.
         self.cs.poll_native_bad_blocks()
+        self.cs.sync_native_terms()
         stats = await asyncio.to_thread(self.cs.store.stats)
         # Snapshot (don't drain) bad blocks: they are only cleared once at
         # least one master has actually received the report.
@@ -159,7 +161,7 @@ class HeartbeatLoop:
             err = None if moved else f"block {block_id} not in hot tier"
         elif ctype == "DELETE":
             await asyncio.to_thread(self.cs.store.delete, block_id)
-            self.cs.cache.invalidate(block_id)
+            self.cs.invalidate_cached(block_id)
             err = None
         else:
             err = f"unknown command type {ctype!r}"

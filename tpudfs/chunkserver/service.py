@@ -344,6 +344,7 @@ class ChunkServer:
                     str(self.store.hot_dir).encode(),
                     str(self.store.cold_dir or "").encode(),
                     self.store.chunk_size, 0,
+                    self.cache.capacity,
                 )
                 if handle >= 0:
                     self._native_dp = handle
@@ -435,6 +436,53 @@ class ChunkServer:
                     self._native_dp, shard.encode(),
                     self.known_terms.get(shard, 0),
                 )
+
+    def invalidate_cached(self, block_id: str) -> None:
+        """Drop a block from BOTH read caches — the Python service LRU and
+        the native engine's (which can't see Python-side writes, deletes,
+        or recovery publishes)."""
+        self.cache.invalidate(block_id)
+        if self._native_dp is not None:
+            lib = native.get_lib()
+            if lib is not None:
+                lib.tpudfs_dataplane_invalidate(
+                    self._native_dp, block_id.encode()
+                )
+
+    def sync_native_terms(self) -> None:
+        """Drain request-learned terms out of the native engine into
+        ``known_terms`` so the gRPC/Python fencing plane converges with the
+        blockport plane (without this, a deposed master's stale-term write
+        arriving on the Python plane would still be accepted until the
+        next master heartbeat taught Python the new term)."""
+        if self._native_dp is None:
+            return
+        lib = native.get_lib()
+        if lib is None:
+            return
+        import ctypes
+
+        buf = ctypes.create_string_buffer(65536)
+        n = lib.tpudfs_dataplane_take_terms(self._native_dp, buf, len(buf))
+        if n < 0:
+            # Dump larger than the buffer: -n is the needed size (terms
+            # only grow, so skipping instead of retrying would silently
+            # stop term sync forever on large shard sets).
+            buf = ctypes.create_string_buffer(-n)
+            n = lib.tpudfs_dataplane_take_terms(self._native_dp, buf,
+                                                len(buf))
+        if n <= 0:
+            return
+        for line in buf.raw[:n].decode().split("\n"):
+            if not line:
+                continue
+            shard, _, term = line.partition("\t")
+            try:
+                t = int(term)
+            except ValueError:
+                continue
+            if t > self.known_terms.get(shard, 0):
+                self.known_terms[shard] = t
 
     def poll_native_bad_blocks(self) -> None:
         """Drain the native engine's corrupt-read findings into the same
@@ -537,7 +585,7 @@ class ChunkServer:
             if forward_task is not None:
                 forward_task.cancel()
             raise
-        self.cache.invalidate(block_id)
+        self.invalidate_cached(block_id)
 
         replicas_written = 0 if local_err else 1
         if forward_task is not None:
@@ -646,16 +694,18 @@ class ChunkServer:
 
     def data_plane_stats(self) -> dict:
         """Native engine counters (zeros when it isn't running)."""
-        out = {"writes": 0, "reads": 0, "forwards": 0, "errors": 0}
+        out = {"writes": 0, "reads": 0, "forwards": 0, "errors": 0,
+               "cache_hits": 0, "cache_misses": 0}
         if self._native_dp is not None:
             lib = native.get_lib()
             if lib is not None:
                 import ctypes
 
-                vals = (ctypes.c_uint64 * 4)()
+                vals = (ctypes.c_uint64 * 6)()
                 lib.tpudfs_dataplane_stats(self._native_dp, vals)
                 out = {"writes": vals[0], "reads": vals[1],
-                       "forwards": vals[2], "errors": vals[3]}
+                       "forwards": vals[2], "errors": vals[3],
+                       "cache_hits": vals[4], "cache_misses": vals[5]}
         return out
 
     def _block_sig(self, block_id: str) -> tuple | None:
@@ -675,8 +725,10 @@ class ChunkServer:
             "used_space_bytes": stats["used_space"],
             "available_space_bytes": stats["available_space"],
             "chunk_count": stats["chunk_count"],
-            "cache_hits": self.cache.hits,
-            "cache_misses": self.cache.misses,
+            # Combined across both serving planes (Python LRU + the native
+            # engine's block cache).
+            "cache_hits": self.cache.hits + dp["cache_hits"],
+            "cache_misses": self.cache.misses + dp["cache_misses"],
             "known_master_term": self.known_term,
             "pending_bad_blocks": len(self.pending_bad_blocks),
             "dataplane_writes_total": dp["writes"],
@@ -687,12 +739,15 @@ class ChunkServer:
 
     async def rpc_stats(self, _req: dict) -> dict:
         stats = await asyncio.to_thread(self.store.stats)
+        dp = self.data_plane_stats()
         stats.update(
             address=self.address,
             rack_id=self.rack_id,
             known_term=self.known_term,
-            cache_hits=self.cache.hits,
-            cache_misses=self.cache.misses,
+            # Combined across both serving planes (Python LRU + the native
+            # engine's block cache).
+            cache_hits=self.cache.hits + dp["cache_hits"],
+            cache_misses=self.cache.misses + dp["cache_misses"],
         )
         return stats
 
@@ -738,7 +793,7 @@ class ChunkServer:
             except OSError as e:
                 logger.error("failed to write recovered block: %s", e)
                 continue
-            self.cache.invalidate(block_id)
+            self.invalidate_cached(block_id)
             self.pending_bad_blocks.discard(block_id)
             logger.info("recovered block %s from %s", block_id, loc)
             return None
@@ -815,7 +870,7 @@ class ChunkServer:
                 try:
                     await asyncio.to_thread(self.store.write, new_block_id,
                                             shards[i])
-                    self.cache.invalidate(new_block_id)
+                    self.invalidate_cached(new_block_id)
                     return None
                 except OSError as e:
                     return f"local shard write failed: {e}"
@@ -961,7 +1016,7 @@ class ChunkServer:
         except Exception as e:  # ErasureError or shape errors
             return f"RS reconstruct error: {e}"
         await asyncio.to_thread(self.store.write, block_id, full[shard_index])
-        self.cache.invalidate(block_id)
+        self.invalidate_cached(block_id)
         logger.info(
             "EC reconstruct: wrote shard %d of block %s (%d bytes)",
             shard_index, block_id, len(full[shard_index]),

@@ -148,12 +148,12 @@ def get_lib() -> ctypes.CDLL | None:
         # symbols and call them with mismatched arguments.
         lib.tpudfs_dataplane_abi.restype = ctypes.c_int64
         lib.tpudfs_dataplane_abi.argtypes = []
-        if lib.tpudfs_dataplane_abi() != 2:
+        if lib.tpudfs_dataplane_abi() != 3:
             raise AttributeError("dataplane ABI mismatch")
         lib.tpudfs_dataplane_start.restype = ctypes.c_int64
         lib.tpudfs_dataplane_start.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_uint32, ctypes.c_uint16,
+            ctypes.c_uint32, ctypes.c_uint16, ctypes.c_uint64,
         ]
         lib.tpudfs_dataplane_port.restype = ctypes.c_int32
         lib.tpudfs_dataplane_port.argtypes = [ctypes.c_int64]
@@ -167,6 +167,14 @@ def get_lib() -> ctypes.CDLL | None:
         lib.tpudfs_dataplane_take_bad.restype = ctypes.c_int64
         lib.tpudfs_dataplane_take_bad.argtypes = [
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.tpudfs_dataplane_take_terms.restype = ctypes.c_int64
+        lib.tpudfs_dataplane_take_terms.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.tpudfs_dataplane_invalidate.restype = None
+        lib.tpudfs_dataplane_invalidate.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p,
         ]
         lib.tpudfs_dataplane_stats.restype = None
         lib.tpudfs_dataplane_stats.argtypes = [ctypes.c_int64,
