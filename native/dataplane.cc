@@ -510,9 +510,25 @@ class Engine {
     return it->second->second;
   }
 
-  void cache_put(const std::string& id, CacheData data) {
+  // Invalidation generation for the insert-vs-invalidate race: a reader
+  // captures cache_gen(id) BEFORE its pread; cache_put only inserts if no
+  // invalidation landed in between (checked under cache_mu_, so an
+  // invalidate can never slip between the check and the insert — the
+  // re-stat signature alone leaves a window between its stat and the
+  // put).
+  uint64_t cache_gen(const std::string& id) {
+    if (!cache_cap_) return 0;
+    std::lock_guard<std::mutex> g(cache_mu_);
+    auto it = inval_gen_.find(id);
+    return it == inval_gen_.end() ? 0 : it->second;
+  }
+
+  void cache_put(const std::string& id, CacheData data, uint64_t gen) {
     if (!cache_cap_) return;
     std::lock_guard<std::mutex> g(cache_mu_);
+    auto git = inval_gen_.find(id);
+    if ((git == inval_gen_.end() ? 0 : git->second) != gen)
+      return;  // a write/invalidate raced the read: don't pin old bytes
     auto it = cache_map_.find(id);
     if (it != cache_map_.end()) {
       it->second->second = std::move(data);
@@ -530,6 +546,11 @@ class Engine {
   void cache_invalidate(const std::string& id) {
     if (!cache_cap_) return;
     std::lock_guard<std::mutex> g(cache_mu_);
+    // Bound the generation map: clearing only LOWERS generations, which
+    // makes concurrent readers' cache_put skip (conservative, never
+    // stale).
+    if (inval_gen_.size() > 65536) inval_gen_.clear();
+    ++inval_gen_[id];
     auto it = cache_map_.find(id);
     if (it != cache_map_.end()) {
       cache_list_.erase(it->second);
@@ -953,6 +974,7 @@ class Engine {
       send_frame(fd, w.out, cached->data() + offset, want);
       return;
     }
+    const uint64_t gen = cache_gen(block_id);  // before the pread
     std::string data_path = hot_ + "/" + block_id;
     struct stat st;
     if (::stat(data_path.c_str(), &st) != 0) {
@@ -1018,7 +1040,7 @@ class Engine {
       struct stat st2;
       if (::stat(data_path.c_str(), &st2) == 0 && same_sig(st, st2)) {
         keep = std::make_shared<std::vector<uint8_t>>(std::move(buf));
-        cache_put(block_id, keep);
+        cache_put(block_id, keep, gen);
       }
     }
     Writer w;
@@ -1069,6 +1091,7 @@ class Engine {
         sizes.push_back(static_cast<int64_t>(cached->size()));
         continue;
       }
+      const uint64_t gen = cache_gen(block_id);  // before the pread
       std::string data_path = hot_ + "/" + block_id;
       struct stat st;
       if (::stat(data_path.c_str(), &st) != 0) {
@@ -1107,8 +1130,10 @@ class Engine {
       sizes.push_back(static_cast<int64_t>(total));
       struct stat st2;  // skip caching when a publish raced the read
       if (::stat(data_path.c_str(), &st2) == 0 && same_sig(st, st2))
-        cache_put(block_id, std::make_shared<std::vector<uint8_t>>(
-                                payload.begin() + base, payload.end()));
+        cache_put(block_id,
+                  std::make_shared<std::vector<uint8_t>>(
+                      payload.begin() + base, payload.end()),
+                  gen);
     }
     Writer w;
     w.map_head(3);
@@ -1152,6 +1177,7 @@ class Engine {
   std::list<std::pair<std::string, CacheData>> cache_list_;  // front = MRU
   std::map<std::string, std::list<std::pair<std::string, CacheData>>::iterator>
       cache_map_;
+  std::map<std::string, uint64_t> inval_gen_;  // see cache_gen/cache_put
   std::atomic<uint64_t> cache_hits_{0}, cache_misses_{0};
 };
 

@@ -268,3 +268,39 @@ async def test_meta_coalescing_sequential_gets(tmp_path):
         assert await client.get_file_info("/mc/nope") is None
     finally:
         await c.stop()
+
+
+async def test_blind_resend_create_recovers_with_fresh_session(tmp_path):
+    """A CreateFile resend resolved via the ALREADY_EXISTS heuristic never
+    learns the surviving file's write token; the strict write-session fence
+    then rejects its token-less writes at apply time. The client must
+    recover by re-creating with overwrite (minting a fresh session) — the
+    pre-fence last-writer-wins outcome — instead of failing the put
+    (round-3 advisor finding)."""
+    c, client = await _ready_cluster(tmp_path)
+    try:
+        # Another session's tokened file occupies the path.
+        await client.create_file("/br/f", b"other-session")
+
+        # Simulate "our resent create collapsed into ALREADY_EXISTS": the
+        # first CreateFile returns retry_resolved with no token, exactly
+        # what _execute produces after an indeterminate resend.
+        real_execute = client._execute
+        calls = {"n": 0}
+
+        async def fake_execute(method, req, **kw):
+            if method == "CreateFile" and calls["n"] == 0:
+                calls["n"] += 1
+                return ({"success": True, "retry_resolved": True},
+                        list(c.masters)[0])
+            return await real_execute(method, req, **kw)
+
+        client._execute = fake_execute
+        await client.create_file("/br/f", b"mine-wins")
+        client._execute = real_execute
+
+        assert await client.read_file_range("/br/f", 0, 1 << 20) == b"mine-wins"
+        assert calls["n"] == 1  # recovery went through the overwrite path
+    finally:
+        await client.close()
+        await c.stop()

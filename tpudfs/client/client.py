@@ -360,6 +360,13 @@ class Client:
         # alloc_error, retried resends, or pre-fusion masters — the
         # per-block AllocateBlock loop covers those.
         first_alloc = resp if resp.get("block") else None
+        # A create that resolved via the ALREADY_EXISTS retry heuristic
+        # never learned the surviving file's write token (it cannot know
+        # whether that file is its own first attempt), so the strict
+        # write-session fence will reject its token-less block writes at
+        # apply time — recoverable below, not a hard failure.
+        blind_resend = bool(resp.get("retry_resolved")) \
+            and not resp.get("write_token")
         try:
             await self._write_blocks_and_complete(
                 path, data, master, k, m, etag, attrs,
@@ -369,6 +376,33 @@ class Client:
         except IndeterminateError:
             raise
         except DfsError as e:
+            if blind_resend and "stale write session" in str(e):
+                # Mint a fresh session with an atomic replace and retry
+                # once: our payload wins exactly as it would have before
+                # the fence existed (last-writer-wins create), instead of
+                # the whole put deterministically failing with token "".
+                # ANY failure here is indeterminate too — the path is
+                # already visible with another session's (or partial)
+                # content, so "nothing applied" would be a lie.
+                try:
+                    resp, master = await self._execute("CreateFile", {
+                        "path": path, "ec_data_shards": k,
+                        "ec_parity_shards": m,
+                        "overwrite": True, "first_block": True,
+                    }, path=path)
+                    await self._write_blocks_and_complete(
+                        path, data, master, k, m, etag, attrs,
+                        first_alloc=resp if resp.get("block") else None,
+                        token=str(resp.get("write_token") or ""),
+                    )
+                    return
+                except IndeterminateError:
+                    raise
+                except DfsError as e2:
+                    raise IndeterminateError(
+                        f"write failed after namespace create for "
+                        f"{path}: {e2}"
+                    ) from e2
             # CreateFile already mutated the namespace: the path is visible
             # (empty/incomplete), so this failure is NOT "nothing applied".
             raise IndeterminateError(

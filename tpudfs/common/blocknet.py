@@ -232,7 +232,12 @@ class BlockConnPool:
         if tls is not None:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
             ctx.load_verify_locations(tls.ca_path)
-            ctx.check_hostname = False
+            # Hostname verification stays ON (the PROTOCOL_TLS_CLIENT
+            # default): _call_blockport passes the peer's host as
+            # server_hostname, so the bulk data plane validates the target
+            # name against the cert SANs exactly like the gRPC plane's
+            # secure_channel — without it, any single CA-issued cert could
+            # impersonate every chunkserver on the data side channel.
             if tls.cert_path and tls.key_path:
                 ctx.load_cert_chain(tls.cert_path, tls.key_path)
             self._ssl_ctx = ctx
@@ -351,7 +356,8 @@ class BlockConnPool:
         if conn is None:
             host, port = hostport.rsplit(":", 1)
             conn = await asyncio.open_connection(
-                host, int(port), ssl=self._ssl_ctx
+                host, int(port), ssl=self._ssl_ctx,
+                server_hostname=host if self._ssl_ctx is not None else None,
             )
             sock = conn[1].get_extra_info("socket")
             if sock is not None:
