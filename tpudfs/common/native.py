@@ -148,12 +148,16 @@ def get_lib() -> ctypes.CDLL | None:
         # symbols and call them with mismatched arguments.
         lib.tpudfs_dataplane_abi.restype = ctypes.c_int64
         lib.tpudfs_dataplane_abi.argtypes = []
-        if lib.tpudfs_dataplane_abi() != 3:
+        if lib.tpudfs_dataplane_abi() != 4:
             raise AttributeError("dataplane ABI mismatch")
         lib.tpudfs_dataplane_start.restype = ctypes.c_int64
         lib.tpudfs_dataplane_start.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_uint32, ctypes.c_uint16, ctypes.c_uint64,
+            # TLS material: server cert/key, client-CA (mTLS), and the
+            # outbound chain-forward CA + cert/key. Empty = plaintext.
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
         ]
         lib.tpudfs_dataplane_port.restype = ctypes.c_int32
         lib.tpudfs_dataplane_port.argtypes = [ctypes.c_int64]
