@@ -246,7 +246,13 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     from tpudfs.tpu.hbm_reader import HbmReader
 
     rpc = RpcClient()
-    client = Client([maddr], rpc_client=rpc, block_size=BLOCK_MB << 20)
+    # etag_mode="crc64": hardware CRC-64/NVME ETags instead of md5 on the
+    # put path (round-3 verdict item 4 — md5 at ~2 ms/MiB was ~30% of the
+    # single-core protocol budget; the S3 gateway still does md5-ETag
+    # conformance, it passes explicit etags). Recorded in the JSON as
+    # etag_mode so cross-round write numbers are read with this in mind.
+    client = Client([maddr], rpc_client=rpc, block_size=BLOCK_MB << 20,
+                    etag_mode="crc64")
 
     # Wait until the master has left safe mode and all 3 chunkservers are
     # registered (first placement needs a full replication set).
@@ -519,6 +525,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "cs_cache_hit_rate": round(
             cache_hits / max(1, cache_hits + cache_misses), 3
         ),
+        "etag_mode": client.etag_mode,
         "platform": jax.devices()[0].platform,
     }
 

@@ -304,3 +304,36 @@ async def test_blind_resend_create_recovers_with_fresh_session(tmp_path):
     finally:
         await client.close()
         await c.stop()
+
+
+async def test_etag_modes(tmp_path):
+    """Default puts carry md5 ETags (reference mod.rs:430 / S3
+    conformance); etag_mode="crc64" swaps in hardware CRC-64/NVME with a
+    distinguishing suffix, and explicit etag overrides still win (the S3
+    gateway's path)."""
+    import hashlib
+
+    from tpudfs.common.checksum import crc64nvme
+
+    c, client = await _ready_cluster(tmp_path)
+    try:
+        data = _rand(300_000, 21)
+        await client.create_file("/et/md5", data)
+        meta = await client.get_file_info("/et/md5")
+        assert meta["etag_md5"] == hashlib.md5(data).hexdigest()
+
+        fast = Client(list(c.masters), rpc_client=c.client,
+                      block_size=256 * 1024, etag_mode="crc64")
+        await fast.create_file("/et/crc", data)
+        meta = await fast.get_file_info("/et/crc")
+        assert meta["etag_md5"] == f"{crc64nvme(data):016x}-crc64"
+        # Content round-trips identically regardless of ETag mode.
+        assert await fast.read_file_range("/et/crc", 0, len(data)) == data
+
+        await fast.create_file("/et/explicit", data, etag="gateway-etag")
+        meta = await fast.get_file_info("/et/explicit")
+        assert meta["etag_md5"] == "gateway-etag"
+    finally:
+        await fast.block_pool.close()
+        await client.close()
+        await c.stop()

@@ -32,6 +32,9 @@ def build_parser() -> argparse.ArgumentParser:
     add_tls_args(p)
     p.add_argument("--hedge-delay", type=float, default=None,
                    help="enable hedged reads with this delay in seconds")
+    p.add_argument("--etag-mode", choices=["md5", "crc64"], default="md5",
+                   help="put-path ETag: md5 (S3 conformance) or hardware "
+                        "CRC-64/NVME (~50x cheaper, '-crc64' suffix)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("put", help="upload a local file")
@@ -106,7 +109,8 @@ def make_client(args) -> Client:
         sys.exit(2)
     _stls, ctls = tls_from_args(args)
     return Client(masters or None, configs or None,
-                  hedge_delay=args.hedge_delay, tls=ctls)
+                  hedge_delay=args.hedge_delay, tls=ctls,
+                  etag_mode=getattr(args, "etag_mode", "md5"))
 
 
 def print_stats(label: str, latencies: list[float], total_bytes: int,

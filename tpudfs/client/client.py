@@ -81,6 +81,7 @@ class Client:
         rpc_timeout: float = 30.0,
         host_aliases: dict[str, str] | None = None,
         local_reads: bool | None = None,
+        etag_mode: str = "md5",
     ):
         if not master_addrs and not config_addrs:
             raise ValueError("need master_addrs or config_addrs")
@@ -92,6 +93,12 @@ class Client:
         self.max_retries = max_retries
         self.initial_backoff = initial_backoff
         self.rpc_timeout = rpc_timeout
+        #: "md5" (default — S3 md5-ETag conformance, reference mod.rs:430)
+        #: or "crc64" (hardware CRC-64/NVME, ~50x cheaper on the put path;
+        #: ETags then carry a "-crc64" suffix and are NOT content md5s).
+        if etag_mode not in ("md5", "crc64"):
+            raise ValueError(f"etag_mode must be md5|crc64, got {etag_mode!r}")
+        self.etag_mode = etag_mode
         self._owns_rpc = rpc_client is None
         self.rpc = rpc_client or RpcClient(tls=tls)
         self.shard_map: ShardMap | None = None
@@ -367,11 +374,15 @@ class Client:
         # apply time — recoverable below, not a hard failure.
         blind_resend = bool(resp.get("retry_resolved")) \
             and not resp.get("write_token")
+        # One digest task for the whole put — the blind-resend retry below
+        # reuses it instead of re-hashing the payload.
+        etag_task = self._start_etag_task(data) if etag is None else None
         try:
             await self._write_blocks_and_complete(
                 path, data, master, k, m, etag, attrs,
                 first_alloc=first_alloc,
                 token=str(resp.get("write_token") or ""),
+                etag_task=etag_task,
             )
         except IndeterminateError:
             raise
@@ -394,6 +405,7 @@ class Client:
                         path, data, master, k, m, etag, attrs,
                         first_alloc=resp if resp.get("block") else None,
                         token=str(resp.get("write_token") or ""),
+                        etag_task=etag_task,
                     )
                     return
                 except IndeterminateError:
@@ -409,13 +421,36 @@ class Client:
                 f"write failed after namespace create for {path}: {e}"
             ) from e
 
+    def _start_etag_task(self, data: bytes) -> asyncio.Task:
+        """ETag digest computed CONCURRENTLY with the block writes:
+        hashlib releases the GIL, so the digest overlaps the chain-ack
+        waits instead of serializing ~2 ms/MiB of single-core CPU in
+        front of CompleteFile (the reference digests inline, mod.rs:430).
+        The opt-in "crc64" mode swaps md5 for hardware CRC-64/NVME (~50x
+        cheaper; the ETag is then NOT an md5 — callers that need S3
+        md5-ETag conformance keep the default)."""
+        if self.etag_mode == "crc64":
+            from tpudfs.common.checksum import crc64nvme
+
+            fn = lambda: f"{crc64nvme(data):016x}-crc64"  # noqa: E731
+        else:
+            fn = lambda: hashlib.md5(data).hexdigest()  # noqa: E731
+        task = asyncio.create_task(asyncio.to_thread(fn))
+        task.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception()
+        )
+        return task
+
     async def _write_blocks_and_complete(self, path: str, data: bytes,
                                          master: str, k: int, m: int,
                                          etag: str | None,
                                          attrs: dict | None = None,
                                          first_alloc: dict | None = None,
                                          token: str = "",
+                                         etag_task: asyncio.Task | None = None,
                                          ) -> None:
+        if etag is None and etag_task is None:
+            etag_task = self._start_etag_task(data)
         # Stick to the creating master for read-your-writes (mod.rs:256-266).
         sticky = [master] + [a for a in self._masters_for(path) if a != master]
         block_checksums = []
@@ -458,8 +493,7 @@ class Client:
         req = {
             "path": path,
             "size": len(data),
-            "etag_md5": etag if etag is not None
-            else hashlib.md5(data).hexdigest(),
+            "etag_md5": etag if etag is not None else await etag_task,
             "block_checksums": block_checksums,
             "token": token,
         }
