@@ -1,0 +1,276 @@
+#!/usr/bin/env python3
+"""Live-tier dynamic Raft membership change against REAL OS processes.
+
+Reference parity: test_scripts/dynamic_membership_test.sh (374 lines: add a
+master to a running cluster, wait for catch-up + joint->final config,
+remove the old leader, verify no write loss) and cluster_membership_test.sh.
+The model tier proves joint consensus + learner catch-up in isolation
+(tests/test_raft_core.py); THIS tier proves the whole operational flow:
+
+  t0   single-shard-ha cluster up (3 masters + 5 chunkservers)
+  t1   multi-block payload written, md5 recorded; background workload on
+  t2   spawn a FOURTH master process (empty data dir, --peers = the three
+       incumbents) — it boots as a non-member; prevote keeps it harmless
+  t3   `cluster add-server` via the client CLI surface -> learner catch-up
+       (InstallSnapshot/appends) -> joint -> final; poll /raft/state until
+       the new node is a VOTER and the config is non-joint
+  t4   the config server's shard map now lists 4 peers (the leader's
+       ShardHeartbeat reports its voter group; reconciliation is what a
+       fresh client discovers through)
+  t5   `cluster remove-server` on the CURRENT LEADER -> joint -> final
+       without it; a new leader emerges among the survivors; the removed
+       process is then SIGTERMed (kill AFTER removal — the group must stay
+       available throughout)
+  t6   workload drains; WGL linearizability check over its history
+  t7   a FRESH client that knows ONLY the config server reads the payload
+       back md5-intact and writes new data — end-to-end proof that
+       discovery, quorum, and data survived the membership change
+
+Run directly or via scripts/run_all_tests.py (the CI live tier).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+WORKLOAD_CLIENTS = 2
+WORKLOAD_OPS = 40
+PAYLOAD_BLOCKS = 12  # x 256 KiB = 3 MiB multi-block file
+
+
+def _ops_port(addr: str) -> int:
+    return int(addr.rsplit(":", 1)[1]) + 1000
+
+
+def raft_state(addr: str) -> dict | None:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{_ops_port(addr)}/raft/state", timeout=2.0
+        ) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
+
+
+def find_leader(addrs: list[str], timeout: float = 30.0) -> str:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for addr in addrs:
+            st = raft_state(addr)
+            if st and st.get("role") == "leader":
+                return addr
+        time.sleep(0.3)
+    raise SystemExit(f"no leader found among {addrs}")
+
+
+def wait_config(addrs: list[str], predicate, what: str,
+                timeout: float = 90.0) -> dict:
+    """Poll /raft/state across ``addrs`` until the LEADER's config
+    satisfies ``predicate`` (voters list, joint flag)."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        for addr in addrs:
+            st = raft_state(addr)
+            if not st or st.get("role") != "leader":
+                continue
+            last = st.get("config") or {}
+            if predicate(last):
+                return last
+        time.sleep(0.5)
+    raise SystemExit(f"timed out waiting for {what}; last config: {last}")
+
+
+async def drive(eps: dict, root: pathlib.Path) -> None:
+    from tpudfs.client.checker import check_linearizability
+    from tpudfs.client.client import Client
+    from tpudfs.client.workload import WorkloadConfig, dump_history, run_workload
+    from tpudfs.common.rpc import RpcClient
+    from tpudfs.testing import procs as procutil
+
+    sid = sorted(eps["shards"])[0]
+    masters = list(eps["shards"][sid])
+    cfg = eps["config_server"]
+
+    client = Client(masters, config_addrs=[cfg], block_size=256 * 1024,
+                    rpc_timeout=10.0)
+    deadline = time.time() + 90
+    while True:
+        try:
+            await client.create_file("/m/probe", b"x")
+            await client.delete_file("/m/probe")
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            await asyncio.sleep(0.5)
+
+    # t1: payload + background workload.
+    payload = os.urandom(PAYLOAD_BLOCKS * 256 * 1024)
+    await client.create_file("/m/member-payload", payload)
+    payload_md5 = hashlib.md5(payload).hexdigest()
+    print(f"t1: payload written ({len(payload)} bytes, md5 {payload_md5})")
+    wl_client = Client(masters, config_addrs=[cfg], rpc_timeout=3.0,
+                      max_retries=8)
+    cfg_wl = WorkloadConfig(clients=WORKLOAD_CLIENTS,
+                            ops_per_client=WORKLOAD_OPS, keys=6, seed=7,
+                            rename_pod_size=3)
+    workload = asyncio.create_task(run_workload(wl_client, cfg_wl))
+
+    # t2: spawn the joiner with an EMPTY data dir; it must receive the
+    # whole state through the leader's snapshot/appends.
+    new_port = procutil.free_port()
+    new_addr = f"127.0.0.1:{new_port}"
+    logdir = root / "logs"
+    joiner_procs: list[subprocess.Popen] = []
+    procutil.spawn(joiner_procs, "m-join", logdir, "tpudfs.master",
+                   "--port", str(new_port),
+                   "--data-dir", str(root / "m-join"),
+                   "--peers", ",".join(masters), "--shard-id", sid,
+                   "--config-servers", cfg,
+                   env={"JAX_PLATFORMS": "cpu"})
+    procutil.wait_ready(logdir, "m-join")
+    print(f"t2: joiner master up at {new_addr} (empty data dir)")
+
+    try:
+        # t3: add-server through the SAME surface the CLI uses.
+        leader0 = find_leader(masters)
+        await client.cluster_add_server(new_addr)
+        final = wait_config(
+            masters + [new_addr],
+            lambda c: new_addr in (c.get("voters") or []) and not c.get("joint"),
+            f"{new_addr} to become a voter (learner catch-up -> joint -> final)",
+        )
+        print(f"t3: joiner is a VOTER; config voters={sorted(final['voters'])}")
+        # The joiner really replicated the namespace: its own /raft/state
+        # shows applied progress.
+        st = raft_state(new_addr)
+        assert st and st["last_applied"] > 0, f"joiner never applied: {st}"
+
+        # t4: client-visible discovery through the config server.
+        rpc = RpcClient()
+        deadline = time.time() + 60
+        while True:
+            m = await rpc.call(cfg, "ConfigService", "FetchShardMap", {},
+                               timeout=5.0)
+            peers = m["shard_map"]["peers"].get(sid) or []
+            if new_addr in peers:
+                break
+            if time.time() > deadline:
+                raise SystemExit(
+                    f"shard map never learned {new_addr}; peers={peers}")
+            await asyncio.sleep(1.0)
+        print(f"t4: shard map reconciled; peers={sorted(peers)}")
+
+        # t5: remove the CURRENT leader (the hardest member to remove —
+        # it must commit itself out via joint consensus, then step down).
+        await client.cluster_remove_server(leader0)
+        survivors = [a for a in masters + [new_addr] if a != leader0]
+        final = wait_config(
+            survivors,
+            lambda c: leader0 not in (c.get("voters") or [])
+            and not c.get("joint"),
+            f"{leader0} removed from the voter set",
+        )
+        new_leader = find_leader(survivors)
+        print(f"t5: old leader {leader0} removed; new leader {new_leader}; "
+              f"voters={sorted(final['voters'])}")
+        # Only NOW is it safe to kill the removed process.
+        old = eps["procs"][
+            next(n for n, v in eps["procs"].items() if v["addr"] == leader0)
+        ]
+        os.kill(old["pid"], signal.SIGTERM)
+        print(f"t5: SIGTERMed removed master pid {old['pid']}")
+
+        # t6: drain + WGL-check the concurrent workload.
+        entries = await workload
+        ok_ops = sum(1 for e in entries if e.get("return_ts") is not None)
+        print(f"t6: workload done: {len(entries)} ops ({ok_ops} returned)")
+        hist_path = tempfile.mkstemp(suffix=".jsonl")[1]
+        dump_history(entries, hist_path)
+        result = check_linearizability(entries, max_states=2_000_000)
+        if not result.linearizable and not result.exhausted:
+            raise SystemExit(
+                f"LINEARIZABILITY VIOLATION across membership change: "
+                f"{result.message}\nhistory: {hist_path}")
+        print(f"t6: history {'linearizable' if result.linearizable else 'UNKNOWN (budget)'}"
+              f" ({hist_path})")
+
+        # t7: a fresh client knowing ONLY the config server must discover
+        # the post-change group and find every byte intact.
+        fresh = Client(config_addrs=[cfg], block_size=256 * 1024,
+                       rpc_timeout=10.0)
+        back = await fresh.get_file("/m/member-payload")
+        got = hashlib.md5(back).hexdigest()
+        assert got == payload_md5, f"payload md5 {got} != {payload_md5}"
+        await fresh.create_file("/m/post-change", b"alive", overwrite=True)
+        assert await fresh.get_file("/m/post-change") == b"alive"
+        print("t7: fresh config-discovered client verified payload md5 + "
+              "wrote post-change data")
+        await fresh.close()
+        await rpc.close()
+    finally:
+        procutil.terminate_all(joiner_procs)
+    await client.close()
+    await wl_client.close()
+
+
+def main() -> None:
+    for attempt in (1, 2):
+        try:
+            _run_once()
+            return
+        except SystemExit as e:
+            if attempt == 2 or "failed to start" not in str(e):
+                raise
+            print(f"cluster start failed ({e}); retrying once")
+
+
+def _run_once() -> None:
+    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
+    with tempfile.TemporaryDirectory(prefix="tpudfs-member-") as tmp:
+        ready = pathlib.Path(tmp) / "endpoints.json"
+        launcher = subprocess.Popen(
+            [sys.executable, "scripts/start_cluster.py",
+             "--topology", str(REPO / "deploy/topologies/single-shard-ha.json"),
+             "--data-dir", f"{tmp}/cluster",
+             "--s3-port", "0", "--ready-file", str(ready)],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            deadline = time.time() + 120
+            while not ready.exists():
+                if launcher.poll() is not None:
+                    out = launcher.stdout.read() if launcher.stdout else ""
+                    raise SystemExit(f"cluster failed to start:\n{out}")
+                if time.time() > deadline:
+                    raise SystemExit("cluster start timed out")
+                time.sleep(0.5)
+            eps = json.loads(ready.read_text())
+            print(f"membership tier against {eps['topology']}")
+            asyncio.run(drive(eps, pathlib.Path(tmp) / "cluster"))
+            print("MEMBERSHIP TIER PASSED")
+        finally:
+            launcher.send_signal(signal.SIGINT)
+            try:
+                launcher.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                launcher.kill()
+
+
+if __name__ == "__main__":
+    main()

@@ -150,6 +150,11 @@ def main() -> None:
         # linearizability_test.sh).
         run("live chaos tier",
             [sys.executable, "-u", "scripts/chaos_live.py", args.topology])
+        # Add a 4th master to a RUNNING group under workload, remove the
+        # old leader, verify discovery + no write loss (reference
+        # dynamic_membership_test.sh / cluster_membership_test.sh).
+        run("live membership tier",
+            [sys.executable, "-u", "scripts/membership_live.py"])
     print("\nALL TIERS PASSED")
 
 

@@ -316,6 +316,7 @@ async def test_etag_modes(tmp_path):
     from tpudfs.common.checksum import crc64nvme
 
     c, client = await _ready_cluster(tmp_path)
+    fast = None
     try:
         data = _rand(300_000, 21)
         await client.create_file("/et/md5", data)
@@ -334,6 +335,7 @@ async def test_etag_modes(tmp_path):
         meta = await fast.get_file_info("/et/explicit")
         assert meta["etag_md5"] == "gateway-etag"
     finally:
-        await fast.block_pool.close()
+        if fast is not None:
+            await fast.block_pool.close()
         await client.close()
         await c.stop()

@@ -143,6 +143,71 @@ async def test_shard_heartbeat_updates_registry(tmp_path):
         await c.stop()
 
 
+async def test_shard_heartbeat_reconciles_membership_change(tmp_path):
+    """The shard leader's reported Raft voter set is authoritative for
+    the map's peer routing: a member added by `cluster add-server`
+    becomes client-discoverable via FetchShardMap, and a removed one
+    drops out AND is freed back to spare in the registry (the reference
+    drives this with dynamic_membership_test.sh; here the reconciliation
+    itself)."""
+    c = ConfigCluster(tmp_path)
+    try:
+        await c.start()
+        orig = ["127.0.0.1:701", "127.0.0.1:702", "127.0.0.1:703"]
+        for a in orig:
+            await c.call("RegisterMaster", {"address": a, "shard_id": "s1"})
+        await c.call("AddShard", {"shard_id": "s1", "peers": orig})
+        v0 = (await c.call("FetchShardMap", {}))["shard_map"]["version"]
+
+        # add-server: the joiner registers itself (spare), then the
+        # leader reports a 4-member group.
+        await c.call("RegisterMaster", {"address": "127.0.0.1:704"})
+        grown = orig + ["127.0.0.1:704"]
+        await c.call("ShardHeartbeat", {"shard_id": "s1",
+                                        "address": orig[0],
+                                        "group": grown})
+        m = await c.call("FetchShardMap", {})
+        assert sorted(m["shard_map"]["peers"]["s1"]) == sorted(grown)
+        assert m["shard_map"]["version"] > v0
+
+        # remove-server: the old member leaves the map and the registry
+        # frees it as a spare (reusable by auto-split allocation).
+        shrunk = grown[1:]
+        await c.call("ShardHeartbeat", {"shard_id": "s1",
+                                        "address": shrunk[0],
+                                        "group": shrunk})
+        m = await c.call("FetchShardMap", {})
+        assert sorted(m["shard_map"]["peers"]["s1"]) == sorted(shrunk)
+        leader = c.nodes[c.leader_addr]
+        assert leader.state.masters[orig[0]]["shard_id"] is None
+        assert leader.state.masters["127.0.0.1:704"]["shard_id"] == "s1"
+
+        # Same-group heartbeats don't churn the map version.
+        v1 = m["shard_map"]["version"]
+        await c.call("ShardHeartbeat", {"shard_id": "s1",
+                                        "address": shrunk[0],
+                                        "group": list(reversed(shrunk))})
+        m = await c.call("FetchShardMap", {})
+        assert m["shard_map"]["version"] == v1
+
+        # Term fencing: the current leader reports at term 7; a deposed
+        # leader (partitioned from its quorum, lease not yet expired)
+        # reporting the OLD group at term 5 must NOT regress the map.
+        await c.call("ShardHeartbeat", {"shard_id": "s1",
+                                        "address": shrunk[0],
+                                        "group": shrunk, "term": 7})
+        await c.call("ShardHeartbeat", {"shard_id": "s1",
+                                        "address": orig[0],
+                                        "group": grown, "term": 5})
+        m = await c.call("FetchShardMap", {})
+        assert sorted(m["shard_map"]["peers"]["s1"]) == sorted(shrunk)
+        # The freed member's registry group reset to itself, so it is
+        # genuinely reusable by allocate_group.
+        assert leader.state.masters[orig[0]]["group"] == [orig[0]]
+    finally:
+        await c.stop()
+
+
 async def test_three_node_replication_and_failover(tmp_path):
     c = ConfigCluster(tmp_path, n=3)
     try:

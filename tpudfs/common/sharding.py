@@ -95,6 +95,18 @@ class ShardMap:
         else:
             self._insert_range(f"z-{shard_id}", shard_id)
 
+    def update_peers(self, shard_id: str, peers: list[str]) -> bool:
+        """Replace a shard's Raft-group routing (dynamic-membership
+        reconciliation: the group's leader reports its voter set via
+        ShardHeartbeat) WITHOUT touching range/ring assignment. Returns
+        True when the map changed (version bumped)."""
+        cur = self._peers.get(shard_id)
+        if cur is None or not peers or sorted(cur) == sorted(peers):
+            return False
+        self._peers[shard_id] = list(peers)
+        self.version += 1
+        return True
+
     def remove_shard(self, shard_id: str) -> None:
         if shard_id not in self._peers:
             return

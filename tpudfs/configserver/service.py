@@ -275,6 +275,8 @@ class ConfigServer:
             "address": req.get("address", ""),
             "at_ms": now_ms(),
             "rps_per_prefix": req.get("rps_per_prefix") or {},
+            "group": req.get("group") or [],
+            "term": int(req.get("term") or 0),
         })
         return {"success": True, "shard_map_version": self.state.shard_map.version}
 

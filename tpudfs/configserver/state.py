@@ -29,6 +29,9 @@ class ConfigState:
         self.masters: dict[str, dict] = {}
         #: shard id -> {"last_heartbeat_ms": int, "from": str}
         self.shard_health: dict[str, dict] = {}
+        #: shard id -> highest Raft term whose leader's group report was
+        #: accepted into the map (fences stale deposed-leader reports).
+        self.group_terms: dict[str, int] = {}
 
     # ------------------------------------------------------------- queries
 
@@ -73,6 +76,7 @@ class ConfigState:
         self._assign(self.shard_map.get_peers(shard_id) or [], None)
         self.shard_map.remove_shard(shard_id)
         self.shard_health.pop(shard_id, None)
+        self.group_terms.pop(shard_id, None)
         return {"success": True, "version": self.shard_map.version}
 
     def _apply_split_shard(self, cmd: dict):
@@ -108,6 +112,7 @@ class ConfigState:
             )
         self._assign(peers, None)
         self.shard_health.pop(victim, None)
+        self.group_terms.pop(victim, None)
         return {"success": True, "version": self.shard_map.version}
 
     def _apply_rebalance_shard(self, cmd: dict):
@@ -207,7 +212,8 @@ class ConfigState:
 
     def _apply_shard_heartbeat(self, cmd: dict):
         at = int(cmd["at_ms"])
-        self.shard_health[cmd["shard_id"]] = {
+        sid = cmd["shard_id"]
+        self.shard_health[sid] = {
             "last_heartbeat_ms": at,
             "from": cmd.get("address", ""),
             # Per-prefix load reported by the shard leader (reference
@@ -217,6 +223,30 @@ class ConfigState:
         }
         if cmd.get("address") in self.masters:
             self.masters[cmd["address"]]["last_heartbeat_ms"] = at
+        # Dynamic-membership reconciliation: the shard leader's reported
+        # voter set is authoritative for its group's routing. A member
+        # added by `cluster add-server` becomes client-discoverable here;
+        # one removed by `remove-server` drops out of the map AND is freed
+        # back to spare in the registry (reusable for auto-split groups:
+        # its stale group record resets to just itself, or allocate_group
+        # would skip it forever). Term-fenced: a deposed leader that can
+        # still reach the config server (partitioned from its Raft quorum,
+        # lease not yet expired) must not regress the map with its stale
+        # voter set — only reports at >= the last-accepted term count.
+        group = [a for a in (cmd.get("group") or []) if a]
+        term = int(cmd.get("term") or 0)
+        if group and term >= self.group_terms.get(sid, 0):
+            # Record the term even when the group is UNCHANGED — otherwise
+            # a current-leader report that matches the map leaves the
+            # fence at an old term and a deposed leader's later stale
+            # report would still pass it.
+            self.group_terms[sid] = term
+            if self.shard_map.update_peers(sid, group):
+                self._assign(group, sid, at_ms=at)
+                for addr, info in self.masters.items():
+                    if info.get("shard_id") == sid and addr not in group:
+                        info["shard_id"] = None
+                        info["group"] = [addr]
         return {"success": True}
 
     def _assign(self, peers: list[str], shard_id: str | None,
@@ -234,6 +264,7 @@ class ConfigState:
             "shard_map": self.shard_map.to_dict(),
             "masters": self.masters,
             "shard_health": self.shard_health,
+            "group_terms": self.group_terms,
         })
 
     def restore(self, data: bytes) -> None:
@@ -245,3 +276,4 @@ class ConfigState:
         self.shard_health = {
             k: dict(v) for k, v in d.get("shard_health", {}).items()
         }
+        self.group_terms = dict(d.get("group_terms", {}))

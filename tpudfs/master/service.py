@@ -1286,6 +1286,14 @@ class Master:
                 await self.call_config("ShardHeartbeat", {
                     "shard_id": self.state.shard_id, "address": self.address,
                     "rps_per_prefix": self.monitor.rps_per_prefix(),
+                    # The leader's CURRENT voter set: the config server
+                    # reconciles the shard map's peer routing with it, so
+                    # clients discover members added/removed by dynamic
+                    # membership changes (cluster add/remove-server). The
+                    # term fences the reconciliation — a deposed leader's
+                    # stale group report must not regress the map.
+                    "group": sorted(self.raft.core.config.voters),
+                    "term": self.raft.core.term,
                 })
         except RpcError as e:
             logger.warning("shard refresh failed: %s", e.message)
