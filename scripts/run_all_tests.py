@@ -155,6 +155,11 @@ def main() -> None:
         # dynamic_membership_test.sh / cluster_membership_test.sh).
         run("live membership tier",
             [sys.executable, "-u", "scripts/membership_live.py"])
+        # Drive hot-prefix traffic until the split detector carves the
+        # range to a spare group; verify REDIRECTs + pre-split data
+        # (reference auto_scaling_test.sh / shard_split_migration_test.sh).
+        run("live autosplit tier",
+            [sys.executable, "-u", "scripts/autosplit_live.py"])
     print("\nALL TIERS PASSED")
 
 
