@@ -92,6 +92,13 @@ def live_cluster_tier(topology: str, workload_ops: int) -> None:
                 r.stdout + r.stderr).lower()
             print("cross-shard put/get/rename ok")
 
+            # --- shard-map visibility (reference inspect-ShardMap flow).
+            r = cli(masters, cfg, "shardmap")
+            smap = json.loads(r.stdout)
+            assert len(smap["ranges"]) >= len(eps["shards"]), smap
+            assert smap["peers"], smap
+            print("shardmap CLI ok")
+
             # --- benchmark burst (reference dfs_cli benchmark semantics).
             cli(masters, cfg, "benchmark", "write", "--files", "20",
                 "--size", str(64 * 1024), "--concurrency", "5",
@@ -160,6 +167,11 @@ def main() -> None:
         # (reference auto_scaling_test.sh / shard_split_migration_test.sh).
         run("live autosplit tier",
             [sys.executable, "-u", "scripts/autosplit_live.py"])
+        # Drive the authenticated gateway with the curl binary: presigned
+        # PUT/GET/HEAD, range reads, aws-chunked streaming (reference
+        # run_s3_test.sh exercises the same flows with the AWS CLI).
+        run("curl S3 conformance",
+            [sys.executable, "-u", "scripts/s3_curl_conformance.py"])
     print("\nALL TIERS PASSED")
 
 

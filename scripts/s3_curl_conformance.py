@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""Curl-only S3 conformance pass against the live gateway.
+
+Drives the authenticated S3 gateway with the **curl binary** — a third
+independent HTTP stack beside pyarrow/AWS-C++-SDK and the urllib
+independent-signer tests. All auth material comes from the from-spec
+signer in ``tpudfs/testing/indep_sigv4.py`` (zero shared code with
+``tpudfs.auth``); curl contributes the wire behavior: its own header
+casing, connection handling, 100-continue, and range plumbing.
+
+Checks (reference parity: ``test_scripts/run_s3_test.sh`` drives the
+same flows with the AWS CLI; curl stands in because the AWS CLI is not
+installable in this image):
+
+1. header-auth bucket create
+2. presigned PUT of a 1 MiB object (``curl -T``), presigned GET back,
+   byte-for-byte md5 compare
+3. presigned HEAD (ETag + Content-Length)
+4. single-range GET (``curl -r``) → 206 with the exact slice
+5. aws-chunked STREAMING-AWS4-HMAC-SHA256-PAYLOAD upload via
+   ``curl --data-binary`` with hand-assembled per-chunk signatures,
+   read back intact
+6. tampered presigned signature → 403 (no bytes served)
+
+Usage: ``python scripts/s3_curl_conformance.py`` (spawns its own
+single-shard cluster + gateway; ~30 s).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpudfs.testing.indep_sigv4 import Signer, http  # noqa: E402
+from tpudfs.testing.procs import terminate_all  # noqa: E402
+from tpudfs.testing.s3stack import spawn_s3_stack  # noqa: E402
+
+AK, SK = "AKIACURL", "curl-conformance-secret"
+S = Signer(AK, SK)
+
+
+def curl(*args: str, body_out: pathlib.Path | None = None) -> tuple[int, str]:
+    """Run curl, return (http_code, stdout-written-metadata)."""
+    cmd = ["curl", "-s", "-o", str(body_out) if body_out else "/dev/null",
+           "-w", "%{http_code}", *args]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
+    if r.returncode != 0:
+        raise SystemExit(f"curl failed rc={r.returncode}: {' '.join(cmd)}\n"
+                         f"{r.stderr}")
+    return int(r.stdout.strip() or 0), r.stderr
+
+
+def md5(p: pathlib.Path) -> str:
+    return hashlib.md5(p.read_bytes()).hexdigest()
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"  {'PASS' if ok else 'FAIL'}  {name}  {detail}")
+    if not ok:
+        raise SystemExit(f"curl conformance failed at: {name}")
+
+
+def main() -> None:
+    if shutil.which("curl") is None:
+        raise SystemExit("curl binary not found")
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="tpudfs-curl-"))
+    logdir = tmp / "logs"
+    logdir.mkdir()
+    procs: list = []
+    try:
+        host, _ = spawn_s3_stack(procs, tmp, logdir, {AK: SK})
+
+        # 1. bucket create via header auth (retried: chunkservers may
+        # still be registering with the master).
+        deadline = time.time() + 60
+        while True:
+            h, *_ = S.sign_headers("PUT", host, "/curlbkt", b"")
+            code, body = http("PUT", f"http://{host}/curlbkt", h, b"")
+            if code == 200:
+                break
+            if time.time() > deadline:
+                raise SystemExit(f"bucket create: {code} {body[:200]!r}")
+            time.sleep(0.5)
+        check("header-auth bucket create", True)
+
+        payload = (b"curl conformance payload \xf0\x9f\x8c\x8a" * 37449)[
+            : 1 << 20]  # exactly 1 MiB, non-ASCII bytes included
+        src = tmp / "payload.bin"
+        src.write_bytes(payload)
+        want_md5 = hashlib.md5(payload).hexdigest()
+
+        # 2. presigned PUT via curl -T, presigned GET back.
+        url = S.presign_url("PUT", host, "/curlbkt/obj.bin")
+        code, _ = curl("-T", str(src), url)
+        check("presigned PUT (curl -T)", code == 200, f"code={code}")
+        url = S.presign_url("GET", host, "/curlbkt/obj.bin")
+        got = tmp / "got.bin"
+        code, _ = curl(url, body_out=got)
+        got_md5 = md5(got)
+        check("presigned GET", code == 200 and got_md5 == want_md5,
+              f"code={code} md5={'ok' if got_md5 == want_md5 else 'BAD'}")
+
+        # 3. presigned HEAD: ETag is the content md5, length matches.
+        hdrs = tmp / "head.txt"
+        url = S.presign_url("HEAD", host, "/curlbkt/obj.bin")
+        code, _ = curl("-I", "-X", "HEAD", url, body_out=hdrs)
+        head = hdrs.read_text().lower()
+        check("presigned HEAD", code == 200
+              and f"content-length: {len(payload)}" in head
+              and want_md5 in head,
+              f"code={code}")
+
+        # 4. single-range GET via curl -r → 206 with the exact slice.
+        url = S.presign_url("GET", host, "/curlbkt/obj.bin")
+        part = tmp / "part.bin"
+        code, _ = curl("-r", "100000-299999", url, body_out=part)
+        check("range GET (curl -r)", code == 206
+              and part.read_bytes() == payload[100000:300000],
+              f"code={code} len={part.stat().st_size}")
+
+        # 5. aws-chunked streaming upload via curl --data-binary.
+        headers, amz_ts, date, seed = S.sign_headers(
+            "PUT", host, "/curlbkt/chunked.bin",
+            "STREAMING-AWS4-HMAC-SHA256-PAYLOAD",
+            extra_headers={
+                "x-amz-decoded-content-length": str(len(payload)),
+                "content-encoding": "aws-chunked",
+            },
+        )
+        body = S.aws_chunked_body(payload, 64 * 1024, amz_ts, date, seed)
+        chunked_src = tmp / "chunked.body"
+        chunked_src.write_bytes(body)
+        hdr_args: list[str] = []
+        for k, v in headers.items():
+            if k != "host":  # curl derives Host from the URL
+                hdr_args += ["-H", f"{k}: {v}"]
+        code, _ = curl("-X", "PUT", "--data-binary", f"@{chunked_src}",
+                       "-H", "Content-Type:",  # drop curl's form default
+                       *hdr_args, f"http://{host}/curlbkt/chunked.bin")
+        check("aws-chunked PUT (curl --data-binary)", code == 200,
+              f"code={code}")
+        url = S.presign_url("GET", host, "/curlbkt/chunked.bin")
+        got2 = tmp / "got2.bin"
+        code, _ = curl(url, body_out=got2)
+        check("aws-chunked readback", code == 200 and md5(got2) == want_md5,
+              f"code={code}")
+
+        # 6. tampered presigned signature must be rejected with no bytes.
+        url = S.presign_url("GET", host, "/curlbkt/obj.bin")
+        bad = url[:-4] + ("0000" if not url.endswith("0000") else "1111")
+        denied = tmp / "denied.bin"
+        code, _ = curl(bad, body_out=denied)
+        check("tampered presign rejected", code == 403
+              and want_md5 != (md5(denied) if denied.exists() else ""),
+              f"code={code}")
+
+        print("curl conformance: ALL PASS")
+    finally:
+        terminate_all(procs)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,19 @@
 """Second fully-independent SigV4 signer path against the live gateway.
 
 The pyarrow interop test covers one independent client stack (AWS C++
-SDK). This module adds another with ZERO shared code: a SigV4 signer
-hand-written here from the AWS Signature Version 4 specification using
-only the stdlib (hashlib/hmac/urllib) — no imports from ``tpudfs.auth``
-— driving plain ``urllib.request`` HTTP against the multi-process
-gateway with auth ENABLED:
+SDK). This module adds another with ZERO shared code with the gateway:
+the from-spec SigV4 signer in ``tpudfs/testing/indep_sigv4.py``
+(stdlib only — no imports from ``tpudfs.auth``, the implementation
+under test) driving plain ``urllib.request`` HTTP against the
+multi-process gateway with auth ENABLED:
 
 1. header-signed PUT + GET round trip,
 2. presigned-URL PUT and GET (query-string auth, UNSIGNED-PAYLOAD),
 3. an aws-chunked STREAMING-AWS4-HMAC-SHA256-PAYLOAD upload with
    per-chunk signatures, assembled by hand.
+
+``scripts/s3_curl_conformance.py`` reuses the same signer to drive the
+gateway with the curl BINARY (a third, non-Python HTTP stack).
 
 Reference parity: test_scripts/s3_integration_test.py (boto3) and
 run_s3_test.sh (AWS CLI) play this role for the reference. boto3 is NOT
@@ -21,149 +24,21 @@ the independent-signer surface is widened in-tree instead.
 
 from __future__ import annotations
 
-import datetime
-import hashlib
-import hmac
 import importlib.util
-import json
 import time
-import urllib.error
-import urllib.parse
-import urllib.request
 
 import pytest
 
-from tpudfs.testing.procs import free_port, spawn, terminate_all, wait_ready
+from tpudfs.testing.indep_sigv4 import Signer, http as _http
+from tpudfs.testing.procs import terminate_all
+from tpudfs.testing.s3stack import spawn_s3_stack
 
 AK, SK = "AKIAINDEP", "independent-signer-secret"
-REGION, SERVICE = "us-east-1", "s3"
 
-
-# --------------------------------------------------------------------------
-# Hand-rolled SigV4 (from the AWS SigV4 spec; stdlib only, no tpudfs.auth)
-# --------------------------------------------------------------------------
-
-
-def _sha256(b: bytes) -> str:
-    return hashlib.sha256(b).hexdigest()
-
-
-def _hmac(key: bytes, msg: str) -> bytes:
-    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
-
-
-def _signing_key(secret: str, date: str) -> bytes:
-    k = _hmac(("AWS4" + secret).encode(), date)
-    k = _hmac(k, REGION)
-    k = _hmac(k, SERVICE)
-    return _hmac(k, "aws4_request")
-
-
-def _uri_encode(path: str) -> str:
-    # S3 canonical URI: encode everything but unreserved chars and "/".
-    return urllib.parse.quote(path, safe="/-_.~")
-
-
-def _canonical_query(params: dict[str, str]) -> str:
-    pairs = sorted(
-        (urllib.parse.quote(k, safe="-_.~"),
-         urllib.parse.quote(v, safe="-_.~"))
-        for k, v in params.items()
-    )
-    return "&".join(f"{k}={v}" for k, v in pairs)
-
-
-def _amz_now() -> tuple[str, str]:
-    now = datetime.datetime.now(datetime.timezone.utc)
-    return now.strftime("%Y%m%dT%H%M%SZ"), now.strftime("%Y%m%d")
-
-
-def sign_headers(
-    method: str, host: str, path: str, payload: bytes | str,
-    extra_headers: dict[str, str] | None = None,
-    params: dict[str, str] | None = None,
-) -> tuple[dict[str, str], str, str, str]:
-    """Build a header-auth SigV4 request. Returns ``(headers, amz_ts,
-    date, signature)`` — the trailing context seeds aws-chunked per-chunk
-    signatures. ``payload`` may be raw bytes (hashed here) or a literal
-    content-sha256 string (streaming)."""
-    amz_ts, date = _amz_now()
-    payload_hash = payload if isinstance(payload, str) else _sha256(payload)
-    headers = {"host": host, "x-amz-date": amz_ts,
-               "x-amz-content-sha256": payload_hash}
-    headers.update({k.lower(): v for k, v in (extra_headers or {}).items()})
-    signed = ";".join(sorted(headers))
-    canonical = "\n".join([
-        method, _uri_encode(path), _canonical_query(params or {}),
-        "".join(f"{k}:{headers[k].strip()}\n" for k in sorted(headers)),
-        signed, payload_hash,
-    ])
-    scope = f"{date}/{REGION}/{SERVICE}/aws4_request"
-    sts = "\n".join(["AWS4-HMAC-SHA256", amz_ts, scope,
-                     _sha256(canonical.encode())])
-    sig = hmac.new(_signing_key(SK, date), sts.encode(),
-                   hashlib.sha256).hexdigest()
-    headers["authorization"] = (
-        f"AWS4-HMAC-SHA256 Credential={AK}/{scope}, "
-        f"SignedHeaders={signed}, Signature={sig}"
-    )
-    return headers, amz_ts, date, sig
-
-
-def presign_url(method: str, host: str, path: str,
-                expires: int = 300) -> str:
-    amz_ts, date = _amz_now()
-    scope = f"{date}/{REGION}/{SERVICE}/aws4_request"
-    params = {
-        "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
-        "X-Amz-Credential": f"{AK}/{scope}",
-        "X-Amz-Date": amz_ts,
-        "X-Amz-Expires": str(expires),
-        "X-Amz-SignedHeaders": "host",
-    }
-    canonical = "\n".join([
-        method, _uri_encode(path), _canonical_query(params),
-        f"host:{host}\n", "host", "UNSIGNED-PAYLOAD",
-    ])
-    sts = "\n".join(["AWS4-HMAC-SHA256", amz_ts, scope,
-                     _sha256(canonical.encode())])
-    sig = hmac.new(_signing_key(SK, date), sts.encode(),
-                   hashlib.sha256).hexdigest()
-    q = _canonical_query(params) + "&X-Amz-Signature=" + sig
-    return f"http://{host}{_uri_encode(path)}?{q}"
-
-
-def aws_chunked_body(data: bytes, chunk_size: int, amz_ts: str, date: str,
-                     seed_sig: str) -> bytes:
-    """STREAMING-AWS4-HMAC-SHA256-PAYLOAD body with per-chunk signatures
-    (the AWS chunked-upload wire format, assembled by hand)."""
-    scope = f"{date}/{REGION}/{SERVICE}/aws4_request"
-    key = _signing_key(SK, date)
-    prev = seed_sig
-    out = bytearray()
-    chunks = [data[i:i + chunk_size]
-              for i in range(0, len(data), chunk_size)] + [b""]
-    for chunk in chunks:
-        sts = "\n".join([
-            "AWS4-HMAC-SHA256-PAYLOAD", amz_ts, scope, prev,
-            _sha256(b""), _sha256(chunk),
-        ])
-        sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
-        out += f"{len(chunk):x};chunk-signature={sig}\r\n".encode()
-        out += chunk + b"\r\n"
-        prev = sig
-    return bytes(out)
-
-
-def _http(method: str, url: str, headers: dict | None = None,
-          body: bytes | None = None) -> tuple[int, bytes]:
-    req = urllib.request.Request(url, data=body, method=method,
-                                 headers=headers or {})
-    try:
-        with urllib.request.urlopen(req, timeout=30) as r:
-            return r.status, r.read()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read()
+_signer = Signer(AK, SK)
+sign_headers = _signer.sign_headers
+presign_url = _signer.presign_url
+aws_chunked_body = _signer.aws_chunked_body
 
 
 # --------------------------------------------------------------------------
@@ -177,30 +52,8 @@ def gateway(tmp_path_factory):
     logdir = root / "logs"
     logdir.mkdir()
     procs = []
-    env = {"JAX_PLATFORMS": "cpu"}
     try:
-        maddr = f"127.0.0.1:{free_port()}"
-        spawn(procs, "master", logdir, "tpudfs.master",
-              "--port", maddr.rsplit(":", 1)[1],
-              "--data-dir", str(root / "m0"), "--http-port", "0", env=env)
-        wait_ready(logdir, "master")
-        for i in range(3):
-            port = free_port()
-            spawn(procs, f"cs{i}", logdir, "tpudfs.chunkserver",
-                  "--port", str(port), "--data-dir", str(root / f"cs{i}"),
-                  "--masters", maddr, "--rack-id", f"rack-{i}",
-                  "--heartbeat-interval", "0.5", "--http-port", "0", env=env)
-            wait_ready(logdir, f"cs{i}")
-        s3_port = free_port()
-        spawn(procs, "s3", logdir, "tpudfs.s3", env={
-            **env,
-            "MASTER_ADDRS": maddr,
-            "S3_PORT": str(s3_port),
-            "S3_AUTH_ENABLED": "true",
-            "S3_USERS_JSON": json.dumps({AK: SK}),
-        })
-        wait_ready(logdir, "s3")
-        host = f"127.0.0.1:{s3_port}"
+        host, _ = spawn_s3_stack(procs, root, logdir, {AK: SK})
         deadline = time.time() + 60
         while True:
             h, *_ = sign_headers("PUT", host, "/indep", b"")

@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("inspect", help="print file metadata as JSON")
     sp.add_argument("path")
 
+    sp = sub.add_parser("shardmap", help="print the cluster shard map as "
+                        "JSON (fetched from the config servers)")
+
     sp = sub.add_parser("ls", help="list files by prefix")
     sp.add_argument("prefix", nargs="?", default="")
 
@@ -226,6 +229,12 @@ async def amain(args) -> int:
                 print("not found", file=sys.stderr)
                 return 1
             print(json.dumps(meta, indent=2))
+        elif args.cmd == "shardmap":
+            await client.refresh_shard_map()
+            if client.shard_map is None:
+                print("no shard map (pass --config-servers)", file=sys.stderr)
+                return 1
+            print(json.dumps(client.shard_map.to_dict(), indent=2))
         elif args.cmd == "ls":
             for p in await client.list_files(args.prefix):
                 print(p)

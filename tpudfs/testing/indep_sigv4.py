@@ -1,0 +1,155 @@
+"""From-spec SigV4 signer for interop harnesses — ZERO ``tpudfs.auth``.
+
+Hand-written from the AWS Signature Version 4 specification using only
+the stdlib (hashlib/hmac/urllib). This module exists so independent
+client harnesses (``tests/test_s3_independent_signer.py`` over plain
+urllib, ``scripts/s3_curl_conformance.py`` over the curl binary) can
+produce auth material without touching the implementation under test:
+the gateway's verifier lives in ``tpudfs/auth``; nothing here imports
+from it, so agreement between the two is evidence of spec conformance,
+not self-agreement.
+
+Reference parity: plays the role boto3 / the AWS CLI play in the
+reference's interop tests (``test_scripts/s3_integration_test.py``,
+``run_s3_test.sh``) — those stacks are not installable in this image.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import hashlib
+import hmac
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def _sha256(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _uri_encode(path: str) -> str:
+    # S3 canonical URI: encode everything but unreserved chars and "/".
+    return urllib.parse.quote(path, safe="/-_.~")
+
+
+def _canonical_query(params: dict[str, str]) -> str:
+    pairs = sorted(
+        (urllib.parse.quote(k, safe="-_.~"),
+         urllib.parse.quote(v, safe="-_.~"))
+        for k, v in params.items()
+    )
+    return "&".join(f"{k}={v}" for k, v in pairs)
+
+
+def _amz_now() -> tuple[str, str]:
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return now.strftime("%Y%m%dT%H%M%SZ"), now.strftime("%Y%m%d")
+
+
+@dataclasses.dataclass
+class Signer:
+    """SigV4 signing context for one principal."""
+
+    ak: str
+    sk: str
+    region: str = "us-east-1"
+    service: str = "s3"
+
+    def _signing_key(self, date: str) -> bytes:
+        k = _hmac(("AWS4" + self.sk).encode(), date)
+        k = _hmac(k, self.region)
+        k = _hmac(k, self.service)
+        return _hmac(k, "aws4_request")
+
+    def sign_headers(
+        self, method: str, host: str, path: str, payload: bytes | str,
+        extra_headers: dict[str, str] | None = None,
+        params: dict[str, str] | None = None,
+    ) -> tuple[dict[str, str], str, str, str]:
+        """Build a header-auth SigV4 request. Returns ``(headers, amz_ts,
+        date, signature)`` — the trailing context seeds aws-chunked
+        per-chunk signatures. ``payload`` may be raw bytes (hashed here)
+        or a literal content-sha256 string (streaming)."""
+        amz_ts, date = _amz_now()
+        payload_hash = (payload if isinstance(payload, str)
+                        else _sha256(payload))
+        headers = {"host": host, "x-amz-date": amz_ts,
+                   "x-amz-content-sha256": payload_hash}
+        headers.update({k.lower(): v for k, v in (extra_headers or {}).items()})
+        signed = ";".join(sorted(headers))
+        canonical = "\n".join([
+            method, _uri_encode(path), _canonical_query(params or {}),
+            "".join(f"{k}:{headers[k].strip()}\n" for k in sorted(headers)),
+            signed, payload_hash,
+        ])
+        scope = f"{date}/{self.region}/{self.service}/aws4_request"
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_ts, scope,
+                         _sha256(canonical.encode())])
+        sig = hmac.new(self._signing_key(date), sts.encode(),
+                       hashlib.sha256).hexdigest()
+        headers["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.ak}/{scope}, "
+            f"SignedHeaders={signed}, Signature={sig}"
+        )
+        return headers, amz_ts, date, sig
+
+    def presign_url(self, method: str, host: str, path: str,
+                    expires: int = 300) -> str:
+        amz_ts, date = _amz_now()
+        scope = f"{date}/{self.region}/{self.service}/aws4_request"
+        params = {
+            "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+            "X-Amz-Credential": f"{self.ak}/{scope}",
+            "X-Amz-Date": amz_ts,
+            "X-Amz-Expires": str(expires),
+            "X-Amz-SignedHeaders": "host",
+        }
+        canonical = "\n".join([
+            method, _uri_encode(path), _canonical_query(params),
+            f"host:{host}\n", "host", "UNSIGNED-PAYLOAD",
+        ])
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_ts, scope,
+                         _sha256(canonical.encode())])
+        sig = hmac.new(self._signing_key(date), sts.encode(),
+                       hashlib.sha256).hexdigest()
+        q = _canonical_query(params) + "&X-Amz-Signature=" + sig
+        return f"http://{host}{_uri_encode(path)}?{q}"
+
+    def aws_chunked_body(self, data: bytes, chunk_size: int, amz_ts: str,
+                         date: str, seed_sig: str) -> bytes:
+        """STREAMING-AWS4-HMAC-SHA256-PAYLOAD body with per-chunk
+        signatures (the AWS chunked-upload wire format, by hand)."""
+        scope = f"{date}/{self.region}/{self.service}/aws4_request"
+        key = self._signing_key(date)
+        prev = seed_sig
+        out = bytearray()
+        chunks = [data[i:i + chunk_size]
+                  for i in range(0, len(data), chunk_size)] + [b""]
+        for chunk in chunks:
+            sts = "\n".join([
+                "AWS4-HMAC-SHA256-PAYLOAD", amz_ts, scope, prev,
+                _sha256(b""), _sha256(chunk),
+            ])
+            sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+            out += f"{len(chunk):x};chunk-signature={sig}\r\n".encode()
+            out += chunk + b"\r\n"
+            prev = sig
+        return bytes(out)
+
+
+def http(method: str, url: str, headers: dict | None = None,
+         body: bytes | None = None) -> tuple[int, bytes]:
+    """Minimal urllib driver (no tpudfs HTTP stack)."""
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()

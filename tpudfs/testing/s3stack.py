@@ -1,0 +1,47 @@
+"""Shared bringup for interop harnesses: a small live DFS + S3 gateway.
+
+One master, N chunkservers, and an auth-enabled gateway, each its own OS
+process — the stack both `tests/test_s3_independent_signer.py` and
+`scripts/s3_curl_conformance.py` drive with independent client stacks.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from tpudfs.testing.procs import free_port, spawn, wait_ready
+
+
+def spawn_s3_stack(
+    procs: list,
+    root: pathlib.Path,
+    logdir: pathlib.Path,
+    users: dict[str, str],
+    n_chunkservers: int = 3,
+    env: dict | None = None,
+) -> tuple[str, str]:
+    """Start master + chunkservers + gateway (credentials from ``users``,
+    auth ENABLED). Appends children to ``procs`` (caller terminates).
+    Returns ``(s3_host, master_addr)``."""
+    env = {"JAX_PLATFORMS": "cpu", **(env or {})}
+    maddr = f"127.0.0.1:{free_port()}"
+    spawn(procs, "master", logdir, "tpudfs.master",
+          "--port", maddr.rsplit(":", 1)[1],
+          "--data-dir", str(root / "m0"), "--http-port", "0", env=env)
+    wait_ready(logdir, "master")
+    for i in range(n_chunkservers):
+        spawn(procs, f"cs{i}", logdir, "tpudfs.chunkserver",
+              "--port", str(free_port()),
+              "--data-dir", str(root / f"cs{i}"),
+              "--masters", maddr, "--rack-id", f"rack-{i}",
+              "--heartbeat-interval", "0.5", "--http-port", "0", env=env)
+        wait_ready(logdir, f"cs{i}")
+    s3_port = free_port()
+    spawn(procs, "s3", logdir, "tpudfs.s3", env={
+        **env, "MASTER_ADDRS": maddr, "S3_PORT": str(s3_port),
+        "S3_AUTH_ENABLED": "true",
+        "S3_USERS_JSON": json.dumps(users),
+    })
+    wait_ready(logdir, "s3")
+    return f"127.0.0.1:{s3_port}", maddr
