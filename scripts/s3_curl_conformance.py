@@ -34,14 +34,15 @@ import shutil
 import subprocess
 import sys
 import tempfile
-import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from tpudfs.testing.indep_sigv4 import Signer, http  # noqa: E402
+from tpudfs.testing.indep_sigv4 import Signer  # noqa: E402
 from tpudfs.testing.procs import terminate_all  # noqa: E402
-from tpudfs.testing.s3stack import spawn_s3_stack  # noqa: E402
+from tpudfs.testing.s3stack import (  # noqa: E402
+    create_bucket_when_ready, spawn_s3_stack,
+)
 
 AK, SK = "AKIACURL", "curl-conformance-secret"
 S = Signer(AK, SK)
@@ -78,17 +79,9 @@ def main() -> None:
     try:
         host, _ = spawn_s3_stack(procs, tmp, logdir, {AK: SK})
 
-        # 1. bucket create via header auth (retried: chunkservers may
-        # still be registering with the master).
-        deadline = time.time() + 60
-        while True:
-            h, *_ = S.sign_headers("PUT", host, "/curlbkt", b"")
-            code, body = http("PUT", f"http://{host}/curlbkt", h, b"")
-            if code == 200:
-                break
-            if time.time() > deadline:
-                raise SystemExit(f"bucket create: {code} {body[:200]!r}")
-            time.sleep(0.5)
+        # 1. bucket create via header auth (retried until the cluster can
+        # place data — shared readiness helper).
+        create_bucket_when_ready(S, host, "curlbkt")
         check("header-auth bucket create", True)
 
         payload = (b"curl conformance payload \xf0\x9f\x8c\x8a" * 37449)[

@@ -1,0 +1,164 @@
+"""Read-sweep laboratory (not part of the bench): one cluster + dataset,
+then N alternating cold/warm sweeps printed individually — fast iteration
+on read-path changes and a view of the window-to-window distribution that
+bench.py's median-of-3 summarizes.
+
+Usage: JAX_PLATFORMS=cpu python scripts/sweep_lab.py [sweeps]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import bench  # noqa: E402
+
+SWEEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+FILES = bench.FILES
+
+
+async def run() -> None:
+    import tempfile
+
+    import jax
+
+    from tpudfs.client.client import Client
+    from tpudfs.common.rpc import RpcClient
+    from tpudfs.tpu.hbm_reader import HbmReader
+
+    tmp = tempfile.TemporaryDirectory(prefix="tpudfs-lab-")
+    maddr, cs_addrs, procs = bench._spawn_cluster(tmp.name)
+    try:
+        rpc = RpcClient()
+        client = Client([maddr], rpc_client=rpc,
+                        block_size=bench.BLOCK_MB << 20, etag_mode="crc64")
+        deadline = asyncio.get_event_loop().time() + 60
+        while True:
+            try:
+                await client.create_file("/lab/probe", b"x")
+                await client.delete_file("/lab/probe")
+                break
+            except Exception:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.3)
+        import numpy as np
+
+        data = np.random.default_rng(0).integers(
+            0, 256, bench.BLOCK_MB << 20, dtype=np.uint8
+        ).tobytes()
+        sem = asyncio.Semaphore(bench.WRITE_CONCURRENCY)
+
+        async def put(i):
+            async with sem:
+                await client.create_file(f"/lab/f{i:04d}", data)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(put(i) for i in range(FILES)))
+        print(f"dataset: {FILES} MiB in {time.perf_counter() - t0:.1f}s")
+
+        multiset = "--multiset" in sys.argv
+        if multiset:
+            async def put_set(s, i):
+                async with sem:
+                    await client.create_file(f"/lab/s{s}/f{i:04d}", data)
+
+            for s in range(3):
+                await asyncio.gather(
+                    *(put_set(s, i) for i in range(FILES)))
+            print("3 extra sets written")
+
+        device = jax.devices()[0]
+        reader = HbmReader(client, [device], batch_reads=bench.BATCH_READS)
+        reader.warm_batches((bench.BLOCK_MB << 20) // 512)
+        metas = await asyncio.gather(
+            *(client.get_file_info(f"/lab/f{i:04d}") for i in range(FILES))
+        )
+
+        async def sweep(read_fn, items, conc):
+            semr = asyncio.Semaphore(conc)
+            blocks: list = []
+
+            async def one(item):
+                async with semr:
+                    bs = await read_fn(item)
+                    blocks.extend(bs)
+                    return sum(b.size for b in bs)
+
+            t0 = time.perf_counter()
+            sizes = await asyncio.gather(*(one(it) for it in items))
+            jax.block_until_ready(
+                [x for b in blocks for x in b.sync_arrays])
+            gbps = sum(sizes) / (time.perf_counter() - t0) / 1e9
+            await reader.confirm(blocks)
+            return gbps
+
+        if multiset:
+            # Warm the process on /lab/f*, then time each NEVER-READ set.
+            for _ in range(3):
+                await sweep(
+                    lambda p: reader.read_file_to_device_blocks(
+                        p, verify="lazy"),
+                    [f"/lab/f{j:04d}" for j in range(FILES)],
+                    bench.FUSED_READ_CONCURRENCY)
+            for s in range(3):
+                c = await sweep(
+                    lambda p: reader.read_file_to_device_blocks(
+                        p, verify="lazy"),
+                    [f"/lab/s{s}/f{j:04d}" for j in range(FILES)],
+                    bench.FUSED_READ_CONCURRENCY)
+                c2 = await sweep(
+                    lambda p: reader.read_file_to_device_blocks(
+                        p, verify="lazy"),
+                    [f"/lab/s{s}/f{j:04d}" for j in range(FILES)],
+                    bench.FUSED_READ_CONCURRENCY)
+                print(f"set {s}: first {c:.3f} repeat {c2:.3f} GB/s")
+            await rpc.close()
+            return
+
+        interleave = "--interleave" in sys.argv
+        colds, warms = [], []
+        for i in range(SWEEPS):
+            if interleave:
+                raw = bench._bench_raw_infeed(
+                    device, bench.BLOCK_MB << 20, 16)
+                client.local_reads = False
+                g = await sweep(
+                    lambda p: reader.read_file_to_device_blocks(
+                        p, verify="lazy"),
+                    [f"/lab/f{j:04d}" for j in range(48)],
+                    bench.READ_CONCURRENCY)
+                client.local_reads = True
+                print(f"  raw {raw:.3f} grpc {g:.3f}")
+            c = await sweep(
+                lambda p: reader.read_file_to_device_blocks(p, verify="lazy"),
+                [f"/lab/f{j:04d}" for j in range(FILES)],
+                bench.FUSED_READ_CONCURRENCY)
+            w = await sweep(
+                lambda m: reader.read_meta_blocks_fast(m, device),
+                metas, bench.FUSED_READ_CONCURRENCY)
+            colds.append(c)
+            warms.append(w)
+            print(f"sweep {i}: cold {c:.3f} warm {w:.3f} GB/s")
+        import statistics
+
+        print(f"cold median {statistics.median(colds):.3f} "
+              f"[{min(colds):.3f},{max(colds):.3f}]  "
+              f"warm median {statistics.median(warms):.3f} "
+              f"[{min(warms):.3f},{max(warms):.3f}]")
+        await rpc.close()
+    finally:
+        from tpudfs.testing.procs import terminate_all
+
+        terminate_all(procs)
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    asyncio.run(run())

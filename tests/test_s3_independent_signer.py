@@ -25,13 +25,12 @@ the independent-signer surface is widened in-tree instead.
 from __future__ import annotations
 
 import importlib.util
-import time
 
 import pytest
 
 from tpudfs.testing.indep_sigv4 import Signer, http as _http
 from tpudfs.testing.procs import terminate_all
-from tpudfs.testing.s3stack import spawn_s3_stack
+from tpudfs.testing.s3stack import create_bucket_when_ready, spawn_s3_stack
 
 AK, SK = "AKIAINDEP", "independent-signer-secret"
 
@@ -54,16 +53,7 @@ def gateway(tmp_path_factory):
     procs = []
     try:
         host, _ = spawn_s3_stack(procs, root, logdir, {AK: SK})
-        deadline = time.time() + 60
-        while True:
-            h, *_ = sign_headers("PUT", host, "/indep", b"")
-            code, body = _http("PUT", f"http://{host}/indep", h, b"")
-            if code == 200:
-                break
-            if time.time() > deadline:
-                raise RuntimeError(f"bucket create never succeeded: "
-                                   f"{code} {body[:200]!r}")
-            time.sleep(0.5)
+        create_bucket_when_ready(_signer, host, "indep")
         yield host
     finally:
         terminate_all(procs)

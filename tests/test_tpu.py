@@ -864,6 +864,32 @@ async def test_fused_read_roundtrip(tmp_path, host_verify):
         await c.stop()
 
 
+async def test_fused_read_buffer_pool_reuse(tmp_path):
+    """Round buffers recycle across rounds (bounded pool) and reuse is
+    bit-exact — a recycled buffer must never leak a previous round's
+    bytes into a later read (device_put copies on CPU; accelerators gate
+    release on transfer completion)."""
+    d1 = _rand(4 * 64 * 1024, seed=53)
+    d2 = _rand(4 * 64 * 1024, seed=54)
+    c, client = await _cluster_with_files(
+        tmp_path, [("/fu/p1", d1), ("/fu/p2", d2)])
+    try:
+        reader, comb = await _batched_reader(client, True)
+        for want, path in [(d1, "/fu/p1"), (d2, "/fu/p2")] * 3:
+            blocks = await reader.read_file_to_device_blocks(path,
+                                                             verify="lazy")
+            await reader.confirm(blocks)
+            got = b"".join(device_array_to_bytes(b.array, b.size)
+                           for b in blocks)
+            assert got == want
+        assert comb.blocks >= 6, "combiner never engaged"
+        pooled = sum(len(v) for v in comb._buf_pool.values())
+        assert 1 <= pooled <= comb._POOL_PER_SHAPE * len(comb._buf_pool), \
+            comb._buf_pool
+    finally:
+        await c.stop()
+
+
 async def test_fused_read_host_verify_falls_back_on_rot(tmp_path):
     """Host-verified fused reads route a corrupt local replica to the
     general path, which excludes it and recovers from a healthy one."""

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import pathlib
+import time
 
 from tpudfs.testing.procs import free_port, spawn, wait_ready
 
@@ -45,3 +46,22 @@ def spawn_s3_stack(
     })
     wait_ready(logdir, "s3")
     return f"127.0.0.1:{s3_port}", maddr
+
+
+def create_bucket_when_ready(signer, host: str, bucket: str,
+                             timeout: float = 60.0) -> None:
+    """Create ``bucket`` through ``signer`` (an indep_sigv4.Signer),
+    retrying until the backing cluster can place data (chunkservers may
+    still be registering with the master when the gateway comes up)."""
+    from tpudfs.testing.indep_sigv4 import http
+
+    deadline = time.time() + timeout
+    while True:
+        h, *_ = signer.sign_headers("PUT", host, f"/{bucket}", b"")
+        code, body = http("PUT", f"http://{host}/{bucket}", h, b"")
+        if code == 200:
+            return
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"bucket create never succeeded: {code} {body[:200]!r}")
+        time.sleep(0.5)

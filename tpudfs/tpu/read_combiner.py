@@ -118,9 +118,33 @@ class ReadCombiner:
         self._read_task: asyncio.Task | None = None
         self._upload_task: asyncio.Task | None = None
         self._queue: asyncio.Queue | None = None
+        #: Reusable round buffers, keyed by row count (each round's pread
+        #: target is (n*cpb, 128) u32). Fresh 16-32 MiB allocations every
+        #: round cost ~4-8 ms of page faults on a one-core host and keep
+        #: the allocator churning; a recycled buffer's pages stay mapped.
+        #: Safe to reuse because device_put COPIES on the CPU backend
+        #: (verified: mutating the source after device_put does not change
+        #: the device array) and the upload stage waits for transfer
+        #: completion before releasing a buffer on accelerators.
+        self._buf_pool: dict[int, list[np.ndarray]] = {}
         #: rounds fused / blocks served (observability + tests).
         self.rounds = 0
         self.blocks = 0
+
+    _POOL_PER_SHAPE = 3
+
+    def _get_buf(self, nrows: int) -> np.ndarray:
+        free = self._buf_pool.get(nrows)
+        if free:
+            return free.pop()
+        return np.empty((nrows, WORDS_PER_CHUNK), dtype="<u4")
+
+    def _put_buf(self, buf: np.ndarray | None) -> None:
+        if buf is None:
+            return
+        free = self._buf_pool.setdefault(buf.shape[0], [])
+        if len(free) < self._POOL_PER_SHAPE:
+            free.append(buf)
 
     # ------------------------------------------------------------- staging
 
@@ -207,14 +231,16 @@ class ReadCombiner:
                 self._pending = [
                     r for r in self._pending if id(r) not in taken
                 ]
+                buf = self._get_buf(len(reqs) * cpb)
                 try:
                     if origin is not None:
-                        buf, ok, crcs = await self._fetch_remote(reqs)
+                        ok, crcs = await self._fetch_remote(reqs, buf)
                     else:
-                        buf, ok, crcs = await asyncio.to_thread(
-                            self._fill_buffer, reqs
+                        ok, crcs = await asyncio.to_thread(
+                            self._fill_buffer, reqs, buf
                         )
                 except asyncio.CancelledError:
+                    self._put_buf(buf)
                     self._fail_out(reqs)
                     raise
                 except Exception as e:
@@ -223,6 +249,7 @@ class ReadCombiner:
                     # per-block path and keep draining.
                     logger.warning("fused read round failed (%s); "
                                    "falling back %d blocks", e, len(reqs))
+                    self._put_buf(buf)
                     for r in reqs:
                         if not r.fut.done():
                             r.fut.set_result(_FALLBACK)
@@ -245,28 +272,41 @@ class ReadCombiner:
                         r.fut.set_result(_FALLBACK)
                 if good:
                     # Compact rows when some slots fell back, preserving
-                    # request order (row i belongs to good[i]).
-                    if len(good) < len(reqs):
+                    # request order (row i belongs to good[i]). The pooled
+                    # buffer returns immediately (its data now lives in
+                    # the compacted copy, which is NOT pooled — its shape
+                    # is a non-bucket size _get_buf would never hand out).
+                    pooled = len(good) == len(reqs)
+                    if not pooled:
                         rows = np.concatenate([
                             buf[i * cpb : (i + 1) * cpb]
                             for i, o in enumerate(ok) if o
                         ])
+                        self._put_buf(buf)
                     else:
                         rows = buf
                     # Ship in power-of-two sub-rounds: a compacted count
                     # (15 after one dropped slot) would otherwise dispatch
                     # a CRC shape warm() never compiled — a fresh XLA
                     # compile mid-infeed on TPU. Full buckets pass through
-                    # in one iteration.
+                    # in one iteration. For pooled rounds the LAST
+                    # sub-round carries `rows` as its release token: the
+                    # upload stage returns it to the pool once every
+                    # sub-round's transfer completed.
                     off = 0
                     while off < len(good):
                         take = 1 << ((len(good) - off).bit_length() - 1)
+                        last = off + take >= len(good)
                         await queue.put((
                             good[off : off + take],
                             rows[off * cpb : (off + take) * cpb],
                             cpb, crcs is not None,
+                            rows if (pooled and last) else None,
+                            pooled,
                         ))
                         off += take
+                else:
+                    self._put_buf(buf)
             aborted = False
         finally:
             # Synchronously (no await since the empty-pending check) clear
@@ -290,19 +330,19 @@ class ReadCombiner:
                 )
 
     async def _fetch_remote(
-        self, reqs: list[_Req],
-    ) -> tuple[np.ndarray, list[bool], np.ndarray | None]:
+        self, reqs: list[_Req], buf: np.ndarray,
+    ) -> tuple[list[bool], np.ndarray | None]:
         """One ReadBlocks frame to the round's origin chunkserver (served
         by the native engine or the asyncio/gRPC handlers — the pool picks
         the transport). Slots the peer couldn't serve fall back to the
         general per-block path; in host-verify mode the received bytes are
-        re-checked end-to-end against the recorded whole-block CRCs."""
+        re-checked end-to-end against the recorded whole-block CRCs.
+        ``buf`` is the caller's pooled (n*cpb, 128) round buffer."""
         from tpudfs.common.rpc import RpcError
 
         addr = reqs[0].addr
         cpb = reqs[0].cpb
         stride = cpb * CHECKSUM_CHUNK_SIZE
-        buf = np.empty((len(reqs) * cpb, WORDS_PER_CHUNK), dtype="<u4")
         try:
             # _data_call centralizes transport choice AND the
             # aliased-routes-stay-on-gRPC rule (fault interposers see the
@@ -314,7 +354,7 @@ class ReadCombiner:
             )
         except RpcError as e:
             logger.debug("remote fused round to %s failed: %s", addr, e)
-            return buf, [False] * len(reqs), None
+            return [False] * len(reqs), None
         sizes = list(resp.get("sizes") or [])
         data = resp.get("data") or b""
         ok: list[bool] = []
@@ -335,9 +375,9 @@ class ReadCombiner:
             )
             ok.append(True)
         if not self.host_verify:
-            return buf, ok, None
+            return ok, None
         crcs = await asyncio.to_thread(self._host_crcs, reqs, flat, ok)
-        return buf, ok, crcs
+        return ok, crcs
 
     def _host_crcs(self, reqs: list[_Req], flat: np.ndarray,
                    ok: list[bool]) -> np.ndarray:
@@ -353,18 +393,17 @@ class ReadCombiner:
         return out
 
     def _fill_buffer(
-        self, reqs: list[_Req],
-    ) -> tuple[np.ndarray, list[bool], np.ndarray | None]:
-        """Worker thread: pread every request's file into one contiguous
-        (n*cpb, 128) uint32 buffer — native engine when available (one
-        GIL-free call for the whole round), per-file Python otherwise.
-        In ``host_verify`` mode also returns each slot's whole-block CRC
-        (fused into the same native call)."""
+        self, reqs: list[_Req], buf: np.ndarray,
+    ) -> tuple[list[bool], np.ndarray | None]:
+        """Worker thread: pread every request's file into the caller's
+        pooled contiguous (n*cpb, 128) uint32 buffer — native engine when
+        available (one GIL-free call for the whole round), per-file Python
+        otherwise. In ``host_verify`` mode also returns each slot's
+        whole-block CRC (fused into the same native call)."""
         import ctypes
 
         cpb = reqs[0].cpb
         stride = cpb * CHECKSUM_CHUNK_SIZE
-        buf = np.empty((len(reqs) * cpb, WORDS_PER_CHUNK), dtype="<u4")
         lib = native.get_lib()
         if lib is not None and hasattr(lib, "tpudfs_blocks_read"):
             paths = (ctypes.c_char_p * len(reqs))(
@@ -383,7 +422,7 @@ class ReadCombiner:
                     paths, len(reqs), stride,
                     buf.ctypes.data, sizes.ctypes.data,
                 )
-            return (buf, [int(s) == r.size for s, r in zip(sizes, reqs)],
+            return ([int(s) == r.size for s, r in zip(sizes, reqs)],
                     crcs)
         from tpudfs.common.checksum import crc32c
 
@@ -407,24 +446,44 @@ class ReadCombiner:
             if crcs is not None:
                 crcs[i] = crc32c(data)
             ok.append(True)
-        return buf, ok, crcs
+        return ok, crcs
 
     # ----------------------------------------------------- stage 2: device
 
     async def _upload_stage(self, queue: asyncio.Queue) -> None:
         from tpudfs.tpu.hbm_reader import DeviceBlock
 
+        is_cpu = getattr(self.device, "platform", "cpu") == "cpu"
+        #: words of sub-rounds sharing the current (unreleased) buffer —
+        #: the buffer may only return to the pool once every transfer out
+        #: of it completed (device_put COPIES immediately on CPU; on
+        #: accelerators it may still be reading the host buffer until the
+        #: device array is ready).
+        since_release: list = []
+        skip_next_release = False  # a sub-round of this buffer failed
         while True:
             item = await queue.get()
             if item is None:
                 return
-            reqs, rows, cpb, host_verified = item
+            reqs, rows, cpb, host_verified, release, pooled = item
             try:
                 words = await asyncio.to_thread(
                     jax.device_put, rows, self.device
                 )
                 crcs = None if host_verified else \
                     batch_block_crc_device(words, len(reqs))
+                if release is not None and not skip_next_release \
+                        and not is_cpu:
+                    # The pooled buffer may only be reused once every
+                    # transfer out of it completed (device_put copies
+                    # immediately on CPU; accelerators may still be
+                    # reading the host buffer). Completion wait only —
+                    # no readback. Inside the try: a device error here
+                    # must take the same fall-back path as a failed
+                    # device_put, not kill the consumer task.
+                    await asyncio.to_thread(
+                        jax.block_until_ready, since_release + [words]
+                    )
             except asyncio.CancelledError:
                 self._fail_out(reqs)
                 raise
@@ -436,10 +495,20 @@ class ReadCombiner:
                 # its own error) and keep consuming.
                 logger.warning("fused upload failed (%s); falling back "
                                "%d blocks", e, len(reqs))
+                since_release = []  # buffer state unknown: drop, don't pool
+                skip_next_release = pooled and release is None
                 for r in reqs:
                     if not r.fut.done():
                         r.fut.set_result(_FALLBACK)
                 continue
+            if release is not None:
+                if skip_next_release:
+                    skip_next_release = False  # buffer dropped, not pooled
+                else:
+                    self._put_buf(release)
+                since_release = []
+            elif pooled:
+                since_release.append(words)
             batch = DeviceBatch(words=words, crcs=crcs, cpb=cpb,
                                 nblocks=len(reqs))
             self.rounds += 1
