@@ -71,8 +71,13 @@ async def run() -> None:
                     *(put_set(s, i) for i in range(FILES)))
             print("3 extra sets written")
 
+        import os
+
         device = jax.devices()[0]
-        reader = HbmReader(client, [device], batch_reads=bench.BATCH_READS)
+        batch = int(os.environ.get("LAB_BATCH", bench.BATCH_READS))
+        conc = int(os.environ.get("LAB_CONC", bench.FUSED_READ_CONCURRENCY))
+        bench.FUSED_READ_CONCURRENCY = conc
+        reader = HbmReader(client, [device], batch_reads=batch)
         reader.warm_batches((bench.BLOCK_MB << 20) // 512)
         metas = await asyncio.gather(
             *(client.get_file_info(f"/lab/f{i:04d}") for i in range(FILES))
