@@ -711,14 +711,14 @@ class Engine {
     if (!cache_cap_) return 0;
     std::lock_guard<std::mutex> g(cache_mu_);
     auto it = inval_gen_.find(id);
-    return it == inval_gen_.end() ? 0 : it->second;
+    return it == inval_gen_.end() ? gen_floor_ : it->second;
   }
 
   void cache_put(const std::string& id, CacheData data, uint64_t gen) {
     if (!cache_cap_) return;
     std::lock_guard<std::mutex> g(cache_mu_);
     auto git = inval_gen_.find(id);
-    if ((git == inval_gen_.end() ? 0 : git->second) != gen)
+    if ((git == inval_gen_.end() ? gen_floor_ : git->second) != gen)
       return;  // a write/invalidate raced the read: don't pin old bytes
     auto it = cache_map_.find(id);
     if (it != cache_map_.end()) {
@@ -737,11 +737,17 @@ class Engine {
   void cache_invalidate(const std::string& id) {
     if (!cache_cap_) return;
     std::lock_guard<std::mutex> g(cache_mu_);
-    // Bound the generation map: clearing only LOWERS generations, which
-    // makes concurrent readers' cache_put skip (conservative, never
-    // stale).
-    if (inval_gen_.size() > 65536) inval_gen_.clear();
-    ++inval_gen_[id];
+    // Bound the generation map. Generations come from one monotone
+    // counter and a clear raises the floor past every value ever issued,
+    // so an id evicted from the map can never REUSE a generation a
+    // concurrent reader captured earlier (a plain per-id counter reset
+    // to zero could: capture 0 -> invalidate -> clear -> absent reads 0
+    // again and the stale cache_put would pass).
+    if (inval_gen_.size() > 65536) {
+      inval_gen_.clear();
+      gen_floor_ = ++gen_counter_;
+    }
+    inval_gen_[id] = ++gen_counter_;
     auto it = cache_map_.find(id);
     if (it != cache_map_.end()) {
       cache_list_.erase(it->second);
@@ -1411,6 +1417,8 @@ class Engine {
   std::map<std::string, std::list<std::pair<std::string, CacheData>>::iterator>
       cache_map_;
   std::map<std::string, uint64_t> inval_gen_;  // see cache_gen/cache_put
+  uint64_t gen_counter_ = 0;  // monotone source of every generation
+  uint64_t gen_floor_ = 0;    // generation reported for absent ids
   std::atomic<uint64_t> cache_hits_{0}, cache_misses_{0};
   void* srv_ctx_ = nullptr;  // SSL_CTX*, set by configure_tls
   void* cli_ctx_ = nullptr;  // SSL_CTX* for chain forwards

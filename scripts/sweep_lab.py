@@ -16,7 +16,8 @@ sys.path.insert(0, ".")
 
 import bench  # noqa: E402
 
-SWEEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+_numeric = [a for a in sys.argv[1:] if not a.startswith("-")]
+SWEEPS = int(_numeric[0]) if _numeric else 6
 FILES = bench.FILES
 
 
@@ -78,7 +79,37 @@ async def run() -> None:
         conc = int(os.environ.get("LAB_CONC", bench.FUSED_READ_CONCURRENCY))
         bench.FUSED_READ_CONCURRENCY = conc
         reader = HbmReader(client, [device], batch_reads=batch)
+        # Warm BEFORE the profiling patch: warm-up transfers must not
+        # count toward the profiled device_put stage total.
         reader.warm_batches((bench.BLOCK_MB << 20) // 512)
+
+        stage_t = {"fill": 0.0, "put": 0.0, "rounds": 0}
+        if "--profile" in sys.argv:
+            # Wall-clock per combiner stage (both run off the event loop,
+            # so their sum can exceed the sweep time only via overlap —
+            # on one core it should roughly EQUAL sweep time; the
+            # difference is Python staging/scheduling).
+            from tpudfs.tpu.read_combiner import ReadCombiner
+
+            real_fill = ReadCombiner._fill_buffer
+
+            def timed_fill(self, reqs, buf):
+                t0 = time.perf_counter()
+                out = real_fill(self, reqs, buf)
+                stage_t["fill"] += time.perf_counter() - t0
+                stage_t["rounds"] += 1
+                return out
+
+            ReadCombiner._fill_buffer = timed_fill
+            real_put = jax.device_put
+
+            def timed_put(x, *a, **k):
+                t0 = time.perf_counter()
+                out = real_put(x, *a, **k)
+                stage_t["put"] += time.perf_counter() - t0
+                return out
+
+            jax.device_put = timed_put
         metas = await asyncio.gather(
             *(client.get_file_info(f"/lab/f{i:04d}") for i in range(FILES))
         )
@@ -154,6 +185,10 @@ async def run() -> None:
               f"[{min(colds):.3f},{max(colds):.3f}]  "
               f"warm median {statistics.median(warms):.3f} "
               f"[{min(warms):.3f},{max(warms):.3f}]")
+        if stage_t["rounds"]:
+            print(f"stages: fill {stage_t['fill']:.2f}s "
+                  f"device_put {stage_t['put']:.2f}s over "
+                  f"{stage_t['rounds']} rounds")
         await rpc.close()
     finally:
         from tpudfs.testing.procs import terminate_all
