@@ -70,6 +70,7 @@ import numpy as np
 #:    single TPU compile is 20-40 s; the 5-bucket warm-up ~200 s; nothing
 #:    legitimate is silent for 10 min).
 WEDGE_TIMEOUT_S = 600.0
+WEDGE_POLL_S = 15.0
 _progress = {"t": None, "stage": "start"}  # t None = watchdog disarmed
 _partial: dict = {}
 
@@ -85,7 +86,7 @@ def _start_watchdog() -> None:
 
     def watch() -> None:
         while True:
-            time.sleep(15.0)
+            time.sleep(WEDGE_POLL_S)
             t0 = _progress["t"]
             if t0 is None:
                 continue

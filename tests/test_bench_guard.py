@@ -1,0 +1,85 @@
+"""The bench's driver-facing contract: ONE parseable JSON line, even when
+the tunneled TPU wedges mid-run (bench.py's watchdog + re-probe defenses;
+see BENCH_NOTES round 4 for the measured incident these guard against)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_watchdog_emits_partial_json_and_exits_hard():
+    """No completed window for WEDGE_TIMEOUT_S -> whatever was measured so
+    far goes out as the one JSON line and the process exits 3 instead of
+    hanging the driver forever."""
+    code = (
+        "import bench, time\n"
+        "bench.WEDGE_TIMEOUT_S = 0.2\n"
+        "bench.WEDGE_POLL_S = 0.05\n"
+        "bench._partial.update({'write_pipeline_GBps': 0.123})\n"
+        "bench._tick('unit-stage')\n"
+        "bench._start_watchdog()\n"
+        "time.sleep(30)\n"  # the watchdog must kill us long before this
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, timeout=25,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+    )
+    assert r.returncode == 3, (r.returncode, r.stderr[-500:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "tpu-wedged-midrun(unit-stage)"
+    assert out["write_pipeline_GBps"] == 0.123
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in out, k
+
+
+def test_watchdog_disarmed_without_tick():
+    """Before the first _tick the watchdog must not fire (cluster spawn
+    and probe phases arm it explicitly)."""
+    code = (
+        "import bench, time, sys\n"
+        "bench.WEDGE_TIMEOUT_S = 0.1\n"
+        "bench.WEDGE_POLL_S = 0.02\n"
+        "bench._start_watchdog()\n"
+        "time.sleep(0.5)\n"
+        "print('alive')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, timeout=20,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+    )
+    assert r.returncode == 0 and "alive" in r.stdout
+
+
+def test_decide_device_falls_back_when_tpu_dies_midrun(monkeypatch):
+    """A TPU that passed the startup probe but died during the write phase
+    must downgrade the run to CPU at the first device touch, not hang."""
+    import bench
+
+    monkeypatch.setattr(bench, "_tpu_intended", True)
+    monkeypatch.setattr(bench, "_fell_back_midrun", False)
+    monkeypatch.setattr(bench, "_probe_tpu", lambda **k: False)
+    device = bench._decide_device()
+    assert device.platform == "cpu"
+    assert bench._fell_back_midrun is True
+
+
+def test_decide_device_no_probe_when_cpu_run(monkeypatch):
+    """CPU-requested runs must not pay the re-probe (or flip the
+    mid-run-fallback flag)."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_probe_tpu",
+                        lambda **k: calls.append(1) or True)
+    monkeypatch.setattr(bench, "_tpu_intended", False)
+    monkeypatch.setattr(bench, "_fell_back_midrun", False)
+    device = bench._decide_device()
+    assert device.platform == "cpu"
+    assert not calls and bench._fell_back_midrun is False
