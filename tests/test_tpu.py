@@ -79,6 +79,95 @@ def test_ici_chain_replication_layout():
             )
 
 
+def test_pod_mesh_2d_chain_and_ec_ride_ici_axis():
+    """Multi-host pod layout: a (dcn, ici) 2-D mesh where the replication
+    chain and the EC scatter/degraded gather ride the LAST (ici) axis and
+    the dcn axis carries independent data-parallel write groups — DCN
+    never moves block bytes (reference multi-host scaling via NCCL/MPI,
+    re-expressed as mesh axes)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpudfs.tpu.ici_replication import (
+        EcShardGather, EcShardScatter, IciReplicator,
+    )
+
+    devs = jax.devices()[:8]
+    n_dcn, n_ici = 2, 4
+    mesh = Mesh(np.array(devs).reshape(n_dcn, n_ici), ("dcn", "ici"))
+    C = 2  # chunks per host
+    rng = np.random.default_rng(33)
+    blocks = [rng.integers(0, 256, C * 512, dtype=np.uint8).tobytes()
+              for _ in range(8)]
+    data = b"".join(blocks)
+    words = jnp.asarray(bytes_to_words(data))
+    crcs = jnp.asarray(crc32c_chunks(data).astype(np.uint32))
+    sharding = NamedSharding(mesh, P(("dcn", "ici")))
+    words = jax.device_put(words, sharding)
+    crcs = jax.device_put(crcs, sharding)
+
+    # 3x chain per dcn row: host (a, b) must hold rows (a, b-r % n_ici) —
+    # the chain never crosses the dcn axis.
+    rep = IciReplicator(mesh, replication=3, axis="ici")
+    replicas, ok, acks = rep.replicate(words, crcs)
+    assert int(acks) == 8 and bool(jnp.all(ok))
+    rep_np = np.asarray(replicas).reshape(n_dcn, n_ici, 3, C, 128)
+    src = np.asarray(words).reshape(n_dcn, n_ici, C, 128)
+    for a in range(n_dcn):
+        for b in range(n_ici):
+            for r in range(3):
+                np.testing.assert_array_equal(
+                    rep_np[a, b, r], src[a, (b - r) % n_ici],
+                    err_msg=f"group {a} host {b} replica {r}",
+                )
+
+    # EC(2,2) scatter + degraded gather per row; ring position 1 of EVERY
+    # dcn group serves garbage and each host still reconstructs its data.
+    k, m = 2, 2
+    scatter = EcShardScatter(mesh, k, m, axis="ici")
+    shards, ec_ok, ec_acks = scatter.scatter(words)
+    assert int(ec_acks) == 8 and bool(np.asarray(ec_ok).all())
+    broken = np.asarray(shards).copy().reshape(n_dcn, n_ici, k + m, -1, 128)
+    broken[:, 1] = 0xCD
+    gather = EcShardGather(mesh, k, m, axis="ici")
+    recon = np.asarray(gather.gather(
+        jax.device_put(jnp.asarray(broken.reshape(shards.shape)), sharding),
+        failed=1,
+    ))
+    per = -(-(C * 512) // k)
+    shard_len_b = -(-per // 512) * 512
+    recon = recon.reshape(8, k, -1)
+    for i in range(8):
+        got = b"".join(
+            recon[i, r].astype("<u4").tobytes()[:shard_len_b]
+            for r in range(k)
+        )[:C * 512]
+        assert got == blocks[i], f"host {i} degraded reconstruction"
+
+
+def test_pod_mesh_size1_ring_axis_rejected():
+    """A multi-device mesh whose ring axis has size 1 must raise, not
+    silently produce zero redundancy (self-ppermute 'replicas') or decode
+    a codeword entirely from the 'failed' device's shards."""
+    from jax.sharding import Mesh
+
+    from tpudfs.tpu.ici_replication import (
+        EcShardGather, EcShardScatter, IciReplicator,
+    )
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs).reshape(4, 1), ("dcn", "ici"))
+    with pytest.raises(ValueError):
+        IciReplicator(mesh, replication=3, axis="ici")
+    with pytest.raises(ValueError):
+        EcShardScatter(mesh, 2, 1, axis="ici")
+    with pytest.raises(ValueError):
+        EcShardGather(mesh, 2, 1, axis="ici")
+    # And the ring axis must be the LAST mesh axis.
+    mesh2 = Mesh(np.array(devs).reshape(2, 2), ("ici", "dcn"))
+    with pytest.raises(ValueError):
+        IciReplicator(mesh2, replication=2, axis="ici")
+
+
 def test_ici_chain_detects_corruption():
     mesh = make_mesh(jax.devices()[:4])
     rep = IciReplicator(mesh, replication=3)

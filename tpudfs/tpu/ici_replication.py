@@ -17,6 +17,15 @@ replicas runs on-device via the Pallas CRC kernel (jnp fallback off-TPU).
 
 Works identically on the virtual CPU mesh used in tests (the driver's
 ``dryrun_multichip`` path) and a real multi-chip mesh.
+
+Multi-host pods: every collective here also runs on an N-D mesh (e.g.
+``Mesh(devs.reshape(n_hosts, chips), ("dcn", "ici"))``) with the ring
+``axis`` naming the LAST mesh axis — the chain/scatter then rides ICI
+inside each host row while the leading axes carry independent
+data-parallel write groups (the reference's NCCL/MPI multi-host scaling,
+re-expressed as mesh axes; DCN never carries block bytes, matching the
+reference's rack-aware "replicas stay in-rack" placement). Ack psums
+reduce over the WHOLE mesh: one scalar says every group verified.
 """
 
 from __future__ import annotations
@@ -39,28 +48,47 @@ def make_mesh(devices=None, axis: str = "hosts") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def _ring_axis(mesh: Mesh, axis: str | None) -> str:
+    """The axis the chain/scatter rings ride. On N-D meshes it must be
+    the LAST (fastest-varying) axis: per-position state built host-side
+    (EcShardGather's decode matrices) maps device order to ring position
+    as ``flat_index % ring_size``, which only holds for the last axis."""
+    axis = axis or mesh.axis_names[-1]
+    if axis != mesh.axis_names[-1]:
+        raise ValueError(
+            f"ring axis {axis!r} must be the last mesh axis "
+            f"{mesh.axis_names[-1]!r}")
+    return axis
+
+
+def _all_axes(mesh: Mesh) -> tuple:
+    return tuple(mesh.axis_names)
+
+
 class IciReplicator:
     """R-way chain replication of per-host chunk groups over the mesh."""
 
     def __init__(self, mesh: Mesh, replication: int = 3, axis: str | None = None):
         self.mesh = mesh
-        self.axis = axis or mesh.axis_names[0]
+        self.axis = _ring_axis(mesh, axis)
         self.replication = replication
-        n = mesh.devices.size
+        n = mesh.shape[self.axis]
         # Single-chip exception: every hop is a self-ppermute, replicas
         # coincide — degenerate but still compiles and runs the full
         # collective graph, which is what the driver's entry() exercises
-        # on the one real chip. Any larger mesh must hold R distinct
-        # replicas, so replication > n stays an error there.
-        if n > 1 and replication > n:
-            raise ValueError(f"replication {replication} > mesh size {n}")
+        # on the one real chip. Any MULTI-device mesh must hold R distinct
+        # replicas along the ring (a size-1 ring axis on a larger mesh
+        # would silently produce zero redundancy), so the exception keys
+        # on the TOTAL device count, not the ring size.
+        if mesh.devices.size > 1 and replication > n:
+            raise ValueError(f"replication {replication} > ring axis size {n}")
         self._fn = self._build()
 
     def _build(self):
         axis = self.axis
         R = self.replication
         mesh = self.mesh
-        n = mesh.devices.size
+        n = mesh.shape[axis]
 
         def step(local_words: jnp.ndarray, local_crcs: jnp.ndarray):
             # local_words: (C, 128) uint32 — this host's pending chunk batch.
@@ -82,12 +110,14 @@ class IciReplicator:
                 lambda w: crc32c_chunks_device(w, use_pallas=None)
             )(stacked)
             ok = jnp.all(actual == expected)
-            # replicas_written analogue: how many hosts verified every replica.
-            acks = jax.lax.psum(ok.astype(jnp.int32), axis)
+            # replicas_written analogue: how many hosts verified every
+            # replica — psum over EVERY mesh axis so the scalar covers all
+            # data-parallel groups of an N-D pod mesh, not just this ring.
+            acks = jax.lax.psum(ok.astype(jnp.int32), _all_axes(mesh))
             # ok gets a singleton axis: rank-0 outputs can't vary over a mesh.
             return stacked, ok[None], acks
 
-        spec_in = P(self.axis)
+        spec_in = P(_all_axes(mesh))
         # check_vma=False: pallas_call outputs don't carry vma metadata yet
         # (JAX 0.9), so the varying-across-mesh check can't see through them.
         return jax.jit(shard_map(
@@ -99,14 +129,15 @@ class IciReplicator:
         ))
 
     def replicate(self, words: jax.Array, crcs: jax.Array):
-        """words: (n*C, 128) uint32 sharded over the mesh axis (C chunks per
-        host); crcs: (n*C,) uint32. Returns (replicas, ok, acks):
-        replicas (n*R, C, 128) — R replica groups per host, ok per-host
-        verify bit, acks = number of hosts whose replicas all verified."""
+        """words: (N*C, 128) uint32 sharded over every mesh axis (N =
+        total devices, C chunks per host); crcs: (N*C,) uint32. Returns
+        (replicas, ok, acks): replicas (N*R, C, 128) — R replica groups
+        per host, ok per-host verify bit, acks = number of hosts (across
+        ALL data-parallel groups) whose replicas all verified."""
         return self._fn(words, crcs)
 
     def sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P(self.axis))
+        return NamedSharding(self.mesh, P(_all_axes(self.mesh)))
 
 
 @partial(jax.jit, static_argnames=("k", "m"))
@@ -142,19 +173,22 @@ class EcShardScatter:
     """
 
     def __init__(self, mesh: Mesh, k: int, m: int, axis: str | None = None):
-        n = mesh.devices.size
-        if n > 1 and k + m > n:
-            raise ValueError(f"RS({k},{m}) scatter needs {k + m} devices, "
-                             f"mesh has {n}")
+        self.axis = _ring_axis(mesh, axis)
+        n = mesh.shape[self.axis]
+        # Degenerate-layout exception only for a true single-chip mesh —
+        # see IciReplicator.__init__ (a size-1 ring axis on a multi-device
+        # mesh must stay an error, not silently co-locate every shard).
+        if mesh.devices.size > 1 and k + m > n:
+            raise ValueError(f"RS({k},{m}) scatter needs {k + m} ring "
+                             f"devices, axis has {n}")
         self.mesh = mesh
-        self.axis = axis or mesh.axis_names[0]
         self.k, self.m = k, m
         self._fn = self._build()
 
     def _build(self):
         axis, k, m = self.axis, self.k, self.m
         mesh = self.mesh
-        n = mesh.devices.size
+        n = mesh.shape[axis]
 
         def step(local_words: jnp.ndarray):
             # local_words: (C, 128) uint32 — this host's block batch.
@@ -189,20 +223,21 @@ class EcShardScatter:
             expected = jnp.stack(recv_crcs)      # (k+m, C')
             actual = jax.vmap(crc32c_chunks_device)(stacked)
             ok = jnp.all(actual == expected)
-            acks = jax.lax.psum(ok.astype(jnp.int32), axis)
+            acks = jax.lax.psum(ok.astype(jnp.int32), _all_axes(mesh))
             return stacked, ok[None], acks
 
-        spec = P(self.axis)
+        spec = P(_all_axes(mesh))
         return jax.jit(shard_map(
             step, mesh=mesh, in_specs=(spec,),
             out_specs=(spec, spec, P()), check_vma=False,
         ))
 
     def scatter(self, words: jax.Array):
-        """words: (n*C, 128) uint32 sharded over the mesh axis. Returns
-        (shards, ok, acks): shards (n*(k+m), C', 128) — device d's group
-        holds shard j of host (d - j) mod n at row j — per-host verify
-        bit, and the psum'd ack count."""
+        """words: (N*C, 128) uint32 sharded over every mesh axis (N =
+        total devices). Returns (shards, ok, acks): shards
+        (N*(k+m), C', 128) — within each ring, device d's group holds
+        shard j of host (d - j) mod ring_size at row j — per-host verify
+        bit, and the mesh-wide psum'd ack count."""
         return self._fn(words)
 
 
@@ -223,15 +258,15 @@ class EcShardGather:
     failure pattern, including none."""
 
     def __init__(self, mesh: Mesh, k: int, m: int, axis: str | None = None):
-        n = mesh.devices.size
-        if n > 1 and k + m > n:
-            # Same guard as EcShardScatter: on a smaller mesh a single
+        self.axis = _ring_axis(mesh, axis)
+        n = mesh.shape[self.axis]
+        if mesh.devices.size > 1 and k + m > n:
+            # Same guard as EcShardScatter: on a smaller ring a single
             # device holds MULTIPLE shards of one codeword, so one failure
             # exceeds what excluding one shard index can repair.
-            raise ValueError(f"RS({k},{m}) gather needs {k + m} devices, "
-                             f"mesh has {n}")
+            raise ValueError(f"RS({k},{m}) gather needs {k + m} ring "
+                             f"devices, axis has {n}")
         self.mesh = mesh
-        self.axis = axis or mesh.axis_names[0]
         self.k, self.m = k, m
         self._fn = self._build()
         #: failed-index -> sharded (n, k, k+m) matrix, cached on device so
@@ -249,17 +284,24 @@ class EcShardGather:
             return cached
         from tpudfs.tpu.rs_pallas import decode_matrix
 
-        n = self.mesh.devices.size
+        n = self.mesh.shape[self.axis]  # ring size
+        total = self.mesh.devices.size
         k, m = self.k, self.m
-        mats = np.zeros((n, k, k + m), dtype=np.uint8)
-        for i in range(n):
+        # One matrix per device, by its RING position (flat_index % n —
+        # valid because _ring_axis pins the ring to the last mesh axis);
+        # ``failed`` names a ring position, i.e. that position in EVERY
+        # data-parallel group loses its shards.
+        mats = np.zeros((total, k, k + m), dtype=np.uint8)
+        for idx in range(total):
+            i = idx % n
             j0 = (failed - i) % n if failed is not None else None
             present = [j for j in range(k + m) if j != j0][:k]
             dec = decode_matrix(k, m, tuple(present))
             for rank, j in enumerate(present):
-                mats[i, :, j] = dec[:, rank]
+                mats[idx, :, j] = dec[:, rank]
         out = jax.device_put(
-            jnp.asarray(mats), NamedSharding(self.mesh, P(self.axis))
+            jnp.asarray(mats),
+            NamedSharding(self.mesh, P(_all_axes(self.mesh))),
         )
         self._mats[failed] = out
         return out
@@ -269,7 +311,7 @@ class EcShardGather:
 
         axis, k, m = self.axis, self.k, self.m
         mesh = self.mesh
-        n = mesh.devices.size
+        n = mesh.shape[axis]
 
         def step(local_shards, mats):
             # local_shards: (k+m, S, 128) — row j = shard j of host
@@ -287,17 +329,19 @@ class EcShardGather:
             )
             return data.reshape(k, S, WORDS_PER_CHUNK)
 
-        spec = P(self.axis)
+        spec = P(_all_axes(mesh))
         return jax.jit(shard_map(
             step, mesh=mesh, in_specs=(spec, spec),
             out_specs=spec, check_vma=False,
         ))
 
     def gather(self, shards: jax.Array, failed: int | None = None) -> jax.Array:
-        """``shards``: EcShardScatter's (n*(k+m), S, 128) layout. Returns
-        (n*k, S, 128): each host's k reconstructed DATA shards, bit-exact
-        with its original encoding even when device ``failed``'s rows are
-        garbage (any single device loss is within RS(k,m>=1) tolerance)."""
+        """``shards``: EcShardScatter's (N*(k+m), S, 128) layout (N =
+        total devices). Returns (N*k, S, 128): each host's k
+        reconstructed DATA shards, bit-exact with its original encoding
+        even when ring position ``failed``'s rows are garbage in every
+        data-parallel group (one loss per ring is within RS(k,m>=1)
+        tolerance)."""
         if failed is not None and self.mesh.devices.size == 1:
             # A 1-device mesh holds EVERY shard of the codeword on the
             # "failed" device — excluding one shard index there decodes
@@ -327,8 +371,8 @@ def replicated_write_step(mesh: Mesh, replication: int = 3,
             shard_map(
                 lambda w: _parity_of_words(w, k, m),
                 mesh=mesh,
-                in_specs=P(mesh.axis_names[0]),
-                out_specs=P(mesh.axis_names[0]),
+                in_specs=P(tuple(mesh.axis_names)),
+                out_specs=P(tuple(mesh.axis_names)),
                 check_vma=False,
             )
         )
