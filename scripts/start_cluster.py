@@ -98,6 +98,12 @@ def main() -> None:
                     help="write endpoint-map JSON here when fully up")
     ap.add_argument("--no-wait", action="store_true",
                     help="exit after starting (processes keep running)")
+    ap.add_argument("--tls", action="store_true",
+                    help="mint a cluster PKI and run EVERY transport over "
+                         "TLS: gRPC listeners, raft peer channels, the raw "
+                         "blockport (native engine included), and the S3 "
+                         "gateway's backend client (reference security.rs: "
+                         "TLS on every transport)")
     args = ap.parse_args()
     topo = load_topology(args)
 
@@ -108,10 +114,20 @@ def main() -> None:
         atexit.register(cleanup)
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
 
+    tls_args: list[str] = []
+    pki: dict = {}
+    if args.tls:
+        from tpudfs.testing.certs import make_test_pki
+
+        pki = make_test_pki(root / "pki")
+        tls_args = ["--tls-cert", pki["server_cert"],
+                    "--tls-key", pki["server_key"],
+                    "--tls-ca", pki["ca"]]
+
     cfg_port = free_port()
     cfg = f"127.0.0.1:{cfg_port}"
     spawn("config", logdir, "tpudfs.configserver", "--port", str(cfg_port),
-          "--data-dir", str(root / "cfg"))
+          "--data-dir", str(root / "cfg"), *tls_args)
     wait_ready(logdir, "config")
     print(f"config server  {cfg}  (ops http://127.0.0.1:{cfg_port + 1000})")
 
@@ -129,7 +145,12 @@ def main() -> None:
     from tpudfs.common.rpc import RpcClient  # noqa: E402
 
     async def add_shards():
-        rpc = RpcClient()
+        if pki:
+            from tpudfs.common.rpc import ClientTls
+
+            rpc = RpcClient(tls=ClientTls(ca_path=pki["ca"]))
+        else:
+            rpc = RpcClient()
         for s in topo["shards"]:
             for _ in range(60):
                 try:
@@ -160,7 +181,7 @@ def main() -> None:
                   "--peers", ",".join(peers), "--shard-id", sid,
                   "--config-servers", cfg,
                   "--split-threshold-rps",
-                  str(topo["split_threshold_rps"]), addr=addr)
+                  str(topo["split_threshold_rps"]), *tls_args, addr=addr)
         for i, addr in enumerate(addrs):
             wait_ready(logdir, f"{sid}-m{i}")
             print(f"{sid}-m{i}     {addr}  "
@@ -172,7 +193,7 @@ def main() -> None:
         port = free_port()
         spawn(f"spare{i}", logdir, "tpudfs.master", "--port", str(port),
               "--data-dir", str(root / f"spare{i}"), "--shard-id", "",
-              "--config-servers", cfg)
+              "--config-servers", cfg, *tls_args)
         wait_ready(logdir, f"spare{i}")
         print(f"spare{i}         127.0.0.1:{port}")
 
@@ -182,17 +203,21 @@ def main() -> None:
               "--data-dir", str(root / f"cs{i}"),
               "--rack-id", f"rack-{i % topo['racks']}",
               "--masters", ",".join(all_masters), "--config-servers", cfg,
-              "--heartbeat-interval", "2", addr=f"127.0.0.1:{port}")
+              "--heartbeat-interval", "2", *tls_args,
+              addr=f"127.0.0.1:{port}")
         wait_ready(logdir, f"cs{i}")
         print(f"chunkserver{i}   127.0.0.1:{port}  "
               f"(ops http://127.0.0.1:{port + 1000})")
         endpoints["chunkservers"].append(f"127.0.0.1:{port}")
 
     if topo["s3"]:
-        spawn("s3", logdir, "tpudfs.s3", env={
+        s3_env = {
             "MASTER_ADDRS": ",".join(all_masters), "CONFIG_SERVERS": cfg,
             "S3_PORT": str(args.s3_port), "S3_AUTH_ENABLED": "false",
-        })
+        }
+        if pki:
+            s3_env["S3_BACKEND_TLS_CA"] = pki["ca"]
+        spawn("s3", logdir, "tpudfs.s3", env=s3_env)
         wait_ready(logdir, "s3")
         print(f"s3 gateway     http://127.0.0.1:{args.s3_port}")
         endpoints["s3"] = f"http://127.0.0.1:{args.s3_port}"
@@ -200,6 +225,10 @@ def main() -> None:
     print(f"\nCLI: python -m tpudfs.client.cli --config-servers {cfg} "
           f"--masters {','.join(all_masters)} <cmd>")
     print("logs:", logdir)
+    if pki:
+        endpoints["tls"] = {"ca": pki["ca"],
+                            "client_cert": pki["client_cert"],
+                            "client_key": pki["client_key"]}
     if args.ready_file:
         endpoints["pids"] = [p.pid for p in PROCS]
         endpoints["procs"] = PROC_MAP
