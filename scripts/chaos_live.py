@@ -81,8 +81,13 @@ async def chaos(eps: dict) -> None:
     procs = eps["procs"]
     addr_to_name = {v["addr"]: k for k, v in procs.items() if v["addr"]}
 
+    tls = None
+    if eps.get("tls"):
+        from tpudfs.common.rpc import ClientTls
+
+        tls = ClientTls(ca_path=eps["tls"]["ca"])
     client = Client(masters, config_addrs=[eps["config_server"]],
-                    block_size=256 * 1024, rpc_timeout=10.0)
+                    block_size=256 * 1024, rpc_timeout=10.0, tls=tls)
     deadline = time.time() + 90
     while True:
         try:
@@ -116,7 +121,7 @@ async def chaos(eps: dict) -> None:
     # window; dozens of them blow the budget into UNKNOWN).
     wl_client = Client(masters, config_addrs=[eps["config_server"]],
                       rpc_timeout=3.0, max_retries=8,
-                      host_aliases={leader1: proxy_addr})
+                      host_aliases={leader1: proxy_addr}, tls=tls)
 
     # Small rename pods keep the checker's rename-connected components
     # tractable under many maybe-applied ops (each crash op widens the
@@ -177,7 +182,7 @@ async def chaos(eps: dict) -> None:
     # t7: md5-verify the payload with a FRESH client (no warm leader hints);
     # reads must fail over around the dead chunkserver's replicas.
     v_client = Client(masters, config_addrs=[eps["config_server"]],
-                      rpc_timeout=10.0)
+                      rpc_timeout=10.0, tls=tls)
     back = await v_client.get_file("/a/chaos-payload")
     got_md5 = hashlib.md5(back).hexdigest()
     assert got_md5 == payload_md5, (
@@ -225,7 +230,9 @@ def main() -> None:
 
 
 def _run_once() -> None:
-    topology = sys.argv[1] if len(sys.argv) > 1 else \
+    args = [a for a in sys.argv[1:] if a != "--tls"]
+    use_tls = "--tls" in sys.argv
+    topology = args[0] if args else \
         str(REPO / "deploy/topologies/two-shard-ha.json")
     env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
     with tempfile.TemporaryDirectory(prefix="tpudfs-chaos-") as tmp:
@@ -233,7 +240,8 @@ def _run_once() -> None:
         launcher = subprocess.Popen(
             [sys.executable, "scripts/start_cluster.py",
              "--topology", topology, "--data-dir", f"{tmp}/cluster",
-             "--s3-port", "0", "--ready-file", str(ready)],
+             "--s3-port", "0", "--ready-file", str(ready),
+             *(["--tls"] if use_tls else [])],
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )

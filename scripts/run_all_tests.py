@@ -50,6 +50,20 @@ def cli(masters: list[str], cfg: str, *args: str, check: bool = True,
 
 def live_cluster_tier(topology: str, workload_ops: int,
                       tls: bool = False) -> None:
+    # One retry: start_cluster's free_port reservation has a TOCTOU
+    # window (same discipline as chaos_live) — an unlucky port collision
+    # should not fail the whole tier.
+    for attempt in (1, 2):
+        try:
+            return _live_cluster_tier_once(topology, workload_ops, tls)
+        except SystemExit as e:
+            if attempt == 2 or "failed to start" not in str(e):
+                raise
+            print(f"cluster start failed ({e}); retrying once")
+
+
+def _live_cluster_tier_once(topology: str, workload_ops: int,
+                            tls: bool = False) -> None:
     with tempfile.TemporaryDirectory(prefix="tpudfs-alltests-") as tmp:
         ready = pathlib.Path(tmp) / "endpoints.json"
         launcher = subprocess.Popen(
@@ -135,17 +149,17 @@ def live_cluster_tier(topology: str, workload_ops: int,
                         if line.startswith(
                                 "tpudfs_chunkserver_dataplane_writes_total"):
                             dp_writes += float(line.split()[-1])
-                from tpudfs.common import native
+                from tpudfs.common import blocknet, native
 
-                if native.has_dataplane():
+                if native.has_dataplane() and blocknet.enabled():
                     assert dp_writes > 0, \
                         "native engine inactive under TLS (regression: " \
                         "secured cluster fell back to asyncio blockport)"
                     print(f"native data plane active under TLS "
                           f"(dataplane_writes_total={dp_writes:.0f})")
                 else:
-                    print("native engine unavailable on this host; "
-                          "TLS tier ran on the asyncio blockport")
+                    print("native engine / blockport disabled on this "
+                          "host; TLS tier ran without the C++ data plane")
 
             # --- concurrent workload spanning both shards + WGL check.
             hist = pathlib.Path(tmp) / "history.jsonl"
@@ -202,6 +216,12 @@ def main() -> None:
         # linearizability_test.sh).
         run("live chaos tier",
             [sys.executable, "-u", "scripts/chaos_live.py", args.topology])
+        # The same fault schedule fully encrypted: failover, partition
+        # heal (TLS re-handshakes through the L4 proxy), and recovery all
+        # ride TLS channels, native engine included.
+        run("live chaos tier (TLS)",
+            [sys.executable, "-u", "scripts/chaos_live.py", args.topology,
+             "--tls"])
         # Add a 4th master to a RUNNING group under workload, remove the
         # old leader, verify discovery + no write loss (reference
         # dynamic_membership_test.sh / cluster_membership_test.sh).

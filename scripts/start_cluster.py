@@ -222,8 +222,9 @@ def main() -> None:
         print(f"s3 gateway     http://127.0.0.1:{args.s3_port}")
         endpoints["s3"] = f"http://127.0.0.1:{args.s3_port}"
 
+    tls_hint = f" --tls-ca {pki['ca']}" if pki else ""
     print(f"\nCLI: python -m tpudfs.client.cli --config-servers {cfg} "
-          f"--masters {','.join(all_masters)} <cmd>")
+          f"--masters {','.join(all_masters)}{tls_hint} <cmd>")
     print("logs:", logdir)
     if pki:
         endpoints["tls"] = {"ca": pki["ca"],
