@@ -162,11 +162,14 @@ async def run() -> None:
                 raw = bench._bench_raw_infeed(
                     device, bench.BLOCK_MB << 20, 16)
                 client.local_reads = False
+                import os as _os
+
+                gconc = int(_os.environ.get("LAB_GRPC_CONC",
+                                            bench.READ_CONCURRENCY))
                 g = await sweep(
                     lambda p: reader.read_file_to_device_blocks(
                         p, verify="lazy"),
-                    [f"/lab/f{j:04d}" for j in range(48)],
-                    bench.READ_CONCURRENCY)
+                    [f"/lab/f{j:04d}" for j in range(48)], gconc)
                 client.local_reads = True
                 print(f"  raw {raw:.3f} grpc {g:.3f}")
             c = await sweep(
