@@ -55,8 +55,11 @@ async def drive(eps: dict) -> None:
     masters = list(eps["shards"][sid0])
     cfg = eps["config_server"]
 
+    from tpudfs.testing.certs import tls_from_endpoints
+
+    tls, _ = tls_from_endpoints(eps)
     client = Client(masters, config_addrs=[cfg], block_size=256 * 1024,
-                    rpc_timeout=10.0, max_retries=8)
+                    rpc_timeout=10.0, max_retries=8, tls=tls)
     deadline = time.time() + 90
     while True:
         try:
@@ -83,7 +86,7 @@ async def drive(eps: dict) -> None:
     # t2: sustained hot traffic until the map splits. The EMA needs the
     # rate ABOVE threshold across several 5 s decay windows plus the 30 s
     # cooldown warm-up, so expect ~40-60 s before the carve.
-    rpc = RpcClient()
+    rpc = RpcClient(tls=tls)
     t0 = time.time()
     split_map = None
     ops = 0
@@ -117,7 +120,7 @@ async def drive(eps: dict) -> None:
     # t4: FRESH config-discovered client — REDIRECTs and the new routing
     # must be completely transparent.
     fresh = Client(config_addrs=[cfg], block_size=256 * 1024,
-                   rpc_timeout=10.0, max_retries=8)
+                   rpc_timeout=10.0, max_retries=8, tls=tls)
     # Ingest/shuffle may still be settling; reads retry through it.
     for path, want in md5s.items():
         deadline = time.time() + 60
@@ -176,7 +179,8 @@ def _run_once() -> None:
              "--masters", "3", "--spares", "3", "--chunkservers", "5",
              "--split-threshold-rps", str(SPLIT_THRESHOLD_RPS),
              "--data-dir", f"{tmp}/cluster",
-             "--s3-port", "0", "--ready-file", str(ready)],
+             "--s3-port", "0", "--ready-file", str(ready),
+             *(["--tls"] if "--tls" in sys.argv else [])],
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )

@@ -81,11 +81,9 @@ async def chaos(eps: dict) -> None:
     procs = eps["procs"]
     addr_to_name = {v["addr"]: k for k, v in procs.items() if v["addr"]}
 
-    tls = None
-    if eps.get("tls"):
-        from tpudfs.common.rpc import ClientTls
+    from tpudfs.testing.certs import tls_from_endpoints
 
-        tls = ClientTls(ca_path=eps["tls"]["ca"])
+    tls, _ = tls_from_endpoints(eps)
     client = Client(masters, config_addrs=[eps["config_server"]],
                     block_size=256 * 1024, rpc_timeout=10.0, tls=tls)
     deadline = time.time() + 90

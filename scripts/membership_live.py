@@ -105,8 +105,11 @@ async def drive(eps: dict, root: pathlib.Path) -> None:
     masters = list(eps["shards"][sid])
     cfg = eps["config_server"]
 
+    from tpudfs.testing.certs import tls_from_endpoints
+
+    tls, tls_args = tls_from_endpoints(eps)
     client = Client(masters, config_addrs=[cfg], block_size=256 * 1024,
-                    rpc_timeout=10.0)
+                    rpc_timeout=10.0, tls=tls)
     deadline = time.time() + 90
     while True:
         try:
@@ -124,7 +127,7 @@ async def drive(eps: dict, root: pathlib.Path) -> None:
     payload_md5 = hashlib.md5(payload).hexdigest()
     print(f"t1: payload written ({len(payload)} bytes, md5 {payload_md5})")
     wl_client = Client(masters, config_addrs=[cfg], rpc_timeout=3.0,
-                      max_retries=8)
+                      max_retries=8, tls=tls)
     cfg_wl = WorkloadConfig(clients=WORKLOAD_CLIENTS,
                             ops_per_client=WORKLOAD_OPS, keys=6, seed=7,
                             rename_pod_size=3)
@@ -140,7 +143,7 @@ async def drive(eps: dict, root: pathlib.Path) -> None:
                    "--port", str(new_port),
                    "--data-dir", str(root / "m-join"),
                    "--peers", ",".join(masters), "--shard-id", sid,
-                   "--config-servers", cfg,
+                   "--config-servers", cfg, *tls_args,
                    env={"JAX_PLATFORMS": "cpu"})
     procutil.wait_ready(logdir, "m-join")
     print(f"t2: joiner master up at {new_addr} (empty data dir)")
@@ -161,7 +164,7 @@ async def drive(eps: dict, root: pathlib.Path) -> None:
         assert st and st["last_applied"] > 0, f"joiner never applied: {st}"
 
         # t4: client-visible discovery through the config server.
-        rpc = RpcClient()
+        rpc = RpcClient(tls=tls)
         deadline = time.time() + 60
         while True:
             m = await rpc.call(cfg, "ConfigService", "FetchShardMap", {},
@@ -212,7 +215,7 @@ async def drive(eps: dict, root: pathlib.Path) -> None:
         # t7: a fresh client knowing ONLY the config server must discover
         # the post-change group and find every byte intact.
         fresh = Client(config_addrs=[cfg], block_size=256 * 1024,
-                       rpc_timeout=10.0)
+                       rpc_timeout=10.0, tls=tls)
         back = await fresh.get_file("/m/member-payload")
         got = hashlib.md5(back).hexdigest()
         assert got == payload_md5, f"payload md5 {got} != {payload_md5}"
@@ -247,7 +250,8 @@ def _run_once() -> None:
             [sys.executable, "scripts/start_cluster.py",
              "--topology", str(REPO / "deploy/topologies/single-shard-ha.json"),
              "--data-dir", f"{tmp}/cluster",
-             "--s3-port", "0", "--ready-file", str(ready)],
+             "--s3-port", "0", "--ready-file", str(ready),
+             *(["--tls"] if "--tls" in sys.argv else [])],
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )

@@ -227,11 +227,20 @@ def main() -> None:
         # dynamic_membership_test.sh / cluster_membership_test.sh).
         run("live membership tier",
             [sys.executable, "-u", "scripts/membership_live.py"])
+        # Learner catch-up (InstallSnapshot + appends), joint consensus,
+        # and leader removal all over encrypted raft channels; the joiner
+        # process serves the cluster PKI.
+        run("live membership tier (TLS)",
+            [sys.executable, "-u", "scripts/membership_live.py", "--tls"])
         # Drive hot-prefix traffic until the split detector carves the
         # range to a spare group; verify REDIRECTs + pre-split data
         # (reference auto_scaling_test.sh / shard_split_migration_test.sh).
         run("live autosplit tier",
             [sys.executable, "-u", "scripts/autosplit_live.py"])
+        # Hot-range carve + metadata handover to a freshly allocated
+        # group, fully encrypted.
+        run("live autosplit tier (TLS)",
+            [sys.executable, "-u", "scripts/autosplit_live.py", "--tls"])
         # Drive the authenticated gateway with the curl binary: presigned
         # PUT/GET/HEAD, range reads, aws-chunked streaming (reference
         # run_s3_test.sh exercises the same flows with the AWS CLI).

@@ -229,7 +229,11 @@ def main() -> None:
     if pki:
         endpoints["tls"] = {"ca": pki["ca"],
                             "client_cert": pki["client_cert"],
-                            "client_key": pki["client_key"]}
+                            "client_key": pki["client_key"],
+                            # Harness use (e.g. membership_live's joiner
+                            # master must serve the cluster's TLS).
+                            "server_cert": pki["server_cert"],
+                            "server_key": pki["server_key"]}
     if args.ready_file:
         endpoints["pids"] = [p.pid for p in PROCS]
         endpoints["procs"] = PROC_MAP

@@ -218,3 +218,22 @@ async def test_configserver_bootstrap_shards(tmp_path):
         await cfg.stop()
         await server.stop()
         await rpc.close()
+
+
+def test_helm_tls_blocks_consistent_across_templates():
+    """The TLS stanza is intentionally inlined per template (no helm
+    binary in CI to render-validate a _helpers refactor), so this pins
+    the four copies against drift: same secret reference, same mount
+    path, and the same flag paths the services expect."""
+    served = ["master.yaml", "configserver.yaml", "chunkserver.yaml"]
+    for tpl in served + ["s3server.yaml"]:
+        text = (HELM / "templates" / tpl).read_text()
+        assert ".Values.tls.secretName" in text, tpl
+        assert "secret: {secretName: {{ .Values.tls.secretName }}}" in text, tpl
+        assert "- {name: tls, mountPath: /tls, readOnly: true}" in text, tpl
+    for tpl in served:
+        text = (HELM / "templates" / tpl).read_text()
+        assert "--tls-cert /tls/tls.crt --tls-key /tls/tls.key" in text, tpl
+        assert "--tls-ca /tls/ca.crt" in text, tpl
+    s3 = (HELM / "templates" / "s3server.yaml").read_text()
+    assert "S3_BACKEND_TLS_CA" in s3 and "value: /tls/ca.crt" in s3

@@ -52,3 +52,18 @@ def make_test_pki(root: str | pathlib.Path,
         out[f"{role}_cert"] = str(cert)
         out[f"{role}_key"] = str(key)
     return out
+
+
+def tls_from_endpoints(eps: dict):
+    """Shared harness glue: (ClientTls | None, server_tls_args) from a
+    start_cluster ready-file's ``tls`` entry — one place to extend when
+    the endpoint TLS schema grows (e.g. client-cert mTLS)."""
+    info = eps.get("tls")
+    if not info:
+        return None, []
+    from tpudfs.common.rpc import ClientTls
+
+    return (ClientTls(ca_path=info["ca"]),
+            ["--tls-cert", info["server_cert"],
+             "--tls-key", info["server_key"],
+             "--tls-ca", info["ca"]])
