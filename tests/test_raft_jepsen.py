@@ -27,16 +27,19 @@ def _balances(commands):
     return bal
 
 
-def test_bank_invariant_under_faults():
-    c = SimCluster(5, seed=42)
-    rng = random.Random(7)
+def run_bank_case(c: SimCluster, rng: random.Random,
+                  fault_schedule: dict[int, str],
+                  steps: int = 48) -> tuple[str | None, int]:
+    """Drive the replicated bank through ``fault_schedule`` and check the
+    jepsen invariants. Shared by the pinned test below and the
+    seed-sweep soak (scripts/raft_fuzz_soak.py) so the checker can never
+    drift between them. Returns (violation | None, acked_count)."""
     c.wait_for_leader()
     acked: list[dict] = []
     attempts = 0
-    fault_schedule = {10: "partition", 20: "heal", 28: "crash", 36: "restart"}
     crashed = None
 
-    for step in range(48):
+    for step in range(steps):
         action = fault_schedule.get(step)
         if action == "partition":
             lead = c.leader()
@@ -47,7 +50,7 @@ def test_bank_invariant_under_faults():
             c.heal()
         elif action == "crash":
             lead = c.leader()
-            if lead:
+            if lead and crashed is None:
                 crashed = lead.node_id
                 c.crash(crashed)
         elif action == "restart" and crashed:
@@ -84,12 +87,15 @@ def test_bank_invariant_under_faults():
     # All replicas applied identical command sequences.
     seqs = [c.committed_commands(nid) for nid in c.ids]
     for s in seqs[1:]:
-        assert s == seqs[0], "state-machine divergence"
+        if s != seqs[0]:
+            return "state-machine divergence", len(acked)
 
     # Balance conservation on the final state.
     bal = _balances(seqs[0])
-    assert sum(bal.values()) == INITIAL * len(ACCOUNTS), bal
-    assert all(v >= 0 for v in bal.values()), bal
+    if sum(bal.values()) != INITIAL * len(ACCOUNTS):
+        return f"balance leak: {bal}", len(acked)
+    if any(v < 0 for v in bal.values()):
+        return f"negative balance: {bal}", len(acked)
 
     # No acknowledged (committed-by-then-leader) transfer lost.
     applied_attempts = {
@@ -97,10 +103,20 @@ def test_bank_invariant_under_faults():
         if isinstance(cmd, dict) and cmd.get("op") == "transfer"
     }
     for cmd in acked:
-        assert cmd["attempt"] in applied_attempts, f"acked op lost: {cmd}"
+        if cmd["attempt"] not in applied_attempts:
+            return f"acked op lost: {cmd}", len(acked)
+    return None, len(acked)
 
+
+def test_bank_invariant_under_faults():
+    c = SimCluster(5, seed=42)
+    violation, acked = run_bank_case(
+        c, random.Random(7),
+        {10: "partition", 20: "heal", 28: "crash", 36: "restart"},
+    )
+    assert violation is None, violation
     # Progress actually happened under faults.
-    assert len(acked) >= 10
+    assert acked >= 10
 
 
 def test_no_double_application():
