@@ -123,69 +123,76 @@ def test_config_loader(tmp_path):
 # ------------------------------------------------------- property fuzz
 
 
+def run_shardmap_case(seed: int, steps: int = 300) -> None:
+    """One randomized split/merge/rebalance/carve schedule with full
+    invariant checks each step — shared by the pinned test below and
+    scripts/shardmap_fuzz_soak-style sweeps (assertion-raising)."""
+    import random
+
+    from tpudfs.common.sharding import RANGE_MAX, ShardMap
+
+    rng = random.Random(seed)
+    sm = ShardMap(strategy="range")
+    sm.add_shard("s0", ["m0"])
+    nxt = 1
+    last_version = sm.version
+    for step in range(steps):
+        shards = sm.get_all_shards()
+        op = rng.choice(["split", "merge", "rebalance", "carve"])
+        key = "".join(rng.choice("abcdxyz/0123") for _ in range(4))
+        if op == "split":
+            sm.split_shard(key, f"s{nxt}", [f"m{nxt}"])
+            nxt += 1
+        elif op == "carve":
+            lo = key
+            hi = key + rng.choice("mz5")
+            sm.carve_shard(lo, hi, f"s{nxt}", [f"m{nxt}"])
+            nxt += 1
+        elif op == "merge" and len(shards) > 1:
+            victim = rng.choice(shards)
+            target = sm.merge_target(victim)
+            if target:
+                sm.merge_shards(victim, target)
+        elif op == "rebalance" and len(shards) > 1:
+            iv = sm.shard_interval(rng.choice(shards))
+            if iv and iv[1]:
+                sm.rebalance_boundary(iv[1], key)
+        assert sm.version >= last_version, "version went backwards"
+        last_version = sm.version
+        # Tiling invariants on the range table itself: ends strictly
+        # sorted (disjoint (prev, end] intervals by construction),
+        # the tail is RANGE_MAX (total coverage), and every shard in
+        # the table is registered with peers — and vice versa, every
+        # registered shard still owns at least one range (an orphaned
+        # shard would silently blackhole its keyspace).
+        ends = sm._range_ends
+        ids = sm._range_ids
+        assert ends == sorted(ends) and len(set(ends)) == len(ends), (
+            f"seed {seed} step {step}: range ends not strictly sorted"
+        )
+        assert ends and ends[-1] == RANGE_MAX, (
+            f"seed {seed} step {step}: keyspace tail uncovered"
+        )
+        assert set(ids) == set(sm.get_all_shards()), (
+            f"seed {seed} step {step}: table/registry divergence "
+            f"{set(ids) ^ set(sm.get_all_shards())}"
+        )
+        # Lookup agrees with an independent interval walk.
+        import bisect as _bisect
+
+        for probe in ("", "a", "az9", key, key + "0", "zzzz"):
+            owner = sm.get_shard(probe)
+            want = ids[_bisect.bisect_left(ends, probe)]
+            assert owner == want, (
+                f"seed {seed} step {step}: {probe!r} -> {owner} "
+                f"but interval walk says {want}"
+            )
+
+
 def test_range_map_total_coverage_under_random_mutation():
     """Property fuzz (proptest analogue, property_based_tests.rs:27-89):
     after ANY random sequence of split/carve/merge/rebalance operations,
     every key maps to exactly one shard, intervals tile the keyspace with
     no gaps or overlaps, and version only moves forward."""
-    import random
-
-    from tpudfs.common.sharding import RANGE_MAX, ShardMap
-
     for seed in (1, 2, 3, 4, 182):
-        rng = random.Random(seed)
-        sm = ShardMap(strategy="range")
-        sm.add_shard("s0", ["m0"])
-        nxt = 1
-        last_version = sm.version
-        for step in range(300):
-            shards = sm.get_all_shards()
-            op = rng.choice(["split", "merge", "rebalance", "carve"])
-            key = "".join(rng.choice("abcdxyz/0123") for _ in range(4))
-            if op == "split":
-                sm.split_shard(key, f"s{nxt}", [f"m{nxt}"])
-                nxt += 1
-            elif op == "carve":
-                lo = key
-                hi = key + rng.choice("mz5")
-                sm.carve_shard(lo, hi, f"s{nxt}", [f"m{nxt}"])
-                nxt += 1
-            elif op == "merge" and len(shards) > 1:
-                victim = rng.choice(shards)
-                target = sm.merge_target(victim)
-                if target:
-                    sm.merge_shards(victim, target)
-            elif op == "rebalance" and len(shards) > 1:
-                iv = sm.shard_interval(rng.choice(shards))
-                if iv and iv[1]:
-                    sm.rebalance_boundary(iv[1], key)
-            assert sm.version >= last_version, "version went backwards"
-            last_version = sm.version
-            # Tiling invariants on the range table itself: ends strictly
-            # sorted (disjoint (prev, end] intervals by construction),
-            # the tail is RANGE_MAX (total coverage), and every shard in
-            # the table is registered with peers — and vice versa, every
-            # registered shard still owns at least one range (an orphaned
-            # shard would silently blackhole its keyspace).
-            ends = sm._range_ends
-            ids = sm._range_ids
-            assert ends == sorted(ends) and len(set(ends)) == len(ends), (
-                f"seed {seed} step {step}: range ends not strictly sorted"
-            )
-            assert ends and ends[-1] == RANGE_MAX, (
-                f"seed {seed} step {step}: keyspace tail uncovered"
-            )
-            assert set(ids) == set(sm.get_all_shards()), (
-                f"seed {seed} step {step}: table/registry divergence "
-                f"{set(ids) ^ set(sm.get_all_shards())}"
-            )
-            # Lookup agrees with an independent interval walk.
-            import bisect as _bisect
-
-            for probe in ("", "a", "az9", key, key + "0", "zzzz"):
-                owner = sm.get_shard(probe)
-                want = ids[_bisect.bisect_left(ends, probe)]
-                assert owner == want, (
-                    f"seed {seed} step {step}: {probe!r} -> {owner} "
-                    f"but interval walk says {want}"
-                )
+        run_shardmap_case(seed)
