@@ -35,7 +35,6 @@ import subprocess
 import sys
 import tempfile
 import time
-import urllib.request
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
@@ -45,28 +44,7 @@ WORKLOAD_OPS = 60  # per client -> >= 240 ops total under faults
 PAYLOAD_BLOCKS = 24  # x 256 KiB = 6 MiB multi-block file
 
 
-def _ops_port(addr: str) -> int:
-    return int(addr.rsplit(":", 1)[1]) + 1000
-
-
-def find_leader(addrs: list[str], timeout: float = 30.0) -> str:
-    """Leader discovery via the /raft/state ops endpoint (the reference's
-    test scripts poll the same route, run_s3_test.sh:42-56)."""
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        for addr in addrs:
-            try:
-                with urllib.request.urlopen(
-                    f"http://127.0.0.1:{_ops_port(addr)}/raft/state",
-                    timeout=2.0,
-                ) as r:
-                    state = json.loads(r.read())
-                if state.get("role") == "leader":
-                    return addr
-            except Exception:
-                continue
-        time.sleep(0.3)
-    raise SystemExit(f"no leader found among {addrs}")
+from tpudfs.testing.livecluster import find_leader  # noqa: E402
 
 
 async def chaos(eps: dict) -> None:

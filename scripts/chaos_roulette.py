@@ -1,0 +1,248 @@
+#!/usr/bin/env python3
+"""Randomized live chaos: each round boots a FRESH two-shard cluster and
+injects a RANDOM fault plan (chunkserver SIGKILLs, master SIGKILLs,
+TCP-proxy partitions at random times and durations) under a concurrent
+workload, then verifies exactly like the fixed-schedule tier
+(scripts/chaos_live.py): WGL-linearizable history, payload md5 intact
+through a fresh client, both shards still writable.
+
+Safety caps keep every plan survivable by design, so any failure is a
+REAL bug, not an over-killed cluster: at most 2 of the 5 chunkservers
+die (replication 3 leaves >= 1 live replica of everything), at most one
+master per 3-member Raft group dies (quorum holds), partitions always
+heal.
+
+  python scripts/chaos_roulette.py [rounds] [--tls] [--seed N]
+                                   [--topology path.json]
+
+The fixed schedule found two real bugs in round 3 (cross-shard fencing,
+torn write); this roulette explores the interleavings around it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import pathlib
+import random
+import signal
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+PAYLOAD_BLOCKS = 16  # x 256 KiB = 4 MiB multi-block payload
+WORKLOAD_CLIENTS = 3
+WORKLOAD_OPS = 50
+
+
+from tpudfs.testing.livecluster import (  # noqa: E402
+    find_leader, find_leader_async,
+)
+
+
+def make_plan(rng: random.Random, eps: dict) -> list[tuple]:
+    """A random, survivable fault plan: [(delay_s, kind, params), ...]."""
+    shards = eps["shards"]
+    cs_names = sorted(n for n in eps["procs"] if n.startswith("cs"))
+    plan: list[tuple] = []
+    cs_kills = 0
+    killed_master_shards: set[str] = set()
+    t = rng.uniform(1.0, 3.0)
+    for _ in range(rng.randint(2, 4)):
+        choices = ["partition"]
+        if cs_kills < 2:
+            choices.append("kill_cs")
+        if len(killed_master_shards) < len(shards):
+            choices.append("kill_master")
+        kind = rng.choice(choices)
+        if kind == "kill_cs":
+            victim = rng.choice(cs_names)
+            cs_names.remove(victim)
+            cs_kills += 1
+            plan.append((t, "kill_cs", victim))
+        elif kind == "kill_master":
+            sid = rng.choice(
+                [s for s in shards if s not in killed_master_shards])
+            killed_master_shards.add(sid)
+            # Leader or follower, decided at injection time.
+            plan.append((t, "kill_master", (sid, rng.random() < 0.7)))
+        else:
+            sid = rng.choice(sorted(shards))
+            dur = rng.uniform(1.5, 4.0)
+            plan.append((t, "partition", (sid, dur)))
+        t += rng.uniform(1.0, 3.0)
+    return plan
+
+
+async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
+    from tpudfs.client.checker import check_linearizability
+    from tpudfs.client.client import Client
+    from tpudfs.client.workload import (
+        WorkloadConfig, dump_history, run_workload,
+    )
+    from tpudfs.testing.certs import tls_from_endpoints
+    from tpudfs.testing.netem import FaultProxy
+
+    tls, _ = tls_from_endpoints(eps)
+    shards = eps["shards"]
+    masters = [a for sid in sorted(shards) for a in shards[sid]]
+    procs = eps["procs"]
+    addr_to_name = {v["addr"]: k for k, v in procs.items() if v["addr"]}
+
+    client = Client(masters, config_addrs=[eps["config_server"]],
+                    block_size=256 * 1024, rpc_timeout=10.0, tls=tls)
+    deadline = time.time() + 90
+    while True:
+        try:
+            await client.create_file("/a/probe", b"x")
+            await client.delete_file("/a/probe")
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            await asyncio.sleep(0.5)
+
+    payload = os.urandom(PAYLOAD_BLOCKS * 256 * 1024)
+    await client.create_file("/a/roulette-payload", payload)
+    payload_md5 = hashlib.md5(payload).hexdigest()
+
+    plan = make_plan(rng, eps)
+    print(f"round {rnd}: plan = "
+          + "; ".join(f"+{d:.1f}s {k} {p}" for d, k, p in plan))
+
+    # Partitions interpose proxies per shard leader via host aliases —
+    # resolved at round start so the workload client routes through them.
+    proxies: dict[str, FaultProxy] = {}
+    aliases: dict[str, str] = {}
+    part_shards = {p[0] for _, k, p in plan if k == "partition"}
+    leaders = {sid: find_leader(shards[sid]) for sid in sorted(shards)}
+    for sid in part_shards:
+        host, port = leaders[sid].rsplit(":", 1)
+        proxy = FaultProxy(host, int(port))
+        aliases[leaders[sid]] = await proxy.start()
+        proxies[sid] = proxy
+
+    wl_client = Client(masters, config_addrs=[eps["config_server"]],
+                       rpc_timeout=3.0, max_retries=8,
+                       host_aliases=aliases, tls=tls)
+    cfg = WorkloadConfig(clients=WORKLOAD_CLIENTS,
+                         ops_per_client=WORKLOAD_OPS, keys=9,
+                         seed=rng.randrange(1 << 30), rename_pod_size=3)
+    workload = asyncio.create_task(run_workload(wl_client, cfg))
+
+    async def injector() -> None:
+        # Plan offsets are absolute from round start.
+        t0 = time.monotonic()
+        for offset, kind, params in plan:
+            wait = offset - (time.monotonic() - t0)
+            if wait > 0:
+                await asyncio.sleep(wait)
+            if kind == "kill_cs":
+                os.kill(procs[params]["pid"], signal.SIGKILL)
+                print(f"  +{offset:.1f}s SIGKILL {params} "
+                      f"({procs[params]['addr']})")
+            elif kind == "kill_master":
+                sid, want_leader = params
+                if want_leader:
+                    # Loop-friendly discovery; a still-running election is
+                    # not a bug — skip the action instead of aborting.
+                    addr = await find_leader_async(shards[sid])
+                    if addr is None:
+                        print(f"  +{offset:.1f}s kill_master {sid} skipped "
+                              f"(no leader during election)")
+                        continue
+                else:
+                    addr = next(a for a in shards[sid]
+                                if a != leaders[sid])
+                name = addr_to_name.get(addr)
+                if name:
+                    os.kill(procs[name]["pid"], signal.SIGKILL)
+                    print(f"  +{offset:.1f}s SIGKILL master {name} "
+                          f"({addr}, leader={want_leader})")
+            else:
+                sid, dur = params
+                proxy = proxies.get(sid)
+                if proxy:
+                    proxy.partition()
+                    print(f"  +{offset:.1f}s partition {sid} "
+                          f"for {dur:.1f}s")
+                    await asyncio.sleep(dur)
+                    proxy.heal()
+                    print(f"  +{offset + dur:.1f}s healed {sid}")
+
+    await asyncio.gather(workload, injector())
+    entries = workload.result()
+    ok_ops = sum(1 for e in entries if e.get("return_ts") is not None)
+    print(f"  workload: {len(entries)} ops ({ok_ops} returned)")
+
+    hist_path = tempfile.mkstemp(suffix=".jsonl")[1]
+    dump_history(entries, hist_path)
+    result = check_linearizability(entries, max_states=2_000_000)
+    if not result.linearizable and not result.exhausted:
+        raise SystemExit(
+            f"LINEARIZABILITY VIOLATION (round {rnd}): {result.message}\n"
+            f"history: {hist_path}\nplan: {plan}")
+    print(f"  history {'linearizable' if result.linearizable else 'UNKNOWN'}"
+          f" ({hist_path})")
+
+    v_client = Client(masters, config_addrs=[eps["config_server"]],
+                      rpc_timeout=10.0, tls=tls)
+    back = await v_client.get_file("/a/roulette-payload")
+    assert hashlib.md5(back).hexdigest() == payload_md5, \
+        f"payload md5 mismatch (round {rnd}); plan: {plan}"
+    for prefix in ("/a/", "/z/"):
+        deadline = time.time() + 45
+        while True:
+            try:
+                await v_client.create_file(f"{prefix}post", b"alive",
+                                           overwrite=True)
+                break
+            except Exception as e:
+                if time.time() > deadline:
+                    raise SystemExit(
+                        f"post-chaos write to {prefix} failed: {e}; "
+                        f"plan: {plan}")
+                await asyncio.sleep(1.0)
+    print(f"  round {rnd}: md5 + post-chaos writes ok")
+
+    for proxy in proxies.values():
+        await proxy.stop()
+    await client.close()
+    await wl_client.close()
+    await v_client.close()
+
+
+def one_cluster_round(rnd: int, rng: random.Random, use_tls: bool,
+                      topology: str) -> None:
+    from tpudfs.testing.livecluster import boot_cluster
+
+    with boot_cluster(topology, tls=use_tls) as eps:
+        asyncio.run(run_round(eps, rng, rnd))
+
+
+def main() -> None:
+    import argparse
+
+    from tpudfs.testing.livecluster import retry_start
+
+    ap = argparse.ArgumentParser("chaos-roulette")
+    ap.add_argument("rounds", type=int, nargs="?", default=3)
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--tls", action="store_true")
+    ap.add_argument("--topology",
+                    default=str(REPO / "deploy/topologies/two-shard-ha.json"))
+    args = ap.parse_args()
+    rng = random.Random(args.seed)
+    for rnd in range(1, args.rounds + 1):
+        retry_start(lambda: one_cluster_round(rnd, rng, args.tls,
+                                              args.topology))
+    print(f"CHAOS ROULETTE PASSED ({args.rounds} rounds, seed {args.seed}, "
+          f"tls={args.tls})")
+
+
+if __name__ == "__main__":
+    main()

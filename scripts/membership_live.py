@@ -41,7 +41,6 @@ import subprocess
 import sys
 import tempfile
 import time
-import urllib.request
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
@@ -51,29 +50,7 @@ WORKLOAD_OPS = 40
 PAYLOAD_BLOCKS = 12  # x 256 KiB = 3 MiB multi-block file
 
 
-def _ops_port(addr: str) -> int:
-    return int(addr.rsplit(":", 1)[1]) + 1000
-
-
-def raft_state(addr: str) -> dict | None:
-    try:
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{_ops_port(addr)}/raft/state", timeout=2.0
-        ) as r:
-            return json.loads(r.read())
-    except Exception:
-        return None
-
-
-def find_leader(addrs: list[str], timeout: float = 30.0) -> str:
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        for addr in addrs:
-            st = raft_state(addr)
-            if st and st.get("role") == "leader":
-                return addr
-        time.sleep(0.3)
-    raise SystemExit(f"no leader found among {addrs}")
+from tpudfs.testing.livecluster import find_leader, raft_state  # noqa: E402
 
 
 def wait_config(addrs: list[str], predicate, what: str,

@@ -222,6 +222,12 @@ def main() -> None:
         run("live chaos tier (TLS)",
             [sys.executable, "-u", "scripts/chaos_live.py", args.topology,
              "--tls"])
+        # Randomized fault plan, seeded for CI determinism — explores
+        # interleavings around the fixed schedule (the plan is printed, so
+        # a failure is reproducible from the log).
+        run("live chaos roulette (seeded)",
+            [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
+             "--seed=1234", "--topology", args.topology])
         # Add a 4th master to a RUNNING group under workload, remove the
         # old leader, verify discovery + no write loss (reference
         # dynamic_membership_test.sh / cluster_membership_test.sh).

@@ -1,0 +1,109 @@
+"""Shared glue for live-cluster harnesses (chaos_live, membership_live,
+autosplit_live, chaos_roulette, run_all_tests): ops-port math, leader
+discovery via /raft/state, and the boot-with-ready-file dance including
+the one-retry for start_cluster's free_port TOCTOU window."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def ops_port(addr: str) -> int:
+    return int(addr.rsplit(":", 1)[1]) + 1000
+
+
+def raft_state(addr: str) -> dict | None:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{ops_port(addr)}/raft/state", timeout=2.0
+        ) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
+
+
+def find_leader(addrs: list[str], timeout: float = 30.0) -> str:
+    """Blocking leader discovery (use BEFORE starting async work)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for addr in addrs:
+            st = raft_state(addr)
+            if st and st.get("role") == "leader":
+                return addr
+        time.sleep(0.3)
+    raise SystemExit(f"no leader found among {addrs}")
+
+
+async def find_leader_async(addrs: list[str],
+                            timeout: float = 20.0) -> str | None:
+    """Event-loop-friendly leader discovery for use INSIDE async fault
+    injectors: never blocks the loop, returns None instead of raising
+    when an election is still in progress (the caller skips the action
+    rather than failing the run)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for addr in addrs:
+            st = await asyncio.to_thread(raft_state, addr)
+            if st and st.get("role") == "leader":
+                return addr
+        await asyncio.sleep(0.3)
+    return None
+
+
+@contextlib.contextmanager
+def boot_cluster(topology: str, *, tls: bool = False, s3_port: str = "0"):
+    """Start a cluster via scripts/start_cluster.py, yield the endpoint
+    map, tear down on exit. Raises SystemExit("...failed to start...")
+    on boot failure — pair with retry_start() for the TOCTOU retry."""
+    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
+    with tempfile.TemporaryDirectory(prefix="tpudfs-live-") as tmp:
+        ready = pathlib.Path(tmp) / "endpoints.json"
+        launcher = subprocess.Popen(
+            [sys.executable, "scripts/start_cluster.py",
+             "--topology", topology, "--data-dir", f"{tmp}/cluster",
+             "--s3-port", s3_port, "--ready-file", str(ready),
+             *(["--tls"] if tls else [])],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            deadline = time.time() + 120
+            while not ready.exists():
+                if launcher.poll() is not None:
+                    out = launcher.stdout.read() if launcher.stdout else ""
+                    raise SystemExit(f"cluster failed to start:\n{out}")
+                if time.time() > deadline:
+                    raise SystemExit("cluster start timed out")
+                time.sleep(0.5)
+            yield json.loads(ready.read_text())
+        finally:
+            launcher.send_signal(signal.SIGINT)
+            try:
+                launcher.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                launcher.kill()
+
+
+def retry_start(fn) -> None:
+    """Run ``fn`` with one retry on the start_cluster free_port TOCTOU
+    (an unlucky port collision must not fail a whole tier)."""
+    for attempt in (1, 2):
+        try:
+            fn()
+            return
+        except SystemExit as e:
+            if attempt == 2 or "failed to start" not in str(e):
+                raise
+            print(f"cluster start failed ({e}); retrying once")
