@@ -191,7 +191,27 @@ async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
 
     v_client = Client(masters, config_addrs=[eps["config_server"]],
                       rpc_timeout=10.0, tls=tls)
-    back = await v_client.get_file("/a/roulette-payload")
+    # Availability-settling window: random plans can kill a leader
+    # seconds before verification, and an election is not a bug — retry
+    # the read with a deadline (same discipline as the post-chaos write
+    # loop). CONSISTENCY stays strict: whatever read succeeds must be
+    # byte-identical.
+    from tpudfs.client.client import IndeterminateError
+
+    deadline = time.time() + 45
+    while True:
+        try:
+            back = await v_client.get_file("/a/roulette-payload")
+            break
+        except IndeterminateError as e:
+            # AVAILABILITY errors only (retry-budget exhaustion during an
+            # election). Anything else — NOT_FOUND on the acked payload, a
+            # checksum error — is a consistency bug and fails immediately.
+            if time.time() > deadline:
+                raise SystemExit(
+                    f"payload unreadable 45s after faults (round {rnd}): "
+                    f"{e}; plan: {plan}")
+            await asyncio.sleep(1.0)
     assert hashlib.md5(back).hexdigest() == payload_md5, \
         f"payload md5 mismatch (round {rnd}); plan: {plan}"
     for prefix in ("/a/", "/z/"):

@@ -339,3 +339,38 @@ async def test_etag_modes(tmp_path):
             await fast.block_pool.close()
         await client.close()
         await c.stop()
+
+
+async def test_stale_hint_to_dead_leader_survives_election(tmp_path):
+    """A freshly killed leader keeps being named by followers' Not-Leader
+    hints until the election completes. The retry loop must not burn its
+    budget ping-ponging follower -> dead node (chaos-roulette seeds
+    3002/3003): hints naming a connection-refused target rotate to other
+    peers WITH backoff, outlasting an election-length outage."""
+    import socket
+
+    from tpudfs.common.rpc import RpcError, RpcServer
+
+    with socket.socket() as s:  # reserve a port nothing listens on
+        s.bind(("127.0.0.1", 0))
+        dead_addr = f"127.0.0.1:{s.getsockname()[1]}"
+
+    elected_at = asyncio.get_event_loop().time() + 1.5  # "election" ends
+
+    async def follower_get_info(req):
+        if asyncio.get_event_loop().time() < elected_at:
+            raise RpcError.not_leader(dead_addr)  # stale hint to the corpse
+        return {"found": True,
+                "metadata": {"path": req["path"], "size": 1, "blocks": []}}
+
+    server = RpcServer(port=0)
+    server.add_service("MasterService", {"GetFileInfo": follower_get_info})
+    await server.start()
+    try:
+        client = Client([server.address, dead_addr], rpc_timeout=2.0,
+                        max_retries=6, initial_backoff=0.2)
+        info = await client.get_file_info("/hint/f")
+        assert info is not None and info["path"] == "/hint/f"
+        await client.close()
+    finally:
+        await server.stop()

@@ -295,6 +295,15 @@ class Client:
         last_err: RpcError | None = None
         indeterminate = False  # a previous attempt may have applied
         idx = 0
+        #: Targets that refused/failed to connect during THIS call. A
+        #: freshly killed leader keeps being named by its followers' "Not
+        #: Leader" hints until the election completes; blindly following
+        #: such a hint ping-pongs follower -> dead node -> follower with
+        #: no backoff and burns the whole retry budget in a couple of
+        #: seconds — faster than a live-cluster election. Hints naming a
+        #: known-unreachable node rotate to the next peer WITH backoff
+        #: instead (found by chaos-roulette seed 3002/3003).
+        refused: set[str] = set()
         for attempt in range(self.max_retries + 1):
             target = targets[idx % len(targets)]
             try:
@@ -306,7 +315,9 @@ class Client:
                 last_err = e
                 hint = e.not_leader_hint
                 redirect = e.redirect_hint
-                if hint:
+                if e.code.name in ("UNAVAILABLE", "DEADLINE_EXCEEDED"):
+                    refused.add(target)
+                if hint and hint not in refused:
                     # Leader hint: try it next, immediately.
                     if hint in targets:
                         idx = targets.index(hint)
@@ -314,6 +325,9 @@ class Client:
                         targets.insert(0, hint)
                         idx = 0
                     continue
+                # A hint naming a node we already failed to reach falls
+                # through to the generic rotate-with-backoff below — a new
+                # leader needs an election timeout to emerge.
                 if redirect is not None:
                     # Wrong shard: refresh the map FIRST, fall back to the
                     # stale map's peers only if the refresh fails
@@ -336,6 +350,13 @@ class Client:
                     raise DfsError(e.message) from None
                 indeterminate = True
                 idx += 1
+                # Rotate PAST known-unreachable targets while any live
+                # candidate remains — redialing the dead node every other
+                # attempt would halve the election-length outage the
+                # budget can ride out.
+                while (len(refused) < len(targets)
+                       and targets[idx % len(targets)] in refused):
+                    idx += 1
             if attempt < self.max_retries:
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, BACKOFF_CAP)
