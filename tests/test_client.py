@@ -355,7 +355,7 @@ async def test_stale_hint_to_dead_leader_survives_election(tmp_path):
         s.bind(("127.0.0.1", 0))
         dead_addr = f"127.0.0.1:{s.getsockname()[1]}"
 
-    elected_at = asyncio.get_event_loop().time() + 1.5  # "election" ends
+    elected_at = asyncio.get_event_loop().time() + 1.2  # "election" ends
 
     async def follower_get_info(req):
         if asyncio.get_event_loop().time() < elected_at:
@@ -368,9 +368,47 @@ async def test_stale_hint_to_dead_leader_survives_election(tmp_path):
     await server.start()
     try:
         client = Client([server.address, dead_addr], rpc_timeout=2.0,
-                        max_retries=6, initial_backoff=0.2)
+                        max_retries=6, initial_backoff=0.35)
         info = await client.get_file_info("/hint/f")
         assert info is not None and info["path"] == "/hint/f"
         await client.close()
     finally:
         await server.stop()
+
+
+async def test_live_hint_ping_pong_survives_handoff(tmp_path):
+    """Two LIVE not-yet-leaders hinting each other during a leadership
+    handoff must not burn the retry budget at RPC speed: beyond the
+    first couple of free hint-follows the loop throttles, outlasting an
+    election-length handoff between reachable peers."""
+    from tpudfs.common.rpc import RpcError, RpcServer
+
+    servers: list = []
+    addrs: list[str] = []
+    elected_at = asyncio.get_event_loop().time() + 1.2
+
+    def make_handler(me: int):
+        async def get_info(req):
+            if asyncio.get_event_loop().time() < elected_at:
+                raise RpcError.not_leader(addrs[1 - me])  # point at peer
+            return {"found": True,
+                    "metadata": {"path": req["path"], "size": 1,
+                                 "blocks": []}}
+        return get_info
+
+    try:
+        for i in range(2):
+            s = RpcServer(port=0)
+            s.add_service("MasterService",
+                          {"GetFileInfo": make_handler(i)})
+            await s.start()
+            servers.append(s)
+            addrs.append(s.address)
+        client = Client(list(addrs), rpc_timeout=2.0,
+                        max_retries=6, initial_backoff=0.35)
+        info = await client.get_file_info("/pp/f")
+        assert info is not None and info["path"] == "/pp/f"
+        await client.close()
+    finally:
+        for s in servers:
+            await s.stop()

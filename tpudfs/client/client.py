@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import time
 import logging
 import os
 import uuid
@@ -44,6 +45,11 @@ DEFAULT_BLOCK_SIZE = 64 * 1024 * 1024
 MAX_RETRIES = 5  # reference mod.rs:23
 INITIAL_BACKOFF = 0.5  # reference mod.rs:24
 BACKOFF_CAP = 5.0
+#: How long a connection-refused/timed-out master stays deprioritized in
+#: one call's retry loop — long enough to stop hint ping-pong against a
+#: freshly killed leader, short enough that a node that failed DURING an
+#: election is retried once it may have become the new leader.
+REFUSED_TTL = 3.0
 
 MASTER = "MasterService"
 CS = "ChunkServerService"
@@ -295,15 +301,41 @@ class Client:
         last_err: RpcError | None = None
         indeterminate = False  # a previous attempt may have applied
         idx = 0
-        #: Targets that refused/failed to connect during THIS call. A
+        #: Targets that refused/timed out recently, with EXPIRY times. A
         #: freshly killed leader keeps being named by its followers' "Not
         #: Leader" hints until the election completes; blindly following
         #: such a hint ping-pongs follower -> dead node -> follower with
         #: no backoff and burns the whole retry budget in a couple of
         #: seconds — faster than a live-cluster election. Hints naming a
-        #: known-unreachable node rotate to the next peer WITH backoff
-        #: instead (found by chaos-roulette seed 3002/3003).
-        refused: set[str] = set()
+        #: recently-unreachable node rotate to the next peer WITH backoff
+        #: instead (found by chaos-roulette seed 3002/3003). The ban is
+        #: TIME-limited, not per-call: a node that failed once DURING an
+        #: election may be the healthy new leader seconds later, and a
+        #: permanent ban would exclude it for the rest of a long call
+        #: (test_chaos lease-window partition caught exactly that).
+        refused: dict[str, float] = {}
+
+        def _refused(addr: str) -> bool:
+            exp = refused.get(addr)
+            if exp is None:
+                return False
+            if time.monotonic() >= exp:
+                del refused[addr]
+                return False
+            return True
+
+        def _rotate(i: int) -> int:
+            # Advance PAST known-unreachable targets while any live
+            # candidate remains — redialing the dead node every other
+            # attempt would halve the election-length outage the retry
+            # budget can ride out.
+            i += 1
+            if any(not _refused(t) for t in targets):
+                while _refused(targets[i % len(targets)]):
+                    i += 1
+            return i
+
+        hint_follows = 0  # free immediate hint-follows used so far
         for attempt in range(self.max_retries + 1):
             target = targets[idx % len(targets)]
             try:
@@ -316,18 +348,36 @@ class Client:
                 hint = e.not_leader_hint
                 redirect = e.redirect_hint
                 if e.code.name in ("UNAVAILABLE", "DEADLINE_EXCEEDED"):
-                    refused.add(target)
-                if hint and hint not in refused:
-                    # Leader hint: try it next, immediately.
+                    refused[target] = time.monotonic() + REFUSED_TTL
+                if hint and not _refused(hint):
+                    # Leader hint: try it next. The first couple of
+                    # follows are free (the normal one-hop redirect);
+                    # beyond that, throttle — two LIVE not-yet-leaders
+                    # hinting each other during a handoff would otherwise
+                    # burn the whole budget at RPC speed (same defect
+                    # class as the dead-leader ping-pong, between
+                    # reachable peers).
                     if hint in targets:
                         idx = targets.index(hint)
                     else:
                         targets.insert(0, hint)
                         idx = 0
+                    hint_follows += 1
+                    if hint_follows > 2 and attempt < self.max_retries:
+                        await asyncio.sleep(max(self.initial_backoff, 0.3))
                     continue
-                # A hint naming a node we already failed to reach falls
-                # through to the generic rotate-with-backoff below — a new
-                # leader needs an election timeout to emerge.
+                if hint:
+                    # Stale hint naming a recently-unreachable node: the
+                    # likely cause is an election in progress, which
+                    # resolves in ~one election timeout — wait a FLAT
+                    # short interval (the escalating backoff is for
+                    # overload, and stretches a ~2 s election window into
+                    # ~12 s of sleeps) and rotate to a live peer.
+                    indeterminate = True
+                    idx = _rotate(idx)
+                    if attempt < self.max_retries:
+                        await asyncio.sleep(max(self.initial_backoff, 0.3))
+                    continue
                 if redirect is not None:
                     # Wrong shard: refresh the map FIRST, fall back to the
                     # stale map's peers only if the refresh fails
@@ -349,14 +399,7 @@ class Client:
                         return {"success": True, "retry_resolved": True}, target
                     raise DfsError(e.message) from None
                 indeterminate = True
-                idx += 1
-                # Rotate PAST known-unreachable targets while any live
-                # candidate remains — redialing the dead node every other
-                # attempt would halve the election-length outage the
-                # budget can ride out.
-                while (len(refused) < len(targets)
-                       and targets[idx % len(targets)] in refused):
-                    idx += 1
+                idx = _rotate(idx)
             if attempt < self.max_retries:
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, BACKOFF_CAP)
