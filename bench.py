@@ -73,6 +73,25 @@ WEDGE_TIMEOUT_S = 600.0
 WEDGE_POLL_S = 15.0
 _progress = {"t": None, "stage": "start"}  # t None = watchdog disarmed
 _partial: dict = {}
+#: One-JSON-line contract: the watchdog and the normal completion path
+#: race when the run finishes just as the timeout elapses — whichever
+#: claims this flag first (under the lock) prints; the other stays silent.
+import threading
+
+_emit_lock = threading.Lock()
+_emitted = False
+
+
+def _emit_once(payload: dict) -> bool:
+    """Print the final JSON line if nobody has yet. Returns True if this
+    caller won the race."""
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return False
+        _emitted = True
+    print(json.dumps(payload), flush=True)
+    return True
 
 
 def _tick(stage: str) -> None:
@@ -82,7 +101,6 @@ def _tick(stage: str) -> None:
 
 def _start_watchdog() -> None:
     import os
-    import threading
 
     def watch() -> None:
         while True:
@@ -99,8 +117,9 @@ def _start_watchdog() -> None:
                     **_partial,
                     "platform": f"tpu-wedged-midrun({_progress['stage']})",
                 }
-                print(json.dumps(out), flush=True)
-                os._exit(3)
+                if _emit_once(out):
+                    os._exit(3)
+                return  # normal path won the race; let it finish
 
     threading.Thread(target=watch, daemon=True).start()
 
@@ -792,7 +811,8 @@ def main() -> None:
         result["platform"] = "cpu-fallback(tpu unreachable)"
     elif _fell_back_midrun:
         result["platform"] = "cpu-fallback(tpu wedged mid-run)"
-    print(json.dumps(result))
+    _progress["t"] = None  # disarm the watchdog before the final line
+    _emit_once(result)
 
 
 if __name__ == "__main__":

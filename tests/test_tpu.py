@@ -979,6 +979,54 @@ async def test_fused_read_buffer_pool_reuse(tmp_path):
         await c.stop()
 
 
+async def test_fused_read_held_blocks_survive_buffer_recycle(tmp_path):
+    """Device blocks from round 1 are HELD while round 2 refills the
+    recycled host buffer, then read back — catches any backend where
+    device_put aliases (rather than copies) the pooled numpy buffer.
+    (ADVICE r4: the previous pool-reuse test never held device arrays
+    across a reuse, so zero-copy aliasing would have passed it.)"""
+    d1 = _rand(4 * 64 * 1024, seed=57)
+    d2 = _rand(4 * 64 * 1024, seed=58)
+    c, client = await _cluster_with_files(
+        tmp_path, [("/fu/h1", d1), ("/fu/h2", d2)])
+    try:
+        reader, comb = await _batched_reader(client, True)
+        held = await reader.read_file_to_device_blocks("/fu/h1",
+                                                       verify="lazy")
+        await reader.confirm(held)
+        # Round 2+ recycles round 1's pooled buffer and overwrites it.
+        for _ in range(3):
+            blocks = await reader.read_file_to_device_blocks("/fu/h2",
+                                                             verify="lazy")
+            await reader.confirm(blocks)
+        assert comb.blocks >= 4, "combiner never engaged"
+        got = b"".join(device_array_to_bytes(b.array, b.size)
+                       for b in held)
+        assert got == d1, "recycled host buffer leaked into held blocks"
+    finally:
+        await c.stop()
+
+
+def test_combiner_pool_buffers_defeat_zero_copy_aliasing():
+    """PJRT's CPU client zero-copy-aliases 64-byte-aligned host buffers
+    (measured on this image) — an aliased device array references pooled
+    memory forever, so a recycled buffer would corrupt held blocks. The
+    combiner defends by (a) misaligning every pool buffer to ptr%64==4
+    and (b) probing that exact allocation pattern at init, disabling
+    pooling if a future jaxlib aliases anyway."""
+    from tpudfs.tpu.read_combiner import ReadCombiner
+
+    dev = jax.devices("cpu")[0]
+    comb = ReadCombiner(None, dev)
+    assert comb._cpu_copies is True and comb._pooling_ok is True
+    buf = comb._alloc_round_buf(512)
+    assert buf.ctypes.data % 64 == 4, "pool buffer not misaligned"
+    # The probe is live, not vacuous: mutating the misaligned source must
+    # leave the device copy intact (the aligned twin aliases on this
+    # jaxlib, which is exactly why _alloc_round_buf misaligns).
+    assert comb._probe_pool_copy_semantics() is True
+
+
 async def test_fused_read_host_verify_falls_back_on_rot(tmp_path):
     """Host-verified fused reads route a corrupt local replica to the
     general path, which excludes it and recovers from a healthy one."""

@@ -372,8 +372,12 @@ class Client:
                     # resolves in ~one election timeout — wait a FLAT
                     # short interval (the escalating backoff is for
                     # overload, and stretches a ~2 s election window into
-                    # ~12 s of sleeps) and rotate to a live peer.
-                    indeterminate = True
+                    # ~12 s of sleeps) and rotate to a live peer. A
+                    # Not-Leader rejection is DETERMINATE (the follower did
+                    # not apply the op), so it must not set indeterminate —
+                    # that flag stays tied to attempts that could actually
+                    # have applied (UNAVAILABLE / DEADLINE_EXCEEDED / the
+                    # generic fallthrough below).
                     idx = _rotate(idx)
                     if attempt < self.max_retries:
                         await asyncio.sleep(max(self.initial_backoff, 0.3))

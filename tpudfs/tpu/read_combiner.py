@@ -122,14 +122,62 @@ class ReadCombiner:
         #: target is (n*cpb, 128) u32). Fresh 16-32 MiB allocations every
         #: round cost ~4-8 ms of page faults on a one-core host and keep
         #: the allocator churning; a recycled buffer's pages stay mapped.
-        #: Safe to reuse because device_put COPIES on the CPU backend
-        #: (verified: mutating the source after device_put does not change
-        #: the device array) and the upload stage waits for transfer
-        #: completion before releasing a buffer on accelerators.
+        #:
+        #: Pooling is only sound if device_put COPIES the host buffer: an
+        #: ALIASED device array references the pooled memory forever, so
+        #: refilling the buffer next round corrupts still-held blocks —
+        #: and no completion wait can help. This image's PJRT CPU client
+        #: really does zero-copy-alias host numpy buffers whose data
+        #: pointer is 64-byte aligned (measured: page+0/+64 alias,
+        #: page+4..+32 copy; allocator luck decided which rounds were
+        #: safe). Defense: on the CPU backend every pool buffer is
+        #: allocated deliberately 64-byte-MISaligned (ptr % 64 == 4) so
+        #: device_put must copy, and an init-time probe of that exact
+        #: allocation pattern disables pooling outright if a future
+        #: jaxlib aliases anyway. Accelerators genuinely copy H2D; their
+        #: release additionally gates on transfer completion.
         self._buf_pool: dict[int, list[np.ndarray]] = {}
+        is_cpu_backend = getattr(device, "platform", "cpu") == "cpu"
+        self._misalign_bufs = is_cpu_backend
+        #: Probe verdict: device_put copies OUR pool buffers. Gates both
+        #: the skip-completion-wait fast path and pooling itself on CPU.
+        self._cpu_copies = (
+            self._probe_pool_copy_semantics() if is_cpu_backend else False
+        )
+        self._pooling_ok = self._cpu_copies if is_cpu_backend else True
         #: rounds fused / blocks served (observability + tests).
         self.rounds = 0
         self.blocks = 0
+
+    def _alloc_round_buf(self, nrows: int) -> np.ndarray:
+        """One round's pread target. On the CPU backend the data pointer
+        is forced to ptr % 64 == 4 — off PJRT's zero-copy alignment — so
+        device_put copies deterministically. Row stride is 512 bytes, so
+        every sub-round slice stays misaligned too."""
+        nbytes = nrows * WORDS_PER_CHUNK * 4
+        if not self._misalign_bufs:
+            return np.empty((nrows, WORDS_PER_CHUNK), dtype="<u4")
+        raw = np.empty(nbytes + 68, dtype=np.uint8)
+        off = (4 - raw.ctypes.data) % 64
+        return raw[off : off + nbytes].view("<u4").reshape(
+            nrows, WORDS_PER_CHUNK
+        )
+
+    def _probe_pool_copy_semantics(self) -> bool:
+        """device_put a real pool-pattern buffer, mutate it, and check the
+        device array kept the original values. False (disables pooling
+        and the skip-wait fast path) if the backend aliased it — or if
+        the probe itself fails."""
+        try:
+            buf = self._alloc_round_buf(512)  # 256 KiB: a real round shape
+            buf[:] = 7
+            dev = jax.device_put(buf, self.device)
+            jax.block_until_ready(dev)
+            buf.reshape(-1)[:] = 0
+            flat = np.asarray(dev).reshape(-1)
+            return bool(flat[0] == 7 and flat[-1] == 7)
+        except Exception:
+            return False
 
     _POOL_PER_SHAPE = 3
 
@@ -137,10 +185,10 @@ class ReadCombiner:
         free = self._buf_pool.get(nrows)
         if free:
             return free.pop()
-        return np.empty((nrows, WORDS_PER_CHUNK), dtype="<u4")
+        return self._alloc_round_buf(nrows)
 
     def _put_buf(self, buf: np.ndarray | None) -> None:
-        if buf is None:
+        if buf is None or not self._pooling_ok:
             return
         free = self._buf_pool.setdefault(buf.shape[0], [])
         if len(free) < self._POOL_PER_SHAPE:
@@ -453,7 +501,8 @@ class ReadCombiner:
     async def _upload_stage(self, queue: asyncio.Queue) -> None:
         from tpudfs.tpu.hbm_reader import DeviceBlock
 
-        is_cpu = getattr(self.device, "platform", "cpu") == "cpu"
+        is_cpu = (getattr(self.device, "platform", "cpu") == "cpu"
+                  and self._cpu_copies)
         #: words of sub-rounds sharing the current (unreleased) buffer —
         #: the buffer may only return to the pool once every transfer out
         #: of it completed (device_put COPIES immediately on CPU; on
