@@ -775,6 +775,393 @@ def _probe_tpu(timeout_s: float = 90.0, attempts: int = 2,
     return False
 
 
+# --------------------------------------------------------- window sprint
+#
+# The tunneled TPU comes alive for SHORT windows (minutes) between hours of
+# wedge; round 4 lost its one window to ~10 min of host-side warm-up. The
+# sprint protocol gets the first device touch within seconds of LIVE:
+#
+# - ``bench.py --standby`` (run by scripts/tpu_probe_loop.sh while the
+#   tunnel is wedged, CPU-only): boots the bench cluster, pre-writes the
+#   read-phase file set, records its addresses in standby.json, and stays
+#   resident — all the host-side minutes are paid OUTSIDE the window.
+# - ``bench.py --sprint`` (run by the probe loop the moment a probe sees
+#   LIVE): connects to the standby cluster, touches the device
+#   immediately, and runs the DEVICE-dependent windows first (raw infeed
+#   -> fused cold sweep -> warm infeed -> ICI/EC kernels), emitting
+#   partials as each lands so even a mid-run wedge leaves data. Results
+#   persist to BENCH_SPRINT.json.
+# - A round-end ``bench.py`` that has to fall back to CPU merges the
+#   latest real-TPU sprint capture into its JSON tail as "tpu_sprint",
+#   so the driver's BENCH_r{N}.json carries the real-TPU numbers even
+#   when the tunnel is wedged at round end.
+
+SPRINT_DIR = "/tmp/tpudfs-sprint"
+SPRINT_READ_REPS = 3
+
+
+def _repo_path(name: str) -> str:
+    import os
+
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
+def _read_standby():
+    """(maddr, cs_addrs) of a live standby cluster, else None (verified:
+    parent alive + master socket connectable)."""
+    import os
+    import socket
+
+    path = os.path.join(SPRINT_DIR, "standby.json")
+    try:
+        with open(path) as f:
+            info = json.load(f)
+        if not info.get("ready"):
+            return None  # mid-prep: sprint self-provisions instead
+        os.kill(int(info["pid"]), 0)  # parent alive?
+        host, port = info["maddr"].rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=2.0):
+            pass
+        return info["maddr"], list(info["cs_addrs"])
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return None
+
+
+async def _prepare_r0_files(maddr: str) -> None:
+    """Write the read-phase file set (/bench/r0/f0000..) if absent, then
+    sync — the host-side minutes the sprint must not pay in-window."""
+    import os as _os
+
+    from tpudfs.client.client import Client
+    from tpudfs.common.rpc import RpcClient
+
+    rpc = RpcClient()
+    client = Client([maddr], rpc_client=rpc, block_size=BLOCK_MB << 20,
+                    etag_mode="crc64")
+    deadline = asyncio.get_event_loop().time() + 60
+    while True:
+        try:
+            await client.create_file("/bench/probe", b"x")
+            await client.delete_file("/bench/probe")
+            break
+        except Exception:
+            if asyncio.get_event_loop().time() > deadline:
+                raise
+            await asyncio.sleep(0.3)
+    try:
+        last = await client.get_file_info(f"/bench/r0/f{FILES - 1:04d}")
+    except Exception:
+        last = None
+    if last is None:
+        data = np.random.default_rng(0).integers(
+            0, 256, BLOCK_MB << 20, dtype=np.uint8).tobytes()
+        sem = asyncio.Semaphore(WRITE_CONCURRENCY)
+
+        async def put(i):
+            async with sem:
+                try:
+                    await client.create_file(f"/bench/r0/f{i:04d}", data)
+                except Exception as e:
+                    if "exists" not in str(e).lower():
+                        raise
+
+        await asyncio.gather(*(put(i) for i in range(FILES)))
+        await asyncio.to_thread(_os.sync)
+    await rpc.close()
+
+
+async def _sprint_against(maddr: str, cs_addrs: list[str],
+                          standby: bool) -> dict:
+    """Device-first bench windows against a (pre-warmed) cluster. Same
+    measurement discipline as the full run — GC parked during windows,
+    D2H-free until the single confirm, median over SPRINT_READ_REPS —
+    minus the host-side phases (writes/metadata/gRPC/cache), which the
+    full protocol covers on CPU and a short window cannot afford."""
+    import gc
+
+    import jax
+
+    from tpudfs.client.client import Client
+    from tpudfs.common.rpc import RpcClient
+    from tpudfs.tpu.hbm_reader import HbmReader
+
+    await _prepare_r0_files(maddr)
+    _tick("sprint-files")
+    rpc = RpcClient()
+    client = Client([maddr], rpc_client=rpc, block_size=BLOCK_MB << 20,
+                    etag_mode="crc64")
+    client.local_reads = True
+    data_len = BLOCK_MB << 20
+
+    # First device touch — seconds after LIVE, nothing host-side left.
+    device = _decide_device()
+    _tick("device-init")
+    _partial["sprint_standby"] = standby
+    raw_samples = [_bench_raw_infeed(device, data_len, 8)]
+    _partial["raw_infeed_GBps"] = round(raw_samples[0], 3)
+    _tick("sprint-raw0")
+
+    reader = HbmReader(client, [device], batch_reads=BATCH_READS)
+    reader.warm_batches(data_len // 512)  # XLA compiles (disk-cached)
+    _tick("warm-batches")
+    keep_blocks: list = []
+
+    def retain(blocks: list) -> None:
+        for b in blocks:
+            if b.pending_crc is not None or b.batch_pending:
+                keep_blocks.append(b)
+
+    async def sweep(read_fn, items, timed: bool):
+        sem = asyncio.Semaphore(FUSED_READ_CONCURRENCY)
+        blocks: list = []
+
+        async def one(item):
+            async with sem:
+                bs = await read_fn(item)
+                blocks.extend(bs)
+                return sum(b.size for b in bs)
+
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            sizes = await asyncio.gather(*(one(it) for it in items))
+            jax.block_until_ready(
+                [x for b in blocks for x in b.sync_arrays])
+            dt = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        retain(blocks)
+        return sum(sizes) / dt / 1e9 if timed else 0.0
+
+    # One untimed pass reaches process steady state (full protocol uses
+    # three; the sprint trades window time for a slightly cold first rep
+    # — the median over 3 tolerates it).
+    await sweep(lambda i: reader.read_file_to_device_blocks(
+        f"/bench/r0/f{i:04d}", verify="lazy"), range(FILES), False)
+    _tick("sprint-warmup")
+
+    cold_samples, warm_samples = [], []
+    metas = await asyncio.gather(
+        *(client.get_file_info(f"/bench/r0/f{i:04d}") for i in range(FILES)))
+    for rep_i in range(SPRINT_READ_REPS):
+        cold_samples.append(await sweep(
+            lambda i: reader.read_file_to_device_blocks(
+                f"/bench/r0/f{i:04d}", verify="lazy"),
+            range(FILES), True))
+        _tick(f"sprint-cold{rep_i}")
+        warm_samples.append(await sweep(
+            lambda m: reader.read_meta_blocks_fast(m, device), metas, True))
+        _tick(f"sprint-warm{rep_i}")
+        if rep_i:
+            raw_samples.append(_bench_raw_infeed(device, data_len, 8))
+        _partial.update({
+            "value": round(statistics.median(cold_samples), 3),
+            "warm_infeed_read_GBps": round(
+                statistics.median(warm_samples), 3),
+            "raw_infeed_GBps": round(statistics.median(raw_samples), 3),
+        })
+        _tick(f"sprint-rep{rep_i}")
+
+    ici_samples, ici_oks = _bench_ici_write_step(device)
+    _tick("ici")
+    ec_samples, ec_acks = _bench_ec_scatter_step(device)
+    _tick("ec")
+
+    t0 = time.perf_counter()
+    await reader.confirm(keep_blocks)
+    confirm_s = time.perf_counter() - t0
+    _tick("confirm")
+    assert all(b.verified for b in keep_blocks)
+    assert np.asarray(ici_oks).all()
+    await rpc.close()
+
+    med = statistics.median
+    achieved = med(cold_samples)
+    raw = med(raw_samples)
+    target = 0.9 * raw
+    return {
+        "metric": (
+            "SPRINT: 1MiB-chunk read GB/s/host into TPU HBM "
+            "(3x-replicated DFS, on-device CRC32C verify), device windows "
+            "only (see bench.py window-sprint protocol)"
+        ),
+        "value": round(achieved, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(achieved / target, 3) if target else 0.0,
+        "windows": SPRINT_READ_REPS,
+        "value_win": _winmm(cold_samples),
+        "warm_infeed_read_GBps": round(med(warm_samples), 3),
+        "warm_infeed_win": _winmm(warm_samples),
+        "raw_infeed_GBps": round(raw, 3),
+        "raw_infeed_win": _winmm(raw_samples),
+        "ici_write_GBps": round(med(ici_samples), 3),
+        "ici_ec_scatter_GBps": round(med(ec_samples), 3),
+        "confirm_s": round(confirm_s, 3),
+        "files": FILES,
+        "sprint_standby": standby,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+async def _run_sprint() -> dict:
+    import tempfile
+
+    standby = _read_standby()
+    if standby:
+        return await _sprint_against(*standby, standby=True)
+    # No standby: self-provision (pays the write minutes in-window; the
+    # probe loop normally has a standby up long before a LIVE probe).
+    tmp = tempfile.TemporaryDirectory(prefix="tpudfs-sprint-")
+    maddr, cs_addrs, procs = _spawn_cluster(tmp.name)
+    try:
+        return await _sprint_against(maddr, cs_addrs, standby=False)
+    finally:
+        from tpudfs.testing.procs import terminate_all
+
+        terminate_all(procs)
+        tmp.cleanup()
+
+
+def main_standby() -> None:
+    """Resident prep cluster for the window sprint (CPU-only; never
+    touches the device). Fresh state every launch: stale master metadata
+    would reference dead chunkserver ports."""
+    import fcntl
+    import os
+    import shutil
+    import signal
+
+    from tpudfs.testing.procs import terminate_all
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.makedirs(SPRINT_DIR, exist_ok=True)
+    # One standby owns the role for the machine: a duplicate launched
+    # during the (minutes-long) file prep would rmtree the live one's
+    # block stores out from under its running cluster.
+    role_fd = os.open(os.path.join(SPRINT_DIR, "standby.lock"),
+                      os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(role_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        print("standby already running; exiting", flush=True)
+        return
+    marker = os.path.join(SPRINT_DIR, "standby.json")
+    tmp_path = os.path.join(SPRINT_DIR, ".standby.tmp")
+
+    def write_marker(payload: dict) -> None:
+        with open(tmp_path, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp_path, marker)
+
+    root = os.path.join(SPRINT_DIR, "cluster")
+    shutil.rmtree(root, ignore_errors=True)
+    maddr, cs_addrs, procs = _spawn_cluster(root)
+    # Provisional marker BEFORE the prep: the probe loop's liveness check
+    # keys on this pid, so it won't double-launch mid-prep; the sprint
+    # side requires ready=true and self-provisions until then.
+    write_marker({"maddr": maddr, "cs_addrs": cs_addrs,
+                  "pid": os.getpid(), "ready": False})
+
+    def bail(_sig, _frm):
+        terminate_all(procs)
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, bail)
+    signal.signal(signal.SIGINT, bail)
+    try:
+        asyncio.run(_prepare_r0_files(maddr))
+    except BaseException:
+        terminate_all(procs)
+        try:
+            os.remove(marker)
+        except OSError:
+            pass
+        raise
+    write_marker({"maddr": maddr, "cs_addrs": cs_addrs,
+                  "pid": os.getpid(), "ready": True})
+    print(f"standby ready: {maddr} {cs_addrs}", flush=True)
+    while True:
+        time.sleep(60)
+        if any(p.poll() is not None for p in procs):
+            # A cluster process died; drop the marker so the probe loop
+            # relaunches a healthy standby.
+            try:
+                os.remove(os.path.join(SPRINT_DIR, "standby.json"))
+            except OSError:
+                pass
+            terminate_all(procs)
+            raise SystemExit(1)
+
+
+def main_sprint() -> None:
+    """Window sprint: assumes a probe JUST saw LIVE. Exits quietly when
+    the device is already gone (windows are short; a full probe retry
+    cycle would eat one)."""
+    import fcntl
+    import os
+
+    lock_fd = os.open("/tmp/tpudfs-tpu.lock", os.O_CREAT | os.O_RDWR, 0o644)
+    fcntl.flock(lock_fd, fcntl.LOCK_EX)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        cpu_requested = True
+    else:
+        cpu_requested = False
+        if not _probe_tpu(timeout_s=60.0, attempts=1):
+            _emit_once({"metric": "SPRINT aborted", "value": 0.0,
+                        "unit": "GB/s", "vs_baseline": 0.0,
+                        "platform": "tpu-unreachable-at-sprint"})
+            return
+    import jax
+
+    if cpu_requested:
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        global _tpu_intended
+        _tpu_intended = True
+    try:
+        # Persistent XLA compile cache: the first window pays the
+        # compiles, every later window reuses them from disk.
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(SPRINT_DIR, "xla-cache"))
+    except Exception:
+        pass
+    global WEDGE_TIMEOUT_S
+    WEDGE_TIMEOUT_S = 300.0  # sprint: concede faster, partials are out
+    _tick("sprint-start")
+    _start_watchdog()
+    result = asyncio.run(_run_sprint())
+    result["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
+    _progress["t"] = None
+    _emit_once(result)
+    if "cpu" not in str(result.get("platform", "")):
+        with open(_repo_path("BENCH_SPRINT.json.tmp"), "w") as f:
+            json.dump(result, f, indent=1)
+        os.replace(_repo_path("BENCH_SPRINT.json.tmp"),
+                   _repo_path("BENCH_SPRINT.json"))
+
+
+def _merge_sprint(result: dict) -> None:
+    """A CPU-fallback round-end run carries the latest real-TPU sprint
+    capture so BENCH_r{N}.json shows the device numbers."""
+    import os
+
+    try:
+        with open(_repo_path("BENCH_SPRINT.json")) as f:
+            sprint = json.load(f)
+        if "cpu" not in str(sprint.get("platform", "")):
+            result["tpu_sprint"] = {
+                k: sprint[k] for k in (
+                    "value", "value_win", "warm_infeed_read_GBps",
+                    "warm_infeed_win", "raw_infeed_GBps", "ici_write_GBps",
+                    "ici_ec_scatter_GBps", "vs_baseline", "windows",
+                    "captured_at", "platform", "sprint_standby")
+                if k in sprint}
+    except (OSError, json.JSONDecodeError):
+        pass
+
+
 def main() -> None:
     import fcntl
     import os
@@ -811,9 +1198,18 @@ def main() -> None:
         result["platform"] = "cpu-fallback(tpu unreachable)"
     elif _fell_back_midrun:
         result["platform"] = "cpu-fallback(tpu wedged mid-run)"
+    if "cpu" in str(result["platform"]):
+        _merge_sprint(result)
     _progress["t"] = None  # disarm the watchdog before the final line
     _emit_once(result)
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--standby" in sys.argv:
+        main_standby()
+    elif "--sprint" in sys.argv:
+        main_sprint()
+    else:
+        main()

@@ -412,3 +412,27 @@ async def test_live_hint_ping_pong_survives_handoff(tmp_path):
     finally:
         for s in servers:
             await s.stop()
+
+
+async def test_write_survives_dead_chain_entry(tmp_path):
+    """The allocated chain's FIRST hop is down: the client rotates the
+    chain to a live entry (dead member moves downstream, where the chain
+    tolerates hop failure) instead of failing the write — the liveness
+    window means the master keeps allocating a just-killed CS for up to
+    15 s."""
+    c, client = await _ready_cluster(tmp_path, n_masters=1, n_cs=3)
+    try:
+        data = _rand(64 * 1024, seed=77)
+        # Pin allocation order by stopping the CS the master would pick
+        # first: write once to learn the placement for this file's shape.
+        await client.create_file("/dead/probe", data)
+        info = await client.get_file_info("/dead/probe")
+        entry = info["blocks"][0]["locations"][0]
+        victim = next(cs for cs in c.chunkservers if cs.address == entry)
+        await victim.stop()
+        # The master still lists the victim (liveness cutoff); rotation
+        # must carry the write through a surviving entry.
+        await client.create_file("/dead/after", data)
+        assert await client.get_file("/dead/after") == data
+    finally:
+        await c.stop()

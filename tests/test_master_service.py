@@ -33,10 +33,11 @@ def _free_port():
 
 
 class MiniCluster:
-    def __init__(self, tmp_path, n_masters=1, n_cs=3, **master_kw):
+    def __init__(self, tmp_path, n_masters=1, n_cs=3, cs_kw=None, **master_kw):
         self.tmp = tmp_path
         self.n_masters = n_masters
         self.n_cs = n_cs
+        self.cs_kw = dict(cs_kw or {})
         self.master_kw = master_kw
         self.masters: dict[str, Master] = {}
         self.servers: dict[str, RpcServer] = {}
@@ -59,7 +60,7 @@ class MiniCluster:
         for i in range(self.n_cs):
             store = BlockStore(self.tmp / f"cs{i}/hot", self.tmp / f"cs{i}/cold")
             cs = ChunkServer(store, rack_id=f"rack-{i}", master_addrs=addrs,
-                             rpc_client=self.client)
+                             rpc_client=self.client, **self.cs_kw)
             await cs.start(scrubber=False)
             hb = HeartbeatLoop(cs, addrs, interval=0.5)
             hb.start()

@@ -100,6 +100,12 @@ class HeartbeatLoop:
             "rack_id": self.cs.rack_id,
             "command_results": results_snapshot,
         }
+        ring = self.cs.ici_ring()
+        if ring:
+            # Advertise the collective write group's ring so the master
+            # allocates successor chains the ppermute rounds physically
+            # produce (tpudfs.tpu.write_group).
+            req["ici_ring"] = ring
         executed: list[dict] = []
         reported = False
         results_delivered = False

@@ -573,31 +573,53 @@ class Client:
                                       servers: list[str], term: int,
                                       crc: int | None = None,
                                       shard: str = "") -> None:
-        req = {
-            "block_id": block_id,
-            "data": data,
-            "next_servers": servers[1:],
-            "expected_crc32c": crc if crc is not None else crc32c(data),
-            "master_term": term,
-            "master_shard": shard,
-        }
         timeout = max(self.rpc_timeout, 60.0)
-        first_hop_safe = False
-        if self._dial(servers[0]) == servers[0]:
-            # Chain transport choice: the native data-plane engine forwards
-            # ONLY to blockports, so it may carry the chain IFF every
-            # member advertises one; an asyncio-blockport first hop
-            # re-resolves per hop (mixed chains fine); anything else goes
-            # gRPC so the handler chain picks transport hop-by-hop —
-            # a mixed chain must never silently degrade to fewer replicas.
-            ports, first_hop_safe = await self.block_pool.chain_info(
-                self.rpc, servers, CS
-            )
-            if first_hop_safe and all(ports):
-                req["next_data_ports"] = ports[1:]
-        resp = await self._data_call(servers[0], "WriteBlock", req,
-                                     timeout=timeout,
-                                     allow_blockport=first_hop_safe)
+        resp = None
+        last_err: RpcError | None = None
+        # Chain-ENTRY failover: a dead/unreachable first hop rotates the
+        # chain (relative order preserved) so the write proceeds through a
+        # live entry with the dead member downstream, where the chain
+        # tolerates hop failure and the healer repairs the replica count
+        # (the reference's chain has the same one-sided tolerance:
+        # chunkserver.rs:777-825 logs, not fails, a downstream error —
+        # but its client gives up on a dead HEAD).
+        for lead in range(len(servers)):
+            chain = servers[lead:] + servers[:lead]
+            req = {
+                "block_id": block_id,
+                "data": data,
+                "next_servers": chain[1:],
+                "expected_crc32c": crc if crc is not None else crc32c(data),
+                "master_term": term,
+                "master_shard": shard,
+            }
+            first_hop_safe = False
+            if self._dial(chain[0]) == chain[0]:
+                # Chain transport choice: the native data-plane engine
+                # forwards ONLY to blockports, so it may carry the chain
+                # IFF every member advertises one; an asyncio-blockport
+                # first hop re-resolves per hop (mixed chains fine);
+                # otherwise gRPC so the handler chain picks transport
+                # hop-by-hop — a mixed chain must never silently degrade
+                # to fewer replicas.
+                ports, first_hop_safe = await self.block_pool.chain_info(
+                    self.rpc, chain, CS
+                )
+                if first_hop_safe and all(ports):
+                    req["next_data_ports"] = ports[1:]
+            try:
+                resp = await self._data_call(chain[0], "WriteBlock", req,
+                                             timeout=timeout,
+                                             allow_blockport=first_hop_safe)
+                break
+            except RpcError as e:
+                if e.code.name not in ("UNAVAILABLE", "DEADLINE_EXCEEDED"):
+                    raise
+                last_err = e
+                logger.warning("chain entry %s unreachable (%s); rotating",
+                               chain[0], e.message)
+        if resp is None:
+            raise last_err  # every candidate entry was unreachable
         if not resp.get("success"):
             raise DfsError(f"write failed: {resp.get('error_message')}")
         written = int(resp.get("replicas_written") or 0)

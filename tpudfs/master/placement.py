@@ -62,6 +62,32 @@ def select_servers_rack_aware(
     return selected
 
 
+def select_ici_chain(
+    servers: dict[str, object], rack_order: list[str], count: int
+) -> list[str] | None:
+    """Successor-chain placement for collective write groups
+    (tpudfs.tpu.write_group): when a candidate primary advertises an ICI
+    ring whose next ``count-1`` members are live, place the replicas on
+    exactly that contiguous successor run — the replica set one ppermute
+    round physically produces, so the primary's chunkserver can serve the
+    write as a collective round instead of a TCP chain. Primaries are
+    tried in ``rack_order`` (the rack-aware selection), keeping the
+    most-space-first bias; pod-host rings make the rack spread moot (the
+    north-star topology colocates every member on one pod's hosts).
+    Returns None when no advertised ring supports the chain — the caller
+    keeps its rack-aware selection and the write rides TCP."""
+    for addr in rack_order:
+        st = servers.get(addr)
+        ring = tuple(getattr(st, "ici_ring", ()) or ()) if st else ()
+        if len(ring) < count or addr not in ring:
+            continue
+        i = ring.index(addr)
+        chain = [ring[(i + j) % len(ring)] for j in range(count)]
+        if all(c in servers for c in chain):
+            return chain
+    return None
+
+
 def heal_under_replicated(state: MasterState) -> HealPlan:
     plan = HealPlan()
     live = state.live_servers()

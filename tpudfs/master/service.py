@@ -474,6 +474,14 @@ class Master:
         servers = placement.select_servers_rack_aware(
             list(self.state.chunk_servers.items()), count
         )
+        if k == 0:
+            # Prefer a collective-write-group successor chain when one is
+            # advertised: that replica set lets the primary replicate the
+            # block as ICI ppermute rounds (tpudfs.tpu.write_group).
+            chain = placement.select_ici_chain(
+                self.state.chunk_servers, servers, count)
+            if chain:
+                servers = chain
         if k > 0 and len(servers) < count:
             raise RpcError.unavailable(
                 f"EC({k},{m}) needs {count} chunkservers, have {len(servers)}"
@@ -700,6 +708,7 @@ class Master:
             available_space=int(req.get("available_space") or 0),
             chunk_count=int(req.get("chunk_count") or 0),
             rack_id=req.get("rack_id", ""),
+            ici_ring=tuple(req.get("ici_ring") or ()),
         )
         bad = list(req.get("bad_blocks") or [])
         if bad:

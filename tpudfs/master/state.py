@@ -108,6 +108,10 @@ class ChunkServerStatus:
     available_space: int = 0
     chunk_count: int = 0
     rack_id: str = "default"
+    #: Collective-write-group ring (ordered CS addresses) this server
+    #: advertises, () when it is not a group member. Soft state, refreshed
+    #: every heartbeat like the space gauges (tpudfs.tpu.write_group).
+    ici_ring: tuple = ()
 
 
 class MasterState:
@@ -183,7 +187,8 @@ class MasterState:
     # ------------------------------------------------------- soft-state ops
 
     def record_heartbeat(self, addr: str, *, used_space: int, available_space: int,
-                         chunk_count: int, rack_id: str, at_ms: int | None = None) -> bool:
+                         chunk_count: int, rack_id: str, at_ms: int | None = None,
+                         ici_ring: tuple = ()) -> bool:
         """Returns True when the CS is newly registered."""
         at = at_ms if at_ms is not None else now_ms()
         is_new = addr not in self.chunk_servers
@@ -194,6 +199,7 @@ class MasterState:
             available_space=available_space,
             chunk_count=chunk_count,
             rack_id=rack_id or prev_rack,
+            ici_ring=tuple(ici_ring),
         )
         if self.safe_mode and self.should_exit_safe_mode(at):
             self.exit_safe_mode()

@@ -1,0 +1,114 @@
+"""In-process DFS cluster: masters + chunkservers as asyncio services in
+ONE process — the topology a single-controller JAX process needs for the
+collective write group (the whole mesh lives in this process, so the
+chunkservers attached to its positions must too). Used by
+``__graft_entry__.dryrun_multichip`` and demo scripts; the pytest twin is
+``tests.test_master_service.MiniCluster`` (kept separate: it carries
+test-only fixtures and fast-raft timings tuned for the suite).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+
+from tpudfs.chunkserver.blockstore import BlockStore
+from tpudfs.chunkserver.heartbeat import HeartbeatLoop
+from tpudfs.chunkserver.service import ChunkServer
+from tpudfs.client.client import Client
+from tpudfs.common.rpc import RpcClient, RpcServer
+from tpudfs.master.service import Master
+from tpudfs.raft.core import Timings
+
+FAST_RAFT = Timings(election_min=0.3, election_max=0.6, heartbeat=0.1,
+                    snapshot_threshold=200)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class InprocCluster:
+    """Boot ``n_masters`` masters and ``n_cs`` chunkservers in-process.
+
+    ``python_data_plane=True`` by default: collective write group members
+    must serve writes from rpc_write_block (tpudfs.tpu.write_group)."""
+
+    def __init__(self, workdir, n_masters: int = 1, n_cs: int = 3,
+                 python_data_plane: bool = True):
+        self.workdir = workdir
+        self.n_masters = n_masters
+        self.n_cs = n_cs
+        self.python_data_plane = python_data_plane
+        self.masters: dict[str, Master] = {}
+        self.servers: dict[str, RpcServer] = {}
+        self.chunkservers: list[ChunkServer] = []
+        self.heartbeats: list[HeartbeatLoop] = []
+        self.rpc = RpcClient()
+
+    async def start(self) -> None:
+        from pathlib import Path
+
+        base = Path(self.workdir)
+        addrs = [f"127.0.0.1:{_free_port()}" for _ in range(self.n_masters)]
+        for i, addr in enumerate(addrs):
+            peers = [a for a in addrs if a != addr]
+            m = Master(addr, peers, str(base / f"m{i}"),
+                       raft_timings=FAST_RAFT, rpc_client=self.rpc)
+            server = RpcServer(port=int(addr.rsplit(":", 1)[1]))
+            m.attach(server)
+            await server.start()
+            await m.start()
+            self.masters[addr] = m
+            self.servers[addr] = server
+        for i in range(self.n_cs):
+            store = BlockStore(base / f"cs{i}/hot", base / f"cs{i}/cold")
+            cs = ChunkServer(
+                store, rack_id=f"host-{i}", master_addrs=addrs,
+                rpc_client=self.rpc,
+                python_data_plane=self.python_data_plane)
+            await cs.start(scrubber=False)
+            hb = HeartbeatLoop(cs, addrs, interval=0.5)
+            hb.start()
+            self.chunkservers.append(cs)
+            self.heartbeats.append(hb)
+
+    async def leader(self, timeout: float = 15.0) -> Master:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            for m in self.masters.values():
+                if m.raft.is_leader:
+                    return m
+            await asyncio.sleep(0.05)
+        raise RuntimeError("no master leader")
+
+    async def ready(self, timeout: float = 15.0) -> Master:
+        """Leader elected, safe mode exited, one heartbeat delivered."""
+        leader = await self.leader(timeout)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if not leader.state.safe_mode:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("master still in safe mode")
+        for hb in self.heartbeats:
+            await hb.tick()
+        return leader
+
+    def client(self, block_size: int = 1 << 20) -> Client:
+        return Client(list(self.masters), rpc_client=self.rpc,
+                      block_size=block_size)
+
+    async def stop(self) -> None:
+        for hb in self.heartbeats:
+            hb.stop()
+        for cs in self.chunkservers:
+            await cs.stop()
+        for m in self.masters.values():
+            await m.stop()
+        for s in self.servers.values():
+            await s.stop()
+        await self.rpc.close()
