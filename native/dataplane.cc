@@ -684,6 +684,19 @@ class Engine {
     out[5] = cache_misses_.load();
   }
 
+  // Write-path stage budget (round-5: isolate fsync scheduling from
+  // protocol cost in the chain write). All nanoseconds except the counts.
+  void stage_stats(uint64_t out[8]) const {
+    out[0] = stage_ns_.load();        // tpudfs_block_write_staged wall
+    out[1] = commit_wait_ns_.load();  // queued -> durable (group commit)
+    out[2] = syncfs_ns_.load();       // commit loop's syncfs calls
+    out[3] = fwd_ack_ns_.load();      // downstream ack recv wall
+    out[4] = commit_batches_.load();
+    out[5] = commit_entries_.load();
+    out[6] = staged_bytes_.load();
+    out[7] = rename_ns_.load();       // publish renames
+  }
+
   // ------------------------------------------------------ LRU block cache
 
   using CacheData = std::shared_ptr<std::vector<uint8_t>>;
@@ -949,7 +962,10 @@ class Engine {
       forwards_.fetch_add(1);
       std::map<std::string, Value> fh;
       std::vector<uint8_t> fp;
-      if (recv_frame(*fwd, &fh, &fp) && fh.count("ok") && fh["ok"].b &&
+      uint64_t ta = now_ns();
+      bool got = recv_frame(*fwd, &fh, &fp);
+      fwd_ack_ns_.fetch_add(now_ns() - ta);
+      if (got && fh.count("ok") && fh["ok"].b &&
           fh.count("success") && fh["success"].b) {
         replicas += fh.count("replicas_written") ? fh["replicas_written"].i : 0;
       } else {
@@ -1086,6 +1102,13 @@ class Engine {
     return fd;
   }
 
+  static uint64_t now_ns() {
+    return static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count());
+  }
+
   bool stage_and_commit(const std::string& block_id,
                         const std::vector<uint8_t>& data, std::string* err) {
     uint64_t token = token_seq_.fetch_add(1);
@@ -1095,13 +1118,17 @@ class Engine {
     entry->meta_tmp = base + ".meta.tmp-n" + std::to_string(token);
     entry->data_final = base;
     entry->meta_final = base + ".meta";
+    uint64_t t0 = now_ns();
     int64_t rc = tpudfs_block_write_staged(
         entry->data_tmp.c_str(), entry->meta_tmp.c_str(), data.data(),
         data.size(), chunk_, nullptr);
+    stage_ns_.fetch_add(now_ns() - t0);
+    staged_bytes_.fetch_add(data.size());
     if (rc < 0) {
       *err = "stage failed: errno " + std::to_string(-rc);
       return false;
     }
+    uint64_t tq = now_ns();
     std::unique_lock<std::mutex> lk(commit_mu_);
     commit_queue_.push_back(entry);
     commit_cv_.notify_one();
@@ -1127,6 +1154,7 @@ class Engine {
       }
       return false;
     });
+    commit_wait_ns_.fetch_add(now_ns() - tq);
     if (dequeued) {
       ::unlink(entry->data_tmp.c_str());
       ::unlink(entry->meta_tmp.c_str());
@@ -1153,7 +1181,10 @@ class Engine {
       // One filesystem sync makes every staged file durable, renames
       // publish, a second sync persists the renames (the group-commit
       // batch path of tpudfs/chunkserver/blockstore.py).
+      uint64_t t0 = now_ns();
       tpudfs_syncfs(hot_.c_str());
+      uint64_t t1 = now_ns();
+      syncfs_ns_.fetch_add(t1 - t0);
       for (auto& e : batch) {
         if (::rename(e->data_tmp.c_str(), e->data_final.c_str()) != 0 ||
             ::rename(e->meta_tmp.c_str(), e->meta_final.c_str()) != 0) {
@@ -1162,7 +1193,12 @@ class Engine {
                      std::string(::strerror(errno));
         }
       }
+      uint64_t t2 = now_ns();
+      rename_ns_.fetch_add(t2 - t1);
       tpudfs_syncfs(hot_.c_str());
+      syncfs_ns_.fetch_add(now_ns() - t2);
+      commit_batches_.fetch_add(1);
+      commit_entries_.fetch_add(batch.size());
       lk.lock();
       for (auto& e : batch) e->done = true;
       commit_done_cv_.notify_all();
@@ -1402,6 +1438,9 @@ class Engine {
   std::map<std::string, uint64_t> terms_;
   std::atomic<uint64_t> token_seq_{1};
   std::atomic<uint64_t> writes_{0}, reads_{0}, forwards_{0}, errors_{0};
+  std::atomic<uint64_t> stage_ns_{0}, commit_wait_ns_{0}, syncfs_ns_{0},
+      fwd_ack_ns_{0}, commit_batches_{0}, commit_entries_{0},
+      staged_bytes_{0}, rename_ns_{0};
   std::thread accept_thread_, commit_thread_;
   std::atomic<int> active_{0};
   std::mutex conns_mu_;
@@ -1503,6 +1542,14 @@ void tpudfs_dataplane_stats(int64_t h, uint64_t out[6]) {
   Engine* e = get_engine(h);
   if (e) e->stats(out);
   else for (int i = 0; i < 6; i++) out[i] = 0;
+}
+
+// Write-path stage budgets: stage_ns, commit_wait_ns, syncfs_ns,
+// fwd_ack_ns, commit_batches, commit_entries, staged_bytes, rename_ns.
+void tpudfs_dataplane_stage_stats(int64_t h, uint64_t out[8]) {
+  Engine* e = get_engine(h);
+  if (e) e->stage_stats(out);
+  else for (int i = 0; i < 8; i++) out[i] = 0;
 }
 
 int64_t tpudfs_dataplane_stop(int64_t h) {

@@ -33,7 +33,12 @@ ensure_standby() {
 }
 
 while true; do
-  ensure_standby
+  # Manage the standby only while the TPU lock is free: a FULL bench run
+  # (which holds it) kills the standby to keep the core quiet, and
+  # relaunching it mid-run would undo that.
+  if flock -n /tmp/tpudfs-tpu.lock true 2>/dev/null; then
+    ensure_standby
+  fi
   ts=$(date -u +%FT%TZ)
   out=$(flock -n /tmp/tpudfs-tpu.lock timeout 60 python -c \
         "import jax; d=jax.devices(); print(d[0].platform, len(d))" 2>&1)

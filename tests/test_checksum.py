@@ -124,3 +124,19 @@ def test_crc_combine_and_native_equivalence_fuzz():
             checksum.crc32c(a), checksum.crc32c(b), len(b)
         ) == whole
         assert checksum._crc32c_numpy(data) == whole
+
+
+def test_native_crc32c_3way_boundary_bit_exact():
+    """The native CRC switches to a 3-lane interleaved hardware chain at
+    8192 bytes (recombined via GF(2) shift matrices) — every size around
+    the switch, odd tails included, must match the numpy reference."""
+    import numpy as np
+
+    from tpudfs.common.checksum import _crc32c_chunks_numpy, crc32c
+
+    rng = np.random.default_rng(123)
+    for n in (8191, 8192, 8193, 8200, 24575, 24576, 65536 + 7,
+              (1 << 20) + 3):
+        buf = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        want = int(_crc32c_chunks_numpy(buf, n)[0])
+        assert crc32c(buf) == want, n

@@ -62,6 +62,7 @@ class BlockStore:
                  owner: bool = False):
         self.hot_dir = Path(hot_dir)
         self.cold_dir = Path(cold_dir) if cold_dir else None
+        self._hot_str = str(self.hot_dir)
         self.chunk_size = chunk_size
         self.hot_dir.mkdir(parents=True, exist_ok=True)
         if self.cold_dir:
@@ -89,6 +90,15 @@ class BlockStore:
             return hot
         cold = self.cold_dir / block_id
         return cold if cold.exists() else hot
+
+    def hot_path_str(self, block_id: str) -> str:
+        """Hot-tier data path as a plain string, NO existence probe — the
+        sweep pump's per-block fast path (pathlib construction + the
+        stat cost ~50-100us/block on the one-core host). A cold-tier or
+        missing block surfaces as a failed pread there and takes the
+        per-block fallback, which uses the probing :meth:`block_path`."""
+        _check_block_id(block_id)
+        return f"{self._hot_str}/{block_id}"
 
     def _meta_path(self, data_path: Path) -> Path:
         return data_path.with_name(data_path.name + ".meta")

@@ -687,9 +687,13 @@ class ChunkServer:
         from tpudfs.tpu.write_group import IciWriteError
 
         group = self._ici_group
-        if (not group.healthy()
-                or len(next_servers) + 1 != group.replication
-                or next_servers != group.successors(self._ici_pos)):
+        if len(next_servers) + 1 != group.replication:
+            # Not a candidate at all (an intermediate TCP hop's shorter
+            # chain, or a short allocation): no fallback counted — the
+            # gauge tracks writes that COULD have ridden ICI but didn't.
+            return None
+        if not group.healthy() \
+                or next_servers != group.successors(self._ici_pos):
             self.ici_fallbacks += 1
             return None
         try:
@@ -823,6 +827,24 @@ class ChunkServer:
                        "cache_hits": vals[4], "cache_misses": vals[5]}
         return out
 
+    def write_stage_stats(self) -> dict:
+        """Write-path stage budget from the native engine (ns totals +
+        counts) — isolates staging vs group-commit wait vs syncfs vs
+        downstream-ack time for the chain-write experiments."""
+        keys = ("stage_ns", "commit_wait_ns", "syncfs_ns", "fwd_ack_ns",
+                "commit_batches", "commit_entries", "staged_bytes",
+                "rename_ns")
+        if self._native_dp is None:
+            return dict.fromkeys(keys, 0)
+        lib = native.get_lib()
+        if lib is None or not hasattr(lib, "tpudfs_dataplane_stage_stats"):
+            return dict.fromkeys(keys, 0)
+        import ctypes
+
+        vals = (ctypes.c_uint64 * 8)()
+        lib.tpudfs_dataplane_stage_stats(self._native_dp, vals)
+        return dict(zip(keys, [int(v) for v in vals]))
+
     def _block_sig(self, block_id: str) -> tuple | None:
         try:
             st = os.stat(self.store.block_path(block_id))
@@ -874,6 +896,7 @@ class ChunkServer:
             # engine's block cache).
             cache_hits=self.cache.hits + dp["cache_hits"],
             cache_misses=self.cache.misses + dp["cache_misses"],
+            write_stages=self.write_stage_stats(),
         )
         return stats
 

@@ -613,7 +613,11 @@ class Client:
                                              allow_blockport=first_hop_safe)
                 break
             except RpcError as e:
-                if e.code.name not in ("UNAVAILABLE", "DEADLINE_EXCEEDED"):
+                # Rotation is only sound for a DEAD entry (refused/reset):
+                # a DEADLINE_EXCEEDED entry may still be committing, and
+                # resending through a second chain would run two chains
+                # concurrently and stretch time-to-failure by R x timeout.
+                if e.code.name != "UNAVAILABLE":
                     raise
                 last_err = e
                 logger.warning("chain entry %s unreachable (%s); rotating",

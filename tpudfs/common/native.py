@@ -132,6 +132,35 @@ def get_lib() -> ctypes.CDLL | None:
         # Prebuilt library predating the batched read engine.
         pass
     try:
+        lib.tpudfs_sweep_start.restype = ctypes.c_int64
+        lib.tpudfs_sweep_start.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),  # paths
+            ctypes.c_uint64,                  # n
+            ctypes.c_uint64,                  # stride
+            ctypes.c_uint64,                  # round_blocks
+            ctypes.POINTER(ctypes.c_void_p),  # ring buffers
+            ctypes.c_uint64,                  # nbufs
+            ctypes.c_void_p,                  # sizes (int64*)
+            ctypes.c_void_p,                  # crcs (uint32*)
+        ]
+        lib.tpudfs_sweep_wait.restype = ctypes.c_int64
+        lib.tpudfs_sweep_wait.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.tpudfs_sweep_release.restype = None
+        lib.tpudfs_sweep_release.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.tpudfs_sweep_stop.restype = None
+        lib.tpudfs_sweep_stop.argtypes = [ctypes.c_int64]
+    except AttributeError:
+        # Prebuilt library predating the sweep pump.
+        pass
+    try:
+        lib.tpudfs_dataplane_stage_stats.restype = None
+        lib.tpudfs_dataplane_stage_stats.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p,
+        ]
+    except AttributeError:
+        # Prebuilt library predating write-stage budgets.
+        pass
+    try:
         lib.tpudfs_block_write_staged.restype = ctypes.c_int64
         lib.tpudfs_block_write_staged.argtypes = \
             list(lib.tpudfs_block_write.argtypes)

@@ -103,6 +103,8 @@ class HbmReader:
         #: reads; 0 keeps every block on the per-block path.
         self.batch_reads = batch_reads
         self._combiners: dict = {}
+        #: blocks served by the native sweep pump (observability/bench).
+        self.sweep_blocks = 0
 
     def _combiner(self, device):
         c = self._combiners.get(device)
@@ -468,6 +470,211 @@ class HbmReader:
         return list(await asyncio.gather(
             *(fast_or_slow(b) for b in meta["blocks"])
         ))
+
+    # ---------------------------------------------------- native sweep pump
+
+    async def sweep_metas_to_device(self, metas: list[dict], device=None, *,
+                                    round_blocks: int = 16,
+                                    ring: int = 3) -> list[DeviceBlock]:
+        """Steady-state SWEEP infeed, native end-to-end (the round-4
+        verdict's 'push the round loop out of Python'): every eligible
+        block of every file is handed to the native sweep pump
+        (native/blockio.cc tpudfs_sweep_*) ONCE — a producer thread
+        drives fused pread+3-lane-CRC into a ring of round buffers ahead
+        of this coroutine, whose only per-round work is one wait (usually
+        already satisfied), one vectorized verify, one device_put, one
+        release. No per-block futures, no executor hops, no staging.
+
+        Blocks that don't qualify (EC, remote-only replica, unaligned
+        tail, CRC mismatch, short read) fall back to the general per-
+        block path — identical recovery semantics. Returns DeviceBlocks
+        flattened in (file, block) order, HOST-verified (the pump checks
+        the recorded whole-block CRC; nothing pending for confirm).
+
+        TPU note: round buffers are recycled, so on accelerators each
+        buffer's device_put completes (block_until_ready) before its
+        round is released — ring depth keeps the producer ahead anyway.
+        The CPU backend's copies are synchronous-by-probe (see
+        read_combiner's aliasing notes; buffers come misaligned)."""
+        import ctypes
+
+        from tpudfs.common import native
+        from tpudfs.tpu.read_combiner import DeviceBatch, alloc_misaligned_u8
+
+        device = device or self.devices[0]
+        lib = native.get_lib()
+        if lib is None or not hasattr(lib, "tpudfs_sweep_start"):
+            out = await asyncio.gather(
+                *(self.read_meta_blocks_fast(m, device) for m in metas))
+            return [b for bs in out for b in bs]
+
+        # ---- eligibility + local path resolution (meta order preserved)
+        entries: list = []   # (slot_index | None, block) per (file, block)
+        paths: list[bytes] = []
+        expected_sizes: list[int] = []
+        expected_crcs: list[int] = []
+        stores: dict[str, object] = {}  # addr -> store|None, sweep-local
+        for meta in metas:
+            for block in meta["blocks"]:
+                size = int(block.get("size") or 0)
+                store = None
+                if (self.client.local_reads
+                        and not block.get("ec_data_shards")
+                        and block.get("checksum_crc32c")
+                        and size > 0 and size % CHECKSUM_CHUNK_SIZE == 0):
+                    for addr in block.get("locations") or []:
+                        if not addr:
+                            continue
+                        if addr in stores:
+                            s = stores[addr]
+                        else:
+                            s = await self.client._local_store(addr)
+                            stores[addr] = s
+                        if s is not None:
+                            store = s
+                            break
+                if store is None:
+                    entries.append((None, block))
+                    continue
+                try:
+                    # No-probe hot-tier path: a cold-tier/missing block
+                    # fails its pread and takes the per-block fallback.
+                    bpath = store.hot_path_str(block["block_id"])
+                except ValueError:
+                    entries.append((None, block))
+                    continue
+                entries.append((len(paths), block))
+                paths.append(bpath.encode())
+                expected_sizes.append(size)
+                expected_crcs.append(int(block["checksum_crc32c"]))
+
+        fallback_idx = [i for i, (slot, _b) in enumerate(entries)
+                        if slot is None]
+        results: list = [None] * len(entries)
+        n = len(paths)
+        if n:
+            stride = max(expected_sizes)
+            stride = -(-stride // CHECKSUM_CHUNK_SIZE) * CHECKSUM_CHUNK_SIZE
+            spb = stride // CHECKSUM_CHUNK_SIZE  # slot rows
+            is_cpu = getattr(device, "platform", "cpu") == "cpu"
+            cpu_copies = is_cpu and self._cpu_copies(device)
+            if is_cpu and not cpu_copies:
+                # Same defense as the combiner's pool: if the probe says
+                # this CPU backend may ALIAS our (misaligned) buffers, no
+                # completion wait makes ring recycling safe — an aliased
+                # device array references the buffer forever. Serve the
+                # whole sweep through the per-block path instead.
+                out = await asyncio.gather(
+                    *(self.read_meta_blocks_fast(m, device)
+                      for m in metas))
+                return [b for bs in out for b in bs]
+            round_bytes = round_blocks * stride
+            if is_cpu:
+                bufs = [alloc_misaligned_u8(round_bytes)
+                        for _ in range(ring)]
+            else:
+                bufs = [np.empty(round_bytes, dtype=np.uint8)
+                        for _ in range(ring)]
+            buf_words = [b.view("<u4").reshape(-1, WORDS_PER_CHUNK)
+                         for b in bufs]
+            sizes = np.zeros(n, dtype=np.int64)
+            crcs = np.zeros(n, dtype=np.uint32)
+            cpaths = (ctypes.c_char_p * n)(*paths)
+            cbufs = (ctypes.c_void_p * ring)(
+                *(b.ctypes.data for b in bufs))
+            exp_sizes = np.asarray(expected_sizes, dtype=np.int64)
+            exp_crcs = np.asarray(expected_crcs, dtype=np.uint32)
+            slot_entry = [i for i, (slot, _b) in enumerate(entries)
+                          if slot is not None]
+            handle = lib.tpudfs_sweep_start(
+                cpaths, n, stride, round_blocks, cbufs, ring,
+                sizes.ctypes.data, crcs.ctypes.data)
+            nrounds = -(-n // round_blocks)
+            outstanding: list = [None] * nrounds  # round words awaiting H2D
+            try:
+                for r in range(nrounds):
+                    if not cpu_copies and r >= ring:
+                        # Recycled buffer: its device copy must complete
+                        # before the producer may refill it.
+                        prev = outstanding[r - ring]
+                        if prev is not None:
+                            await asyncio.to_thread(
+                                jax.block_until_ready, prev)
+                        lib.tpudfs_sweep_release(handle, r - ring)
+                    nblk = await asyncio.to_thread(
+                        lib.tpudfs_sweep_wait, handle, r)
+                    if nblk < 0:
+                        break
+                    lo = r * round_blocks
+                    hi = lo + nblk
+                    ok = (sizes[lo:hi] == exp_sizes[lo:hi]) \
+                        & (crcs[lo:hi] == exp_crcs[lo:hi])
+                    words = jax.device_put(
+                        buf_words[r % ring][: nblk * spb], device)
+                    if cpu_copies:
+                        lib.tpudfs_sweep_release(handle, r)
+                    else:
+                        outstanding[r] = words
+                    batch = DeviceBatch(words=words, crcs=None,
+                                        cpb=spb, nblocks=nblk)
+                    for j in range(nblk):
+                        slot = lo + j
+                        eidx = slot_entry[slot]
+                        _s, block = entries[eidx]
+                        if not ok[j]:
+                            fallback_idx.append(eidx)
+                            continue
+                        results[eidx] = DeviceBlock(
+                            block["block_id"], None,
+                            int(exp_sizes[slot]), True,
+                            expected_crc=int(exp_crcs[slot]),
+                            source=block, device=device,
+                            batch=batch, batch_index=j,
+                            batch_pending=False)
+                        self.sweep_blocks += 1
+            finally:
+                # Completion before stop: the producer may still point at
+                # a buffer a dispatched transfer is reading on non-CPU.
+                if not cpu_copies:
+                    pend = [w for w in outstanding if w is not None]
+                    if pend:
+                        await asyncio.to_thread(jax.block_until_ready, pend)
+                lib.tpudfs_sweep_stop(handle)
+
+        if fallback_idx:
+            async def fb(eidx: int):
+                _slot, block = entries[eidx]
+                results[eidx] = await self.read_block_to_device(
+                    block, device, verify=True)
+
+            await asyncio.gather(*(fb(i) for i in fallback_idx))
+        return results
+
+    def _cpu_copies(self, device) -> bool:
+        """Whether device_put copies our (misaligned) host buffers
+        synchronously on this CPU backend — cached probe, shared with the
+        combiner's pool logic."""
+        cached = getattr(self, "_cpu_copies_probe", None)
+        if cached is None:
+            from tpudfs.tpu.read_combiner import ReadCombiner
+
+            cached = ReadCombiner(None, device)._cpu_copies
+            self._cpu_copies_probe = cached
+        return cached
+
+    async def sweep_paths_to_device(self, paths: list[str], device=None, *,
+                                    round_blocks: int = 16,
+                                    ring: int = 3) -> list[DeviceBlock]:
+        """sweep_metas_to_device with the metadata fan-out in front (the
+        'cold' flagship pattern: nothing cached, metadata fetched
+        in-sweep, then the native pump drives the data plane)."""
+        metas = await asyncio.gather(
+            *(self.client.get_file_info(p) for p in paths))
+        missing = [p for p, m in zip(paths, metas) if m is None]
+        if missing:
+            raise DfsError(f"file not found: {missing[0]}")
+        return await self.sweep_metas_to_device(
+            metas, device, round_blocks=round_blocks, ring=ring)
 
     # ------------------------------------------------------------- per file
 

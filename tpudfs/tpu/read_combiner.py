@@ -98,6 +98,17 @@ def _bucket(n: int, cap: int) -> int:
     return 1 << (n.bit_length() - 1)
 
 
+def alloc_misaligned_u8(nbytes: int) -> np.ndarray:
+    """A uint8 buffer whose data pointer is 64-byte-MISaligned (ptr%64==4)
+    so PJRT's CPU client must COPY it on device_put instead of zero-copy
+    aliasing (which it does for 64-aligned hosts buffers — see
+    ReadCombiner's pool notes). Required for any host buffer that is
+    mutated/recycled after device_put on the CPU backend."""
+    raw = np.empty(nbytes + 68, dtype=np.uint8)
+    off = (4 - raw.ctypes.data) % 64
+    return raw[off : off + nbytes]
+
+
 class ReadCombiner:
     def __init__(self, client, device, *, max_batch: int = DEFAULT_MAX_BATCH,
                  host_verify: bool | None = None):
@@ -157,9 +168,7 @@ class ReadCombiner:
         nbytes = nrows * WORDS_PER_CHUNK * 4
         if not self._misalign_bufs:
             return np.empty((nrows, WORDS_PER_CHUNK), dtype="<u4")
-        raw = np.empty(nbytes + 68, dtype=np.uint8)
-        off = (4 - raw.ctypes.data) % 64
-        return raw[off : off + nbytes].view("<u4").reshape(
+        return alloc_misaligned_u8(nbytes).view("<u4").reshape(
             nrows, WORDS_PER_CHUNK
         )
 

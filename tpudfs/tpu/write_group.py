@@ -280,27 +280,37 @@ class IciWriteGroup:
         total = len(self.members)
         C = B * cpb
         stride = cpb * CHECKSUM_CHUNK_SIZE
-        words = np.zeros((total * C, WORDS_PER_CHUNK), dtype="<u4")
-        crcs = np.full(total * C, _ZERO_CHUNK_CRC, dtype="<u4")
-        flat = words.reshape(-1).view(np.uint8)
-        for pos, take in enumerate(per_pos):
-            for j, p in enumerate(take):
-                off = (pos * C + j * cpb) * CHECKSUM_CHUNK_SIZE
-                flat[off : off + len(p.data)] = np.frombuffer(
-                    p.data, dtype=np.uint8)
-                padded = flat[off : off + stride].tobytes()
-                crcs[pos * C + j * cpb : pos * C + (j + 1) * cpb] = \
-                    crc32c_chunks(padded, CHECKSUM_CHUNK_SIZE)
+
+        def stage() -> tuple[np.ndarray, np.ndarray]:
+            # Multi-MiB memcpy + CRC staging: worker thread, not the event
+            # loop — a stalled loop stalls every RPC handler and heartbeat
+            # in the process on the one-core host.
+            words = np.zeros((total * C, WORDS_PER_CHUNK), dtype="<u4")
+            crcs = np.full(total * C, _ZERO_CHUNK_CRC, dtype="<u4")
+            flat = words.reshape(-1).view(np.uint8)
+            for pos, take in enumerate(per_pos):
+                for j, p in enumerate(take):
+                    off = (pos * C + j * cpb) * CHECKSUM_CHUNK_SIZE
+                    flat[off : off + len(p.data)] = np.frombuffer(
+                        p.data, dtype=np.uint8)
+                    padded = flat[off : off + stride].tobytes()
+                    crcs[pos * C + j * cpb : pos * C + (j + 1) * cpb] = \
+                        crc32c_chunks(padded, CHECKSUM_CHUNK_SIZE)
+            return words, crcs
+
         try:
             import jax
 
+            words, crcs = await asyncio.to_thread(stage)
             sharding = self.replicator.sharding()
             dwords, dcrcs = await asyncio.to_thread(
                 lambda: (jax.device_put(words, sharding),
                          jax.device_put(crcs, sharding)))
             replicas, _ok, acks = await asyncio.to_thread(
                 self.replicator.replicate, dwords, dcrcs)
-            acks = int(np.asarray(acks))
+            # int(np.asarray(...)) is a D2H sync (10-50 ms on a tunneled
+            # TPU) — worker thread too.
+            acks = await asyncio.to_thread(lambda: int(np.asarray(acks)))
         except Exception as e:
             self.stats.round_failures += 1
             self._fail_round(per_pos, f"collective round failed: {e}")
@@ -349,7 +359,9 @@ class IciWriteGroup:
             if member is None:
                 self.stats.persist_failures += 1
                 continue
-            local = np.asarray(shard.data)  # (R, C, 128) u32
+            # Several-MiB D2H drain per device shard: off the event loop.
+            local = await asyncio.to_thread(
+                lambda s=shard: np.asarray(s.data))  # (R, C, 128) u32
             row = (p // n) * n
             for r in range(R):
                 src = row + ((p % n) - r) % n
