@@ -1169,6 +1169,13 @@ class Engine {
   }
 
   void commit_loop() {
+    // No artificial accumulation window: the write pipeline is a closed
+    // latency loop (fixed client concurrency), so delaying commits to
+    // widen batches proportionally lowers the arrival rate instead —
+    // measured round 5 (BENCH_NOTES): a 6 ms window moved batches only
+    // 1.7 -> 2.1 entries at equal throughput. The stage budgets put the
+    // chain at 75-93% of the disk's sustained fdatasync rate already;
+    // arrivals during an in-flight sync batch naturally.
     std::unique_lock<std::mutex> lk(commit_mu_);
     while (running_.load() || !commit_queue_.empty()) {
       if (commit_queue_.empty()) {
