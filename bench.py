@@ -390,11 +390,32 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
     # ---- write-side windows: each rep writes a DISTINCT file set (no
     # create-over-existing shortcuts), interleaving creates/s and the 3x
     # pipeline-replicated data writes (logical GB/s).
-    meta_samples, write_samples = [], []
+    meta_samples, meta_fused_samples, write_samples = [], [], []
+
+    async def fused_create(rep: int, i: int) -> None:
+        # The metadata PLANE alone: one fused create+alloc proposal (WAL
+        # group commit), no data-plane stages. The legacy meta_creates
+        # number spends ~3 of its ~4 ms/op in the empty 3x chain write +
+        # CompleteFile — i.e., two data-plane fsync stages (round-5
+        # breakdown in BENCH_NOTES).
+        async with wsem:
+            resp = await rpc.call(maddr, "MasterService", "CreateFile",
+                                  {"path": f"/bench/metaf{rep}/m{i:03d}",
+                                   "first_block": True}, timeout=15.0)
+            # A degraded response (alloc skipped: no registered CS, lapsed
+            # heartbeat) would silently time the create-ONLY proposal and
+            # inflate the create+alloc metric — fail the window instead.
+            if not resp.get("block"):
+                raise RuntimeError(
+                    f"fused alloc degraded: {resp.get('alloc_error')}")
+
     for rep in range(REPS):
         t0 = time.perf_counter()
         await asyncio.gather(*(put_empty(rep, i) for i in range(100)))
         meta_samples.append(100 / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        await asyncio.gather(*(fused_create(rep, i) for i in range(100)))
+        meta_fused_samples.append(100 / (time.perf_counter() - t0))
         t0 = time.perf_counter()
         await asyncio.gather(*(put(rep, i) for i in range(FILES)))
         write_samples.append(
@@ -405,6 +426,8 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "write_pipeline_GBps": round(statistics.median(write_samples), 3),
         "write_pipeline_win": _winmm(write_samples),
         "meta_creates_per_s": round(statistics.median(meta_samples), 1),
+        "meta_fused_creates_per_s": round(
+            statistics.median(meta_fused_samples), 1),
         "files": FILES,
         "etag_mode": client.etag_mode,
     })
@@ -714,6 +737,8 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "write_pipeline_win": _winmm(write_samples),
         "meta_creates_per_s": round(med(meta_samples), 1),
         "meta_creates_win": _winmm(meta_samples, 1),
+        "meta_fused_creates_per_s": round(med(meta_fused_samples), 1),
+        "meta_fused_creates_win": _winmm(meta_fused_samples, 1),
         "ici_write_GBps": round(med(ici_samples), 3),
         "ici_write_win": _winmm(ici_samples),
         "ici_ec_scatter_GBps": round(med(ec_samples), 3),
