@@ -148,6 +148,10 @@ CACHE_PASSES = 4
 # write_pipeline_GBps stays comparable across rounds.
 READ_CONCURRENCY = 6
 FUSED_READ_CONCURRENCY = 32
+#: Remote (non-colocated) fused sweep: 16 in-flight files batch into
+#: denser per-origin ReadBlocks frames than 6 (measured round 5 with the
+#: scatter receive: 0.39 -> 0.51 GB/s); past 16 the one-core loop churns.
+REMOTE_SWEEP_CONCURRENCY = 16
 #: Fused round cap (blocks). Kept at 16 so the batched-CRC bucket set is
 #: {1,2,4,8,16} — five warm-up compiles, bounded on real TPU.
 BATCH_READS = 16
@@ -585,6 +589,7 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
             range(grpc_files),
             lambda i: reader.read_file_to_device_blocks(
                 f"/bench/r{rep}/f{i:04d}", verify="lazy"),
+            concurrency=REMOTE_SWEEP_CONCURRENCY,
         )
         client.local_reads = True
         grpc_samples.append(gbps)

@@ -1339,11 +1339,15 @@ class Engine {
                static_cast<uint64_t>(rc));
   }
 
-  // Batched verified full reads: header {"block_ids": [...]}; response
-  // header carries "sizes" (bytes per slot, -1 = missing/corrupt — the
-  // caller falls back per block) and the payload concatenates the
-  // successful blocks in request order. One frame replaces N round
-  // trips for a remote reader's fused round.
+  // Batched UNVERIFIED full reads: header {"block_ids": [...]}; response
+  // header carries "sizes" (bytes per slot, -1 = missing/unreadable/
+  // over-budget — the caller falls back per block) and the payload
+  // concatenates the successful blocks in request order. One frame
+  // replaces N round trips for a remote reader's fused round. No sidecar
+  // verify here: every consumer (the combiner's remote rounds)
+  // re-verifies end-to-end against the recorded whole-block checksum and
+  // routes mismatches to the per-block VERIFIED path, which detects the
+  // rot, reports it, and triggers recovery.
   void handle_read_batch(Stream& s, std::map<std::string, Value>& h) {
     const std::vector<std::string> ids =
         h.count("block_ids") ? h["block_ids"].astr
@@ -1353,6 +1357,22 @@ class Engine {
     sizes.reserve(ids.size());
     constexpr size_t kMaxSlots = 256;
     constexpr size_t kMaxBatchBytes = 96ull << 20;  // < 100 MiB frame caps
+    // One allocation for the whole frame: growing block-by-block would
+    // realloc-copy the accumulated payload several times per 16-48 MiB
+    // round (round-5 remote-read budget).
+    {
+      size_t est = 0;
+      struct stat st;
+      for (const auto& block_id : ids) {
+        if (est >= kMaxBatchBytes || block_id.empty()) continue;
+        std::string p = hot_ + "/" + block_id;
+        if (::stat(p.c_str(), &st) == 0 ||
+            (!cold_.empty() &&
+             ::stat((cold_ + "/" + block_id).c_str(), &st) == 0))
+          est += static_cast<uint64_t>(st.st_size);
+      }
+      payload.reserve(est < kMaxBatchBytes ? est : kMaxBatchBytes);
+    }
     for (const auto& block_id : ids) {
       reads_.fetch_add(1);
       if (sizes.size() >= kMaxSlots || payload.size() >= kMaxBatchBytes) {
@@ -1373,7 +1393,6 @@ class Engine {
         sizes.push_back(static_cast<int64_t>(cached->size()));
         continue;
       }
-      const uint64_t gen = cache_gen(block_id);  // before the pread
       std::string data_path = hot_ + "/" + block_id;
       struct stat st;
       if (::stat(data_path.c_str(), &st) != 0) {
@@ -1394,9 +1413,15 @@ class Engine {
         continue;
       }
       payload.resize(base + total);
+      // verify=0: every ReadBlocks consumer (the combiner's remote
+      // rounds) re-verifies END-TO-END — host CRC against the recorded
+      // whole-block checksum, or the on-device fold — and a mismatch
+      // falls back to the per-block VERIFIED path, which detects rot,
+      // reports it, and triggers recovery. A server-side sidecar verify
+      // here would be a second full CRC pass on the hot sweep path.
       int64_t rc = tpudfs_block_read_verify(
           data_path.c_str(), (data_path + ".meta").c_str(), 0, total,
-          payload.data() + base, 1, chunk_);
+          payload.data() + base, 0, chunk_);
       if (rc < 0 || static_cast<uint64_t>(rc) != total) {
         payload.resize(base);
         sizes.push_back(-1);
@@ -1410,12 +1435,11 @@ class Engine {
         continue;
       }
       sizes.push_back(static_cast<int64_t>(total));
-      struct stat st2;  // skip caching when a publish raced the read
-      if (::stat(data_path.c_str(), &st2) == 0 && same_sig(st, st2))
-        cache_put(block_id,
-                  std::make_shared<std::vector<uint8_t>>(
-                      payload.begin() + base, payload.end()),
-                  gen);
+      // NOT cached: the batch read is unverified (consumers re-verify
+      // end-to-end), and the LRU must only ever hold VERIFIED bytes —
+      // caching here would let a corrupt replica poison later per-block
+      // reads that trust cache hits. (The streaming sweep shouldn't wash
+      // the cache anyway.)
     }
     Writer w;
     w.map_head(3);

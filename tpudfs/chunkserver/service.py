@@ -265,13 +265,15 @@ class ChunkServer:
     async def rpc_read_blocks(self, req: dict) -> dict:
         """Batched full reads for a remote reader's fused round: one
         frame/RPC instead of one per block. Per-slot ``sizes`` (-1 =
-        missing/corrupt/over-budget; caller falls back per block),
-        payload = the successful blocks concatenated in request order.
-        Reads go straight to the verified store path — the streaming
-        fused sweep must not wash the whole LRU block cache (nor copy
-        every block into it), and corruption surfaces as a -1 slot whose
-        per-block fallback triggers the usual recovery. The native
-        engine serves the same method on the blockport."""
+        missing/over-budget; caller falls back per block), payload = the
+        successful blocks concatenated in request order. Reads bypass
+        the LRU block cache (the streaming fused sweep must not wash it)
+        AND skip the sidecar verify: every ReadBlocks consumer — the
+        combiner's remote rounds — re-verifies END-TO-END against the
+        recorded whole-block checksum (host CRC or on-device fold), and
+        a mismatch falls back to the per-block VERIFIED path, which
+        detects the rot, reports it, and triggers recovery. The native
+        engine serves the same method, same contract, on the blockport."""
         sizes: list[int] = []
         chunks: list[bytes] = []
         total = 0
@@ -280,9 +282,7 @@ class ChunkServer:
                 sizes.append(-1)
                 continue
             try:
-                data = await asyncio.to_thread(
-                    self.store.read_verified, block_id
-                )
+                data = await asyncio.to_thread(self.store.read, block_id)
             except (BlockNotFoundError, BlockCorruptionError, OSError):
                 sizes.append(-1)
                 continue

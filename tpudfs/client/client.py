@@ -232,19 +232,24 @@ class Client:
 
     async def _data_call(self, addr: str, method: str, req: dict,
                          timeout: float, *,
-                         allow_blockport: bool = True) -> dict:
+                         allow_blockport: bool = True,
+                         payload_into=None) -> dict:
         """Block-payload RPC to a chunkserver: blockport when the peer
         advertises one, gRPC otherwise. Aliased routes (host_aliases — the
         Docker/FaultProxy indirections) stay on gRPC so an interposer on
         the gRPC address can't be bypassed by the data side channel.
         ``allow_blockport=False`` forces gRPC (chain writers use it when
-        the remaining chain isn't blockport-safe)."""
+        the remaining chain isn't blockport-safe). ``payload_into``:
+        blockport scatter callback for the response payload (blocknet
+        _read_frame); on the gRPC path the payload still arrives as
+        ``resp["data"]`` and the caller copies."""
         dialed = self._dial(addr)
         if dialed != addr or not allow_blockport:
             return await self.rpc.call(dialed, CS, method, req,
                                        timeout=timeout)
         return await self.block_pool.call(self.rpc, addr, CS, method, req,
-                                          timeout=timeout)
+                                          timeout=timeout,
+                                          payload_into=payload_into)
 
     # ----------------------------------------------------------- shard map
 

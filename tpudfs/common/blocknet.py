@@ -106,7 +106,14 @@ def _pack_frame(header: dict, payload) -> list[bytes]:
     return out
 
 
-async def _read_frame(r: asyncio.StreamReader) -> tuple[dict, bytes]:
+async def _read_frame(r: asyncio.StreamReader, into=None
+                      ) -> tuple[dict, bytes | None]:
+    """``into``: optional scatter callback ``(header, plen) -> segments``
+    (writable buffers whose lengths sum to plen) — the payload then
+    streams DIRECTLY into the caller's buffers in bounded chunks instead
+    of materializing one multi-MiB bytes via readexactly (which also
+    forces the caller into slice copies); returns (header, None). A None
+    result from the callback falls back to the bytes path."""
     hlen = _U32.unpack(await r.readexactly(4))[0]
     if hlen > _MAX_HEADER:
         raise ConnectionError(f"blockport header too large: {hlen}")
@@ -115,8 +122,40 @@ async def _read_frame(r: asyncio.StreamReader) -> tuple[dict, bytes]:
     plen = _U64.unpack(await r.readexactly(8))[0]
     if plen > _MAX_PAYLOAD:
         raise ConnectionError(f"blockport payload too large: {plen}")
+    if plen and into is not None:
+        segments = into(header, plen)
+        if segments is not None:
+            await _read_into(r, segments, plen)
+            return header, None
     payload = await r.readexactly(plen) if plen else b""
     return header, payload
+
+
+async def _read_into(r: asyncio.StreamReader, segments, plen: int) -> None:
+    total = 0
+    views = []
+    for seg in segments:
+        v = memoryview(seg).cast("B")
+        views.append(v)
+        total += len(v)
+    if total != plen:
+        # The connection is mid-payload and cannot be resynced.
+        raise ConnectionError(
+            f"scatter segments cover {total} of {plen} payload bytes")
+    for v in views:
+        off = 0
+        n = len(v)
+        while off < n:
+            chunk = await r.read(min(_READ_INTO_CHUNK, n - off))
+            if not chunk:
+                raise asyncio.IncompleteReadError(b"", plen)
+            v[off : off + len(chunk)] = chunk
+            off += len(chunk)
+
+
+#: Scatter-read chunk: big enough to amortize event-loop trips, small
+#: enough to stay within the stream buffer's high-water mark.
+_READ_INTO_CHUNK = 1 << 20
 
 
 class BlockPortServer:
@@ -316,9 +355,14 @@ class BlockConnPool:
         return ports, not self._native.get(addrs[0], False)
 
     async def call(self, rpc: RpcClient, addr: str, service: str,
-                   method: str, req: dict, timeout: float = 30.0) -> dict:
+                   method: str, req: dict, timeout: float = 30.0,
+                   payload_into=None) -> dict:
         """Blockport when advertised, gRPC otherwise. ``req["data"]`` (if
-        any) travels as the raw payload frame."""
+        any) travels as the raw payload frame. ``payload_into``: scatter
+        callback for the RESPONSE payload (see _read_frame) — honored on
+        the blockport transport only; the gRPC path (and a None callback
+        result) returns the payload as ``resp["data"]`` and the caller
+        copies it itself."""
         port = None
         if enabled():
             port = await self._data_port(rpc, addr, service)
@@ -327,7 +371,8 @@ class BlockConnPool:
         host = addr.rsplit(":", 1)[0]
         try:
             return await asyncio.wait_for(
-                self._call_blockport(f"{host}:{port}", method, req),
+                self._call_blockport(f"{host}:{port}", method, req,
+                                     payload_into),
                 timeout=timeout,
             )
         except RpcError:
@@ -349,7 +394,7 @@ class BlockConnPool:
                            f"blockport {host}:{port}: {e!r}") from None
 
     async def _call_blockport(self, hostport: str, method: str,
-                              req: dict) -> dict:
+                              req: dict, payload_into=None) -> dict:
         conn = None
         free = self._free.setdefault(hostport, [])
         while free:
@@ -374,7 +419,7 @@ class BlockConnPool:
             header["m"] = method
             w.writelines(_pack_frame(header, req.get("data")))
             await w.drain()
-            resp, payload = await _read_frame(r)
+            resp, payload = await _read_frame(r, into=payload_into)
         except BaseException:
             w.close()
             raise

@@ -86,12 +86,18 @@ def contrib_table(n: int) -> tuple[np.ndarray, int]:
 
 
 def crc32c(data: bytes | bytearray | memoryview | np.ndarray, crc: int = 0) -> int:
-    """CRC32C of ``data``, optionally continuing from a previous ``crc``."""
-    buf = _as_bytes(data)
+    """CRC32C of ``data``, optionally continuing from a previous ``crc``.
+    C-contiguous uint8 ndarrays pass by POINTER (no tobytes copy — the
+    remote-round verify runs over multi-MiB buffer views)."""
     lib = native.get_lib()
     if lib is not None:
+        if isinstance(data, np.ndarray) and data.dtype == np.uint8 \
+                and data.flags["C_CONTIGUOUS"]:
+            return int(lib.tpudfs_crc32c(crc & 0xFFFFFFFF,
+                                         data.ctypes.data, data.nbytes))
+        buf = _as_bytes(data)
         return int(lib.tpudfs_crc32c(crc & 0xFFFFFFFF, buf, len(buf)))
-    return _crc32c_numpy(buf, crc)
+    return _crc32c_numpy(_as_bytes(data), crc)
 
 
 def _as_bytes(data) -> bytes:
