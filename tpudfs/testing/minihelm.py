@@ -1,0 +1,373 @@
+"""Minimal Helm template renderer for the tpudfs chart.
+
+This image has neither a Docker daemon nor a ``helm`` binary (recorded
+environment constraint — the reference's container tier,
+run_all_tests.sh:53-103, cannot execute here), so the chart's templates
+were previously validated only at reference level (flags exist, values
+resolve). This module renders them for REAL: the Go-template subset the
+chart actually uses — ``.Values``/``.Release`` lookups, ``if``/``else``,
+``range`` (lists and ``until``), ``define``/``include``, variables,
+pipes, and the sprig calls ``toYaml nindent join printf add int until
+list append`` — so tests can parse every produced Kubernetes object and
+assert its golden structure end-to-end.
+
+NOT a general Helm: unsupported constructs raise (loudly — a chart edit
+that outgrows the subset should fail the suite, not silently skip).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import yaml
+
+_ACTION = re.compile(r"\{\{(-?)(.*?)(-?)\}\}", re.S)
+
+
+class TemplateError(Exception):
+    pass
+
+
+# --------------------------------------------------------------- parsing
+
+
+def _lex(src: str) -> list[tuple[str, str]]:
+    """[(kind, payload)]: kind 'text' or 'action' (payload trimmed, with
+    whitespace-trim markers applied to neighboring text — a chunk between
+    a '-}}' and a '{{-' gets BOTH trims, like Go)."""
+    out: list[tuple[str, str]] = []
+    pos = 0
+    pending_lstrip = False
+    for m in _ACTION.finditer(src):
+        text = src[pos : m.start()]
+        if pending_lstrip:
+            text = text.lstrip()
+            pending_lstrip = False
+        if m.group(1) == "-":
+            text = text.rstrip()
+        out.append(("text", text))
+        out.append(("action", m.group(2).strip()))
+        pending_lstrip = m.group(3) == "-"
+        pos = m.end()
+    text = src[pos:]
+    if pending_lstrip:
+        text = text.lstrip()
+    out.append(("text", text))
+    return out
+
+
+def _parse(tokens: list[tuple[str, str]], i: int = 0,
+           until_kw: tuple[str, ...] = ()) -> tuple[list, int, str | None]:
+    """Nested node list; returns (nodes, next_index, closing_keyword)."""
+    nodes: list = []
+    while i < len(tokens):
+        kind, payload = tokens[i]
+        if kind == "text":
+            nodes.append(("text", payload))
+            i += 1
+            continue
+        if payload.startswith("/*"):
+            i += 1
+            continue
+        word = payload.split(None, 1)[0] if payload else ""
+        if word in until_kw:
+            return nodes, i, word
+        if word == "if":
+            body, i, closer = _parse(tokens, i + 1, ("else", "end"))
+            alt: list = []
+            if closer == "else":
+                rest = tokens[i][1][4:].strip()
+                if rest:
+                    # `else if` would silently mis-render; the contract
+                    # is loud failure on anything beyond the subset.
+                    raise TemplateError(
+                        f"unsupported construct: else {rest!r}")
+                alt, i, closer = _parse(tokens, i + 1, ("end",))
+            nodes.append(("if", payload[2:].strip(), body, alt))
+            i += 1
+        elif word == "range":
+            body, i, _ = _parse(tokens, i + 1, ("end",))
+            nodes.append(("range", payload[5:].strip(), body))
+            i += 1
+        elif word == "define":
+            name = payload.split(None, 1)[1].strip().strip('"')
+            body, i, _ = _parse(tokens, i + 1, ("end",))
+            nodes.append(("define", name, body))
+            i += 1
+        else:
+            nodes.append(("expr", payload))
+            i += 1
+    return nodes, i, None
+
+
+# ------------------------------------------------------------ evaluation
+
+
+def _truthy(v) -> bool:
+    return not (v is None or v is False or v == "" or v == 0
+                or (isinstance(v, (list, dict)) and not v))
+
+
+def _split_call(expr: str) -> list[str]:
+    """Split one pipeline stage into argument tokens, respecting quotes
+    and parentheses."""
+    toks: list[str] = []
+    buf = ""
+    depth = 0
+    in_q = False
+    for ch in expr:
+        if in_q:
+            buf += ch
+            if ch == '"':
+                in_q = False
+            continue
+        if ch == '"':
+            in_q = True
+            buf += ch
+        elif ch == "(":
+            depth += 1
+            buf += ch
+        elif ch == ")":
+            depth -= 1
+            buf += ch
+        elif ch.isspace() and depth == 0:
+            if buf:
+                toks.append(buf)
+                buf = ""
+        else:
+            buf += ch
+    if buf:
+        toks.append(buf)
+    return toks
+
+
+class Renderer:
+    def __init__(self, values: dict, release: str = "tpudfs"):
+        self.root = {
+            "Values": values,
+            "Release": {"Name": release, "Namespace": "default",
+                        "Service": "Helm"},
+            "Chart": {"Name": "tpudfs", "Version": "0"},
+        }
+        self.defines: dict[str, list] = {}
+
+    # -- expression atoms ---------------------------------------------
+
+    def _atom(self, tok: str, scope: dict):
+        if tok.startswith("(") and tok.endswith(")"):
+            return self._pipeline(tok[1:-1], scope)
+        if tok.startswith('"') and tok.endswith('"'):
+            return tok[1:-1]
+        if re.fullmatch(r"-?\d+", tok):
+            return int(tok)
+        if tok == "$":
+            return scope["$root_ctx"]
+        if tok.startswith("$."):
+            return self._walk(scope["$root_ctx"], tok[2:])
+        if tok.startswith("$"):
+            name, _, rest = tok[1:].partition(".")
+            if name not in scope:
+                raise TemplateError(f"undefined variable ${name}")
+            val = scope[name]
+            return self._walk(val, rest) if rest else val
+        if tok == ".":
+            return scope["$ctx"]
+        if tok.startswith("."):
+            return self._walk(scope["$ctx"], tok[1:])
+        raise TemplateError(f"unsupported atom: {tok!r}")
+
+    def _walk(self, base, path: str):
+        cur = base
+        for part in filter(None, path.split(".")):
+            if isinstance(cur, dict) and part in cur:
+                cur = cur[part]
+            else:
+                raise TemplateError(
+                    f"missing field .{path} (at {part!r})")
+        return cur
+
+    # -- calls ---------------------------------------------------------
+
+    def _call(self, toks: list[str], scope: dict, piped=_ACTION):
+        args = [self._atom(t, scope) for t in toks[1:]]
+        if piped is not _ACTION:
+            args.append(piped)
+        fn = toks[0]
+        if fn == "include":
+            name, ctx = args[0], args[1]
+            if name not in self.defines:
+                raise TemplateError(f"no define {name!r}")
+            sub = dict(scope)
+            sub["$ctx"] = ctx
+            return self._render_nodes(self.defines[name], sub)
+        if fn == "until":
+            return list(range(int(args[0])))
+        if fn == "int":
+            return int(args[0])
+        if fn == "add":
+            return sum(int(a) for a in args)
+        if fn == "list":
+            return list(args)
+        if fn == "append":
+            return list(args[0]) + [args[1]]
+        if fn == "join":
+            sep, items = args[0], args[1]
+            return sep.join(str(x) for x in items)
+        if fn == "printf":
+            fmt = re.sub(r"%[-+ #0-9.]*[dv]", "%s", args[0])
+            return fmt % tuple(args[1:])
+        if fn == "toYaml":
+            return yaml.safe_dump(args[0], default_flow_style=False
+                                  ).rstrip("\n")
+        if fn == "nindent":
+            n, s = int(args[0]), str(args[1])
+            pad = " " * n
+            return "\n" + "\n".join(pad + line
+                                    for line in s.splitlines())
+        if fn == "indent":
+            n, s = int(args[0]), str(args[1])
+            pad = " " * n
+            return "\n".join(pad + line for line in s.splitlines())
+        if fn == "quote":
+            return f'"{args[0]}"'
+        if fn == "default":
+            return args[1] if _truthy(args[1]) else args[0]
+        if len(toks) == 1 and piped is _ACTION:
+            return self._atom(fn, scope)
+        raise TemplateError(f"unsupported function {fn!r}")
+
+    def _pipeline(self, expr: str, scope: dict):
+        stages: list[str] = []
+        buf = ""
+        depth = 0
+        in_q = False
+        for ch in expr:
+            if in_q:
+                buf += ch
+                if ch == '"':
+                    in_q = False
+            elif ch == '"':
+                in_q = True
+                buf += ch
+            elif ch == "(":
+                depth += 1
+                buf += ch
+            elif ch == ")":
+                depth -= 1
+                buf += ch
+            elif ch == "|" and depth == 0:
+                stages.append(buf.strip())
+                buf = ""
+            else:
+                buf += ch
+        stages.append(buf.strip())
+        val = self._call(_split_call(stages[0]), scope)
+        for stage in stages[1:]:
+            val = self._call(_split_call(stage), scope, piped=val)
+        return val
+
+    # -- rendering -----------------------------------------------------
+
+    def _render_nodes(self, nodes: list, scope: dict) -> str:
+        out: list[str] = []
+        for node in nodes:
+            kind = node[0]
+            if kind == "text":
+                out.append(node[1])
+            elif kind == "define":
+                self.defines[node[1]] = node[2]
+            elif kind == "if":
+                _, cond, body, alt = node
+                branch = body if _truthy(self._pipeline(cond, scope)) \
+                    else alt
+                out.append(self._render_nodes(branch, scope))
+            elif kind == "range":
+                _, header, body = node
+                var = None
+                expr = header
+                m = re.match(r"(\$\w+)\s*:?=\s*(.*)", header)
+                if m:
+                    var, expr = m.group(1)[1:], m.group(2)
+                items = self._pipeline(expr, scope)
+                if items is not None and not isinstance(items, list):
+                    # Go ranges a map's VALUES and never a string's
+                    # characters — both would silently diverge here.
+                    raise TemplateError(
+                        f"range over {type(items).__name__} unsupported "
+                        "(only lists)")
+                # Go templates SHARE scope with the range body: `$x = ...`
+                # inside must mutate the outer $x (the chart's
+                # configEndpoints accumulator depends on it). Only the
+                # dot and the loop variable are restored after.
+                saved_ctx = scope["$ctx"]
+                had_var = var in scope if var else False
+                saved_var = scope.get(var) if var else None
+                for item in items or []:
+                    scope["$ctx"] = item
+                    if var is not None:
+                        scope[var] = item
+                    out.append(self._render_nodes(body, scope))
+                scope["$ctx"] = saved_ctx
+                if var is not None:
+                    if had_var:
+                        scope[var] = saved_var
+                    else:
+                        scope.pop(var, None)
+            elif kind == "expr":
+                # Variable assignment emits nothing — and mutates the
+                # CURRENT scope so later expressions see it.
+                m = re.match(r"(\$\w+)\s*:?=\s*(.*)", node[1], re.S)
+                if m:
+                    scope[m.group(1)[1:]] = self._pipeline(
+                        m.group(2), scope)
+                    continue
+                val = self._pipeline(node[1], scope)
+                if val is None:
+                    out.append("")
+                elif val is True or val is False:
+                    out.append("true" if val else "false")  # Go bools
+                else:
+                    out.append(str(val))
+            else:  # pragma: no cover
+                raise TemplateError(f"bad node {kind}")
+        return "".join(out)
+
+    def render(self, src: str) -> str:
+        nodes, _, _ = _parse(_lex(src))
+        scope = {"$ctx": self.root, "$root_ctx": self.root}
+        return self._render_nodes(nodes, scope)
+
+
+def render_chart(chart_dir: str | Path, release: str = "tpudfs",
+                 values_overrides: dict | None = None) -> dict[str, str]:
+    """Render every template of the chart with its values.yaml (plus
+    overrides); returns {template_filename: rendered_text}. _helpers.tpl
+    is rendered first so its defines are registered."""
+    chart = Path(chart_dir)
+    values = yaml.safe_load((chart / "values.yaml").read_text())
+    if values_overrides:
+        def deep(dst, src):
+            for k, v in src.items():
+                if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    deep(dst[k], v)
+                else:
+                    dst[k] = v
+        deep(values, values_overrides)
+    r = Renderer(values, release=release)
+    tpl_dir = chart / "templates"
+    r.render((tpl_dir / "_helpers.tpl").read_text())
+    out: dict[str, str] = {}
+    for f in sorted(tpl_dir.glob("*.yaml")):
+        out[f.name] = r.render(f.read_text())
+    return out
+
+
+def render_objects(chart_dir: str | Path, **kw) -> dict[str, list[dict]]:
+    """{template_filename: [parsed kubernetes objects]} — every document
+    of every rendered template, yaml-parsed (None docs dropped)."""
+    out: dict[str, list[dict]] = {}
+    for name, text in render_chart(chart_dir, **kw).items():
+        docs = [d for d in yaml.safe_load_all(text) if d is not None]
+        out[name] = docs
+    return out
