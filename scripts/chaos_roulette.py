@@ -6,17 +6,36 @@ workload, then verifies exactly like the fixed-schedule tier
 (scripts/chaos_live.py): WGL-linearizable history, payload md5 intact
 through a fresh client, both shards still writable.
 
+Beyond the kill/partition core, each round randomly composes extra
+AXES (round-5 expansion — the round-4 plans centered on kills):
+
+- ``ec``: an RS(3,2) erasure-coded payload written up front and
+  md5-verified at the end — EC shard fan-out and degraded decode ride
+  the same kills/partitions (2 losses are within RS(3,2) tolerance,
+  matching the cs-kill cap).
+- ``torn``: a large multi-block write CANCELLED mid-faults at a random
+  time, then the same path definitively overwritten post-faults — the
+  readback must be exactly the final payload (write-session fencing: a
+  stray block or late CompleteFile from the dead session must never
+  surface).
+- ``tiering``: the cluster boots with 1-2 s cold/EC thresholds
+  (COLD_THRESHOLD_SECS / EC_THRESHOLD_SECS / EC_SHAPE env), so the
+  tiering scanner converts the replicated payload to RS(3,2) DURING the
+  fault window; the md5 must hold whether or not conversion completed
+  (the conversion state is printed per round).
+
 Safety caps keep every plan survivable by design, so any failure is a
 REAL bug, not an over-killed cluster: at most 2 of the 5 chunkservers
-die (replication 3 leaves >= 1 live replica of everything), at most one
-master per 3-member Raft group dies (quorum holds), partitions always
-heal.
+die (replication 3 leaves >= 1 live replica of everything; RS(3,2)
+loses at most 2 shards), at most one master per 3-member Raft group
+dies (quorum holds), partitions always heal.
 
   python scripts/chaos_roulette.py [rounds] [--tls] [--seed N]
                                    [--topology path.json]
 
 The fixed schedule found two real bugs in round 3 (cross-shard fencing,
-torn write); this roulette explores the interleavings around it.
+torn write) and this roulette caught the stale-dead-leader-hint client
+bug in round 4; the new axes widen the interleavings it explores.
 """
 
 from __future__ import annotations
@@ -78,7 +97,18 @@ def make_plan(rng: random.Random, eps: dict) -> list[tuple]:
     return plan
 
 
-async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
+def make_axes(rng: random.Random) -> dict:
+    """Per-round extra fault axes (decided before boot: tiering needs
+    master env)."""
+    return {
+        "ec": rng.random() < 0.5,
+        "torn": rng.random() < 0.5,
+        "tiering": rng.random() < 0.4,
+    }
+
+
+async def run_round(eps: dict, rng: random.Random, rnd: int,
+                    axes: dict | None = None) -> None:
     from tpudfs.client.checker import check_linearizability
     from tpudfs.client.client import Client
     from tpudfs.client.workload import (
@@ -110,8 +140,17 @@ async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
     await client.create_file("/a/roulette-payload", payload)
     payload_md5 = hashlib.md5(payload).hexdigest()
 
+    axes = axes or {}
+    ec_md5 = None
+    if axes.get("ec"):
+        ec_payload = os.urandom(6 * 256 * 1024)
+        await client.create_file("/a/roulette-ec", ec_payload, ec=(3, 2))
+        ec_md5 = hashlib.md5(ec_payload).hexdigest()
+
     plan = make_plan(rng, eps)
-    print(f"round {rnd}: plan = "
+    print(f"round {rnd}: axes = "
+          + (",".join(k for k, v in sorted(axes.items()) if v) or "none")
+          + "; plan = "
           + "; ".join(f"+{d:.1f}s {k} {p}" for d, k, p in plan))
 
     # Partitions interpose proxies per shard leader via host aliases —
@@ -133,6 +172,16 @@ async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
                          ops_per_client=WORKLOAD_OPS, keys=9,
                          seed=rng.randrange(1 << 30), rename_pod_size=3)
     workload = asyncio.create_task(run_workload(wl_client, cfg))
+
+    torn_task: asyncio.Task | None = None
+    torn_cancel_at = None
+    if axes.get("torn"):
+        big = os.urandom(32 * 256 * 1024)  # 8 MiB multi-block session
+        torn_task = asyncio.create_task(
+            wl_client.create_file("/a/roulette-torn", big, overwrite=True))
+        torn_task.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception())
+        torn_cancel_at = rng.uniform(0.5, 5.0)
 
     async def injector() -> None:
         # Plan offsets are absolute from round start.
@@ -174,7 +223,16 @@ async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
                     proxy.heal()
                     print(f"  +{offset + dur:.1f}s healed {sid}")
 
-    await asyncio.gather(workload, injector())
+    async def torn_killer() -> None:
+        if torn_task is None:
+            return
+        await asyncio.sleep(torn_cancel_at)
+        if not torn_task.done():
+            torn_task.cancel()
+            print(f"  +{torn_cancel_at:.1f}s cancelled torn write "
+                  f"mid-session")
+
+    await asyncio.gather(workload, injector(), torn_killer())
     entries = workload.result()
     ok_ops = sum(1 for e in entries if e.get("return_ts") is not None)
     print(f"  workload: {len(entries)} ops ({ok_ops} returned)")
@@ -214,6 +272,50 @@ async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
             await asyncio.sleep(1.0)
     assert hashlib.md5(back).hexdigest() == payload_md5, \
         f"payload md5 mismatch (round {rnd}); plan: {plan}"
+    if axes.get("tiering"):
+        meta = await v_client.get_file_info("/a/roulette-payload")
+        converted = all(b.get("ec_data_shards") for b in meta["blocks"])
+        print(f"  tiering axis: payload md5 held; EC conversion "
+              f"{'completed' if converted else 'still replicated'} "
+              f"under faults")
+    if ec_md5 is not None:
+        deadline = time.time() + 45
+        while True:
+            try:
+                ec_back = await v_client.get_file("/a/roulette-ec")
+                break
+            except IndeterminateError as e:
+                if time.time() > deadline:
+                    raise SystemExit(
+                        f"EC payload unreadable 45s after faults "
+                        f"(round {rnd}): {e}; plan: {plan}")
+                await asyncio.sleep(1.0)
+        assert hashlib.md5(ec_back).hexdigest() == ec_md5, \
+            f"EC payload md5 mismatch (round {rnd}); plan: {plan}"
+        print("  ec axis: RS(3,2) payload md5 held (degraded decode "
+              "within the kill cap)")
+    if axes.get("torn"):
+        # The dead session must never surface: the definitive overwrite
+        # wins, byte-exactly.
+        final = os.urandom(3 * 256 * 1024)
+        deadline = time.time() + 45
+        while True:
+            try:
+                await v_client.create_file("/a/roulette-torn", final,
+                                           overwrite=True)
+                torn_back = await v_client.get_file("/a/roulette-torn")
+                break
+            except IndeterminateError as e:
+                if time.time() > deadline:
+                    raise SystemExit(
+                        f"torn-path overwrite failed 45s after faults "
+                        f"(round {rnd}): {e}; plan: {plan}")
+                await asyncio.sleep(1.0)
+        assert torn_back == final, \
+            (f"torn axis: final overwrite did not win byte-exactly "
+             f"(round {rnd}); plan: {plan}")
+        print("  torn axis: cancelled session never surfaced; final "
+              "overwrite read back byte-exact")
     for prefix in ("/a/", "/z/"):
         deadline = time.time() + 45
         while True:
@@ -237,11 +339,24 @@ async def run_round(eps: dict, rng: random.Random, rnd: int) -> None:
 
 
 def one_cluster_round(rnd: int, rng: random.Random, use_tls: bool,
-                      topology: str) -> None:
+                      topology: str, axes: dict) -> None:
     from tpudfs.testing.livecluster import boot_cluster
 
-    with boot_cluster(topology, tls=use_tls) as eps:
-        asyncio.run(run_round(eps, rng, rnd))
+    env_saved = {}
+    tier_env = {"COLD_THRESHOLD_SECS": "1", "EC_THRESHOLD_SECS": "2",
+                "EC_SHAPE": "3,2"} if axes.get("tiering") else {}
+    for k, v in tier_env.items():
+        env_saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        with boot_cluster(topology, tls=use_tls) as eps:
+            asyncio.run(run_round(eps, rng, rnd, axes))
+    finally:
+        for k, old in env_saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
 
 
 def main() -> None:
@@ -258,8 +373,9 @@ def main() -> None:
     args = ap.parse_args()
     rng = random.Random(args.seed)
     for rnd in range(1, args.rounds + 1):
+        axes = make_axes(rng)
         retry_start(lambda: one_cluster_round(rnd, rng, args.tls,
-                                              args.topology))
+                                              args.topology, axes))
     print(f"CHAOS ROULETTE PASSED ({args.rounds} rounds, seed {args.seed}, "
           f"tls={args.tls})")
 
