@@ -74,3 +74,50 @@ async def test_torch_dataloader_trains_from_dfs(tmp_path):
         assert np.linalg.norm(w - w_true) < 0.5 * np.linalg.norm(w_true)
     finally:
         await c.stop()
+
+
+async def test_torch_multiworker_dataloader_from_dfs(tmp_path):
+    """num_workers=2 with spawn: each worker process re-creates its own
+    DFS client lazily from the pickled dataset (the real-world DataLoader
+    deployment shape; fork is avoided — JAX threads make forked children
+    deadlock-prone)."""
+    from tpudfs.tpu.torch_data import DfsTorchDataset
+
+    w_true = np.random.default_rng(6).normal(size=FEATURES).astype(
+        np.float32)
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3)
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=1024)
+        paths = []
+        for i in range(2):
+            p = f"/torchw/shard-{i}.f32"
+            await client.create_file(p, _shard(20 + i, w_true, n=64))
+            paths.append(p)
+
+        def load_all():
+            ds = DfsTorchDataset(list(c.masters), paths, RECORD_BYTES,
+                                 dtype="float32")
+            try:
+                loader = torch.utils.data.DataLoader(
+                    ds, batch_size=16, num_workers=2,
+                    multiprocessing_context="spawn")
+                rows = [b for batch in loader for b in batch]
+                return torch.stack(rows).numpy()
+            finally:
+                ds.close()
+
+        got = await asyncio.to_thread(load_all)
+        assert got.shape == (2 * 64, RECORD_FLOATS)
+        # Bit-exact against the source shards, order-preserving
+        # (DataLoader default sampler is sequential).
+        want = np.concatenate([
+            np.frombuffer(_shard(20 + i, w_true, n=64),
+                          dtype=np.float32).reshape(-1, RECORD_FLOATS)
+            for i in range(2)])
+        np.testing.assert_array_equal(got, want)
+    finally:
+        await c.stop()

@@ -63,11 +63,16 @@ async def find_leader_async(addrs: list[str],
 
 
 @contextlib.contextmanager
-def boot_cluster(topology: str, *, tls: bool = False, s3_port: str = "0"):
+def boot_cluster(topology: str, *, tls: bool = False, s3_port: str = "0",
+                 extra_env: dict | None = None):
     """Start a cluster via scripts/start_cluster.py, yield the endpoint
     map, tear down on exit. Raises SystemExit("...failed to start...")
-    on boot failure — pair with retry_start() for the TOCTOU retry."""
-    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
+    on boot failure — pair with retry_start() for the TOCTOU retry.
+    ``extra_env`` reaches every cluster binary (e.g. the tiering
+    thresholds COLD_THRESHOLD_SECS/EC_THRESHOLD_SECS/EC_SHAPE) without
+    mutating the caller's process environment."""
+    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu",
+           **(extra_env or {})}
     with tempfile.TemporaryDirectory(prefix="tpudfs-live-") as tmp:
         ready = pathlib.Path(tmp) / "endpoints.json"
         launcher = subprocess.Popen(

@@ -593,9 +593,13 @@ class HbmReader:
             outstanding: list = [None] * nrounds  # round words awaiting H2D
             try:
                 for r in range(nrounds):
-                    if not cpu_copies and r >= ring:
-                        # Recycled buffer: its device copy must complete
-                        # before the producer may refill it.
+                    if r >= ring:
+                        # Recycled buffer: its device copy must COMPLETE
+                        # before the producer may refill it — on EVERY
+                        # backend. The CPU client copies by completion,
+                        # not at dispatch (measured: mutating the source
+                        # right after device_put corrupts ~15% of 4 MiB
+                        # transfers without this wait).
                         prev = outstanding[r - ring]
                         if prev is not None:
                             await asyncio.to_thread(
@@ -611,10 +615,7 @@ class HbmReader:
                         & (crcs[lo:hi] == exp_crcs[lo:hi])
                     words = jax.device_put(
                         buf_words[r % ring][: nblk * spb], device)
-                    if cpu_copies:
-                        lib.tpudfs_sweep_release(handle, r)
-                    else:
-                        outstanding[r] = words
+                    outstanding[r] = words
                     batch = DeviceBatch(words=words, crcs=None,
                                         cpb=spb, nblocks=nblk)
                     for j in range(nblk):
@@ -633,12 +634,11 @@ class HbmReader:
                             batch_pending=False)
                         self.sweep_blocks += 1
             finally:
-                # Completion before stop: the producer may still point at
-                # a buffer a dispatched transfer is reading on non-CPU.
-                if not cpu_copies:
-                    pend = [w for w in outstanding if w is not None]
-                    if pend:
-                        await asyncio.to_thread(jax.block_until_ready, pend)
+                # Completion before stop: a dispatched transfer may still
+                # be reading a ring buffer (any backend).
+                pend = [w for w in outstanding if w is not None]
+                if pend:
+                    await asyncio.to_thread(jax.block_until_ready, pend)
                 lib.tpudfs_sweep_stop(handle)
 
         if fallback_idx:
@@ -651,9 +651,10 @@ class HbmReader:
         return results
 
     def _cpu_copies(self, device) -> bool:
-        """Whether device_put copies our (misaligned) host buffers
-        synchronously on this CPU backend — cached probe, shared with the
-        combiner's pool logic."""
+        """Whether device_put COPIES (vs zero-copy-aliases) our misaligned
+        host buffers on this CPU backend — cached probe, shared with the
+        combiner's pool logic. Copy semantics hold by COMPLETION, not at
+        dispatch: recycling still requires block_until_ready first."""
         cached = getattr(self, "_cpu_copies_probe", None)
         if cached is None:
             from tpudfs.tpu.read_combiner import ReadCombiner

@@ -554,13 +554,17 @@ class ReadCombiner:
     async def _upload_stage(self, queue: asyncio.Queue) -> None:
         from tpudfs.tpu.hbm_reader import DeviceBlock
 
-        is_cpu = (getattr(self.device, "platform", "cpu") == "cpu"
-                  and self._cpu_copies)
+        # No skip-wait fast path on ANY backend: the CPU client copies by
+        # COMPLETION, not at dispatch (measured: mutating the source right
+        # after device_put corrupts ~15% of 4 MiB transfers), so a pooled
+        # buffer may only return once its transfers are block_until_ready.
+        # (_cpu_copies still gates POOLING itself — an ALIASING backend is
+        # unsafe no matter how long we wait.)
         #: words of sub-rounds sharing the current (unreleased) buffer —
         #: the buffer may only return to the pool once every transfer out
-        #: of it completed (device_put COPIES immediately on CPU; on
-        #: accelerators it may still be reading the host buffer until the
-        #: device array is ready).
+        #: of it COMPLETED (every backend may still be reading the host
+        #: buffer until the device array is ready — the CPU client copies
+        #: by completion, not at dispatch).
         since_release: list = []
         skip_next_release = False  # a sub-round of this buffer failed
         while True:
@@ -574,15 +578,14 @@ class ReadCombiner:
                 )
                 crcs = None if host_verified else \
                     batch_block_crc_device(words, len(reqs))
-                if release is not None and not skip_next_release \
-                        and not is_cpu:
+                if release is not None and not skip_next_release:
                     # The pooled buffer may only be reused once every
-                    # transfer out of it completed (device_put copies
-                    # immediately on CPU; accelerators may still be
-                    # reading the host buffer). Completion wait only —
-                    # no readback. Inside the try: a device error here
-                    # must take the same fall-back path as a failed
-                    # device_put, not kill the consumer task.
+                    # transfer out of it COMPLETED — on every backend
+                    # (see the completion-not-dispatch note above).
+                    # Completion wait only — no readback. Inside the
+                    # try: a device error here must take the same
+                    # fall-back path as a failed device_put, not kill
+                    # the consumer task.
                     await asyncio.to_thread(
                         jax.block_until_ready, since_release + [words]
                     )
