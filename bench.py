@@ -758,6 +758,12 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
             cache_hits / max(1, cache_hits + cache_misses), 3
         ),
         "etag_mode": client.etag_mode,
+        # The pump verifies END-TO-END against the CompleteFile-recorded
+        # whole-block checksums INSIDE the native producer (3-lane
+        # hardware CRC32C fused into the pread) — host-side, overlapping
+        # the device copies; the per-block/combiner paths still carry the
+        # on-device fold where the platform wants it.
+        "verify_mode": "host-crc32c(sweep-pump)",
         "platform": jax.devices()[0].platform,
         **({"debug_samples": {
             "raw": [round(x, 3) for x in raw_samples],
@@ -1024,6 +1030,7 @@ async def _sprint_against(maddr: str, cs_addrs: list[str],
         "confirm_s": round(confirm_s, 3),
         "files": FILES,
         "sprint_standby": standby,
+        "verify_mode": "host-crc32c(sweep-pump)",
         "platform": jax.devices()[0].platform,
     }
 
