@@ -176,12 +176,15 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
     torn_task: asyncio.Task | None = None
     torn_cancel_at = None
     if axes.get("torn"):
-        big = os.urandom(32 * 256 * 1024)  # 8 MiB multi-block session
+        # 16 MiB / 64 blocks and an early cancel point: an 8 MiB session
+        # often FINISHED before a 0.5-5 s cancel (seeds 5002/5100 logged
+        # DEGENERATE), so the axis rarely exercised mid-session death.
+        big = os.urandom(64 * 256 * 1024)
         torn_task = asyncio.create_task(
             wl_client.create_file("/a/roulette-torn", big, overwrite=True))
         torn_task.add_done_callback(
             lambda t: None if t.cancelled() else t.exception())
-        torn_cancel_at = rng.uniform(0.5, 5.0)
+        torn_cancel_at = rng.uniform(0.15, 2.0)
 
     async def injector() -> None:
         # Plan offsets are absolute from round start.
