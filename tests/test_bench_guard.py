@@ -83,3 +83,34 @@ def test_decide_device_no_probe_when_cpu_run(monkeypatch):
     device = bench._decide_device()
     assert device.platform == "cpu"
     assert not calls and bench._fell_back_midrun is False
+
+
+def test_merge_sprint_attaches_real_tpu_capture(tmp_path, monkeypatch):
+    """A CPU-fallback round-end bench carries the latest REAL-TPU sprint
+    capture as tpu_sprint (and ignores a CPU-platform sprint file)."""
+    import bench
+
+    monkeypatch.setattr(bench, "_repo_path",
+                        lambda name: str(tmp_path / name))
+    result = {"platform": "cpu-fallback(tpu unreachable)"}
+    bench._merge_sprint(result)
+    assert "tpu_sprint" not in result  # no capture file at all
+
+    sprint = {"value": 1.9, "value_win": [1.7, 2.1],
+              "warm_infeed_read_GBps": 2.2, "raw_infeed_GBps": 2.4,
+              "vs_baseline": 0.88, "windows": 3,
+              "captured_at": "2026-07-31T12:00:00Z", "platform": "tpu",
+              "sprint_standby": True, "ici_write_GBps": 150.0}
+    (tmp_path / "BENCH_SPRINT.json").write_text(json.dumps(sprint))
+    bench._merge_sprint(result)
+    assert result["tpu_sprint"]["value"] == 1.9
+    assert result["tpu_sprint"]["platform"] == "tpu"
+    assert result["tpu_sprint"]["captured_at"] == "2026-07-31T12:00:00Z"
+
+    # A sprint that itself fell back to CPU must NOT masquerade as a
+    # device capture.
+    sprint["platform"] = "cpu"
+    (tmp_path / "BENCH_SPRINT.json").write_text(json.dumps(sprint))
+    result2 = {"platform": "cpu-fallback(tpu unreachable)"}
+    bench._merge_sprint(result2)
+    assert "tpu_sprint" not in result2
