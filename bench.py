@@ -940,8 +940,13 @@ async def _sprint_against(maddr: str, cs_addrs: list[str],
     _tick("sprint-raw0")
 
     reader = HbmReader(client, [device], batch_reads=BATCH_READS)
-    reader.warm_batches(data_len // 512)  # XLA compiles (disk-cached)
-    _tick("warm-batches")
+    # NO warm_batches here: the sweep pump verifies HOST-side (fused
+    # hardware CRC in the native producer) and never dispatches the
+    # batched on-device CRC buckets — on a real TPU those five compiles
+    # cost ~100-200 s, which is the whole window (the per-block fallback
+    # path can hit one uncompiled shape on a corrupt/missing block; the
+    # persistent XLA cache amortizes that across windows).
+    _tick("sprint-reader")
     keep_blocks: list = []
 
     def retain(blocks: list) -> None:
@@ -1013,8 +1018,8 @@ async def _sprint_against(maddr: str, cs_addrs: list[str],
     return {
         "metric": (
             "SPRINT: 1MiB-chunk read GB/s/host into TPU HBM "
-            "(3x-replicated DFS, on-device CRC32C verify), device windows "
-            "only (see bench.py window-sprint protocol)"
+            "(3x-replicated DFS, end-to-end CRC32C verify), device "
+            "windows only (see bench.py window-sprint protocol)"
         ),
         "value": round(achieved, 3),
         "unit": "GB/s",
