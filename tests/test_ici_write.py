@@ -285,3 +285,33 @@ async def test_mixed_geometry_blocks_are_not_starved(tmp_path):
         assert group.stats.round_failures == 0
     finally:
         await _stop_all(c, group)
+
+
+async def test_s3_put_rides_collective_rounds(tmp_path):
+    """The API surface composes with the collective write path: an S3
+    PUT through the gateway (in-process, auth off) lands as ppermute
+    rounds on the ICI cluster, and GET returns the object byte-exact."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpudfs.s3.server import Gateway
+
+    c, group, client = await _ici_cluster(tmp_path)
+    try:
+        gw = Gateway(client, auth_enabled=False)
+        tc = TestClient(TestServer(gw.build_app()))
+        await tc.start_server()
+        try:
+            assert (await tc.put("/icibkt")).status in (200, 409)
+            body = _rand(3 * 64 * 1024, seed=90)
+            rounds_before = group.stats.rounds
+            r = await tc.put("/icibkt/obj", data=body)
+            assert r.status == 200, await r.text()
+            assert group.stats.rounds > rounds_before, \
+                "S3 PUT did not ride collective rounds"
+            g = await tc.get("/icibkt/obj")
+            assert g.status == 200
+            assert await g.read() == body
+        finally:
+            await tc.close()
+    finally:
+        await _stop_all(c, group)
