@@ -165,8 +165,11 @@ def _bench_raw_infeed(device, nbytes_each: int, reps: int) -> float:
     the denominator is strictly favorable: (a) one dispatcher issuing all
     device_puts back-to-back with a single final sync (pipelined), and
     (b) READ_CONCURRENCY persistent threads each pipelining its share (what
-    the measured path's 8-way fan-out gets to use). Distinct buffers per
-    transfer — no residency reuse."""
+    the measured path's 8-way fan-out gets to use). Distinct FRESH buffers
+    per transfer — no residency reuse. (Round 5 tried reusing host buffers
+    across interleaved windows to cut allocator churn: the raw number
+    DROPPED 40% and inflated vs_baseline without the measured path
+    changing — reverted; the denominator must stay its fastest self.)"""
     import concurrent.futures
 
     import jax
