@@ -73,6 +73,9 @@ WEDGE_TIMEOUT_S = 600.0
 WEDGE_POLL_S = 15.0
 _progress = {"t": None, "stage": "start"}  # t None = watchdog disarmed
 _partial: dict = {}
+#: Set by main_sprint(): the watchdog persists PARTIAL captures to
+#: BENCH_SPRINT.json so a mid-run wedge can't lose a window's data.
+_sprint_mode = False
 #: One-JSON-line contract: the watchdog and the normal completion path
 #: race when the run finishes just as the timeout elapses — whichever
 #: claims this flag first (under the lock) prints; the other stays silent.
@@ -118,6 +121,21 @@ def _start_watchdog() -> None:
                     "platform": f"tpu-wedged-midrun({_progress['stage']})",
                 }
                 if _emit_once(out):
+                    if _sprint_mode:
+                        # A PARTIAL real-TPU sprint capture (e.g. the raw
+                        # window landed before the wedge) must still
+                        # persist for the round-end merge — a lost window
+                        # is exactly what the sprint exists to prevent.
+                        try:
+                            out["captured_at"] = time.strftime(
+                                "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                            with open(_repo_path("BENCH_SPRINT.json.tmp"),
+                                      "w") as f:
+                                json.dump(out, f, indent=1)
+                            os.replace(_repo_path("BENCH_SPRINT.json.tmp"),
+                                       _repo_path("BENCH_SPRINT.json"))
+                        except OSError:
+                            pass
                     os._exit(3)
                 return  # normal path won the race; let it finish
 
@@ -1173,8 +1191,9 @@ def main_sprint() -> None:
                           os.path.join(SPRINT_DIR, "xla-cache"))
     except Exception:
         pass
-    global WEDGE_TIMEOUT_S
+    global WEDGE_TIMEOUT_S, _sprint_mode
     WEDGE_TIMEOUT_S = 300.0  # sprint: concede faster, partials are out
+    _sprint_mode = True
     _tick("sprint-start")
     _start_watchdog()
     result = asyncio.run(_run_sprint())
