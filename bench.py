@@ -572,6 +572,23 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
 
     retain(warm)
 
+    # One UNTIMED full-size REMOTE sweep: debug_samples show the gRPC
+    # windows RAMPING across reps (0.32 -> 0.45) — connection pools,
+    # per-peer frames, and the serving engines otherwise reach steady
+    # state inside the timed windows (the single-file warm above only
+    # compiles shapes and dials one peer). Same harness as the timed
+    # window it pre-warms; the throughput is discarded.
+    client.local_reads = False
+    warm_remote_blocks, _ = await timed_sweep(
+        range(grpc_files),
+        lambda i: reader.read_file_to_device_blocks(
+            f"/bench/r0/f{i:04d}", verify="lazy"),
+        concurrency=REMOTE_SWEEP_CONCURRENCY,
+    )
+    retain(warm_remote_blocks)
+    client.local_reads = True
+    _tick("warm-remote-sweep")
+
     # Full-size UNTIMED warm-up sweeps (scripts/sweep_lab.py measurement,
     # idle host: the first fused sweep of a process runs ~3x below steady
     # state — from one-time host costs: allocator arenas growing to round
