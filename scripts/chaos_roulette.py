@@ -99,11 +99,14 @@ def make_plan(rng: random.Random, eps: dict) -> list[tuple]:
 
 def make_axes(rng: random.Random) -> dict:
     """Per-round extra fault axes (decided before boot: tiering needs
-    master env)."""
+    master env). CHAOS_FORCE_AXES=a,b pins axes on for targeted hunts
+    (e.g. the seed-7803 tiering-window chase)."""
+    forced = set(filter(None, os.environ.get(
+        "CHAOS_FORCE_AXES", "").split(",")))
     return {
-        "ec": rng.random() < 0.5,
-        "torn": rng.random() < 0.5,
-        "tiering": rng.random() < 0.4,
+        "ec": "ec" in forced or rng.random() < 0.5,
+        "torn": "torn" in forced or rng.random() < 0.5,
+        "tiering": "tiering" in forced or rng.random() < 0.4,
     }
 
 
@@ -265,6 +268,8 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
     # byte-identical.
     from tpudfs.client.client import IndeterminateError
 
+    from tpudfs.client.client import DfsError
+
     async def settle(what: str, op):
         deadline = time.time() + 45
         while True:
@@ -276,6 +281,32 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
                         f"{what} failed 45s after faults (round {rnd}): "
                         f"{e}; plan: {plan}")
                 await asyncio.sleep(1.0)
+            except DfsError as e:
+                # A DETERMINATE failure is a consistency-bug candidate —
+                # but classify it first: retry ONCE after a pause and
+                # dump the metadata, so a recurrence (seed 7803's
+                # tiering-window EC decode failure) tells us whether the
+                # state was transient or persistent before failing.
+                meta = None
+                try:
+                    meta = await v_client.get_file_info(
+                        "/a/roulette-payload")
+                except Exception:
+                    pass
+                print(f"  {what}: DETERMINATE failure: {e}\n"
+                      f"  meta at failure: {meta}")
+                await asyncio.sleep(2.0)
+                try:
+                    out = await op()
+                    print(f"  {what}: SUCCEEDED on the post-failure "
+                          f"retry — transient window, still a bug")
+                    raise SystemExit(
+                        f"{what} transiently failed then healed "
+                        f"(round {rnd}): {e}; plan: {plan}")
+                except DfsError as e2:
+                    raise SystemExit(
+                        f"{what} PERSISTENTLY failed (round {rnd}): "
+                        f"first {e}; retry {e2}; plan: {plan}")
 
     back = await settle("payload read",
                         lambda: v_client.get_file("/a/roulette-payload"))

@@ -933,9 +933,14 @@ class Client:
         )
 
     async def _fetch_ec_shards(self, block: dict, *,
-                               local_verify: bool = True) -> list[bytes | None]:
+                               local_verify: bool = True,
+                               reasons: list | None = None,
+                               ) -> list[bytes | None]:
         """Concurrent fetch of all k+m shard slots; None per missing shard
-        (reference read_ec_block's fan-out, mod.rs:1110-1150)."""
+        (reference read_ec_block's fan-out, mod.rs:1110-1150). ``reasons``
+        (if given) collects one per-slot failure description — decode
+        failures are rare enough that the error must carry WHY each slot
+        was missing."""
         k = int(block["ec_data_shards"])
         m = int(block["ec_parity_shards"])
         locations = block["locations"]
@@ -943,6 +948,8 @@ class Client:
         async def fetch(i: int) -> bytes | None:
             addr = locations[i] if i < len(locations) else ""
             if not addr:
+                if reasons is not None:
+                    reasons.append(f"shard {i}: empty location")
                 return None
             local = await self._read_local(addr, block["block_id"], 0, 0,
                                            verify=local_verify)
@@ -957,6 +964,8 @@ class Client:
                 return resp["data"]
             except RpcError as e:
                 logger.warning("EC shard %d fetch failed: %s", i, e.message)
+                if reasons is not None:
+                    reasons.append(f"shard {i}@{addr}: {e.message}")
                 return None
 
         return list(await asyncio.gather(*(fetch(i) for i in range(k + m))))
@@ -967,14 +976,17 @@ class Client:
         k = int(block["ec_data_shards"])
         m = int(block["ec_parity_shards"])
         original = int(block.get("original_size") or block.get("size") or 0)
-        shards = await self._fetch_ec_shards(block)
+        reasons: list = []
+        shards = await self._fetch_ec_shards(block, reasons=reasons)
         if all(s is not None for s in shards[:k]):
             return b"".join(shards[:k])[:original]  # type: ignore[arg-type]
         try:
             return ec_decode(shards, k, m, original)
         except Exception as e:
             raise DfsError(
-                f"EC decode failed for block {block['block_id']}: {e}"
+                f"EC decode failed for block {block['block_id']}: {e}; "
+                f"locations={block.get('locations')}; "
+                f"slot failures: {reasons or 'none recorded'}"
             ) from None
 
     # -------------------------------------------------------- namespace ops
