@@ -70,10 +70,14 @@ def make_plan(rng: random.Random, eps: dict) -> list[tuple]:
     plan: list[tuple] = []
     cs_kills = 0
     killed_master_shards: set[str] = set()
+    # CHAOS_PLAN=masters: control-plane-only faults (no CS kills) — the
+    # tiering/EC-conversion window needs all k+m chunkservers live, so a
+    # targeted hunt must not starve it (seed-7803 chase).
+    masters_only = os.environ.get("CHAOS_PLAN") == "masters"
     t = rng.uniform(1.0, 3.0)
     for _ in range(rng.randint(2, 4)):
         choices = ["partition"]
-        if cs_kills < 2:
+        if cs_kills < 2 and not masters_only:
             choices.append("kill_cs")
         if len(killed_master_shards) < len(shards):
             choices.append("kill_master")
