@@ -380,7 +380,12 @@ def one_cluster_round(rnd: int, rng: random.Random, use_tls: bool,
     from tpudfs.testing.livecluster import boot_cluster
 
     tier_env = {"COLD_THRESHOLD_SECS": "1", "EC_THRESHOLD_SECS": "2",
-                "EC_SHAPE": "3,2"} if axes.get("tiering") else None
+                "EC_SHAPE": "3,2",
+                # Scans every 3 s: the default 60 s scan fired at most
+                # once per round, at the edge — conversions must land
+                # INSIDE the fault window for the axis to mean anything.
+                "TIERING_INTERVAL_SECS": "3"} \
+        if axes.get("tiering") else None
     with boot_cluster(topology, tls=use_tls, extra_env=tier_env) as eps:
         asyncio.run(run_round(eps, rng, rnd, axes))
 

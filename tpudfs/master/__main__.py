@@ -71,11 +71,21 @@ def make_backup(args):
 
 
 async def amain(args) -> None:
+    import os as _os
+
     address = args.advertise or f"{args.host}:{args.port}"
     peers = [x for x in args.peers.split(",") if x]
     configs = [x for x in args.config_servers.split(",") if x]
     stls, ctls = tls_from_args(args)
     from tpudfs.common.rpc import RpcClient
+    # TIERING_INTERVAL_SECS env: how often the tiering scanner runs
+    # (default 60 s). Ops/test knob — the chaos hunts need conversions to
+    # land INSIDE fault windows, and a fixed 60 s scan fired at most once
+    # per round, always at the edge.
+    intervals = None
+    tiering_iv = _os.environ.get("TIERING_INTERVAL_SECS")
+    if tiering_iv:
+        intervals = {"tiering": float(tiering_iv)}
     master = Master(address, peers, args.data_dir, shard_id=args.shard_id,
                     config_servers=configs,
                     cold_threshold_secs=args.cold_threshold_secs,
@@ -85,6 +95,7 @@ async def amain(args) -> None:
                     merge_threshold_rps=args.merge_threshold_rps,
                     split_cooldown_secs=args.split_cooldown_secs,
                     snapshot_backup=make_backup(args),
+                    intervals=intervals,
                     rpc_client=RpcClient(tls=ctls) if ctls else None)
     server = RpcServer(args.host, args.port, tls=stls)
     master.attach(server)
