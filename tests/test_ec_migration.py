@@ -241,6 +241,9 @@ async def test_delete_mid_migration_gcs_orphan_shards(tmp_path):
                 "new_block_id": attempt["new_id"],
                 "ec_data_shards": 2, "ec_parity_shards": 1,
                 "targets": attempt["targets"],
+                # Real reports are shard-scoped (seed-8100 fix): only a
+                # same-shard not-found may GC.
+                "shard_id": leader.state.shard_id,
             })
         assert bid not in leader._ec_migrations
         deletes = [
@@ -351,5 +354,157 @@ async def test_sweep_never_gcs_committed_swap(tmp_path):
             for cmd in leader.state.pending_commands.get(addr, []):
                 assert not (cmd.get("type") == "DELETE" and
                             cmd.get("block_id") == attempt["new_id"]), cmd
+    finally:
+        await c.stop()
+
+
+async def test_late_dead_attempt_completion_never_gcs_committed_shards(
+        tmp_path):
+    """Round-5 roulette catch (seed 8100): attempt C's swap APPLIES while
+    its handler still awaits the propose; a LATE completion for a dead
+    leader's attempt A then hits the not-found branch, pops C from the
+    soft state, and — without the winner guard — queues DELETE for C's
+    freshly committed shards on every target (all k+m copies of live
+    data gone: 'EC decode failed: need 3 shards, have 0').
+
+    Reconstructs the interleaving deterministically: commit C's swap,
+    re-insert C's tracking entry (as the in-flight handler would still
+    have it), deliver A's late completion, and assert no DELETE was
+    queued for C's id — then that the block still reads back."""
+    data = _rand(120_000, seed=9)
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=3,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(2, 1),
+        intervals={"tiering": 0.3},
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/race/a.bin", data)
+        before = await client.get_file_info("/race/a.bin")
+        old_id = before["blocks"][0]["block_id"]
+        meta = await _converted(client, "/race/a.bin")
+        new_id = meta["blocks"][0]["block_id"]  # committed winner (C)
+        targets = list(meta["blocks"][0]["locations"])
+
+        # The handler's pop hasn't run yet in the poison interleaving:
+        # re-insert C's tracking entry to reconstruct that state.
+        leader._ec_migrations[old_id] = {
+            "ts": 0.0, "new_id": new_id, "targets": targets, "stale": [],
+        }
+        # Late completion for dead-leader attempt A (unique id, same old
+        # block) — must be rejected WITHOUT collateral damage.
+        from tpudfs.common.rpc import RpcError
+        try:
+            await leader.rpc_complete_ec_conversion({
+                "block_id": old_id,
+                "new_block_id": f"{old_id}.ec-deadbeef",
+                "ec_data_shards": 2,
+                "ec_parity_shards": 1,
+                "targets": targets,
+            })
+            raise AssertionError("late dead completion was accepted")
+        except RpcError:
+            pass
+        # No DELETE for the committed id may be queued anywhere.
+        for addr in targets:
+            for cmd in leader.state.pending_commands.get(addr, []):
+                assert not (cmd.get("type") == "DELETE"
+                            and cmd.get("block_id") == new_id), \
+                    f"winner shards scheduled for deletion on {addr}"
+        # The sweep must also leave the winner alone.
+        leader._ec_migrations[old_id] = {
+            "ts": 0.0, "new_id": new_id, "targets": targets, "stale": [],
+        }
+        leader._sweep_dead_ec_migrations()
+        for addr in targets:
+            for cmd in leader.state.pending_commands.get(addr, []):
+                assert not (cmd.get("type") == "DELETE"
+                            and cmd.get("block_id") == new_id)
+        # And the data still reads back through a fresh client.
+        fresh = Client(list(c.masters), rpc_client=c.client,
+                       block_size=64 * 1024)
+        assert await fresh.get_file("/race/a.bin") == data
+    finally:
+        await c.stop()
+
+
+async def test_wrong_shard_completion_report_never_gcs_shards(tmp_path):
+    """Round-5 roulette catch (seed 8100, the REAL chain): when the
+    issuing leader dies, the converting chunkserver retries its
+    CompleteEcConversion across EVERY known master — including the OTHER
+    shard group's. A wrong-shard master used to read 'block not in my
+    namespace' as 'file deleted mid-migration' and queue DELETE for all
+    k+m freshly committed shards of live data. It must refuse the report
+    with no side effects; only a same-shard not-found may GC."""
+    data = _rand(100_000, seed=11)
+    c = MiniCluster(
+        tmp_path, n_masters=1, n_cs=3,
+        cold_threshold_secs=0, ec_threshold_secs=0, ec_shape=(2, 1),
+        intervals={"tiering": 0.3},
+    )
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024)
+        await client.create_file("/ws/a.bin", data)
+        meta = await _converted(client, "/ws/a.bin")
+        new_id = meta["blocks"][0]["block_id"]
+        old_id = new_id.split(".ec-")[0]
+        targets = list(meta["blocks"][0]["locations"])
+        from tpudfs.common.rpc import RpcError
+
+        def deletes_for(bid):
+            return [
+                (a, cmd) for a, cmds in
+                leader.state.pending_commands.items() for cmd in cmds
+                if cmd.get("type") == "DELETE"
+                and cmd.get("block_id") == bid
+            ]
+
+        # Wrong-shard report (this master is shard-0): refused, no GC.
+        try:
+            await leader.rpc_complete_ec_conversion({
+                "block_id": old_id, "new_block_id": f"{old_id}.ec-aaaa0000",
+                "ec_data_shards": 2, "ec_parity_shards": 1,
+                "targets": targets, "shard_id": "shard-z",
+            })
+            raise AssertionError("wrong-shard report accepted")
+        except RpcError as e:
+            assert "shard" in e.message
+        assert not deletes_for(f"{old_id}.ec-aaaa0000")
+        assert not deletes_for(new_id)
+
+        # Unscoped (legacy) not-found report: refused WITHOUT GC too.
+        try:
+            await leader.rpc_complete_ec_conversion({
+                "block_id": old_id, "new_block_id": f"{old_id}.ec-bbbb0000",
+                "ec_data_shards": 2, "ec_parity_shards": 1,
+                "targets": targets,
+            })
+            raise AssertionError("legacy not-found accepted")
+        except RpcError:
+            pass
+        assert not deletes_for(f"{old_id}.ec-bbbb0000")
+
+        # Same-shard not-found: the orphan GC still runs (leak control).
+        try:
+            await leader.rpc_complete_ec_conversion({
+                "block_id": old_id, "new_block_id": f"{old_id}.ec-cccc0000",
+                "ec_data_shards": 2, "ec_parity_shards": 1,
+                "targets": targets, "shard_id": leader.state.shard_id,
+            })
+            raise AssertionError("dead-attempt completion accepted")
+        except RpcError:
+            pass
+        assert deletes_for(f"{old_id}.ec-cccc0000")
+        # The committed shards were never touched; data still reads.
+        assert not deletes_for(new_id)
+        assert await client.get_file("/ws/a.bin") == data
     finally:
         await c.stop()

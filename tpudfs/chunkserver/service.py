@@ -1054,6 +1054,12 @@ class ChunkServer:
             "ec_data_shards": data_shards,
             "ec_parity_shards": parity_shards,
             "targets": list(targets),
+            # The issuing Raft group: _call_master_leader tries EVERY
+            # known master (both shard groups), and a wrong-shard master
+            # must reject this report rather than read "block not in my
+            # namespace" as "file deleted" and GC the live shards
+            # (round-5 roulette catch, seed 8100).
+            "shard_id": shard,
         }
         resp, err = await self._call_master_leader(
             "CompleteEcConversion", report

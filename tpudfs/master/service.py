@@ -1415,8 +1415,12 @@ class Master:
             if b.is_ec or not b.size:
                 continue
             attempt = self._ec_migrations.get(b.block_id)
-            if attempt is not None and \
-                    now - attempt["ts"] < EC_MIGRATION_RETRY_SECS:
+            if attempt is not None and (
+                    attempt.get("committing")
+                    or now - attempt["ts"] < EC_MIGRATION_RETRY_SECS):
+                # committing: the swap propose is in flight — issuing a
+                # duplicate conversion now would only produce shards for
+                # the sweep to GC.
                 continue
             sources = [loc for loc in b.locations if loc in live]
             if not sources:
@@ -1461,11 +1465,26 @@ class Master:
                        targets: list[str]) -> None:
         """Delete the shards a dead conversion attempt wrote (file deleted
         mid-migration / attempt superseded across a leader change) and drop
-        its tracking entry."""
-        for addr in targets:
-            self.state.queue_command(
-                addr, {"type": "DELETE", "block_id": new_id}
-            )
+        its tracking entry.
+
+        WINNER GUARD (round-5 roulette catch, seed 8100): never GC an id
+        that RESOLVES in the metadata — it is live data. The poison
+        interleaving: attempt C's swap propose APPLIES while its handler
+        still awaits the propose (pop pending); a LATE completion for a
+        dead-leader attempt A lands in the not-found branch, pops C from
+        the soft state here, and without the guard would queue DELETE for
+        C's freshly-committed shards on every target — all k+m copies of
+        live data."""
+
+        def gc(bid: str, addrs: list[str]) -> None:
+            if self.state.find_block(bid) is not None:
+                return  # committed winner: live data, never GC
+            for addr in addrs:
+                self.state.queue_command(
+                    addr, {"type": "DELETE", "block_id": bid}
+                )
+
+        gc(new_id, targets)
         attempt = self._ec_migrations.pop(block_id, None)
         if attempt is not None:
             stale = attempt["stale"] + [
@@ -1474,10 +1493,7 @@ class Master:
             for stale_id, stale_targets in stale:
                 if stale_id == new_id:
                     continue
-                for addr in stale_targets:
-                    self.state.queue_command(
-                        addr, {"type": "DELETE", "block_id": stale_id}
-                    )
+                gc(stale_id, stale_targets)
 
     def _sweep_dead_ec_migrations(self) -> None:
         """Drop tracking (and GC issued shards) for migrations whose source
@@ -1507,6 +1523,17 @@ class Master:
         metadata swap through Raft."""
         if not self.raft.is_leader:
             raise RpcError.not_leader(self.raft.leader_hint)
+        # Shard scoping FIRST (round-5 roulette catch, seed 8100): the
+        # reporting chunkserver retries across EVERY known master — both
+        # shard groups — when the issuing leader died. A wrong-shard
+        # master must bounce the report: "block not in MY namespace" is
+        # NOT "file deleted", and the GC below would otherwise delete all
+        # k+m freshly committed shards of live data.
+        req_shard = str(req.get("shard_id") or "")
+        if req_shard and req_shard != self.state.shard_id:
+            raise RpcError.failed_precondition(
+                f"conversion report for shard {req_shard}, "
+                f"this is {self.state.shard_id}")
         found = self.state.find_block(req["block_id"])
         if found is None:
             # Already swapped (the new id resolves) — duplicate completion.
@@ -1515,9 +1542,15 @@ class Master:
             # Otherwise the file was deleted mid-migration, or another
             # attempt won after a leader change: the shards THIS attempt
             # wrote are orphans — queue their deletion before failing, or
-            # they live on the target stores forever.
-            self._gc_ec_attempt(req["block_id"], req["new_block_id"],
-                                req.get("targets") or [])
+            # they live on the target stores forever. Only a report that
+            # PROVES it belongs to this shard may trigger the GC — an
+            # unscoped (legacy) report is refused without side effects.
+            # NON-EMPTY match only: a spare/retired master's shard_id is
+            # "" and an unscoped legacy report would "match" it, re-
+            # opening the wrong-namespace GC this gate exists to close.
+            if req_shard and req_shard == self.state.shard_id:
+                self._gc_ec_attempt(req["block_id"], req["new_block_id"],
+                                    req.get("targets") or [])
             raise RpcError.not_found(f"block not found: {req['block_id']}")
         attempt = self._ec_migrations.get(req["block_id"])
         if attempt is not None and attempt["new_id"] != req["new_block_id"]:
@@ -1529,15 +1562,49 @@ class Master:
                 f"conversion attempt {req['new_block_id']} superseded"
             )
         f, _block = found
-        await self._propose({
-            "op": "complete_ec_block_conversion",
-            "path": f.path,
-            "block_id": req["block_id"],
-            "new_block_id": req["new_block_id"],
-            "ec_data_shards": int(req["ec_data_shards"]),
-            "ec_parity_shards": int(req["ec_parity_shards"]),
+        # Mark the entry COMMITTING before awaiting the propose: the
+        # await yields, and concurrent handlers must keep full context —
+        # the tiering scan must not re-schedule a duplicate conversion
+        # (the entry stays, so the throttle holds), a late completion for
+        # a superseded attempt must still be fenced locally (the entry's
+        # new_id comparison above), and once the swap APPLIES, a late
+        # dead-attempt completion's _gc_ec_attempt is stopped from
+        # deleting the winner's shards by the resolve guard there
+        # (seed-8100 catch — that interleaving deleted all k+m committed
+        # shards). On propose failure the flag clears and the 60 s retry
+        # owns recovery.
+        committing = {
+            "ts": time.monotonic(),
+            "new_id": req["new_block_id"],
             "targets": list(req["targets"]),
-        })
+            "stale": (attempt or {}).get("stale", []),
+            "committing": True,
+        }
+        self._ec_migrations[req["block_id"]] = committing
+        try:
+            await self._propose({
+                "op": "complete_ec_block_conversion",
+                "path": f.path,
+                "block_id": req["block_id"],
+                "new_block_id": req["new_block_id"],
+                "ec_data_shards": int(req["ec_data_shards"]),
+                "ec_parity_shards": int(req["ec_parity_shards"]),
+                "targets": list(req["targets"]),
+            })
+        except BaseException:
+            # Restore the pre-commit view so the 60 s retry owns
+            # recovery — but never reinstate ANOTHER handler's committing
+            # entry (a client-retry duplicate racing this handler): a
+            # restored committing=True dict with no handler behind it
+            # would suppress re-scheduling forever. Dropping the entry is
+            # always safe (re-issue after the retry window at worst).
+            if self._ec_migrations.get(req["block_id"]) is committing:
+                if attempt is not None and not attempt.get("committing"):
+                    self._ec_migrations[req["block_id"]] = attempt
+                else:
+                    self._ec_migrations.pop(req["block_id"], None)
+            raise
+        self._ec_migrations.pop(req["block_id"], None)
         # GC shards any superseded attempt managed to write.
         if attempt is not None:
             for stale_id, stale_targets in attempt["stale"]:
@@ -1545,7 +1612,6 @@ class Master:
                     self.state.queue_command(
                         addr, {"type": "DELETE", "block_id": stale_id}
                     )
-        self._ec_migrations.pop(req["block_id"], None)
         return {"success": True}
 
     async def run_tiering_scan(self) -> None:

@@ -19,10 +19,13 @@ to the proposing client without mutating state.
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 
 import msgpack
+
+logger = logging.getLogger(__name__)
 
 REPLICATION_FACTOR = 3  # reference master.rs:27
 SAFE_MODE_BLOCK_RATIO = 0.99  # reference master.rs:260-366
@@ -217,6 +220,11 @@ class MasterState:
             del self.bad_block_locations[bid]
 
     def queue_command(self, addr: str, command: dict) -> None:
+        if command.get("type") == "DELETE":
+            # Deletions are the irreversible command class — always leave
+            # an attributable trace (the round-5 shard-GC hunt needed it).
+            logger.info("queue DELETE %s -> %s",
+                        command.get("block_id"), addr)
         queue = self.pending_commands.setdefault(addr, [])
         if command not in queue:
             queue.append(command)
@@ -283,6 +291,9 @@ class MasterState:
             # way the old metadata's blocks leave the namespace here, so
             # their chunkserver data must be queued for deletion in the
             # same replicated command or it leaks forever.
+            logger.info("create-overwrite of %s frees %d old block(s) "
+                        "(existing complete=%s)", path,
+                        len(existing.blocks), existing.complete)
             for b in existing.blocks:
                 for loc in b.locations:
                     self.queue_command(
@@ -358,6 +369,7 @@ class MasterState:
         f = self.files.pop(path, None)
         if f is None:
             raise ValueError(f"file not found: {path}")
+        logger.info("delete_file %s frees %d block(s)", path, len(f.blocks))
         # Queue best-effort block deletion on every holder (idempotent; the
         # reference leaves orphans — proto DELETE is marked "future use").
         for b in f.blocks:

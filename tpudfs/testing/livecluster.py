@@ -73,7 +73,17 @@ def boot_cluster(topology: str, *, tls: bool = False, s3_port: str = "0",
     mutating the caller's process environment."""
     env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu",
            **(extra_env or {})}
-    with tempfile.TemporaryDirectory(prefix="tpudfs-live-") as tmp:
+    # CHAOS_KEEP_DIR=<dir>: keep the cluster's data/log dirs for
+    # post-mortem (per-boot subdirectory, never cleaned) — a failing
+    # chaos round's stores and logs are otherwise destroyed on teardown.
+    keep_root = os.environ.get("CHAOS_KEEP_DIR")
+    if keep_root:
+        os.makedirs(keep_root, exist_ok=True)
+    ctx = (contextlib.nullcontext(
+               tempfile.mkdtemp(prefix="boot-", dir=keep_root))
+           if keep_root
+           else tempfile.TemporaryDirectory(prefix="tpudfs-live-"))
+    with ctx as tmp:
         ready = pathlib.Path(tmp) / "endpoints.json"
         launcher = subprocess.Popen(
             [sys.executable, "scripts/start_cluster.py",
