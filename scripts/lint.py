@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""tpulint runner — thin wrapper so CI and humans share one entry point.
+
+    python scripts/lint.py                # == python -m tpudfs.analysis
+    python scripts/lint.py --list-rules
+    python scripts/lint.py --write-baseline
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tpudfs.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -199,6 +199,11 @@ def main() -> None:
         sys.executable, "-m", "compileall", "-q",
         "tpudfs", "tests", "scripts", "bench.py", "__graft_entry__.py",
     ])
+    # tpulint: the distributed-systems-aware static analysis gate. Runs
+    # BEFORE pytest so an event-loop stall or unverified read path fails
+    # fast, with file:line output, instead of as a flaky live-cluster tier.
+    run("lint (tpulint static analysis)",
+        [sys.executable, "-m", "tpudfs.analysis"])
     if not args.skip_unit:
         run("unit + integration suite",
             [sys.executable, "-m", "pytest", "tests/", "-x", "-q"])

@@ -8,8 +8,7 @@ import json
 import time
 
 import pytest
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+from tpudfs.auth.crypto_compat import hashes, padding, rsa
 
 from tpudfs.auth.errors import AuthError
 from tpudfs.auth.oidc import JwksCache, OidcValidator

@@ -540,7 +540,7 @@ async def test_sts_assume_role_end_to_end(tmp_path):
     """OIDC token → STS temp creds → SigV4-signed request under the role's
     (read-only) policy."""
     from tests.test_oidc import make_token, base_claims, ISSUER, AUDIENCE
-    from cryptography.hazmat.primitives.asymmetric import rsa
+    from tpudfs.auth.crypto_compat import rsa
     from tpudfs.auth.oidc import JwksCache, OidcValidator
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)

@@ -1,0 +1,422 @@
+"""tpulint: unit tests for every rule (positive + negative fixtures),
+suppressions, baseline mechanics — and the tier-1 gate that holds the whole
+``tpudfs/`` tree at zero new findings against the checked-in baseline."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import textwrap
+
+from tpudfs.analysis.cli import main as lint_main
+from tpudfs.analysis.linter import (
+    all_rules,
+    analyze_file,
+    load_baseline,
+    run,
+    write_baseline,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+BASELINE = REPO / "tpudfs" / "analysis" / "baseline.json"
+
+
+def lint(tmp_path, src: str, rel: str = "tpudfs/chunkserver/mod.py",
+         rule: str | None = None):
+    """Write ``src`` at ``rel`` under a scratch root and lint that file."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    rules = [all_rules()[rule]] if rule else None
+    return analyze_file(path, tmp_path, rules=rules)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------ TPL001
+
+
+def test_tpl001_flags_time_sleep_in_async(tmp_path):
+    findings = lint(tmp_path, """
+        import time
+        async def pump():
+            time.sleep(0.5)
+    """, rule="TPL001")
+    assert rule_ids(findings) == ["TPL001"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_tpl001_flags_sync_io_methods_and_requests(tmp_path):
+    findings = lint(tmp_path, """
+        import requests
+        async def fetch(p):
+            body = requests.get("http://x/")
+            return p.read_bytes()
+    """, rule="TPL001")
+    assert rule_ids(findings) == ["TPL001", "TPL001"]
+
+
+def test_tpl001_ignores_sync_functions(tmp_path):
+    assert lint(tmp_path, """
+        import time
+        def warmup():
+            time.sleep(0.5)
+    """, rule="TPL001") == []
+
+
+def test_tpl001_ignores_to_thread_closures(tmp_path):
+    # A sync def (or lambda) nested in an async def runs in a worker
+    # thread under asyncio.to_thread — not on the event loop.
+    assert lint(tmp_path, """
+        import asyncio, time
+        async def fetch(p, nonce):
+            def _work():
+                time.sleep(0.1)
+                return p.read_bytes()
+            same = await asyncio.to_thread(
+                lambda: p.read_bytes() == nonce)
+            return await asyncio.to_thread(_work), same
+    """, rule="TPL001") == []
+
+
+# ------------------------------------------------------------------ TPL002
+
+
+def test_tpl002_flags_await_under_thread_lock(tmp_path):
+    findings = lint(tmp_path, """
+        import threading
+        class S:
+            def __init__(self):
+                self._mu = threading.Lock()
+            async def flush(self, sink):
+                with self._mu:
+                    await sink.drain()
+    """, rule="TPL002")
+    assert rule_ids(findings) == ["TPL002"]
+    assert "self._mu" in findings[0].message
+
+
+def test_tpl002_flags_acquire_from_async(tmp_path):
+    findings = lint(tmp_path, """
+        import threading
+        mu = threading.RLock()
+        async def step():
+            mu.acquire()
+    """, rule="TPL002")
+    assert rule_ids(findings) == ["TPL002"]
+
+
+def test_tpl002_ignores_asyncio_locks_and_threaded_use(tmp_path):
+    assert lint(tmp_path, """
+        import asyncio, threading
+        amu = asyncio.Lock()
+        tmu = threading.Lock()
+        async def ok(sink):
+            async with amu:
+                await sink.drain()
+        def worker():
+            with tmu:
+                return 1
+    """, rule="TPL002") == []
+
+
+# ------------------------------------------------------------------ TPL003
+
+
+def test_tpl003_flags_silent_broad_except(tmp_path):
+    findings = lint(tmp_path, """
+        def a():
+            try:
+                risky()
+            except Exception:
+                pass
+        def b():
+            try:
+                risky()
+            except:
+                return None
+    """, rule="TPL003")
+    assert rule_ids(findings) == ["TPL003", "TPL003"]
+
+
+def test_tpl003_accepts_log_raise_or_counter(tmp_path):
+    assert lint(tmp_path, """
+        def a():
+            try:
+                risky()
+            except Exception:
+                logger.exception("risky failed")
+        def b():
+            try:
+                risky()
+            except Exception as e:
+                raise RuntimeError("wrapped") from e
+        def c(self):
+            try:
+                risky()
+            except Exception:
+                self.metrics.read_errors += 1
+    """, rule="TPL003") == []
+
+
+def test_tpl003_ignores_narrow_excepts(tmp_path):
+    assert lint(tmp_path, """
+        def a():
+            try:
+                risky()
+            except (OSError, ValueError):
+                return None
+    """, rule="TPL003") == []
+
+
+# ------------------------------------------------------------------ TPL004
+
+
+def test_tpl004_flags_core_mutation_outside_core(tmp_path):
+    findings = lint(tmp_path, """
+        def hack(core, entry):
+            core.term = 7
+            core.log.append(entry)
+    """, rel="tpudfs/raft/node.py", rule="TPL004")
+    assert rule_ids(findings) == ["TPL004", "TPL004"]
+    assert "core.term" in findings[0].message
+
+
+def test_tpl004_exempts_core_module_itself(tmp_path):
+    assert lint(tmp_path, """
+        class RaftCore:
+            def become_follower(self, term):
+                self.term = term
+                self.voted_for = None
+    """, rel="tpudfs/raft/core.py", rule="TPL004") == []
+
+
+def test_tpl004_ignores_unrelated_receivers(tmp_path):
+    assert lint(tmp_path, """
+        def ok(view, stats):
+            view.term = 3        # not a core-ish receiver
+            stats.log = []
+    """, rel="tpudfs/raft/node.py", rule="TPL004") == []
+
+
+# ------------------------------------------------------------------ TPL005
+
+
+def test_tpl005_flags_unverified_data_plane_read(tmp_path):
+    findings = lint(tmp_path, """
+        def read_block(path):
+            with open(path, "rb") as f:
+                return f.read()
+    """, rel="tpudfs/chunkserver/raw.py", rule="TPL005")
+    assert rule_ids(findings) == ["TPL005"]
+
+
+def test_tpl005_accepts_verification_or_delegation(tmp_path):
+    assert lint(tmp_path, """
+        import asyncio
+        def read_checked(store, bid, want):
+            data = store.pread_raw(bid)
+            if crc32c(data) != want:
+                raise ChecksumError(bid)
+            return data
+        async def read_cached(store, bid):
+            return await asyncio.to_thread(store.read_verified, bid)
+    """, rel="tpudfs/chunkserver/raw.py", rule="TPL005") == []
+
+
+def test_tpl005_scoped_to_data_plane_packages(tmp_path):
+    assert lint(tmp_path, """
+        def read_manifest(path):
+            with open(path, "rb") as f:
+                return f.read()
+    """, rel="tpudfs/master/manifest.py", rule="TPL005") == []
+
+
+# ------------------------------------------------------------------ TPL006
+
+
+def test_tpl006_flags_nondeterminism_in_raft_core(tmp_path):
+    findings = lint(tmp_path, """
+        import time, random, uuid
+        def election_timeout():
+            return time.monotonic() + random.uniform(1, 2)
+        def request_id():
+            return uuid.uuid4()
+    """, rel="tpudfs/raft/core.py", rule="TPL006")
+    assert sorted(rule_ids(findings)) == ["TPL006", "TPL006", "TPL006"]
+
+
+def test_tpl006_allows_injected_rng_and_other_modules(tmp_path):
+    assert lint(tmp_path, """
+        import random
+        def make_rng(seed):
+            return random.Random(seed)
+        def jitter(rng):
+            return rng.uniform(1, 2)
+    """, rel="tpudfs/raft/core.py", rule="TPL006") == []
+    assert lint(tmp_path, """
+        import time
+        def now():
+            return time.time()
+    """, rel="tpudfs/common/clock.py", rule="TPL006") == []
+
+
+# ------------------------------------------------------------------ TPL007
+
+
+def test_tpl007_flags_dropped_task_handles(tmp_path):
+    findings = lint(tmp_path, """
+        import asyncio
+        async def go(loop):
+            asyncio.create_task(beat())
+            _ = asyncio.ensure_future(scrub())
+            loop.create_task(repair())
+    """, rule="TPL007")
+    assert rule_ids(findings) == ["TPL007", "TPL007", "TPL007"]
+
+
+def test_tpl007_accepts_kept_handles_and_task_groups(tmp_path):
+    assert lint(tmp_path, """
+        import asyncio
+        class S:
+            async def start(self, tg):
+                self._task = asyncio.create_task(self.beat())
+                tg.create_task(self.scrub())
+    """, rule="TPL007") == []
+
+
+# -------------------------------------------------------------- suppression
+
+
+def test_line_suppression(tmp_path):
+    assert lint(tmp_path, """
+        import time
+        async def pump():
+            time.sleep(0.5)  # tpulint: disable=TPL001
+    """, rule="TPL001") == []
+
+
+def test_comment_line_above_suppression(tmp_path):
+    assert lint(tmp_path, """
+        import time
+        async def pump():
+            # tpulint: disable=TPL001
+            time.sleep(0.5)
+    """, rule="TPL001") == []
+
+
+def test_file_suppression(tmp_path):
+    assert lint(tmp_path, """
+        # tpulint: disable-file=TPL001
+        import time
+        async def a():
+            time.sleep(1)
+        async def b():
+            time.sleep(2)
+    """, rule="TPL001") == []
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    findings = lint(tmp_path, """
+        import time
+        async def pump():
+            time.sleep(0.5)  # tpulint: disable=TPL003
+    """, rule="TPL001")
+    assert rule_ids(findings) == ["TPL001"]
+
+
+# ------------------------------------------------------------------ TPL000
+
+
+def test_syntax_error_reported_as_tpl000(tmp_path):
+    findings = lint(tmp_path, "def broken(:\n    pass\n")
+    assert rule_ids(findings) == ["TPL000"]
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    src = """
+        def a():
+            try:
+                risky()
+            except Exception:
+                pass
+    """
+    target = tmp_path / "tpudfs" / "chunkserver" / "mod.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(textwrap.dedent(src))
+
+    first = run([target], tmp_path)
+    assert len(first.new) == 1
+
+    bl = tmp_path / "baseline.json"
+    write_baseline(bl, first.findings)
+    assert load_baseline(bl) == {f.fingerprint for f in first.findings}
+
+    second = run([target], tmp_path, baseline_path=bl)
+    assert second.new == [] and len(second.baselined) == 1
+
+    # Fix the code: the baseline entry goes stale (reported, not an error).
+    target.write_text("def a():\n    return risky()\n")
+    third = run([target], tmp_path, baseline_path=bl)
+    assert third.new == [] and third.findings == []
+    assert len(third.stale_baseline) == 1
+
+
+def test_fingerprint_survives_line_drift(tmp_path):
+    src = textwrap.dedent("""
+        def a():
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    f1 = lint(tmp_path, src, rel="tpudfs/chunkserver/m1.py", rule="TPL003")
+    # Same code shifted 20 lines down in an otherwise-identical module.
+    f2 = lint(tmp_path, "\n" * 20 + src, rel="tpudfs/chunkserver/m1.py",
+              rule="TPL003")
+    assert f1[0].line != f2[0].line
+    assert f1[0].fingerprint == f2[0].fingerprint
+
+
+# ------------------------------------------------------------- tier-1 gate
+
+
+def test_every_rule_is_registered():
+    ids = set(all_rules())
+    assert {"TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
+            "TPL007"} <= ids
+
+
+def test_baseline_is_committed_and_small():
+    assert BASELINE.exists(), "tpudfs/analysis/baseline.json must be checked in"
+    data = json.loads(BASELINE.read_text())
+    assert data["version"] == 1
+    assert len(data["findings"]) <= 15
+
+
+def test_tree_is_clean_against_baseline():
+    """THE gate: `tpudfs/` must produce zero findings not in the baseline."""
+    result = run([REPO / "tpudfs"], REPO, baseline_path=BASELINE)
+    assert not result.new, "new tpulint findings:\n" + "\n".join(
+        f.render() for f in result.new
+    )
+
+
+def test_cli_exits_zero_on_clean_tree(capsys):
+    assert lint_main([]) == 0
+    out = capsys.readouterr().out
+    assert "tpulint" in out
+
+
+def test_cli_exits_nonzero_on_new_finding(tmp_path, capsys):
+    bad = tmp_path / "tpudfs" / "raft" / "hack.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def f(core):\n    core.term = 1\n")
+    rc = lint_main(["--root", str(tmp_path), "--no-baseline", str(bad)])
+    assert rc == 1
+    assert "TPL004" in capsys.readouterr().out

@@ -84,6 +84,9 @@ async def test_wds_training_loop_learns(tmp_path):
             # serves on the MAIN event loop, which must stay unblocked.
             import grain
 
+            if not hasattr(grain, "MapDataset"):
+                import grain.python as grain  # namespace-package install
+
             source = DfsWdsSource(list(c.masters), shards)
             try:
                 assert len(source) == SAMPLES

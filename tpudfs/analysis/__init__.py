@@ -1,0 +1,19 @@
+"""tpulint: distributed-systems-aware static analysis for tpudfs.
+
+Run ``python -m tpudfs.analysis`` (or ``scripts/lint.py``) to lint the tree;
+see tpudfs/analysis/linter.py for the framework and docs/static-analysis.md
+for the rule catalogue.
+"""
+
+from tpudfs.analysis.linter import (  # noqa: F401
+    Finding,
+    ModuleInfo,
+    Rule,
+    all_rules,
+    analyze_file,
+    analyze_tree,
+    load_baseline,
+    register,
+    run,
+    write_baseline,
+)

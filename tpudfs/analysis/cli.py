@@ -1,0 +1,101 @@
+"""tpulint CLI.
+
+    python -m tpudfs.analysis                 # lint tpudfs/ against baseline
+    python -m tpudfs.analysis path/to/file.py # lint specific paths
+    python -m tpudfs.analysis --write-baseline
+    python -m tpudfs.analysis --list-rules
+    python -m tpudfs.analysis --no-baseline   # show grandfathered too
+
+Exit codes: 0 clean (or fully baselined), 1 non-baselined findings,
+2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from tpudfs.analysis import linter
+
+#: Repo root = parent of the ``tpudfs`` package directory.
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_TARGET = REPO_ROOT / "tpudfs"
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="distributed-systems-aware static analysis for tpudfs",
+    )
+    p.add_argument("paths", nargs="*", type=pathlib.Path,
+                   help="files/dirs to lint (default: the tpudfs package)")
+    p.add_argument("--root", type=pathlib.Path, default=REPO_ROOT,
+                   help="repo root used for relative paths and baselines")
+    p.add_argument("--baseline", type=pathlib.Path, default=BASELINE_PATH,
+                   help="baseline file (default: tpudfs/analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline; report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from current findings")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every registered rule and exit")
+    p.add_argument("--rule", action="append", dest="rules", metavar="TPLxxx",
+                   help="run only these rule ids (repeatable)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _parser().parse_args(argv)
+
+    rules = linter.all_rules()
+    if args.list_rules:
+        for rule in rules.values():
+            print(f"{rule.id}  {rule.name}")
+            print(f"        {rule.summary}")
+        return 0
+
+    selected = None
+    if args.rules:
+        wanted = {r.upper() for r in args.rules}
+        unknown = wanted - rules.keys()
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        selected = [rules[r] for r in sorted(wanted)]
+
+    paths = args.paths or [DEFAULT_TARGET]
+    for p in paths:
+        if not p.exists():
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        findings = linter.analyze_tree(paths, args.root, selected)
+        linter.write_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = None if args.no_baseline else args.baseline
+    result = linter.run(paths, args.root, baseline, selected)
+
+    report = result.findings if args.no_baseline else result.new
+    for f in report:
+        print(f.render())
+    if not args.quiet:
+        n_files = "" if args.paths else " across tpudfs/"
+        print(
+            f"tpulint: {len(result.new)} new finding(s), "
+            f"{len(result.baselined)} baselined{n_files}"
+        )
+        if result.stale_baseline:
+            print(
+                f"tpulint: {len(result.stale_baseline)} stale baseline "
+                "entr(ies) — findings fixed but still grandfathered; run "
+                "--write-baseline to shrink the baseline"
+            )
+    return 1 if result.new else 0

@@ -1,0 +1,365 @@
+"""tpulint — distributed-systems-aware static analysis for tpudfs.
+
+The paper's safety story (Raft linearizability, end-to-end CRC32C, pipeline
+replication) rests on invariants that no type checker sees: async code must
+not block the event loop, the data plane must not hand out unverified bytes,
+Raft core state must only change inside the sans-io step functions. tpulint
+turns those review-time rules into machine-checked ones.
+
+Architecture:
+
+- :class:`ModuleInfo` parses one source file once and precomputes what every
+  rule needs: the AST, a child->parent map, per-node enclosing-scope
+  resolution, and the suppression table parsed from ``# tpulint:`` comments.
+- :class:`Rule` is the plugin API. A rule declares ``id``/``name``/``summary``
+  and yields :class:`Finding` objects from ``check(module)``. Rules register
+  themselves via the :func:`register` decorator (see tpudfs/analysis/rules/).
+- :class:`Finding` carries a content-addressed ``fingerprint`` (rule + path +
+  enclosing scope + normalized source line) so the checked-in baseline
+  survives unrelated line-number drift.
+- :func:`run` walks a tree, applies suppressions and the baseline, and
+  returns the surviving findings; the CLI lives in ``tpudfs/analysis/cli.py``.
+
+Suppression grammar (documented in docs/static-analysis.md):
+
+- ``# tpulint: disable=TPL001[,TPL002]`` on a code line (or on the comment
+  line directly above it) suppresses those rules for that statement.
+- ``# tpulint: disable-file=TPL001[,TPL002]`` anywhere in a file suppresses
+  the rules for the whole file. ``all`` is accepted in either form.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+import pathlib
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Finding",
+    "ModuleInfo",
+    "Rule",
+    "register",
+    "all_rules",
+    "analyze_file",
+    "analyze_tree",
+    "load_baseline",
+    "write_baseline",
+    "run",
+]
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    scope: str  # dotted enclosing scope, e.g. "ChunkServer.read_block"
+    snippet: str = ""  # stripped source line (fingerprint input)
+
+    @property
+    def fingerprint(self) -> str:
+        """Content-addressed id, stable across line-number drift: unrelated
+        edits above a grandfathered finding must not invalidate the baseline,
+        and a baseline entry must die when its code is actually fixed."""
+        basis = "\x1f".join((self.rule, self.path, self.scope, self.snippet))
+        return hashlib.sha256(basis.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        return f"{loc}: {self.rule} [{self.scope or '<module>'}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint,
+            "rule": self.rule,
+            "path": self.path,
+            "scope": self.scope,
+            "message": self.message,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Per-module analysis context
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\s]+)"
+)
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+class ModuleInfo:
+    """Parsed source file plus the shared lookups every rule needs."""
+
+    def __init__(self, path: pathlib.Path, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel_path)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self._line_suppressions: dict[int, set[str]] = {}
+        self._file_suppressions: set[str] = set()
+        self._parse_suppressions()
+
+    # -- suppressions -------------------------------------------------------
+
+    def _parse_suppressions(self) -> None:
+        for lineno, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            kind = m.group(1)
+            rules = {r.strip().upper() for r in m.group(2).split(",") if r.strip()}
+            if kind == "disable-file":
+                self._file_suppressions |= rules
+                continue
+            target = lineno
+            if text.lstrip().startswith("#"):
+                # Comment-only line: applies to the next code line.
+                target = lineno + 1
+            self._line_suppressions.setdefault(target, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rule = rule.upper()
+        for pool in (self._file_suppressions,
+                     self._line_suppressions.get(line, ())):
+            if rule in pool or "ALL" in pool:
+                return True
+        return False
+
+    # -- tree navigation ----------------------------------------------------
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    def in_async_context(self, node: ast.AST) -> bool:
+        """True iff the innermost enclosing function is ``async def``. A sync
+        ``def`` (or lambda) nested inside an ``async def`` is NOT async
+        context — such closures typically run under
+        ``asyncio.to_thread``."""
+        fn = self.enclosing_function(node)
+        return isinstance(fn, ast.AsyncFunctionDef)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted scope of ``node`` (class + function names, outermost
+        first); empty string at module level."""
+        parts: list[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, _SCOPE_NODES):
+                parts.append(anc.name)
+        if isinstance(node, _SCOPE_NODES):
+            parts.insert(0, node.name)
+        return ".".join(reversed(parts))
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Attribute/Name chains rooted at a Name; None for
+    anything dynamic (subscripts, calls, literals)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Rule plugin API
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """Base class for tpulint rules. Subclasses set ``id``/``name``/``summary``
+    and implement ``check``; registration is via the :func:`register`
+    decorator so importing ``tpudfs.analysis.rules`` is the only wiring."""
+
+    id: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: ModuleInfo, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(
+            rule=self.id,
+            path=module.rel_path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            scope=module.qualname(node),
+            snippet=module.snippet(line),
+        )
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    # Importing the package registers every rule module.
+    from tpudfs.analysis import rules  # noqa: F401
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+DEFAULT_EXCLUDE = ("__pycache__",)
+
+
+def analyze_file(
+    path: pathlib.Path,
+    root: pathlib.Path,
+    rules: Iterable[Rule] | None = None,
+) -> list[Finding]:
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    try:
+        source = path.read_text(encoding="utf-8")
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("TPL000", rel, 0, 0, f"unreadable source: {e}", "")]
+    try:
+        module = ModuleInfo(path, rel, source)
+    except SyntaxError as e:
+        return [Finding("TPL000", rel, e.lineno or 0, 0,
+                        f"syntax error: {e.msg}", "")]
+    findings: list[Finding] = []
+    for rule in rules if rules is not None else all_rules().values():
+        for f in rule.check(module):
+            if not module.suppressed(f.rule, f.line):
+                findings.append(f)
+    return findings
+
+
+def iter_python_files(
+    base: pathlib.Path, exclude: tuple[str, ...] = DEFAULT_EXCLUDE
+) -> Iterator[pathlib.Path]:
+    if base.is_file():
+        yield base
+        return
+    for p in sorted(base.rglob("*.py")):
+        if any(part in exclude for part in p.parts):
+            continue
+        yield p
+
+
+def analyze_tree(
+    paths: Iterable[pathlib.Path],
+    root: pathlib.Path,
+    rules: Iterable[Rule] | None = None,
+) -> list[Finding]:
+    rules = list(rules) if rules is not None else list(all_rules().values())
+    findings: list[Finding] = []
+    for base in paths:
+        for path in iter_python_files(base):
+            findings.extend(analyze_file(path, root, rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: pathlib.Path) -> set[str]:
+    """Fingerprints of grandfathered findings; missing file = empty."""
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {entry["fingerprint"] for entry in data.get("findings", [])}
+
+
+def write_baseline(path: pathlib.Path, findings: list[Finding]) -> None:
+    data = {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "Grandfathered tpulint findings. Regenerate with "
+            "`python -m tpudfs.analysis --write-baseline` after burning one "
+            "down; never add entries by hand for NEW code."
+        ),
+        "findings": [f.to_dict() for f in findings],
+    }
+    path.write_text(json.dumps(data, indent=2, sort_keys=False) + "\n")
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)  # all, post-suppression
+    new: list[Finding] = field(default_factory=list)  # not in baseline
+    baselined: list[Finding] = field(default_factory=list)
+    stale_baseline: set[str] = field(default_factory=set)  # fixed but listed
+
+
+def run(
+    paths: Iterable[pathlib.Path],
+    root: pathlib.Path,
+    baseline_path: pathlib.Path | None = None,
+    rules: Iterable[Rule] | None = None,
+) -> RunResult:
+    findings = analyze_tree(paths, root, rules)
+    baseline = load_baseline(baseline_path) if baseline_path else set()
+    result = RunResult(findings=findings)
+    seen: set[str] = set()
+    for f in findings:
+        seen.add(f.fingerprint)
+        if f.fingerprint in baseline:
+            result.baselined.append(f)
+        else:
+            result.new.append(f)
+    result.stale_baseline = baseline - seen
+    return result

@@ -1,0 +1,16 @@
+"""tpulint rule registry.
+
+Importing this package registers every rule with the framework (the
+``@register`` decorator in tpudfs.analysis.linter). Adding a rule = adding a
+module here and importing it below.
+"""
+
+from tpudfs.analysis.rules import (  # noqa: F401
+    blocking,
+    locks,
+    exceptions,
+    raft_state,
+    checksum,
+    determinism,
+    tasks,
+)

@@ -1,0 +1,86 @@
+"""TPL001 — blocking call inside ``async def``.
+
+A single ``time.sleep`` or synchronous HTTP/subprocess call on the event
+loop stalls every in-flight RPC on that process: heartbeats miss, Raft
+elections fire, replication pipelines wedge. Blocking work belongs behind
+``await asyncio.to_thread(...)`` / ``loop.run_in_executor`` or an async
+equivalent (``await asyncio.sleep``, aiohttp).
+
+Sync ``def``s nested inside an ``async def`` are exempt — that is exactly
+the ``to_thread`` closure pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+#: Exact dotted names that block the calling thread.
+BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "os.system": "use `asyncio.create_subprocess_shell`",
+    "os.wait": "use `asyncio.create_subprocess_exec` + `await proc.wait()`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec`",
+    "subprocess.call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+    "subprocess.getoutput": "use `asyncio.create_subprocess_shell`",
+    "subprocess.getstatusoutput": "use `asyncio.create_subprocess_shell`",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "urllib.request.urlopen": "use aiohttp",
+}
+
+#: Any call into these modules is synchronous network I/O.
+BLOCKING_PREFIXES = {
+    "requests.": "use aiohttp (requests is fully synchronous)",
+}
+
+#: Methods that do synchronous file I/O when invoked on pathlib.Path-like
+#: receivers. Attribute calls are receiver-typed only by convention, so this
+#: list is deliberately short and unambiguous.
+BLOCKING_METHODS = {
+    "read_bytes", "read_text", "write_bytes", "write_text",
+}
+
+
+@register
+class BlockingCallInAsync(Rule):
+    id = "TPL001"
+    name = "blocking-call-in-async"
+    summary = ("time.sleep / sync I/O / subprocess inside `async def` stalls "
+               "the event loop (heartbeats, elections, replication)")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not module.in_async_context(node):
+                continue
+            name = dotted_name(node.func)
+            hint = None
+            what = name
+            if name in BLOCKING_CALLS:
+                hint = BLOCKING_CALLS[name]
+            elif name:
+                for prefix, h in BLOCKING_PREFIXES.items():
+                    if name.startswith(prefix):
+                        hint = h
+                        break
+            if hint is None and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in BLOCKING_METHODS:
+                what = f".{node.func.attr}(...)"
+                hint = "wrap in `await asyncio.to_thread(...)`"
+            if hint is None:
+                continue
+            yield self.finding(
+                module, node,
+                f"blocking call `{what}` in async function; {hint}",
+            )

@@ -1,0 +1,161 @@
+"""TPL005 — data-plane read path without checksum verification.
+
+tpudfs promises END-TO-END CRC32C: every byte handed to a caller was either
+verified against the sidecar checksums in this hop or is explicitly
+delegated to a path that verifies. A read function that silently skips
+verification turns a flipped bit on disk or on the wire into silent
+corruption delivered to training jobs.
+
+Scope: functions in the data-plane packages (``tpudfs/chunkserver/``,
+``tpudfs/client/``, ``tpudfs/tpu/``) whose name starts with ``read``/
+``pread`` or contains ``_read``, and that return a value.
+
+A function passes if it shows any of:
+
+- a verification call — dotted path mentioning ``verify``, ``crc32c``,
+  ``checksum`` or ``validate``;
+- a raise of a corruption error (``BlockCorruptionError``/``ChecksumError``)
+  — it implements verification itself;
+- delegation — it calls another read-style function (``self.store.
+  read_verified(...)``, ``read_from(...)``) which is linted in its own
+  right. Raw OS/stdlib reads (``os.pread``, ``f.read``) do NOT count as
+  delegation.
+
+Intentionally-unverified primitives (the raw ``BlockStore.read`` under the
+verified wrappers) must carry an explicit
+``# tpulint: disable=TPL005`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+DATA_PLANE_PREFIXES = (
+    "tpudfs/chunkserver/",
+    "tpudfs/client/",
+    "tpudfs/tpu/",
+)
+
+_READ_NAME = re.compile(r"^p?read|_read")
+_VERIFY_HINTS = ("verify", "crc32c", "checksum", "validate")
+_CORRUPTION_ERRORS = {"BlockCorruptionError", "ChecksumError", "CorruptionError"}
+#: Receivers whose ``read*`` methods are raw byte I/O, not linted delegates.
+_RAW_RECEIVERS = {"os", "io", "socket", "struct", "mmap", "f", "fh", "fd",
+                  "file", "fp", "buf", "reader"}
+
+
+def _is_read_name(name: str) -> bool:
+    return bool(_READ_NAME.search(name))
+
+
+def _returns_value(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                   module: ModuleInfo) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if module.enclosing_function(node) is fn:
+                if isinstance(node.value, ast.Constant) \
+                        and node.value.value is None:
+                    continue
+                return True
+    return False
+
+
+_THREAD_BRIDGES = {"asyncio.to_thread"}
+_EXECUTOR_ATTRS = {"run_in_executor"}
+
+
+def _has_verification(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            target = exc.func if isinstance(exc, ast.Call) else exc
+            name = dotted_name(target) or ""
+            if name.split(".")[-1] in _CORRUPTION_ERRORS:
+                return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and any(h in name.lower() for h in _VERIFY_HINTS):
+                return True
+        if isinstance(node, ast.Attribute):
+            # Verified callables passed by reference, e.g.
+            # `asyncio.to_thread(store.read_verified, ...)`.
+            if any(h in node.attr.lower() for h in _VERIFY_HINTS):
+                return True
+    return False
+
+
+def _read_callable_ref(node: ast.AST) -> bool:
+    """``node`` references (not calls) a linted read-style callable."""
+    if isinstance(node, ast.Attribute):
+        if not _is_read_name(node.attr):
+            return False
+        receiver = dotted_name(node.value) or ""
+        return receiver.split(".")[0] not in _RAW_RECEIVERS
+    if isinstance(node, ast.Name):
+        return _is_read_name(node.id)
+    if isinstance(node, ast.IfExp):
+        # `store.read_verified if verify else store.read`
+        return _read_callable_ref(node.body) or _read_callable_ref(node.orelse)
+    return False
+
+
+def _delegates(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = dotted_name(func) or ""
+        # Thread-bridge indirection: the effective callee is the first
+        # function argument (`asyncio.to_thread(self.store.read, ...)`,
+        # `loop.run_in_executor(None, store.read, ...)`).
+        if name in _THREAD_BRIDGES and node.args:
+            if _read_callable_ref(node.args[0]):
+                return True
+            continue
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _EXECUTOR_ATTRS and len(node.args) >= 2:
+            if _read_callable_ref(node.args[1]):
+                return True
+            continue
+        if _read_callable_ref(func):
+            return True
+    return False
+
+
+@register
+class UnverifiedBlockRead(Rule):
+    id = "TPL005"
+    name = "unverified-block-read"
+    summary = ("data-plane read path returns bytes without a CRC32C/verify "
+               "call or a delegation to a verified read")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not module.rel_path.startswith(DATA_PLANE_PREFIXES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _is_read_name(node.name):
+                continue
+            if not _returns_value(node, module):
+                continue
+            if _has_verification(node) or _delegates(node):
+                continue
+            yield self.finding(
+                module, node,
+                f"read path `{node.name}` returns data without checksum "
+                "verification or delegation to a verified read — end-to-end "
+                "CRC32C requires every hop to verify or explicitly delegate "
+                "(`# tpulint: disable=TPL005` with justification for raw "
+                "primitives)",
+            )

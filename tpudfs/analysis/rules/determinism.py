@@ -1,0 +1,67 @@
+"""TPL006 — nondeterminism inside the pure Raft core.
+
+tpudfs/raft/core.py is a deterministic state machine by contract: time
+enters via ``tick(now)``, randomness via an injected ``random.Random``, and
+every run of the simulation tiers (test_raft_core / test_raft_partitions /
+test_raft_jepsen) must replay bit-identically from a seed. A stray
+``time.time()`` or module-level ``random.uniform()`` silently breaks replay
+— bugs found by the Jepsen-style fuzzer stop being reproducible.
+
+``random.Random(...)`` (constructing the injectable RNG) is allowed; calling
+the module-level convenience functions, wall clocks, uuid or os.urandom is
+not. The rule applies only to the modules listed in ``PURE_MODULES``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+PURE_MODULES = ("tpudfs/raft/core.py",)
+
+_FORBIDDEN_EXACT = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "os.urandom", "os.getrandom",
+    "uuid.uuid1", "uuid.uuid4",
+}
+_FORBIDDEN_PREFIXES = ("random.", "secrets.")
+_ALLOWED = {"random.Random", "random.SystemRandom"}  # SystemRandom flagged below
+
+_MESSAGE = ("nondeterministic call `{name}` in the pure Raft core — inject "
+            "time via `tick(now)` and randomness via the `rng` parameter so "
+            "simulation replays stay bit-identical")
+
+
+@register
+class NondeterminismInPureCore(Rule):
+    id = "TPL006"
+    name = "nondeterminism-in-pure-core"
+    summary = ("wall-clock / module-level random / uuid inside raft/core.py "
+               "breaks deterministic simulation replay")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.rel_path not in PURE_MODULES:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            if name == "random.Random":
+                continue  # the injectable RNG type itself
+            bad = name in _FORBIDDEN_EXACT or name == "random.SystemRandom" \
+                or any(name.startswith(p) for p in _FORBIDDEN_PREFIXES)
+            if bad:
+                yield self.finding(module, node, _MESSAGE.format(name=name))

@@ -1,0 +1,116 @@
+"""TPL003 — silent broad exception handler.
+
+A bare ``except:`` / ``except Exception:`` that neither logs, re-raises,
+propagates, nor counts the failure can swallow data-plane corruption: a
+checksum mismatch or a failed replication ack disappears without a trace and
+the system keeps serving. Every broad handler must leave evidence.
+
+Accepted evidence inside the handler body:
+
+- ``raise`` (bare or new exception);
+- a logging call — any ``logger.*`` / ``logging.*`` / ``self.log.*`` method
+  (``debug`` through ``critical``/``exception``), or ``print`` (CLI surface);
+- error propagation — ``fut.set_exception(...)`` / ``callback(e)``-style
+  delivery via ``.set_exception``/``.set_result`` on a future;
+- a telemetry update — calling ``.inc``/``.observe``/``.increment``, touching
+  a dotted path containing ``metrics``/``stats``/``counter``, or an
+  augmented assignment to such a path (``self.stats.failures += 1``).
+
+Narrow handlers (``except RpcError:`` etc.) are out of scope: catching a
+specific type is itself a statement of intent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "fatal",
+}
+_PROPAGATE_METHODS = {"set_exception", "set_result"}
+_COUNTER_METHODS = {"inc", "observe", "increment", "add", "update"}
+_COUNTER_HINTS = ("metrics", "stats", "counter", "telemetry")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name)
+                   and e.id in ("Exception", "BaseException")
+                   for e in t.elts)
+    return False
+
+
+def _counterish(name: str | None) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return any(h in low for h in _COUNTER_HINTS)
+
+
+def _has_evidence(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.AugAssign):
+            if _counterish(dotted_name(node.target)):
+                return True
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            return True
+        name = dotted_name(func)
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            receiver = dotted_name(func.value) or ""
+            rlow = receiver.lower()
+            if attr in _LOG_METHODS and (
+                "log" in rlow or rlow in ("logging",)
+            ):
+                return True
+            if attr in _PROPAGATE_METHODS:
+                return True
+            if attr in _COUNTER_METHODS and _counterish(receiver):
+                return True
+        if _counterish(name):
+            return True
+    return False
+
+
+@register
+class SilentBroadExcept(Rule):
+    id = "TPL003"
+    name = "silent-broad-except"
+    summary = ("bare/broad `except` that neither logs, re-raises, propagates "
+               "nor counts — can silently swallow data-plane corruption")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _has_evidence(node):
+                continue
+            caught = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            yield self.finding(
+                module, node,
+                f"{caught} swallows errors silently — log it, re-raise, "
+                "or bump a telemetry counter (or narrow the except type)",
+            )

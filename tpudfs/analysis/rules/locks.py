@@ -1,0 +1,137 @@
+"""TPL002 — thread locks mixed into async control flow.
+
+``await`` while holding a ``threading.Lock`` is a classic distributed-systems
+deadlock: the coroutine parks, the lock stays held, and any thread (or any
+other coroutine on the same loop reaching the same lock) blocks the whole
+event loop waiting for it. Thread locks also have no cancellation semantics,
+so a cancelled coroutine leaks the acquisition.
+
+Detected patterns:
+
+- ``with <thread lock>:`` whose body contains ``await`` (directly, not in a
+  nested function);
+- ``<thread lock>.acquire()`` called from an ``async def``.
+
+A "thread lock" is any symbol assigned from ``threading.Lock()``,
+``threading.RLock()``, ``threading.Condition()`` or ``threading.Semaphore()``
+anywhere in the same module (tracked as plain names and ``self.attr``
+targets). asyncio primitives (``asyncio.Lock`` etc.) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_THREAD_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+
+
+def _lock_symbols(module: ModuleInfo) -> set[str]:
+    """Dotted names assigned from a threading lock constructor. ``self.x``
+    targets are tracked as ``self.x`` — receiver identity across methods of
+    the same class is assumed, which is the common case."""
+    symbols: set[str] = set()
+    for node in ast.walk(module.tree):
+        value = None
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if value is None or not isinstance(value, ast.Call):
+            continue
+        ctor = dotted_name(value.func)
+        if ctor not in _THREAD_LOCK_CTORS:
+            continue
+        for t in targets:
+            name = dotted_name(t)
+            if name:
+                symbols.add(name)
+    return symbols
+
+
+def _awaits_directly_in(body: list[ast.stmt]) -> ast.Await | None:
+    """First Await in ``body`` that is not inside a nested function/lambda."""
+
+    class V(ast.NodeVisitor):
+        found: ast.Await | None = None
+
+        def visit_Await(self, node: ast.Await) -> None:
+            if self.found is None:
+                self.found = node
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            pass  # different execution context
+
+        def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+            pass
+
+        def visit_Lambda(self, node: ast.Lambda) -> None:
+            pass
+
+    v = V()
+    for stmt in body:
+        v.visit(stmt)
+        if v.found is not None:
+            return v.found
+    return None
+
+
+@register
+class AwaitUnderThreadLock(Rule):
+    id = "TPL002"
+    name = "await-under-thread-lock"
+    summary = ("`await` while holding a threading.Lock (or acquiring one "
+               "from async code) can deadlock the event loop")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        locks = _lock_symbols(module)
+        if not locks:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    expr = item.context_expr
+                    # `with self._lock:` and `with self._lock.acquire():`
+                    target = expr.func if isinstance(expr, ast.Call) else expr
+                    if isinstance(target, ast.Attribute) \
+                            and target.attr in ("acquire", "locked"):
+                        target = target.value
+                    name = dotted_name(target)
+                    if name not in locks:
+                        continue
+                    awaited = _awaits_directly_in(node.body)
+                    if awaited is not None:
+                        yield self.finding(
+                            module, awaited,
+                            f"`await` inside `with {name}` — thread lock "
+                            "held across a suspension point; use "
+                            "`asyncio.Lock` or release before awaiting",
+                        )
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr == "acquire"):
+                    continue
+                name = dotted_name(func.value)
+                if name in locks and module.in_async_context(node):
+                    yield self.finding(
+                        module, node,
+                        f"thread lock `{name}.acquire()` called from async "
+                        "code; blocks the event loop — use `asyncio.Lock` "
+                        "or `asyncio.to_thread`",
+                    )

@@ -1,0 +1,111 @@
+"""TPL004 — Raft core state mutated outside the sans-io step functions.
+
+The consensus core (tpudfs/raft/core.py) is a pure state machine: ``term``,
+``voted_for``, ``log``, ``commit_index`` and ``last_applied`` change only
+inside its step functions, which emit the matching persistence effects
+(PersistHardState / AppendLog / TruncateLog). A write from the shell or any
+other layer bypasses that effect discipline — state diverges from what the
+WAL records, which is exactly the crash-recovery hole Raft's proof forbids.
+
+Heuristic: a write (assign, augmented assign, delete, subscript store, or a
+mutating method call like ``.append``/``.clear``) to one of the protected
+attributes on a receiver that names a Raft core — a dotted path whose final
+component is ``core``, ``_core``, ``raft`` or ``raft_core`` (``self.core``,
+``node.raft.core``, ...). tpudfs/raft/core.py itself is exempt: it IS the
+step-function home.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+PROTECTED_ATTRS = {
+    "term", "current_term", "voted_for", "log", "commit_index",
+    "last_applied", "role", "snapshot",
+}
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "sort",
+    "reverse", "update", "setdefault",
+}
+_CORE_TAILS = {"core", "_core", "raft", "_raft", "raft_core"}
+
+EXEMPT_MODULES = ("tpudfs/raft/core.py",)
+
+
+def _core_receiver(node: ast.AST) -> str | None:
+    """Dotted name of ``node`` if it plausibly denotes a RaftCore."""
+    name = dotted_name(node)
+    if not name:
+        return None
+    if name.split(".")[-1] in _CORE_TAILS:
+        return name
+    return None
+
+
+def _protected_target(node: ast.AST) -> tuple[str, str] | None:
+    """(receiver, attr) when ``node`` is ``<core>.<protected attr>`` or a
+    subscript thereof (``<core>.log[i]``)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if not isinstance(node, ast.Attribute):
+        return None
+    if node.attr not in PROTECTED_ATTRS:
+        return None
+    recv = _core_receiver(node.value)
+    if recv is None:
+        return None
+    return recv, node.attr
+
+
+@register
+class RaftStateMutation(Rule):
+    id = "TPL004"
+    name = "raft-state-mutation"
+    summary = ("Raft core state (term/voted_for/log/commit_index) mutated "
+               "outside raft/core.py — bypasses the persistence effects")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.rel_path in EXEMPT_MODULES:
+            return
+        for node in ast.walk(module.tree):
+            targets: list[ast.AST] = []
+            verb = "assignment to"
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+                verb = "deletion of"
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                hit = _protected_target(node.func.value)
+                if hit:
+                    recv, attr = hit
+                    yield self.finding(
+                        module, node,
+                        f"in-place mutation `{recv}.{attr}.{node.func.attr}"
+                        "(...)` outside raft/core.py — route through a core "
+                        "step function so the persistence effect is emitted",
+                    )
+                continue
+            for t in targets:
+                hit = _protected_target(t)
+                if hit:
+                    recv, attr = hit
+                    yield self.finding(
+                        module, node,
+                        f"{verb} Raft core state `{recv}.{attr}` outside "
+                        "raft/core.py — only core step functions may mutate "
+                        "consensus state (and must emit persistence effects)",
+                    )

@@ -1,0 +1,79 @@
+"""TPL007 — fire-and-forget ``asyncio.create_task``.
+
+CPython keeps only a WEAK reference to tasks: a task whose handle is dropped
+can be garbage-collected mid-flight, silently cancelling a replication
+forward, heartbeat loop or scrubber iteration. Dropped handles also lose the
+exception — the task dies, nobody logs it.
+
+Flagged:
+
+- ``asyncio.create_task(...)`` / ``asyncio.ensure_future(...)`` /
+  ``loop.create_task(...)`` as a bare expression statement;
+- the same assigned to ``_`` (explicitly discarded).
+
+Keep the handle (``self._task = asyncio.create_task(...)``), add it to a
+collection with a done-callback, or use structured concurrency
+(``asyncio.TaskGroup``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_SPAWN_EXACT = {"asyncio.create_task", "asyncio.ensure_future"}
+_SPAWN_ATTRS = {"create_task", "ensure_future"}
+
+
+def _is_spawn(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in _SPAWN_EXACT:
+        return name
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _SPAWN_ATTRS:
+        # loop.create_task / self._loop.create_task / tg.create_task —
+        # TaskGroup.create_task keeps its own strong reference, so exempt
+        # receivers that look like task groups.
+        receiver = dotted_name(call.func.value) or ""
+        tail = receiver.split(".")[-1].lstrip("_")
+        if tail in ("tg", "taskgroup", "task_group", "group"):
+            return None
+        return f"{receiver or '<expr>'}.{call.func.attr}"
+    return None
+
+
+@register
+class DroppedTaskHandle(Rule):
+    id = "TPL007"
+    name = "dropped-task-handle"
+    summary = ("fire-and-forget asyncio.create_task — a weakly-referenced "
+               "task can be GC'd mid-flight and its exception lost")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            call: ast.Call | None = None
+            if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+                call = node.value
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and all(isinstance(t, ast.Name) and t.id == "_"
+                            for t in node.targets):
+                call = node.value
+            if call is None:
+                continue
+            name = _is_spawn(call)
+            if name is None:
+                continue
+            yield self.finding(
+                module, node,
+                f"`{name}(...)` handle dropped — the event loop holds only "
+                "a weak reference, so the task can be GC'd mid-flight; keep "
+                "the handle and observe its result/exception",
+            )

@@ -1,0 +1,311 @@
+"""`cryptography`-or-fallback shim for the auth stack.
+
+The auth stack needs exactly three primitives: AES-256-GCM (SSE envelopes,
+STS session sealing), RS256 verify (OIDC), and RS256 sign/keygen (tests'
+fake identity provider). The `cryptography` wheel provides all three but is
+not installed in every image this repo must run in (TPU test containers are
+minimal). This module exports the same names and uses `cryptography` when
+importable; otherwise it falls back to:
+
+- **AES-GCM** via ctypes over the system libcrypto (OpenSSL's EVP API —
+  present wherever Python's ssl module works), and
+- **RSA PKCS#1 v1.5 / SHA-256** in pure Python (verify is one modexp with
+  e=65537; sign/keygen are test-only paths and use CRT + Miller-Rabin).
+
+Import surface (drop-in for the `cryptography` spellings used here)::
+
+    from tpudfs.auth.crypto_compat import (
+        AESGCM, InvalidTag, InvalidSignature, hashes, padding, rsa,
+    )
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import hashlib
+import hmac as _hmac
+import os as _os
+
+try:  # pragma: no cover - exercised only where the wheel exists
+    from cryptography.exceptions import InvalidSignature, InvalidTag
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        """AEAD authentication failed."""
+
+    class InvalidSignature(Exception):  # type: ignore[no-redef]
+        """Asymmetric signature verification failed."""
+
+    # ------------------------------------------------------------- AES-GCM
+
+    def _load_libcrypto() -> ctypes.CDLL:
+        candidates = []
+        found = ctypes.util.find_library("crypto")
+        if found:
+            candidates.append(found)
+        candidates += ["libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so",
+                       "libcrypto.dylib"]
+        last_err: Exception | None = None
+        for name in candidates:
+            try:
+                lib = ctypes.CDLL(name)
+                lib.EVP_CIPHER_CTX_new  # probe the EVP surface
+                return lib
+            except (OSError, AttributeError) as e:
+                last_err = e
+        raise ImportError(
+            f"neither `cryptography` nor a usable libcrypto found: {last_err}"
+        )
+
+    _lib = _load_libcrypto()
+    _lib.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+    _lib.EVP_CIPHER_CTX_free.argtypes = [ctypes.c_void_p]
+    for _fn in ("EVP_aes_128_gcm", "EVP_aes_192_gcm", "EVP_aes_256_gcm"):
+        getattr(_lib, _fn).restype = ctypes.c_void_p
+    _lib.EVP_CipherInit_ex.argtypes = [ctypes.c_void_p] * 5 + [ctypes.c_int]
+    _lib.EVP_CipherInit_ex.restype = ctypes.c_int
+    _lib.EVP_CIPHER_CTX_ctrl.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+    ]
+    _lib.EVP_CIPHER_CTX_ctrl.restype = ctypes.c_int
+    _lib.EVP_CipherUpdate.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.c_void_p, ctypes.c_int,
+    ]
+    _lib.EVP_CipherUpdate.restype = ctypes.c_int
+    _lib.EVP_CipherFinal_ex.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_int),
+    ]
+    _lib.EVP_CipherFinal_ex.restype = ctypes.c_int
+
+    _EVP_CTRL_GCM_SET_IVLEN = 0x9
+    _EVP_CTRL_GCM_GET_TAG = 0x10
+    _EVP_CTRL_GCM_SET_TAG = 0x11
+    _TAG_LEN = 16
+
+    class AESGCM:  # type: ignore[no-redef]
+        """AES-GCM via the system libcrypto, API-compatible with
+        cryptography.hazmat.primitives.ciphers.aead.AESGCM."""
+
+        _CIPHERS = {16: "EVP_aes_128_gcm", 24: "EVP_aes_192_gcm",
+                    32: "EVP_aes_256_gcm"}
+
+        def __init__(self, key: bytes):
+            if len(key) not in self._CIPHERS:
+                raise ValueError("AESGCM key must be 128, 192, or 256 bits")
+            self._key = bytes(key)
+            self._cipher = ctypes.c_void_p(
+                getattr(_lib, self._CIPHERS[len(key)])()
+            )
+
+        @staticmethod
+        def generate_key(bit_length: int) -> bytes:
+            if bit_length not in (128, 192, 256):
+                raise ValueError("bit_length must be 128, 192 or 256")
+            return _os.urandom(bit_length // 8)
+
+        def _run(self, nonce: bytes, data: bytes, aad: bytes | None,
+                 encrypt: bool, tag: bytes | None) -> tuple[bytes, bytes]:
+            if not 8 <= len(nonce) <= 128:
+                raise ValueError("nonce must be between 8 and 128 bytes")
+            ctx = ctypes.c_void_p(_lib.EVP_CIPHER_CTX_new())
+            if not ctx:
+                raise MemoryError("EVP_CIPHER_CTX_new failed")
+            enc = 1 if encrypt else 0
+            try:
+                if _lib.EVP_CipherInit_ex(ctx, self._cipher, None, None,
+                                          None, enc) != 1:
+                    raise RuntimeError("cipher init failed")
+                if _lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_SET_IVLEN,
+                                            len(nonce), None) != 1:
+                    raise RuntimeError("set ivlen failed")
+                if _lib.EVP_CipherInit_ex(ctx, None, None, self._key,
+                                          nonce, enc) != 1:
+                    raise RuntimeError("key/nonce init failed")
+                outl = ctypes.c_int(0)
+                if aad:
+                    if _lib.EVP_CipherUpdate(ctx, None, ctypes.byref(outl),
+                                             aad, len(aad)) != 1:
+                        raise RuntimeError("aad update failed")
+                out = ctypes.create_string_buffer(len(data) + 16)
+                total = 0
+                if data:
+                    if _lib.EVP_CipherUpdate(ctx, out, ctypes.byref(outl),
+                                             data, len(data)) != 1:
+                        raise RuntimeError("update failed")
+                    total = outl.value
+                if not encrypt:
+                    tagbuf = ctypes.create_string_buffer(bytes(tag or b""),
+                                                         _TAG_LEN)
+                    if _lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_SET_TAG,
+                                                _TAG_LEN, tagbuf) != 1:
+                        raise RuntimeError("set tag failed")
+                fin = ctypes.create_string_buffer(16)
+                if _lib.EVP_CipherFinal_ex(ctx, fin,
+                                           ctypes.byref(outl)) != 1:
+                    raise InvalidTag("authentication failed")
+                total += outl.value
+                out_tag = b""
+                if encrypt:
+                    tagbuf = ctypes.create_string_buffer(_TAG_LEN)
+                    if _lib.EVP_CIPHER_CTX_ctrl(ctx, _EVP_CTRL_GCM_GET_TAG,
+                                                _TAG_LEN, tagbuf) != 1:
+                        raise RuntimeError("get tag failed")
+                    out_tag = tagbuf.raw
+                return out.raw[:total], out_tag
+            finally:
+                _lib.EVP_CIPHER_CTX_free(ctx)
+
+        def encrypt(self, nonce: bytes, data: bytes,
+                    associated_data: bytes | None) -> bytes:
+            ct, tag = self._run(nonce, data, associated_data, True, None)
+            return ct + tag
+
+        def decrypt(self, nonce: bytes, data: bytes,
+                    associated_data: bytes | None) -> bytes:
+            if len(data) < _TAG_LEN:
+                raise InvalidTag("ciphertext shorter than tag")
+            ct, tag = data[:-_TAG_LEN], data[-_TAG_LEN:]
+            pt, _ = self._run(nonce, ct, associated_data, False, tag)
+            return pt
+
+    # ----------------------------------------------------- RSA / RS256
+
+    class hashes:  # type: ignore[no-redef]  # noqa: N801 - mirrors cryptography
+        class SHA256:
+            name = "sha256"
+            digest_size = 32
+
+    class padding:  # type: ignore[no-redef]  # noqa: N801
+        class PKCS1v15:
+            name = "EMSA-PKCS1-v1_5"
+
+    # DigestInfo prefix for SHA-256 (RFC 8017 §9.2 note 1).
+    _SHA256_PREFIX = bytes.fromhex(
+        "3031300d060960864801650304020105000420"
+    )
+
+    def _emsa_pkcs1v15_sha256(message: bytes, em_len: int) -> bytes:
+        t = _SHA256_PREFIX + hashlib.sha256(message).digest()
+        if em_len < len(t) + 11:
+            raise ValueError("intended encoded message length too short")
+        return b"\x00\x01" + b"\xff" * (em_len - len(t) - 3) + b"\x00" + t
+
+    _SMALL_PRIMES = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43,
+                     47, 53, 59, 61, 67, 71, 73, 79, 83, 89, 97]
+
+    def _is_probable_prime(n: int, rounds: int = 40) -> bool:
+        if n < 2:
+            return False
+        for p in _SMALL_PRIMES:
+            if n % p == 0:
+                return n == p
+        d, r = n - 1, 0
+        while d % 2 == 0:
+            d //= 2
+            r += 1
+        rng = _os.urandom
+        for _ in range(rounds):
+            a = int.from_bytes(rng(32), "big") % (n - 3) + 2
+            x = pow(a, d, n)
+            if x in (1, n - 1):
+                continue
+            for _ in range(r - 1):
+                x = pow(x, 2, n)
+                if x == n - 1:
+                    break
+            else:
+                return False
+        return True
+
+    def _gen_prime(bits: int, e: int) -> int:
+        while True:
+            cand = int.from_bytes(_os.urandom(bits // 8), "big")
+            cand |= (1 << (bits - 1)) | (1 << (bits - 2)) | 1
+            if not _is_probable_prime(cand):
+                continue
+            if cand % e == 1:  # gcd(e, p-1) must be 1 for e prime
+                continue
+            return cand
+
+    class rsa:  # type: ignore[no-redef]  # noqa: N801 - mirrors cryptography
+        class RSAPublicNumbers:
+            def __init__(self, e: int, n: int):
+                self.e = e
+                self.n = n
+
+            def public_key(self) -> "rsa._PublicKey":
+                return rsa._PublicKey(self.e, self.n)
+
+        class _PublicKey:
+            def __init__(self, e: int, n: int):
+                self._e = e
+                self._n = n
+                self._k = (n.bit_length() + 7) // 8
+
+            def public_numbers(self) -> "rsa.RSAPublicNumbers":
+                return rsa.RSAPublicNumbers(self._e, self._n)
+
+            def verify(self, signature: bytes, message: bytes,
+                       pad=None, algorithm=None) -> None:
+                if len(signature) != self._k:
+                    raise InvalidSignature("bad signature length")
+                s = int.from_bytes(signature, "big")
+                if s >= self._n:
+                    raise InvalidSignature("signature out of range")
+                em = pow(s, self._e, self._n).to_bytes(self._k, "big")
+                try:
+                    expected = _emsa_pkcs1v15_sha256(message, self._k)
+                except ValueError as exc:
+                    raise InvalidSignature(str(exc)) from exc
+                if not _hmac.compare_digest(em, expected):
+                    raise InvalidSignature("signature mismatch")
+
+        class _PrivateKey:
+            def __init__(self, p: int, q: int, e: int):
+                self._p, self._q, self._e = p, q, e
+                self._n = p * q
+                lam = (p - 1) * (q - 1)
+                self._d = pow(e, -1, lam)
+                self._dp = self._d % (p - 1)
+                self._dq = self._d % (q - 1)
+                self._qinv = pow(q, -1, p)
+                self._k = (self._n.bit_length() + 7) // 8
+
+            def public_key(self) -> "rsa._PublicKey":
+                return rsa._PublicKey(self._e, self._n)
+
+            def sign(self, message: bytes, pad=None,
+                     algorithm=None) -> bytes:
+                m = int.from_bytes(
+                    _emsa_pkcs1v15_sha256(message, self._k), "big"
+                )
+                # CRT: two half-size modexps instead of one full-size.
+                m1 = pow(m, self._dp, self._p)
+                m2 = pow(m, self._dq, self._q)
+                h = (self._qinv * (m1 - m2)) % self._p
+                s = m2 + h * self._q
+                return s.to_bytes(self._k, "big")
+
+        @staticmethod
+        def generate_private_key(public_exponent: int = 65537,
+                                 key_size: int = 2048,
+                                 backend=None) -> "rsa._PrivateKey":
+            if key_size % 2 != 0 or key_size < 1024:
+                raise ValueError("key_size must be an even number >= 1024")
+            half = key_size // 2
+            while True:
+                p = _gen_prime(half, public_exponent)
+                q = _gen_prime(half, public_exponent)
+                if p == q:
+                    continue
+                n = p * q
+                if n.bit_length() == key_size:
+                    return rsa._PrivateKey(p, q, public_exponent)

@@ -15,9 +15,7 @@ import time
 from dataclasses import dataclass
 from typing import Any
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import padding, rsa
+from tpudfs.auth.crypto_compat import InvalidSignature, hashes, padding, rsa
 
 from tpudfs.auth.errors import AuthError
 

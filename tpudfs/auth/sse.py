@@ -15,8 +15,7 @@ from __future__ import annotations
 
 import os
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from tpudfs.auth.crypto_compat import AESGCM, InvalidTag
 
 MAGIC = b"SSE1"
 _HEADER_LEN = len(MAGIC) + 12 + 48 + 12

@@ -20,8 +20,7 @@ import os
 import time
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from tpudfs.auth.crypto_compat import AESGCM, InvalidTag
 
 from tpudfs.auth.errors import AuthError
 

@@ -288,7 +288,10 @@ class BlockStore:
         except FileNotFoundError:
             raise BlockNotFoundError(f"block {block_id} not found") from None
 
-    def read(self, block_id: str, offset: int = 0, length: int | None = None) -> bytes:
+    # Raw pread primitive: the verified variants (read_verified, verify_full,
+    # verify_range) layer on top of this; callers wanting verified bytes go
+    # through those.
+    def read(self, block_id: str, offset: int = 0, length: int | None = None) -> bytes:  # tpulint: disable=TPL005
         path = self.block_path(block_id)
         try:
             fd = os.open(path, os.O_RDONLY)

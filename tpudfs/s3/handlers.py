@@ -20,6 +20,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import logging
 import time
 import urllib.parse
 import uuid
@@ -30,6 +31,8 @@ from tpudfs.auth.bucket_policy import BucketPolicy
 from tpudfs.auth.sse import SseEngine, SseError
 from tpudfs.client.client import Client, DfsError
 from tpudfs.s3 import xml_types as xt
+
+logger = logging.getLogger(__name__)
 
 BUCKET_MARKER = ".bucket"
 POLICY_KEY = ".policy"
@@ -383,6 +386,8 @@ class S3Handlers:
         try:
             keys, quiet = xt.parse_delete_objects(body)
         except Exception:
+            logger.debug("rejecting malformed DeleteObjects body",
+                         exc_info=True)
             return _err("MalformedXML", "could not parse DeleteObjects body", 400)
         deleted, errors = [], []
         for key in keys:
@@ -568,6 +573,8 @@ class S3Handlers:
         try:
             requested = xt.parse_complete_multipart_upload(body)
         except Exception:
+            logger.debug("rejecting malformed CompleteMultipartUpload body",
+                         exc_info=True)
             return _err("MalformedXML", "could not parse CompleteMultipartUpload", 400)
         if not requested:
             return _err("InvalidRequest", "no parts in request", 400)
@@ -710,4 +717,5 @@ def _decode_token(token: str) -> str:
     try:
         return base64.urlsafe_b64decode(token.encode()).decode()
     except Exception:
+        logger.debug("ignoring undecodable continuation token %r", token)
         return ""

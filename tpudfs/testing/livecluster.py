@@ -30,7 +30,7 @@ def raft_state(addr: str) -> dict | None:
             f"http://127.0.0.1:{ops_port(addr)}/raft/state", timeout=2.0
         ) as r:
             return json.loads(r.read())
-    except Exception:
+    except (OSError, ValueError):  # URLError/timeouts and bad/partial JSON
         return None
 
 

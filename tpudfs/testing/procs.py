@@ -32,7 +32,7 @@ try:
     import ctypes as _ctypes
 
     _PRCTL = _ctypes.CDLL(None).prctl
-except Exception:  # non-Linux / no libc — best-effort only
+except (OSError, AttributeError):  # non-Linux / no libc — best-effort only
     _PRCTL = None
 
 

@@ -24,15 +24,24 @@ buffers. Here the DFS is a first-class `grain` random-access data source:
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 from typing import Any, Sequence
 
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 try:
     import grain
 
+    if not hasattr(grain, "MapDataset"):
+        # Some grain distributions install only the namespace package at the
+        # top level, with the real API one level down.
+        import grain.python as grain  # type: ignore[no-redef]
+
     _HAVE_GRAIN = True
+# tpulint: disable=TPL003  (optional-dependency import guard)
 except Exception:  # pragma: no cover - grain is installed in this image
     grain = None
     _HAVE_GRAIN = False
@@ -81,7 +90,8 @@ class _ClientLoop:
         try:
             self.run(self.client.close(), timeout=10.0)
         except Exception:
-            pass
+            logger.warning("DFS client close failed during infeed shutdown",
+                           exc_info=True)
         self._shutdown_loop()
 
     def _shutdown_loop(self) -> None:

@@ -15,6 +15,7 @@ the "chunk read into TPU HBM" path of BASELINE.json.
 from __future__ import annotations
 
 import asyncio
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,8 @@ from tpudfs.tpu.crc32c_pallas import (
     bytes_to_words,
     crc32c_chunks_device,
 )
+
+logger = logging.getLogger(__name__)
 
 
 class DeviceBlock:
@@ -461,6 +464,9 @@ class HbmReader:
             except Exception:
                 # Tiering move / stale location / rot: the general path
                 # handles probing, RPC fallback, and corruption retry.
+                logger.debug("local fast-path read of block %s failed; "
+                             "retrying via general path",
+                             block.get("block_id"), exc_info=True)
                 return await self.read_block_to_device(block, device,
                                                        verify=verify)
             db.source = block

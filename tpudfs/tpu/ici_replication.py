@@ -35,7 +35,16 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6: experimental namespace, check_rep spelling
+    from functools import wraps
+
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    @wraps(_shard_map_legacy)
+    def shard_map(f, *, check_vma=True, **kw):
+        return _shard_map_legacy(f, check_rep=check_vma, **kw)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpudfs.tpu.crc32c_pallas import WORDS_PER_CHUNK, crc32c_chunks_device

@@ -186,6 +186,8 @@ class ReadCombiner:
             flat = np.asarray(dev).reshape(-1)
             return bool(flat[0] == 7 and flat[-1] == 7)
         except Exception:
+            logger.debug("device round-buffer pooling probe failed; "
+                         "falling back to per-read allocs", exc_info=True)
             return False
 
     _POOL_PER_SHAPE = 3
@@ -205,6 +207,10 @@ class ReadCombiner:
 
     # ------------------------------------------------------------- staging
 
+    # Verification is LAZY by design: the DeviceBlock carries a pending
+    # on-device CRC32C fold that HbmReader.confirm resolves against
+    # expected_crc before any bytes are handed to the consumer.
+    # tpulint: disable=TPL005
     async def read(self, block: dict):
         """Stage one block; returns a lazily-verified DeviceBlock riding a
         DeviceBatch, or None when the block must take the general path."""

@@ -25,6 +25,7 @@ try:
     from torch.utils.data import Dataset
 
     _HAVE_TORCH = True
+# tpulint: disable=TPL003  (optional-dependency import guard)
 except Exception:  # pragma: no cover - torch is installed in this image
     torch = None
 

@@ -208,8 +208,10 @@ class IciWriteGroup:
             task.cancel()
             try:
                 await task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                logger.exception("write-group scheduler failed during stop")
         for q in self._queues:
             for p in q:
                 if not p.fut.done():
