@@ -46,7 +46,7 @@ def _bench_engine(engine: str, secs: float) -> list[dict]:
     if engine == "python":
         _force_python_fallback()
     else:
-        if native.get_lib() is None or not native.has_blockio():
+        if native.build_and_load() is None or not native.has_blockio():
             return [{"engine": engine, "error": "native engine unavailable"}]
 
     results = []

@@ -2,6 +2,7 @@
 """tpulint runner — thin wrapper so CI and humans share one entry point.
 
     python scripts/lint.py                # == python -m tpudfs.analysis
+    python scripts/lint.py --changed      # pre-commit: files changed vs main
     python scripts/lint.py --list-rules
     python scripts/lint.py --write-baseline
 """

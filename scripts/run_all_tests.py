@@ -202,8 +202,13 @@ def main() -> None:
     # tpulint: the distributed-systems-aware static analysis gate. Runs
     # BEFORE pytest so an event-loop stall or unverified read path fails
     # fast, with file:line output, instead of as a flaky live-cluster tier.
+    # The SARIF artifact makes lint results diffable across CI runs (and
+    # loadable in code-scanning viewers) the same way BENCH_*.json is.
     run("lint (tpulint static analysis)",
         [sys.executable, "-m", "tpudfs.analysis"])
+    run("lint (tpulint.sarif artifact)",
+        [sys.executable, "-m", "tpudfs.analysis",
+         "--format", "sarif", "--output", "tpulint.sarif", "-q"])
     if not args.skip_unit:
         run("unit + integration suite",
             [sys.executable, "-m", "pytest", "tests/", "-x", "-q"])

@@ -33,6 +33,14 @@ jax.config.update("jax_platforms", "cpu")
 # with Client(..., local_reads=True).
 os.environ.setdefault("TPUDFS_LOCAL_READS", "0")
 
+# Build (no-op when fresh) and load the native library once, up front.
+# get_lib() itself never runs make — it must stay safe to call from event
+# loops — so the test session is the synchronous context that guarantees an
+# edited native/*.cc is recompiled before anything dlopens a stale .so.
+from tpudfs.common import native  # noqa: E402
+
+native.build_and_load()
+
 
 def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj

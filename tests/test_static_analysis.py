@@ -420,3 +420,462 @@ def test_cli_exits_nonzero_on_new_finding(tmp_path, capsys):
     rc = lint_main(["--root", str(tmp_path), "--no-baseline", str(bad)])
     assert rc == 1
     assert "TPL004" in capsys.readouterr().out
+
+
+# ===================================================== interprocedural (v2)
+#
+# TPL010-TPL014 need a whole program, not a snippet: every fixture below is
+# a small multi-file tree linted through analyze_tree, so resolution runs
+# the same code path as the real gate (imports, self-type inference, string
+# constants, cross-module edges).
+
+from tpudfs.analysis.linter import analyze_tree, scan_suppressions  # noqa: E402
+
+SUPPRESSIONS = REPO / "tpudfs" / "analysis" / "suppressions.json"
+
+
+def lint_tree(tmp_path, files: dict, rules: list | None = None):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    selected = [all_rules()[r] for r in rules] if rules else None
+    return analyze_tree([tmp_path], tmp_path, selected)
+
+
+# ------------------------------------------------------------------ TPL010
+
+
+def test_tpl010_flags_transitive_blocking_across_files(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "util.py": """
+            import time
+            def fetch_meta(req):
+                return slow_probe(req)
+            def slow_probe(req):
+                time.sleep(0.2)
+                return req
+        """,
+        "handler.py": """
+            from util import fetch_meta
+            async def handle(req):
+                return fetch_meta(req)
+        """,
+    }, rules=["TPL010"])
+    assert rule_ids(findings) == ["TPL010"]
+    assert findings[0].path == "handler.py"
+    # The message names the whole chain down to the leaf.
+    for hop in ("handle", "fetch_meta", "slow_probe", "time.sleep"):
+        assert hop in findings[0].message
+
+
+def test_tpl010_resolves_methods_via_self_attr_types(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "store.py": """
+            import time
+            class Store:
+                def compact(self):
+                    time.sleep(1.0)
+        """,
+        "server.py": """
+            from store import Store
+            class Server:
+                def __init__(self):
+                    self.store = Store()
+                def maintain(self):
+                    self.store.compact()
+                async def on_tick(self):
+                    self.maintain()
+        """,
+    }, rules=["TPL010"])
+    assert rule_ids(findings) == ["TPL010"]
+    assert "Server.on_tick" in findings[0].message
+
+
+def test_tpl010_stops_at_thread_bridges_and_async_callees(tmp_path):
+    assert lint_tree(tmp_path, {
+        "util.py": """
+            import time
+            def slow():
+                time.sleep(1.0)
+        """,
+        "handler.py": """
+            import asyncio
+            from util import slow
+            async def ok(loop):
+                await asyncio.to_thread(slow)
+                await loop.run_in_executor(None, slow)
+            async def sub():
+                await ok(None)
+        """,
+    }, rules=["TPL010"]) == []
+
+
+# ------------------------------------------------------------------ TPL011
+
+
+def test_tpl011_flags_two_file_lock_cycle(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "alpha.py": """
+            import threading
+            import beta
+            LOCK_A = threading.Lock()
+            def take_a():
+                with LOCK_A:
+                    pass
+            def fwd():
+                with LOCK_A:
+                    beta.take_b()
+        """,
+        "beta.py": """
+            import threading
+            import alpha
+            LOCK_B = threading.Lock()
+            def take_b():
+                with LOCK_B:
+                    pass
+            def rev():
+                with LOCK_B:
+                    alpha.take_a()
+        """,
+    }, rules=["TPL011"])
+    assert rule_ids(findings) == ["TPL011"]
+    msg = findings[0].message
+    assert "lock-order inversion" in msg
+    assert "LOCK_A" in msg and "LOCK_B" in msg
+
+
+def test_tpl011_flags_slow_thread_lock_on_async_path(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "state.py": """
+            import threading, time
+            MU = threading.Lock()
+            def flush():
+                with MU:
+                    time.sleep(0.5)
+            def bump():
+                with MU:
+                    pass
+        """,
+        "loop.py": """
+            from state import bump
+            async def tick():
+                bump()
+        """,
+    }, rules=["TPL011"])
+    assert rule_ids(findings) == ["TPL011"]
+    assert "threading lock" in findings[0].message
+    assert "state.MU" in findings[0].message
+
+
+def test_tpl011_allows_fast_locks_and_consistent_order(tmp_path):
+    assert lint_tree(tmp_path, {
+        "state.py": """
+            import threading
+            MU = threading.Lock()
+            NEST = threading.Lock()
+            def bump():
+                with MU:
+                    with NEST:
+                        pass
+            def other():
+                with MU:
+                    with NEST:
+                        pass
+        """,
+        "loop.py": """
+            from state import bump
+            async def tick():
+                bump()
+        """,
+    }, rules=["TPL011"]) == []
+
+
+# ------------------------------------------------------------------ TPL012
+
+
+def test_tpl012_flags_method_name_typo_with_suggestion(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "server.py": """
+            SERVICE = "cs"
+            class Server:
+                def handlers(self) -> dict:
+                    return {
+                        "ReadBlock": self.rpc_read_block,
+                        "Stats": self.rpc_stats,
+                    }
+                def attach(self, server):
+                    server.add_service(SERVICE, self.handlers())
+                async def rpc_read_block(self, req):
+                    return {}
+                async def rpc_stats(self, req):
+                    return {}
+        """,
+        "client.py": """
+            CS = "cs"
+            class Client:
+                async def fetch(self, rpc, addr):
+                    return await rpc.call(addr, CS, "ReadBlok", {"x": 1})
+                async def stats(self, rpc, addr):
+                    return await rpc.call(addr, CS, "Stats", {})
+        """,
+    }, rules=["TPL012"])
+    assert rule_ids(findings) == ["TPL012"]
+    assert findings[0].path == "client.py"
+    assert "ReadBlok" in findings[0].message
+    assert "ReadBlock" in findings[0].message  # difflib suggestion
+
+
+def test_tpl012_flags_bad_handler_signature_and_unknown_ref(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "server.py": """
+            class Server:
+                def attach(self, server):
+                    server.add_service("cs", {
+                        "Wide": self.rpc_wide,
+                        "Gone": self.rpc_gone,
+                    })
+                async def rpc_wide(self, req, extra):
+                    return {}
+        """,
+    }, rules=["TPL012"])
+    msgs = " | ".join(f.message for f in findings)
+    assert rule_ids(findings) == ["TPL012", "TPL012"]
+    assert "exactly one request argument" in msgs
+    assert "does not resolve" in msgs
+
+
+def test_tpl012_skips_dynamic_methods_and_unknown_services(tmp_path):
+    assert lint_tree(tmp_path, {
+        "server.py": """
+            class Server:
+                def attach(self, server):
+                    server.add_service("cs", {"Ping": self.rpc_ping})
+                async def rpc_ping(self, req):
+                    return {}
+        """,
+        "client.py": """
+            class Client:
+                async def relay(self, rpc, addr, method):
+                    # dynamic method variable: no guess, no finding
+                    return await rpc.call(addr, "cs", method, {})
+                async def external(self, rpc, addr):
+                    # service not registered in this tree: out of scope
+                    return await rpc.call(addr, "s3", "PutObject", {})
+        """,
+    }, rules=["TPL012"]) == []
+
+
+# ------------------------------------------------------------------ TPL013
+
+
+def test_tpl013_flags_wrapper_over_declared_raw_read(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/chunkserver/store.py": """
+            class Store:
+                def read(self, block_id):  # tpulint: disable=TPL005
+                    return b"raw"
+                def read_verified(self, block_id):
+                    data = self.read(block_id)
+                    self.verify_crc32c(data)
+                    return data
+                def verify_crc32c(self, data):
+                    pass
+        """,
+        "tpudfs/client/cache.py": """
+            from tpudfs.chunkserver.store import Store
+            class ReadCache:
+                def __init__(self):
+                    self.store = Store()
+                def read_cached(self, block_id):
+                    return self.store.read(block_id)
+        """,
+    }, rules=["TPL013"])
+    assert rule_ids(findings) == ["TPL013"]
+    assert findings[0].path == "tpudfs/client/cache.py"
+    assert "Store.read" in findings[0].message
+
+
+def test_tpl013_accepts_verified_hops(tmp_path):
+    assert lint_tree(tmp_path, {
+        "tpudfs/chunkserver/store.py": """
+            class Store:
+                def read(self, block_id):  # tpulint: disable=TPL005
+                    return b"raw"
+                def read_verified(self, block_id):
+                    data = self.read(block_id)
+                    self.verify_crc32c(data)
+                    return data
+                def verify_crc32c(self, data):
+                    pass
+        """,
+        "tpudfs/client/cache.py": """
+            from tpudfs.chunkserver.store import Store
+            class ReadCache:
+                def __init__(self):
+                    self.store = Store()
+                def read_ok(self, block_id):
+                    return self.store.read_verified(block_id)
+        """,
+    }, rules=["TPL013"]) == []
+
+
+# ------------------------------------------------------------------ TPL014
+
+
+def test_tpl014_flags_task_handle_dying_with_frame(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "spawner.py": """
+            import asyncio
+            async def fire(work):
+                task = asyncio.create_task(work())
+                return 1
+        """,
+    }, rules=["TPL014"])
+    assert rule_ids(findings) == ["TPL014"]
+    assert "task" in findings[0].message
+
+
+def test_tpl014_accepts_awaited_stored_or_registered_handles(tmp_path):
+    assert lint_tree(tmp_path, {
+        "spawner.py": """
+            import asyncio
+            async def ok(work, registry):
+                t1 = asyncio.create_task(work())
+                await t1
+                t2 = asyncio.create_task(work())
+                registry.add(t2)
+                t3 = asyncio.create_task(work())
+                t3.cancel()
+                t4 = asyncio.create_task(work())
+                return t4
+        """,
+    }, rules=["TPL014"]) == []
+
+
+# ----------------------------------------------------- output formats, cache
+
+
+def test_sarif_and_json_output(tmp_path):
+    from tpudfs.analysis.output import render_json, render_sarif
+
+    (tmp_path / "mod.py").write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n")
+    result = run([tmp_path], tmp_path)
+    sarif = json.loads(render_sarif(result))
+    assert sarif["version"] == "2.1.0"
+    res = sarif["runs"][0]["results"]
+    assert len(res) == 1 and res[0]["ruleId"] == "TPL001"
+    assert res[0]["baselineState"] == "new"
+    assert res[0]["partialFingerprints"]["tpulint/v1"]
+    rules_meta = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"TPL010", "TPL011", "TPL012", "TPL013", "TPL014"} <= rules_meta
+
+    doc = json.loads(render_json(result))
+    assert doc["summary"]["new"] == 1
+    assert doc["new"][0]["rule"] == "TPL001"
+
+
+def test_cache_warm_run_matches_cold_and_invalidates_on_edit(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    cache = tmp_path / ".tpulint_cache.json"
+
+    cold = run([tmp_path], tmp_path, cache_path=cache)
+    assert cache.exists()
+    warm = run([tmp_path], tmp_path, cache_path=cache)
+    assert [f.fingerprint for f in warm.findings] == \
+        [f.fingerprint for f in cold.findings] and cold.findings
+
+    target.write_text("import asyncio\nasync def f():\n"
+                      "    await asyncio.sleep(1)\n")
+    fixed = run([tmp_path], tmp_path, cache_path=cache)
+    assert fixed.findings == []
+
+
+def test_full_tree_lint_warm_cache_under_ten_seconds():
+    import time as _time
+
+    cache = REPO / ".tpulint_cache.json"
+    run([REPO / "tpudfs"], REPO, cache_path=cache)  # prime
+    t0 = _time.monotonic()
+    result = run([REPO / "tpudfs"], REPO, baseline_path=BASELINE,
+                 cache_path=cache)
+    elapsed = _time.monotonic() - t0
+    assert not result.new
+    assert elapsed < 10.0, f"warm cached lint took {elapsed:.1f}s"
+
+
+# ------------------------------------------------ suppression inventory gate
+
+
+def test_suppression_inventory_and_baseline_have_not_grown():
+    """Tier-1 ratchet: suppressions and baseline only shrink. When a PR
+    legitimately removes entries, regenerate suppressions.json to lower
+    the ceiling; raising it needs the bar in docs/static-analysis.md."""
+    committed = json.loads(SUPPRESSIONS.read_text())
+    ceiling = committed["suppressions"]
+    current = scan_suppressions([REPO / "tpudfs"], REPO)
+    assert len(current) <= len(ceiling), (
+        "suppression inventory grew beyond the committed ceiling:\n"
+        + "\n".join(f"{s['path']}:{s['line']} {s['rules']}" for s in current)
+    )
+    allowed = {(s["path"], tuple(s["rules"])) for s in ceiling}
+    for s in current:
+        assert (s["path"], tuple(s["rules"])) in allowed, (
+            f"new suppression {s['path']}:{s['line']} {s['rules']} — fix the "
+            "finding instead, or make the case per docs/static-analysis.md"
+        )
+    baseline = load_baseline(BASELINE)
+    assert len(baseline) <= committed["baseline_size"]
+
+
+def test_scan_suppressions_reports_kind_and_rules(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "# tpulint: disable-file=TPL004\n"
+        "import time\n"
+        "time.sleep(0)  # tpulint: disable=TPL001,TPL002\n"
+    )
+    inv = scan_suppressions([tmp_path], tmp_path)
+    assert [(s["kind"], s["rules"]) for s in inv] == [
+        ("disable-file", ["TPL004"]),
+        ("disable", ["TPL001", "TPL002"]),
+    ]
+
+
+# ------------------------------------------------------------ --changed mode
+
+
+def test_changed_paths_lists_only_diverged_python_files(tmp_path):
+    import subprocess
+
+    def git(*a):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t.invalid", "-c", "user.name=t", *a],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    git("symbolic-ref", "HEAD", "refs/heads/main")
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    (tmp_path / "notes.txt").write_text("not python\n")
+    git("add", ".")
+    git("commit", "-qm", "init")
+    git("checkout", "-qb", "feature")
+    (tmp_path / "dirty.py").write_text("y = 2\n")
+    git("add", "dirty.py")
+    git("commit", "-qm", "feature work")
+    (tmp_path / "untracked.py").write_text("z = 3\n")
+
+    from tpudfs.analysis.cli import changed_paths
+
+    subset = changed_paths(tmp_path)
+    assert subset is not None
+    assert sorted(p.name for p in subset) == ["dirty.py", "untracked.py"]
+
+
+def test_changed_paths_degrades_to_none_outside_git(tmp_path):
+    from tpudfs.analysis.cli import changed_paths
+
+    assert changed_paths(tmp_path / "nowhere") is None

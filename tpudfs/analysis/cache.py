@@ -1,0 +1,176 @@
+"""Content-hash analysis cache for tpulint.
+
+The tier-1 gate lints the whole tree on every test run; the interprocedural
+pass (call graph + TPL010-TPL014) makes that meaningfully more expensive
+than PR 1's per-function rules. The cache keeps the common case — nothing
+changed since the last lint — at file-hash speed:
+
+- Every source file is keyed by ``sha256(source)``; its per-module findings
+  are stored post-suppression (suppressions are derived from the same
+  content, so content addressing is sound).
+- Project-rule findings are keyed by a tree hash over every (path, hash)
+  pair, because any edit anywhere can change the call graph.
+- Both are salted with a hash of ``tpudfs/analysis/**/*.py`` itself, so
+  editing a rule invalidates everything.
+
+Warm path (no edits): read + hash every file, return the stored findings —
+no parsing, no rule execution. One edited file re-runs its module rules and
+the project pass (which must re-parse the tree — the symbol table cannot be
+partially stale); everything else is served from the cache.
+
+The cache file lives at ``<root>/.tpulint_cache.json`` and is git-ignored;
+it is an optimization only, and any decode problem falls back to a full
+analysis and a rewrite.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+from typing import Iterable
+
+from tpudfs.analysis.linter import (
+    Finding,
+    ProjectRule,
+    _load_module,
+    _module_findings,
+    _project_findings,
+    all_rules,
+    iter_python_files,
+)
+
+CACHE_VERSION = 2
+
+DEFAULT_CACHE_NAME = ".tpulint_cache.json"
+
+_ANALYSIS_DIR = pathlib.Path(__file__).resolve().parent
+
+_salt_memo: str | None = None
+
+
+def rules_salt() -> str:
+    """Hash of the analyzer's own sources: rule edits invalidate the cache."""
+    global _salt_memo
+    if _salt_memo is None:
+        h = hashlib.sha256()
+        for p in sorted(_ANALYSIS_DIR.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            h.update(p.name.encode())
+            h.update(p.read_bytes())
+        _salt_memo = h.hexdigest()[:16]
+    return _salt_memo
+
+
+def _load(cache_path: pathlib.Path) -> dict:
+    try:
+        data = json.loads(cache_path.read_text())
+        if data.get("version") == CACHE_VERSION \
+                and data.get("salt") == rules_salt():
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"version": CACHE_VERSION, "salt": rules_salt(),
+            "files": {}, "project": {}}
+
+
+def _store(cache_path: pathlib.Path, data: dict) -> None:
+    try:
+        cache_path.write_text(json.dumps(data))
+    except OSError:
+        pass  # read-only checkout: the cache is an optimization only
+
+
+def analyze_tree_cached(
+    paths: Iterable[pathlib.Path],
+    root: pathlib.Path,
+    cache_path: pathlib.Path,
+) -> list[Finding]:
+    """Cache-assisted equivalent of :func:`~tpudfs.analysis.linter.
+    analyze_tree` for the full default rule set."""
+    rules = list(all_rules().values())
+    module_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+
+    # Hash every file up front; this is the whole cost of a warm hit.
+    file_list: list[tuple[pathlib.Path, str, str]] = []  # path, rel, hash
+    seen: set[pathlib.Path] = set()
+    for base in paths:
+        for path in iter_python_files(base):
+            resolved = path.resolve()
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            rel = resolved.relative_to(root.resolve()).as_posix()
+            try:
+                digest = hashlib.sha256(path.read_bytes()).hexdigest()
+            except OSError:
+                digest = ""
+            file_list.append((path, rel, digest))
+
+    tree_hash = hashlib.sha256(
+        "\n".join(f"{rel}\x1f{h}" for _, rel, h in
+                  sorted(file_list, key=lambda t: t[1])).encode()
+    ).hexdigest()
+
+    cache = _load(cache_path)
+    cached_files: dict = cache["files"]
+    project_entry: dict = cache["project"]
+
+    findings: list[Finding] = []
+    project_warm = project_entry.get("tree") == tree_hash
+    all_files_warm = all(
+        cached_files.get(rel, {}).get("hash") == digest and digest
+        for _, rel, digest in file_list
+    )
+
+    if project_warm and all_files_warm:
+        for _, rel, _h in file_list:
+            findings.extend(Finding.from_full_dict(d)
+                            for d in cached_files[rel]["findings"])
+        findings.extend(Finding.from_full_dict(d)
+                        for d in project_entry.get("findings", []))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
+
+    # Cold or partially warm: the project pass needs every module parsed,
+    # but unchanged files skip their module-rule execution.
+    modules = {}
+    new_files: dict = {}
+    for path, rel, digest in file_list:
+        module, errors = _load_module(path, root)
+        entry = cached_files.get(rel)
+        if entry is not None and entry.get("hash") == digest and digest:
+            per_file = [Finding.from_full_dict(d) for d in entry["findings"]]
+            new_files[rel] = entry
+        else:
+            per_file = list(errors)
+            if module is not None:
+                per_file.extend(_module_findings(module, module_rules))
+            new_files[rel] = {
+                "hash": digest,
+                "findings": [f.to_full_dict() for f in per_file],
+            }
+        findings.extend(per_file)
+        if module is not None:
+            modules[module.rel_path] = module
+
+    project_findings: list[Finding] = []
+    if project_rules and modules:
+        project_findings = _project_findings(modules, project_rules)
+    findings.extend(project_findings)
+
+    # Merge (don't replace): a subset run — `--changed` pre-commit lints —
+    # must not evict entries for files it didn't visit. Stale keys are
+    # harmless: content-addressed, never served unless the hash matches.
+    cached_files.update(new_files)
+    cache["files"] = cached_files
+    cache["project"] = {
+        "tree": tree_hash,
+        "findings": [f.to_full_dict() for f in project_findings],
+    }
+    _store(cache_path, cache)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

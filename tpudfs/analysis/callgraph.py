@@ -1,0 +1,467 @@
+"""Project-wide symbol table and call graph for tpulint's interprocedural
+rules (TPL010-TPL014).
+
+Per-function AST rules (TPL001-TPL007) see one module at a time; the bugs
+that dominate distributed-systems incident reports cross those boundaries: a
+``time.sleep`` three calls deep under an async handler, a lock-order
+inversion split across ``raft/node.py`` and ``common/rpc.py``, a client stub
+calling an RPC method the server never registered. This module gives rules a
+whole-program view:
+
+- :class:`Project` parses every module once (reusing :class:`ModuleInfo`)
+  and builds a symbol table of classes, methods, module functions and nested
+  functions, keyed by dotted qualified name.
+- Self-type inference: ``self.attr`` receivers resolve through attribute
+  types inferred from ``self.attr = Ctor(...)`` assignments and
+  ``self.attr: Ctor`` / class-body annotations, so ``self.store.read()``
+  edges into ``BlockStore.read``.
+- Call edges carry a ``kind``: ``"call"`` (same execution context),
+  ``"thread"`` (``asyncio.to_thread`` / ``loop.run_in_executor`` /
+  ``threading.Thread(target=...)`` — a worker thread, NOT the event loop)
+  and ``"task"`` (``asyncio.create_task``/``ensure_future`` — a new
+  coroutine on the loop). Reachability analyses propagate along ``"call"``
+  edges only; blocking work behind a ``"thread"`` edge is exactly the fix
+  the blocking rules recommend.
+
+Resolution is deliberately conservative: an edge exists only when the callee
+resolves to a function in the project. Dynamic dispatch, higher-order calls
+and external libraries produce no edge — interprocedural rules therefore err
+toward silence, never toward false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from tpudfs.analysis.linter import ModuleInfo, dotted_name
+
+__all__ = [
+    "CallEdge",
+    "ClassInfo",
+    "FunctionInfo",
+    "Project",
+    "module_qualname",
+]
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: asyncio bridges whose first argument runs on a worker thread.
+_THREAD_BRIDGES = {"asyncio.to_thread"}
+#: ``loop.run_in_executor(executor, fn, ...)`` — fn runs off-loop.
+_EXECUTOR_ATTRS = {"run_in_executor"}
+#: spawn points whose coroutine argument becomes a new loop task.
+_TASK_SPAWNS = {"create_task", "ensure_future"}
+
+
+def module_qualname(rel_path: str) -> str:
+    """``tpudfs/client/client.py`` -> ``tpudfs.client.client``;
+    ``tpudfs/raft/__init__.py`` -> ``tpudfs.raft``."""
+    parts = rel_path.split("/")
+    parts[-1] = parts[-1].removesuffix(".py")
+    if parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts)
+
+
+@dataclass
+class FunctionInfo:
+    """One function or method definition anywhere in the project."""
+
+    qualname: str  # "tpudfs.client.client.Client._read_ec_block"
+    module: ModuleInfo
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    cls: "ClassInfo | None" = None
+    #: outgoing edges, populated by Project._build_edges
+    calls: list["CallEdge"] = field(default_factory=list)
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def short(self) -> str:
+        """Human name for findings: drop the package prefix."""
+        return self.qualname.rsplit(".", 2)[-2] + "." + self.name \
+            if self.cls else self.name
+
+    def __hash__(self) -> int:
+        return id(self.node)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, FunctionInfo) and other.node is self.node
+
+
+@dataclass
+class ClassInfo:
+    qualname: str  # "tpudfs.chunkserver.blockstore.BlockStore"
+    module: ModuleInfo
+    node: ast.ClassDef
+    #: base-class dotted names as written (resolved lazily via imports)
+    bases: list[str] = field(default_factory=list)
+    methods: dict[str, FunctionInfo] = field(default_factory=dict)
+    #: ``self.attr`` -> class qualname, inferred from constructor calls and
+    #: annotations inside this class's body
+    attr_types: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class CallEdge:
+    caller: FunctionInfo
+    callee: FunctionInfo
+    site: ast.AST  # the Call node at the caller
+    kind: str  # "call" | "thread" | "task"
+
+
+class Project:
+    """Symbol table + call graph over a set of parsed modules."""
+
+    def __init__(self, modules: dict[str, ModuleInfo]):
+        #: rel_path -> ModuleInfo
+        self.modules = modules
+        #: dotted module name -> ModuleInfo
+        self.by_modname: dict[str, ModuleInfo] = {}
+        #: fully qualified name -> info
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        #: ast function node -> FunctionInfo (edge attribution)
+        self._func_by_node: dict[ast.AST, FunctionInfo] = {}
+        #: per module: local name -> imported dotted target
+        self._imports: dict[str, dict[str, str]] = {}
+        #: per module: module-level constant name -> string value
+        self._str_consts: dict[str, dict[str, str]] = {}
+        #: per module: module-level function name -> FunctionInfo
+        self._mod_funcs: dict[str, dict[str, FunctionInfo]] = {}
+        #: per function node: directly nested function name -> FunctionInfo
+        self._nested: dict[ast.AST, dict[str, FunctionInfo]] = {}
+
+        for mod in modules.values():
+            self._index_module(mod)
+        for mod in modules.values():
+            self._infer_attr_types(mod)
+        for mod in modules.values():
+            self._build_edges(mod)
+
+    # ------------------------------------------------------------- indexing
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        modname = module_qualname(mod.rel_path)
+        self.by_modname[modname] = mod
+        self._imports[modname] = imports = {}
+        self._str_consts[modname] = consts = {}
+        self._mod_funcs[modname] = mod_funcs = {}
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    if alias.asname is None:
+                        # `import a.b.c` binds `a`, but dotted uses of the
+                        # full path must also resolve.
+                        imports.setdefault(alias.name, alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_base(modname, node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    imports[alias.asname or alias.name] = \
+                        f"{base}.{alias.name}" if base else alias.name
+            elif isinstance(node, ast.Assign) and mod.parent(node) is mod.tree:
+                if isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            consts[t.id] = node.value.value
+
+        # Classes, methods, functions (including nested).
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                scope = mod.qualname(node)
+                qual = f"{modname}.{scope}"
+                info = ClassInfo(
+                    qualname=qual, module=mod, node=node,
+                    bases=[n for n in map(dotted_name, node.bases) if n],
+                )
+                self.classes[qual] = info
+            elif isinstance(node, _FUNC_NODES):
+                scope = mod.qualname(node)
+                qual = f"{modname}.{scope}"
+                finfo = FunctionInfo(qualname=qual, module=mod, node=node)
+                self.functions[qual] = finfo
+                self._func_by_node[node] = finfo
+                parent = mod.parent(node)
+                if isinstance(parent, ast.ClassDef):
+                    cls_qual = f"{modname}.{mod.qualname(parent)}"
+                    cls = self.classes.get(cls_qual)
+                    if cls is not None:
+                        finfo.cls = cls
+                        cls.methods[node.name] = finfo
+                elif parent is mod.tree:
+                    self._mod_funcs[modname][node.name] = finfo
+                elif isinstance(parent, _FUNC_NODES):
+                    self._nested.setdefault(parent, {})[node.name] = finfo
+
+    @staticmethod
+    def _import_base(modname: str, node: ast.ImportFrom) -> str | None:
+        if node.level == 0:
+            return node.module or ""
+        # Relative import: strip `level` trailing components of the package.
+        parts = modname.split(".")
+        if node.level > len(parts):
+            return None
+        base_parts = parts[: len(parts) - node.level]
+        if node.module:
+            base_parts.append(node.module)
+        return ".".join(base_parts)
+
+    def _infer_attr_types(self, mod: ModuleInfo) -> None:
+        modname = module_qualname(mod.rel_path)
+        for cls in self.classes.values():
+            if cls.module is not mod:
+                continue
+            for node in ast.walk(cls.node):
+                target = value = anno = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value, anno = node.target, node.value, \
+                        node.annotation
+                else:
+                    continue
+                name = dotted_name(target) if target is not None else None
+                if not name or not name.startswith("self.") \
+                        or name.count(".") != 1:
+                    # class-body annotation `attr: Foo` (dataclass style)
+                    if isinstance(target, ast.Name) \
+                            and mod.parent(node) is cls.node and anno:
+                        name = f"self.{target.id}"
+                    else:
+                        continue
+                attr = name.split(".", 1)[1]
+                resolved = None
+                if isinstance(value, ast.Call):
+                    resolved = self._resolve_class(modname, dotted_name(value.func))
+                if resolved is None and anno is not None:
+                    anno_name = dotted_name(anno)
+                    if anno_name is None and isinstance(anno, ast.Constant) \
+                            and isinstance(anno.value, str):
+                        anno_name = anno.value.strip("'\" ").split("|")[0].strip()
+                    resolved = self._resolve_class(modname, anno_name)
+                if resolved is not None:
+                    cls.attr_types.setdefault(attr, resolved.qualname)
+
+    # ----------------------------------------------------------- resolution
+
+    def _resolve_class(self, modname: str, name: str | None) -> ClassInfo | None:
+        if not name:
+            return None
+        qual = self._qualify(modname, name)
+        return self.classes.get(qual) if qual else None
+
+    def _qualify(self, modname: str, name: str) -> str | None:
+        """Fully qualify a dotted name as written in ``modname``."""
+        head, _, rest = name.partition(".")
+        imports = self._imports.get(modname, {})
+        if name in self.classes or name in self.functions:
+            return name
+        if head in imports:
+            target = imports[head]
+            return f"{target}.{rest}" if rest else target
+        local = f"{modname}.{name}"
+        if local in self.classes or local in self.functions:
+            return local
+        return None
+
+    def resolve_str_const(self, mod: ModuleInfo, node: ast.AST) -> str | None:
+        """String value of ``node``: a literal, a module-level constant, or
+        an imported module-level constant."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        name = dotted_name(node)
+        if not name:
+            return None
+        modname = module_qualname(mod.rel_path)
+        if name in self._str_consts.get(modname, {}):
+            return self._str_consts[modname][name]
+        qual = self._qualify(modname, name)
+        if qual and "." in qual:
+            owner, const = qual.rsplit(".", 1)
+            return self._str_consts.get(owner, {}).get(const)
+        return None
+
+    def class_of(self, fn: FunctionInfo) -> ClassInfo | None:
+        return fn.cls
+
+    def method_on(self, cls: ClassInfo, name: str,
+                  _depth: int = 0) -> FunctionInfo | None:
+        """Method lookup through the (project-resolvable) MRO."""
+        if name in cls.methods:
+            return cls.methods[name]
+        if _depth > 6:
+            return None
+        modname = module_qualname(cls.module.rel_path)
+        for base in cls.bases:
+            base_cls = self._resolve_class(modname, base)
+            if base_cls is not None:
+                hit = self.method_on(base_cls, name, _depth + 1)
+                if hit is not None:
+                    return hit
+        return None
+
+    def attr_class(self, cls: ClassInfo, attr: str) -> ClassInfo | None:
+        qual = cls.attr_types.get(attr)
+        if qual is not None:
+            return self.classes.get(qual)
+        modname = module_qualname(cls.module.rel_path)
+        for base in cls.bases:
+            base_cls = self._resolve_class(modname, base)
+            if base_cls is not None:
+                hit = self.attr_class(base_cls, attr)
+                if hit is not None:
+                    return hit
+        return None
+
+    def function_at(self, node: ast.AST) -> FunctionInfo | None:
+        return self._func_by_node.get(node)
+
+    def enclosing_function_info(self, mod: ModuleInfo,
+                                node: ast.AST) -> FunctionInfo | None:
+        """FunctionInfo of the innermost enclosing def/async def (lambdas
+        are transparent: a call inside a lambda is attributed to the lambda's
+        enclosing function)."""
+        cur = mod.parent(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                return self._func_by_node.get(cur)
+            cur = mod.parent(cur)
+        return None
+
+    def resolve_call(self, caller: FunctionInfo,
+                     func: ast.AST) -> FunctionInfo | None:
+        """Resolve the callee of ``func`` (a Call's .func, or a callable
+        reference passed to to_thread/run_in_executor) to a FunctionInfo."""
+        mod = caller.module
+        modname = module_qualname(mod.rel_path)
+        name = dotted_name(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+
+        # self.m(...) / cls.m(...) / self.attr.m(...)
+        if parts[0] in ("self", "cls") and caller.cls is not None:
+            if len(parts) == 2:
+                return self.method_on(caller.cls, parts[1])
+            if len(parts) == 3:
+                attr_cls = self.attr_class(caller.cls, parts[1])
+                if attr_cls is not None:
+                    return self.method_on(attr_cls, parts[2])
+            return None
+
+        # Bare name: nested defs (walking out), then module functions,
+        # then imports.
+        if len(parts) == 1:
+            cur: ast.AST | None = caller.node
+            while cur is not None:
+                hit = self._nested.get(cur, {}).get(name)
+                if hit is not None:
+                    return hit
+                cur = mod.parent(cur)
+                if not isinstance(cur, _FUNC_NODES):
+                    break
+            hit = self._mod_funcs.get(modname, {}).get(name)
+            if hit is not None:
+                return hit
+            qual = self._imports.get(modname, {}).get(name)
+            return self.functions.get(qual) if qual else None
+
+        # Dotted: local-variable constructor types, imported modules/classes.
+        local_cls = self._local_var_class(caller, parts[0])
+        if local_cls is not None and len(parts) == 2:
+            return self.method_on(local_cls, parts[1])
+        qual = self._qualify(modname, name)
+        if qual is None:
+            return None
+        if qual in self.functions:
+            return self.functions[qual]
+        # Imported-class method reference: `BlockStore.read`.
+        owner, _, meth = qual.rpartition(".")
+        cls = self.classes.get(owner)
+        if cls is not None:
+            return self.method_on(cls, meth)
+        return None
+
+    def _local_var_class(self, caller: FunctionInfo,
+                         var: str) -> ClassInfo | None:
+        """Type of a local assigned from a constructor inside ``caller``
+        (``store = BlockStore(...)``)."""
+        modname = module_qualname(caller.module.rel_path)
+        for node in ast.walk(caller.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == var \
+                    and isinstance(node.value, ast.Call):
+                return self._resolve_class(modname, dotted_name(node.value.func))
+        return None
+
+    # ---------------------------------------------------------- call edges
+
+    def _build_edges(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            caller = self.enclosing_function_info(mod, node)
+            if caller is None:
+                continue
+            name = dotted_name(node.func) or ""
+            kind = "call"
+            target: ast.AST | None = node.func
+
+            if name in _THREAD_BRIDGES and node.args:
+                kind, target = "thread", node.args[0]
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _EXECUTOR_ATTRS \
+                    and len(node.args) >= 2:
+                kind, target = "thread", node.args[1]
+            elif name == "threading.Thread" or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "Thread"):
+                kw = next((k.value for k in node.keywords
+                           if k.arg == "target"), None)
+                if kw is None:
+                    continue
+                kind, target = "thread", kw
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _TASK_SPAWNS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    kind, target = "task", arg.func
+                else:
+                    continue
+
+            if target is None:
+                continue
+            callee = self.resolve_call(caller, target)
+            if callee is None:
+                continue
+            caller.calls.append(
+                CallEdge(caller=caller, callee=callee, site=node, kind=kind)
+            )
+
+    # -------------------------------------------------------- reachability
+
+    def sync_call_edges(self, fn: FunctionInfo) -> Iterator[CallEdge]:
+        """Edges that stay in the caller's execution context (kind "call")
+        and land on a SYNC function — the propagation edges for
+        blocking/lock reachability from async code. Calling an async
+        function without awaiting creates a coroutine, it runs nothing;
+        awaited async callees are analyzed in their own right."""
+        for edge in fn.calls:
+            if edge.kind == "call" and not edge.callee.is_async:
+                yield edge

@@ -2,9 +2,19 @@
 
     python -m tpudfs.analysis                 # lint tpudfs/ against baseline
     python -m tpudfs.analysis path/to/file.py # lint specific paths
+    python -m tpudfs.analysis --format sarif  # SARIF 2.1.0 to stdout
+    python -m tpudfs.analysis --changed       # only files differing from
+                                              # `git merge-base HEAD main`
     python -m tpudfs.analysis --write-baseline
     python -m tpudfs.analysis --list-rules
     python -m tpudfs.analysis --no-baseline   # show grandfathered too
+
+Full-tree runs reuse a content-hash cache (``.tpulint_cache.json`` at the
+repo root, git-ignored) so the common nothing-changed case costs file
+hashing only; ``--no-cache`` forces a cold analysis. ``--changed`` is the
+fast pre-commit mode — note the interprocedural rules (TPL010-TPL014) then
+see only the changed files' call graph, so cross-file findings involving
+unchanged files surface in the next full run, not here.
 
 Exit codes: 0 clean (or fully baselined), 1 non-baselined findings,
 2 bad invocation.
@@ -14,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import pathlib
+import subprocess
 import sys
 
 from tpudfs.analysis import linter
@@ -43,9 +54,45 @@ def _parser() -> argparse.ArgumentParser:
                    help="print every registered rule and exit")
     p.add_argument("--rule", action="append", dest="rules", metavar="TPLxxx",
                    help="run only these rule ids (repeatable)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text",
+                   help="output format (default: human-readable text)")
+    p.add_argument("--output", type=pathlib.Path, metavar="FILE",
+                   help="write the report to FILE instead of stdout")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files differing from "
+                        "`git merge-base HEAD main` (fast pre-commit mode)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the content-hash analysis cache")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the summary line")
     return p
+
+
+def _git_lines(root: pathlib.Path, *args: str) -> list[str]:
+    out = subprocess.run(
+        ["git", *args], cwd=root, capture_output=True, text=True, check=True,
+    ).stdout
+    return [line for line in out.splitlines() if line.strip()]
+
+
+def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
+    """Python files differing from ``git merge-base HEAD main``, plus
+    untracked ones. None when git/merge-base is unavailable (detached
+    checkouts, exported trees) — the caller falls back to a full lint."""
+    try:
+        base = _git_lines(root, "merge-base", "HEAD", "main")[0]
+        names = _git_lines(root, "diff", "--name-only", base)
+        names += _git_lines(root, "ls-files", "--others",
+                            "--exclude-standard")
+    except (subprocess.CalledProcessError, OSError, IndexError):
+        return None
+    out = []
+    for name in sorted(set(names)):
+        p = root / name
+        if name.endswith(".py") and p.exists():
+            out.append(p)
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -69,6 +116,19 @@ def main(argv: list[str] | None = None) -> int:
         selected = [rules[r] for r in sorted(wanted)]
 
     paths = args.paths or [DEFAULT_TARGET]
+    if args.changed:
+        if args.paths:
+            print("--changed and explicit paths are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        subset = changed_paths(args.root)
+        if subset is not None:
+            if not subset:
+                if not args.quiet:
+                    print("tpulint: no python files changed since "
+                          "merge-base with main")
+                return 0
+            paths = subset
     for p in paths:
         if not p.exists():
             print(f"no such path: {p}", file=sys.stderr)
@@ -80,14 +140,43 @@ def main(argv: list[str] | None = None) -> int:
         print(f"wrote {len(findings)} finding(s) to {args.baseline}")
         return 0
 
+    # The cache is only sound for the full default rule set (a --rule
+    # subset would poison stored findings), so selection disables it.
+    cache_path = None
+    if not args.no_cache and selected is None:
+        cache_path = args.root / ".tpulint_cache.json"
+
     baseline = None if args.no_baseline else args.baseline
-    result = linter.run(paths, args.root, baseline, selected)
+    result = linter.run(paths, args.root, baseline, selected,
+                        cache_path=cache_path)
+
+    if args.format != "text":
+        from tpudfs.analysis import output as output_mod
+
+        if args.format == "json":
+            doc = output_mod.render_json(result)
+        else:
+            doc = output_mod.render_sarif(result)
+        if args.output is not None:
+            args.output.write_text(doc)
+            if not args.quiet:
+                print(f"tpulint: wrote {args.format} report "
+                      f"({len(result.new)} new, {len(result.baselined)} "
+                      f"baselined) to {args.output}")
+        else:
+            print(doc, end="")
+        return 1 if result.new else 0
 
     report = result.findings if args.no_baseline else result.new
-    for f in report:
-        print(f.render())
+    lines = [f.render() for f in report]
+    if args.output is not None:
+        args.output.write_text("\n".join(lines) + ("\n" if lines else ""))
+    else:
+        for line in lines:
+            print(line)
     if not args.quiet:
-        n_files = "" if args.paths else " across tpudfs/"
+        n_files = "" if args.paths and not args.changed else \
+            (" (changed files only)" if args.changed else " across tpudfs/")
         print(
             f"tpulint: {len(result.new)} new finding(s), "
             f"{len(result.baselined)} baselined{n_files}"

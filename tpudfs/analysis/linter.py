@@ -42,6 +42,7 @@ __all__ = [
     "Finding",
     "ModuleInfo",
     "Rule",
+    "ProjectRule",
     "register",
     "all_rules",
     "analyze_file",
@@ -49,6 +50,7 @@ __all__ = [
     "load_baseline",
     "write_baseline",
     "run",
+    "scan_suppressions",
 ]
 
 
@@ -89,6 +91,25 @@ class Finding:
             "scope": self.scope,
             "message": self.message,
         }
+
+    def to_full_dict(self) -> dict:
+        """Lossless form (cache / --format json round-trips)."""
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "scope": self.scope,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint,
+        }
+
+    @classmethod
+    def from_full_dict(cls, d: dict) -> "Finding":
+        return cls(rule=d["rule"], path=d["path"], line=d["line"],
+                   col=d["col"], message=d["message"], scope=d["scope"],
+                   snippet=d.get("snippet", ""))
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +254,22 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """A rule that needs the whole program: symbol table, call graph, RPC
+    contract tables (see tpudfs/analysis/callgraph.py). ``check`` is a
+    no-op so project rules compose transparently with the per-module
+    driver; the tree driver calls ``check_project`` once with a
+    :class:`~tpudfs.analysis.callgraph.Project` built from every linted
+    module. Line suppressions and the baseline apply exactly as for
+    per-module rules."""
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
 _REGISTRY: dict[str, Rule] = {}
 
 
@@ -260,26 +297,68 @@ def all_rules() -> dict[str, Rule]:
 DEFAULT_EXCLUDE = ("__pycache__",)
 
 
+def _load_module(
+    path: pathlib.Path, root: pathlib.Path
+) -> tuple[ModuleInfo | None, list[Finding]]:
+    """Parse one file; unreadable/unparseable sources become TPL000."""
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    try:
+        source = path.read_text(encoding="utf-8")
+    except (OSError, UnicodeDecodeError) as e:
+        return None, [Finding("TPL000", rel, 0, 0,
+                              f"unreadable source: {e}", "")]
+    try:
+        return ModuleInfo(path, rel, source), []
+    except SyntaxError as e:
+        return None, [Finding("TPL000", rel, e.lineno or 0, 0,
+                              f"syntax error: {e.msg}", "")]
+
+
+def _module_findings(module: ModuleInfo,
+                     rules: Iterable[Rule]) -> list[Finding]:
+    findings: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(module):
+            if not module.suppressed(f.rule, f.line):
+                findings.append(f)
+    return findings
+
+
+def _project_findings(modules: dict[str, ModuleInfo],
+                      rules: Iterable[Rule]) -> list[Finding]:
+    from tpudfs.analysis.callgraph import Project  # deferred: import cycle
+
+    project = Project(modules)
+    findings: list[Finding] = []
+    for rule in rules:
+        for f in rule.check_project(project):
+            mod = modules.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    return findings
+
+
 def analyze_file(
     path: pathlib.Path,
     root: pathlib.Path,
     rules: Iterable[Rule] | None = None,
 ) -> list[Finding]:
-    rel = path.resolve().relative_to(root.resolve()).as_posix()
-    try:
-        source = path.read_text(encoding="utf-8")
-    except (OSError, UnicodeDecodeError) as e:
-        return [Finding("TPL000", rel, 0, 0, f"unreadable source: {e}", "")]
-    try:
-        module = ModuleInfo(path, rel, source)
-    except SyntaxError as e:
-        return [Finding("TPL000", rel, e.lineno or 0, 0,
-                        f"syntax error: {e.msg}", "")]
-    findings: list[Finding] = []
-    for rule in rules if rules is not None else all_rules().values():
-        for f in rule.check(module):
-            if not module.suppressed(f.rule, f.line):
-                findings.append(f)
+    """Lint a single file. Project rules see a one-module project — the
+    right semantics for fixtures; tree lints use :func:`analyze_tree`."""
+    module, errors = _load_module(path, root)
+    if module is None:
+        return errors
+    rules = list(rules) if rules is not None else list(all_rules().values())
+    findings = _module_findings(
+        module, [r for r in rules if not isinstance(r, ProjectRule)]
+    )
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+    if project_rules:
+        findings.extend(
+            _project_findings({module.rel_path: module}, project_rules)
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
@@ -300,13 +379,65 @@ def analyze_tree(
     root: pathlib.Path,
     rules: Iterable[Rule] | None = None,
 ) -> list[Finding]:
+    """Lint a tree: per-module rules file by file, then project rules over
+    the whole call graph. For cached runs see tpudfs/analysis/cache.py."""
     rules = list(rules) if rules is not None else list(all_rules().values())
+    module_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+
+    seen: set[pathlib.Path] = set()
     findings: list[Finding] = []
+    modules: dict[str, ModuleInfo] = {}
     for base in paths:
         for path in iter_python_files(base):
-            findings.extend(analyze_file(path, root, rules))
+            resolved = path.resolve()
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            module, errors = _load_module(path, root)
+            findings.extend(errors)
+            if module is None:
+                continue
+            modules[module.rel_path] = module
+            findings.extend(_module_findings(module, module_rules))
+    if project_rules and modules:
+        findings.extend(_project_findings(modules, project_rules))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def scan_suppressions(
+    paths: Iterable[pathlib.Path], root: pathlib.Path
+) -> list[dict]:
+    """Every ``# tpulint: disable``/``disable-file`` comment in the tree,
+    as ``{"path", "line", "kind", "rules"}`` — the raw material for the
+    suppression-inventory gate (tpudfs/analysis/suppressions.json)."""
+    out: list[dict] = []
+    for base in paths:
+        for path in iter_python_files(base):
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+            try:
+                text = path.read_text(encoding="utf-8")
+            except (OSError, UnicodeDecodeError):
+                continue
+            for lineno, line in enumerate(text.splitlines(), start=1):
+                m = _SUPPRESS_RE.search(line)
+                if not m:
+                    continue
+                # Doc examples quote the grammar in backticks; those are
+                # not live suppressions.
+                if m.start() > 0 and line[m.start() - 1] == "`":
+                    continue
+                out.append({
+                    "path": rel,
+                    "line": lineno,
+                    "kind": m.group(1),
+                    "rules": sorted(
+                        r.strip().upper()
+                        for r in m.group(2).split(",") if r.strip()
+                    ),
+                })
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -350,8 +481,15 @@ def run(
     root: pathlib.Path,
     baseline_path: pathlib.Path | None = None,
     rules: Iterable[Rule] | None = None,
+    cache_path: pathlib.Path | None = None,
 ) -> RunResult:
-    findings = analyze_tree(paths, root, rules)
+    if cache_path is not None and rules is None:
+        # Content-hash cache is only sound for the full default rule set.
+        from tpudfs.analysis.cache import analyze_tree_cached
+
+        findings = analyze_tree_cached(paths, root, cache_path)
+    else:
+        findings = analyze_tree(paths, root, rules)
     baseline = load_baseline(baseline_path) if baseline_path else set()
     result = RunResult(findings=findings)
     seen: set[str] = set()

@@ -1,0 +1,86 @@
+"""Machine-readable tpulint output: ``--format json`` and ``--format sarif``.
+
+JSON is the stable programmatic surface (one object, full finding dicts).
+SARIF 2.1.0 is the interchange format CI viewers understand (GitHub code
+scanning, VS Code SARIF viewer); ``scripts/run_all_tests.py`` drops a
+``tpulint.sarif`` artifact per run so lint regressions are diffable across
+CI runs the same way BENCH_*.json series are.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpudfs.analysis.linter import Finding, RunResult, all_rules
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_json(result: RunResult, *, baselined: bool = True) -> str:
+    payload = {
+        "tool": "tpulint",
+        "new": [f.to_full_dict() for f in result.new],
+        "baselined": [f.to_full_dict() for f in result.baselined]
+        if baselined else [],
+        "stale_baseline": sorted(result.stale_baseline),
+        "summary": {
+            "new": len(result.new),
+            "baselined": len(result.baselined),
+            "stale_baseline": len(result.stale_baseline),
+        },
+    }
+    return json.dumps(payload, indent=2) + "\n"
+
+
+def _sarif_result(f: Finding, *, baselined: bool) -> dict:
+    return {
+        "ruleId": f.rule,
+        "level": "note" if baselined else "error",
+        "message": {"text": f.message},
+        "partialFingerprints": {"tpulint/v1": f.fingerprint},
+        "baselineState": "unchanged" if baselined else "new",
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {
+                    "startLine": max(f.line, 1),
+                    "startColumn": max(f.col + 1, 1),
+                },
+            },
+            "logicalLocations": [{"fullyQualifiedName": f.scope or
+                                  "<module>"}],
+        }],
+    }
+
+
+def render_sarif(result: RunResult) -> str:
+    rules_meta = [
+        {
+            "id": rule.id,
+            "name": rule.name,
+            "shortDescription": {"text": rule.summary},
+        }
+        for rule in all_rules().values()
+    ]
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "tpulint",
+                    "informationUri":
+                        "docs/static-analysis.md",
+                    "rules": rules_meta,
+                }
+            },
+            "results": [
+                *(_sarif_result(f, baselined=False) for f in result.new),
+                *(_sarif_result(f, baselined=True)
+                  for f in result.baselined),
+            ],
+        }],
+    }
+    return json.dumps(doc, indent=2) + "\n"

@@ -13,4 +13,10 @@ from tpudfs.analysis.rules import (  # noqa: F401
     checksum,
     determinism,
     tasks,
+    # Interprocedural rules (call-graph backed, see tpudfs/analysis/callgraph.py)
+    transitive,
+    lock_order,
+    rpc_contract,
+    checksum_taint,
+    task_escape,
 )

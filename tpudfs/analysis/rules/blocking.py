@@ -51,6 +51,22 @@ BLOCKING_METHODS = {
 }
 
 
+def blocking_call(node: ast.Call) -> tuple[str, str] | None:
+    """(what, hint) when ``node`` is a call that blocks the calling thread,
+    per the tables above. Shared with TPL010's transitive analysis."""
+    name = dotted_name(node.func)
+    if name in BLOCKING_CALLS:
+        return name, BLOCKING_CALLS[name]
+    if name:
+        for prefix, hint in BLOCKING_PREFIXES.items():
+            if name.startswith(prefix):
+                return name, hint
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in BLOCKING_METHODS:
+        return f".{node.func.attr}(...)", "wrap in `await asyncio.to_thread(...)`"
+    return None
+
+
 @register
 class BlockingCallInAsync(Rule):
     id = "TPL001"
@@ -64,22 +80,10 @@ class BlockingCallInAsync(Rule):
                 continue
             if not module.in_async_context(node):
                 continue
-            name = dotted_name(node.func)
-            hint = None
-            what = name
-            if name in BLOCKING_CALLS:
-                hint = BLOCKING_CALLS[name]
-            elif name:
-                for prefix, h in BLOCKING_PREFIXES.items():
-                    if name.startswith(prefix):
-                        hint = h
-                        break
-            if hint is None and isinstance(node.func, ast.Attribute) \
-                    and node.func.attr in BLOCKING_METHODS:
-                what = f".{node.func.attr}(...)"
-                hint = "wrap in `await asyncio.to_thread(...)`"
-            if hint is None:
+            hit = blocking_call(node)
+            if hit is None:
                 continue
+            what, hint = hit
             yield self.finding(
                 module, node,
                 f"blocking call `{what}` in async function; {hint}",

@@ -53,6 +53,15 @@ _CORRUPTION_ERRORS = {"BlockCorruptionError", "ChecksumError", "CorruptionError"
 _RAW_RECEIVERS = {"os", "io", "socket", "struct", "mmap", "f", "fh", "fd",
                   "file", "fp", "buf", "reader"}
 
+#: RPC methods whose server-side handler verifies the sidecar CRC32C before
+#: the bytes leave the chunkserver (rpc_read_block raises
+#: BlockCorruptionError on mismatch; TPL012 cross-checks the method name
+#: exists). A client-side call passing one of these as a string argument to
+#: a ``*call``-named helper is delegation to a verified read. ``ReadBlocks``
+#: (the batch path) is deliberately absent — its payloads ship unverified
+#: and every consumer re-verifies per-slot.
+_VERIFIED_RPC_METHODS = {"ReadBlock"}
+
 
 def _is_read_name(name: str) -> bool:
     return bool(_READ_NAME.search(name))
@@ -129,6 +138,14 @@ def _delegates(fn: ast.AST) -> bool:
             continue
         if _read_callable_ref(func):
             return True
+        # RPC delegation: `self._data_call(addr, "ReadBlock", req)` /
+        # `rpc.call(addr, CS, "ReadBlock", req)` — the named server handler
+        # verifies before responding.
+        if isinstance(func, ast.Attribute) and func.attr.endswith("call"):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and arg.value in _VERIFIED_RPC_METHODS:
+                    return True
     return False
 
 

@@ -1,0 +1,109 @@
+"""TPL013 — interprocedural checksum taint through the read path.
+
+TPL005 judges one function at a time and credits *any* delegation to a
+read-named callee, because it cannot see what that callee does. The gap:
+a wrapper that delegates to the **declared-raw** primitive —
+
+    def read_cached(self, block_id):
+        return self.store.read(block_id)   # raw pread, disable=TPL005
+
+— passes TPL005 on both sides (the wrapper delegates; the primitive is
+suppressed with justification), yet unverified bytes escape the data
+plane. That is precisely the bug class behind silent-corruption reads.
+
+This rule walks the resolved call graph instead of trusting names. A
+function whose ``# tpulint: disable=TPL005`` sits on its ``def`` line is
+*declared raw*: intentionally unverified, safe only under a verifying
+caller. For every other data-plane read function, taint propagates along
+resolved read-delegation edges (plain calls and ``to_thread``/executor
+bridges alike — threading changes where code runs, not whether bytes were
+checked): a function is flagged when it performs no verification of its
+own and some resolved chain reaches a declared-raw read with no
+verification anywhere between. The full chain appears in the message.
+
+Unresolved delegation stays TPL005's territory — no resolution, no
+finding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import FunctionInfo, Project
+from tpudfs.analysis.linter import Finding, ProjectRule, register
+from tpudfs.analysis.rules.checksum import (
+    DATA_PLANE_PREFIXES,
+    _has_verification,
+    _is_read_name,
+    _returns_value,
+)
+
+
+def _declared_raw(fn: FunctionInfo) -> bool:
+    return fn.module.suppressed("TPL005", fn.node.lineno)
+
+
+def _is_read_fn(fn: FunctionInfo) -> bool:
+    return _is_read_name(fn.name) and _returns_value(fn.node, fn.module)
+
+
+@register
+class ChecksumTaintEscape(ProjectRule):
+    id = "TPL013"
+    name = "checksum-taint-escape"
+    summary = ("data-plane read path resolves (transitively) to a "
+               "declared-raw read with no CRC32C verification on the way — "
+               "unverified bytes escape the data plane")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        #: fn -> chain down to the raw primitive, or None if clean
+        memo: dict[FunctionInfo, list[FunctionInfo] | None] = {}
+
+        def raw_chain(fn: FunctionInfo,
+                      stack: set[FunctionInfo]) -> list[FunctionInfo] | None:
+            """Chain from ``fn`` to a declared-raw read it taints from,
+            given that ``fn`` itself does not verify."""
+            if fn in memo:
+                return memo[fn]
+            if fn in stack:
+                return None
+            stack.add(fn)
+            result = None
+            for edge in fn.calls:
+                if edge.kind == "task":
+                    continue  # spawned readers return via their own awaiters
+                callee = edge.callee
+                if not _is_read_name(callee.name):
+                    continue
+                if _declared_raw(callee):
+                    result = [fn, callee]
+                    break
+                if _has_verification(callee.node):
+                    continue  # verified hop: taint stops here
+                sub = raw_chain(callee, stack)
+                if sub is not None:
+                    result = [fn] + sub
+                    break
+            stack.discard(fn)
+            memo[fn] = result
+            return result
+
+        for fn in project.functions.values():
+            if not fn.module.rel_path.startswith(DATA_PLANE_PREFIXES):
+                continue
+            if not _is_read_fn(fn) or _declared_raw(fn):
+                continue
+            if _has_verification(fn.node):
+                continue
+            chain = raw_chain(fn, set())
+            if chain is None:
+                continue
+            path = " -> ".join(f.short() for f in chain)
+            yield self.finding(
+                fn.module, fn.node,
+                f"read path `{fn.short()}` returns bytes from the "
+                f"declared-raw primitive `{chain[-1].short()}` "
+                f"({path}) with no checksum verification on the chain — "
+                "verify here, or route through a verified variant, or mark "
+                "this function raw on its `def` line with justification",
+            )

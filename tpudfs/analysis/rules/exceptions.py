@@ -10,8 +10,10 @@ Accepted evidence inside the handler body:
 - ``raise`` (bare or new exception);
 - a logging call — any ``logger.*`` / ``logging.*`` / ``self.log.*`` method
   (``debug`` through ``critical``/``exception``), or ``print`` (CLI surface);
-- error propagation — ``fut.set_exception(...)`` / ``callback(e)``-style
-  delivery via ``.set_exception``/``.set_result`` on a future;
+- error propagation — ``fut.set_exception(...)``/``.set_result`` on a
+  future, or handing the caught exception object itself to any callable
+  (``out.put(e)``, ``callback(e)``, ``errors.append(e)``) — the error
+  travels on for someone else to observe;
 - a telemetry update — calling ``.inc``/``.observe``/``.increment``, touching
   a dotted path containing ``metrics``/``stats``/``counter``, or an
   augmented assignment to such a path (``self.stats.failures += 1``).
@@ -66,6 +68,13 @@ def _has_evidence(handler: ast.ExceptHandler) -> bool:
     for node in ast.walk(handler):
         if isinstance(node, ast.Raise):
             return True
+        if isinstance(node, ast.Call) and handler.name:
+            # `except Exception as e: out.put(e)` — the exception object is
+            # handed to another party; that IS the propagation.
+            values = list(node.args) + [k.value for k in node.keywords]
+            if any(isinstance(v, ast.Name) and v.id == handler.name
+                   for v in values):
+                return True
         if isinstance(node, ast.AugAssign):
             if _counterish(dotted_name(node.target)):
                 return True

@@ -1,0 +1,394 @@
+"""TPL011 — lock-order inversion and thread-lock pressure on the event loop,
+detected across the whole project.
+
+TPL002 sees one module: an ``await`` under a ``threading.Lock`` in the same
+file. The deadlocks that survive review are split: ``raft/node.py`` takes
+lock A then calls into ``common/rpc.py`` which takes lock B, while another
+path takes B then A — no single file contains the cycle. This rule builds
+the project-wide lock-acquisition graph and reports:
+
+1. **Inversions** — a cycle in the held-lock -> acquired-lock graph, where
+   "acquired while held" includes acquisitions reached through any resolved
+   call chain from inside the ``with`` body. Both ``threading`` and
+   ``asyncio`` locks participate: ABBA between coroutines deadlocks just as
+   hard as between threads.
+2. **Thread locks on async paths** — an ``async def`` whose call chain
+   (or body) acquires a ``threading`` lock that is elsewhere held across an
+   ``await`` or a blocking call. Such a lock can be held for a long time,
+   so the event-loop thread can block on ``acquire`` — every coroutine on
+   the loop stalls, not just the caller. Short hand-off locks (never held
+   across slow work anywhere) are deliberately NOT flagged: guarding a few
+   assignments with a mutex from async code is harmless and common.
+
+Lock identity is the owning scope plus attribute (``pkg.mod.Class._mu`` /
+``pkg.mod.global_mu``), registered from ``threading.Lock()`` /
+``asyncio.Lock()``-style constructor assignments anywhere in the project.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import (
+    ClassInfo,
+    FunctionInfo,
+    Project,
+    module_qualname,
+)
+from tpudfs.analysis.linter import (
+    Finding,
+    ProjectRule,
+    dotted_name,
+    register,
+)
+from tpudfs.analysis.rules.blocking import blocking_call
+
+_THREAD_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+_ASYNC_CTORS = {
+    "asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclass
+class _Acq:
+    """One lock acquisition site."""
+
+    lock: str
+    kind: str  # "thread" | "async"
+    fn: FunctionInfo
+    site: ast.AST
+    body: list[ast.stmt] | None  # with-body when held as a context manager
+
+
+class _LockWorld:
+    """Registry + per-function acquisitions + transitive closures."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.locks: dict[str, str] = {}  # lock id -> kind
+        self.acqs: dict[FunctionInfo, list[_Acq]] = {}
+        self._closure_memo: dict[FunctionInfo, dict[str, list[str]]] = {}
+        self._slow_memo: dict[FunctionInfo, bool] = {}
+        self._register_locks()
+        for fn in project.functions.values():
+            self.acqs[fn] = list(self._function_acqs(fn))
+
+    # -- lock registry ------------------------------------------------------
+
+    def _register_locks(self) -> None:
+        for mod in self.project.modules.values():
+            modname = module_qualname(mod.rel_path)
+            for node in ast.walk(mod.tree):
+                value = None
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    value, targets = node.value, [node.target]
+                if not isinstance(value, ast.Call):
+                    continue
+                ctor = dotted_name(value.func)
+                if ctor in _THREAD_CTORS:
+                    kind = "thread"
+                elif ctor in _ASYNC_CTORS:
+                    kind = "async"
+                else:
+                    continue
+                for t in targets:
+                    name = dotted_name(t)
+                    if not name:
+                        continue
+                    if name.startswith("self.") and name.count(".") == 1:
+                        cls = self._enclosing_class(mod, node)
+                        if cls is None:
+                            continue
+                        lock_id = f"{cls.qualname}.{name.split('.', 1)[1]}"
+                    elif "." not in name:
+                        lock_id = f"{modname}.{name}"
+                    else:
+                        continue
+                    self.locks[lock_id] = kind
+
+    def _enclosing_class(self, mod, node: ast.AST) -> ClassInfo | None:
+        modname = module_qualname(mod.rel_path)
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return self.project.classes.get(
+                    f"{modname}.{mod.qualname(anc)}")
+        return None
+
+    # -- acquisition sites --------------------------------------------------
+
+    def resolve_lock(self, fn: FunctionInfo, expr: ast.AST) -> str | None:
+        """Lock id for a with-item / acquire receiver expression."""
+        target = expr.func if isinstance(expr, ast.Call) else expr
+        if isinstance(target, ast.Attribute) \
+                and target.attr in ("acquire", "locked"):
+            target = target.value
+        name = dotted_name(target)
+        if not name:
+            return None
+        parts = name.split(".")
+        candidates: list[str] = []
+        if parts[0] in ("self", "cls") and fn.cls is not None:
+            if len(parts) == 2:
+                candidates.append(f"{fn.cls.qualname}.{parts[1]}")
+                for base in fn.cls.bases:
+                    base_cls = self.project._resolve_class(
+                        module_qualname(fn.module.rel_path), base)
+                    if base_cls is not None:
+                        candidates.append(f"{base_cls.qualname}.{parts[1]}")
+            elif len(parts) == 3:
+                attr_cls = self.project.attr_class(fn.cls, parts[1])
+                if attr_cls is not None:
+                    candidates.append(f"{attr_cls.qualname}.{parts[2]}")
+        elif len(parts) == 1:
+            candidates.append(
+                f"{module_qualname(fn.module.rel_path)}.{parts[0]}")
+        for cand in candidates:
+            if cand in self.locks:
+                return cand
+        return None
+
+    def _function_acqs(self, fn: FunctionInfo) -> Iterator[_Acq]:
+        for node in ast.walk(fn.node):
+            if fn.module.enclosing_function(node) is not fn.node:
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = self.resolve_lock(fn, item.context_expr)
+                    if lock is not None:
+                        yield _Acq(lock, self.locks[lock], fn, node,
+                                   node.body)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                lock = self.resolve_lock(fn, node.func.value)
+                if lock is not None:
+                    yield _Acq(lock, self.locks[lock], fn, node, None)
+
+    # -- closures -----------------------------------------------------------
+
+    def closure(self, fn: FunctionInfo,
+                _stack: frozenset = frozenset()) -> dict[str, list[str]]:
+        """Locks acquired by ``fn`` or anything it (transitively) calls in
+        the same execution context: lock id -> call chain of function
+        names. Task/thread edges are other contexts and excluded."""
+        if fn in self._closure_memo:
+            return self._closure_memo[fn]
+        if fn in _stack:
+            return {}
+        out: dict[str, list[str]] = {}
+        for acq in self.acqs.get(fn, ()):
+            out.setdefault(acq.lock, [fn.short()])
+        for edge in fn.calls:
+            if edge.kind != "call":
+                continue
+            for lock, chain in self.closure(
+                    edge.callee, _stack | {fn}).items():
+                out.setdefault(lock, [fn.short()] + chain)
+        self._closure_memo[fn] = out
+        return out
+
+    # -- "slow" locks -------------------------------------------------------
+
+    def _fn_blocks_or_awaits(self, fn: FunctionInfo,
+                             _stack: frozenset = frozenset()) -> bool:
+        """fn (or its same-context callees) awaits or calls a blocking
+        leaf — holding a lock across a call to it is a long hold."""
+        if fn in self._slow_memo:
+            return self._slow_memo[fn]
+        if fn in _stack:
+            return False
+        result = False
+        for node in ast.walk(fn.node):
+            if fn.module.enclosing_function(node) is not fn.node:
+                continue
+            if isinstance(node, ast.Await):
+                result = True
+                break
+            if isinstance(node, ast.Call) and blocking_call(node):
+                result = True
+                break
+        if not result:
+            for edge in fn.calls:
+                if edge.kind == "call" and self._fn_blocks_or_awaits(
+                        edge.callee, _stack | {fn}):
+                    result = True
+                    break
+        self._slow_memo[fn] = result
+        return result
+
+    def slow_locks(self) -> dict[str, str]:
+        """Locks held somewhere across an await / blocking call / slow
+        callee: lock id -> 'file:line' of the slow hold."""
+        slow: dict[str, str] = {}
+        for fn, acqs in self.acqs.items():
+            for acq in acqs:
+                if acq.body is None or acq.lock in slow:
+                    continue
+                where = (f"{fn.module.rel_path}:"
+                         f"{getattr(acq.site, 'lineno', 0)}")
+                for node in self._body_nodes(fn, acq.body):
+                    if isinstance(node, ast.Await):
+                        slow[acq.lock] = where
+                        break
+                    if isinstance(node, ast.Call) and blocking_call(node):
+                        slow[acq.lock] = where
+                        break
+                if acq.lock in slow:
+                    continue
+                for edge in fn.calls:
+                    if edge.kind == "call" \
+                            and self._in_body(fn, acq.body, edge.site) \
+                            and self._fn_blocks_or_awaits(edge.callee):
+                        slow[acq.lock] = where
+                        break
+        return slow
+
+    # -- body membership ----------------------------------------------------
+
+    @staticmethod
+    def _body_nodes(fn: FunctionInfo,
+                    body: list[ast.stmt]) -> Iterator[ast.AST]:
+        """Nodes lexically inside ``body``, excluding nested function
+        subtrees (they execute in another context/at another time)."""
+        stack: list[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    continue
+                stack.append(child)
+
+    def _in_body(self, fn: FunctionInfo, body: list[ast.stmt],
+                 site: ast.AST) -> bool:
+        for node in self._body_nodes(fn, body):
+            if node is site:
+                return True
+        return False
+
+
+@dataclass
+class _Edge:
+    held: str
+    acquired: str
+    fn: FunctionInfo
+    site: ast.AST
+    chain: list[str]
+
+
+@register
+class LockOrderInversion(ProjectRule):
+    id = "TPL011"
+    name = "lock-order-inversion"
+    summary = ("cyclic lock-acquisition order across the project, or a "
+               "threading.Lock that async code can block on while another "
+               "path holds it across slow work")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        world = _LockWorld(project)
+        if not world.locks:
+            return
+
+        # ---- build the held -> acquired graph
+        edges: list[_Edge] = []
+        for fn, acqs in world.acqs.items():
+            for acq in acqs:
+                if acq.body is None:
+                    continue
+                body_nodes = set(map(id, world._body_nodes(fn, acq.body)))
+                # direct nested acquisitions
+                for other in acqs:
+                    if other is acq or other.lock == acq.lock:
+                        continue
+                    if id(other.site) in body_nodes:
+                        edges.append(_Edge(acq.lock, other.lock, fn,
+                                           other.site, [fn.short()]))
+                # acquisitions via calls made while held
+                for edge in fn.calls:
+                    if edge.kind != "call" or id(edge.site) not in body_nodes:
+                        continue
+                    for lock, chain in world.closure(edge.callee).items():
+                        if lock != acq.lock:
+                            edges.append(_Edge(acq.lock, lock, fn,
+                                               edge.site,
+                                               [fn.short()] + chain))
+
+        adj: dict[str, set[str]] = {}
+        for e in edges:
+            adj.setdefault(e.held, set()).add(e.acquired)
+
+        def reachable(src: str, dst: str) -> bool:
+            seen, stack = set(), [src]
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(adj.get(cur, ()))
+            return False
+
+        reported: set[frozenset] = set()
+        for e in sorted(edges, key=lambda e: (e.fn.module.rel_path,
+                                              getattr(e.site, "lineno", 0))):
+            if not reachable(e.acquired, e.held):
+                continue
+            cycle = frozenset((e.held, e.acquired))
+            if cycle in reported:
+                continue
+            reported.add(cycle)
+            via = " -> ".join(e.chain)
+            yield self.finding(
+                e.fn.module, e.site,
+                f"lock-order inversion: `{e.held}` is held here while "
+                f"acquiring `{e.acquired}` (via {via}), but another path "
+                f"acquires them in the opposite order — a timing-dependent "
+                "deadlock; pick one global order or merge the locks",
+            )
+
+        # ---- thread locks reachable from async context
+        slow = world.slow_locks()
+        for fn in project.functions.values():
+            if not fn.is_async:
+                continue
+            # direct: `with self._mu:` in the async body (no await inside —
+            # that exact case is TPL002's)
+            for acq in world.acqs.get(fn, ()):
+                if acq.kind != "thread" or acq.lock not in slow:
+                    continue
+                if acq.body is not None and any(
+                        isinstance(n, ast.Await)
+                        for n in world._body_nodes(fn, acq.body)):
+                    continue  # TPL002 reports await-under-lock
+                yield self.finding(
+                    fn.module, acq.site,
+                    f"async `{fn.short()}` acquires threading lock "
+                    f"`{acq.lock}`, which is held across slow work at "
+                    f"{slow[acq.lock]} — the event loop can block on "
+                    "acquire; use asyncio.Lock or move this off-loop",
+                )
+            for edge in project.sync_call_edges(fn):
+                for lock, chain in world.closure(edge.callee).items():
+                    if world.locks.get(lock) != "thread" or lock not in slow:
+                        continue
+                    via = " -> ".join([fn.short()] + chain)
+                    yield self.finding(
+                        fn.module, edge.site,
+                        f"async `{fn.short()}` reaches a threading lock "
+                        f"`{lock}` ({via}) that is held across slow work "
+                        f"at {slow[lock]} — the event loop can block on "
+                        "acquire; use asyncio.Lock or asyncio.to_thread",
+                    )
+                    break  # one finding per call edge is enough

@@ -1,0 +1,211 @@
+"""TPL012 — RPC contract conformance across server and client modules.
+
+The RPC substrate is stringly typed: servers register
+``server.add_service(SERVICE, {"ReadBlock": self.rpc_read_block, ...})``
+and clients invoke ``await rpc.call(addr, SERVICE, "ReadBlock", req)``.
+A typo'd method name, a client module's stale private copy of a service
+constant, or a handler with the wrong signature all pass every unit test
+that doesn't happen to cross that exact wire — and then fail at runtime
+as an ``unknown method`` error three layers from the typo.
+
+This rule cross-checks the two sides project-wide:
+
+- **Server tables** are collected from every ``add_service(name, table)``
+  call. The table may be a dict literal, a local variable bound to one, or
+  a call to a method/function whose ``return`` is one (the
+  ``self.handlers()`` idiom). Multiple registrations of one service name
+  merge — masters and chunkservers both register per-process.
+- **Client sites** are any ``*.call(...)`` whose positional args contain a
+  resolvable service-name string immediately followed by a method string
+  (literal or module constant). This shape survives the arg shifts between
+  ``RpcClient.call(addr, service, method, req)`` and
+  ``pool.call(rpc, addr, service, method, req)``. Dynamic method variables
+  produce no finding — conservatism over guesses.
+- **Handlers** must resolve to a real function taking exactly one request
+  parameter (plus ``self``) — the dispatcher calls ``handler(request)``.
+
+Unknown service names on the client side are skipped entirely: tests and
+tools talk to services defined outside the scanned tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import FunctionInfo, Project
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    ProjectRule,
+    dotted_name,
+    register,
+)
+
+
+def _dict_literal(node: ast.AST) -> ast.Dict | None:
+    return node if isinstance(node, ast.Dict) else None
+
+
+def _returned_dict(fn: FunctionInfo) -> ast.Dict | None:
+    """The dict literal a table-builder function returns, if that is the
+    only shape it returns."""
+    result = None
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Return) and node.value is not None \
+                and fn.module.enclosing_function(node) is fn.node:
+            d = _dict_literal(node.value)
+            if d is None:
+                return None
+            result = d
+    return result
+
+
+def _local_dict(fn: FunctionInfo, var: str) -> ast.Dict | None:
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == var:
+            return _dict_literal(node.value)
+    return None
+
+
+def _request_params(fn: FunctionInfo) -> int:
+    """Positional parameters the dispatcher must fill: everything except
+    an implicit self/cls, minus parameters with defaults."""
+    args = fn.node.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if fn.cls is not None and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return len(names) - len(args.defaults)
+
+
+@register
+class RpcContractConformance(ProjectRule):
+    id = "TPL012"
+    name = "rpc-contract-conformance"
+    summary = ("client RPC call names a method no server registers for that "
+               "service, or a registered handler has the wrong signature")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        #: service name -> method name -> handler (or None if unresolved)
+        tables: dict[str, dict[str, FunctionInfo | None]] = {}
+        handler_findings: list[Finding] = []
+
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute) \
+                        or node.func.attr != "add_service" \
+                        or len(node.args) < 2:
+                    continue
+                caller = project.enclosing_function_info(mod, node)
+                if caller is None:
+                    continue
+                service = project.resolve_str_const(mod, node.args[0])
+                resolved = self._resolve_table(project, caller, node.args[1])
+                if service is None or resolved is None:
+                    continue
+                table, owner = resolved
+                dest = tables.setdefault(service, {})
+                handler_findings.extend(
+                    self._ingest_table(project, owner, service, table, dest))
+
+        yield from handler_findings
+        if not tables:
+            return
+
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute) \
+                        or node.func.attr != "call":
+                    continue
+                hit = self._call_site(project, mod, node, tables)
+                if hit is None:
+                    continue
+                service, method = hit
+                if method in tables[service]:
+                    continue
+                close = difflib.get_close_matches(
+                    method, tables[service], n=1)
+                suggest = f"; did you mean `{close[0]}`?" if close \
+                    else ""
+                yield self.finding(
+                    mod, node,
+                    f"RPC call to `{service}.{method}` — no server "
+                    f"registers a `{method}` handler for service "
+                    f"`{service}`{suggest}",
+                )
+
+    # ------------------------------------------------------------ server side
+
+    def _resolve_table(
+        self, project: Project, caller: FunctionInfo, arg: ast.AST,
+    ) -> tuple[ast.Dict, FunctionInfo] | None:
+        """The handler table's dict literal plus the function whose scope
+        owns it — the dict may live in another module entirely (the
+        ``self.handlers()`` idiom on the service class), and handler refs
+        must resolve against the owner, not the registration site."""
+        d = _dict_literal(arg)
+        if d is not None:
+            return d, caller
+        if isinstance(arg, ast.Name):
+            local = _local_dict(caller, arg.id)
+            return (local, caller) if local is not None else None
+        if isinstance(arg, ast.Call):
+            builder = project.resolve_call(caller, arg.func)
+            if builder is not None:
+                returned = _returned_dict(builder)
+                if returned is not None:
+                    return returned, builder
+        return None
+
+    def _ingest_table(self, project: Project, owner: FunctionInfo,
+                      service: str, table: ast.Dict,
+                      dest: dict) -> Iterator[Finding]:
+        for key, value in zip(table.keys, table.values):
+            if key is None:
+                continue
+            method = project.resolve_str_const(owner.module, key)
+            if method is None:
+                continue
+            handler = project.resolve_call(owner, value)
+            dest.setdefault(method, handler)
+            ref = dotted_name(value)
+            if handler is None and ref is not None \
+                    and ref.startswith(("self.", "cls.")):
+                yield self.finding(
+                    owner.module, value,
+                    f"service `{service}` registers method `{method}` "
+                    f"with handler `{ref}`, which does not resolve to any "
+                    "method on this class — a startup-time AttributeError "
+                    "or a silently dead RPC",
+                )
+            elif handler is not None and _request_params(handler) != 1:
+                yield self.finding(
+                    owner.module, value,
+                    f"handler `{handler.short()}` for "
+                    f"`{service}.{method}` must take exactly one request "
+                    f"argument (the dispatcher calls `handler(request)`), "
+                    f"but its signature requires "
+                    f"{_request_params(handler)}",
+                )
+
+    # ------------------------------------------------------------ client side
+
+    @staticmethod
+    def _call_site(project: Project, mod: ModuleInfo, node: ast.Call,
+                   tables: dict) -> tuple[str, str] | None:
+        """(service, method) when this ``*.call(...)`` names a known
+        service followed by a resolvable method string."""
+        for i in range(len(node.args) - 1):
+            service = project.resolve_str_const(mod, node.args[i])
+            if service is None or service not in tables:
+                continue
+            method = project.resolve_str_const(mod, node.args[i + 1])
+            if method is None:
+                return None  # dynamic method variable: stay silent
+            return service, method
+        return None

@@ -1,0 +1,90 @@
+"""TPL014 — coroutine escape: a task handle is bound but never observed.
+
+TPL007 catches the blatant form — ``asyncio.create_task(...)`` with the
+result thrown away on the spot. The subtler leak binds the handle and then
+forgets it:
+
+    task = asyncio.create_task(self._replicate(block))
+    if fast_path:
+        return await self._local(block)      # `task` never escapes
+    await task
+
+On the fast path the only strong reference dies with the frame: the event
+loop keeps tasks weakly, so the replication forward can be garbage-
+collected mid-flight and its exception is never observed. The same applies
+to handles appended to a list that is itself never read.
+
+For every local bound from a spawn, this rule checks that the name
+*escapes* somewhere in the function: awaited, returned, yielded, passed as
+an argument (``gather``, ``wait``, done-callback registration, a
+registry's ``add``), stored onto an attribute/subscript, cancelled, or
+captured by a nested function. Any single escape anywhere in the body
+counts — path-sensitive liveness would flag half-legitimate patterns, and
+this rule's contract (like all tpulint rules) is: if it fires, it's real.
+Handles stored to ``self.*`` or containers are out of scope here — their
+lifetime belongs to the owner object, which TPL007 already accepts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import Project
+from tpudfs.analysis.linter import Finding, ProjectRule, register
+from tpudfs.analysis.rules.tasks import _is_spawn
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@register
+class CoroutineEscape(ProjectRule):
+    id = "TPL014"
+    name = "coroutine-escape"
+    summary = ("a local bound to asyncio.create_task(...) is never awaited, "
+               "returned, passed on, stored, or cancelled — the handle dies "
+               "with the frame and the task can be GC'd mid-flight")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for fn in project.functions.values():
+            spawns: dict[str, ast.Assign] = {}
+            for node in ast.walk(fn.node):
+                if fn.module.enclosing_function(node) is not fn.node:
+                    continue
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id != "_" \
+                        and _is_spawn(node.value):
+                    spawns[node.targets[0].id] = node
+            if not spawns:
+                continue
+            escaped = self._escaped_names(fn.node, spawns.keys())
+            for name, assign in spawns.items():
+                if name in escaped:
+                    continue
+                yield self.finding(
+                    fn.module, assign,
+                    f"task handle `{name}` in `{fn.short()}` is bound but "
+                    "never awaited, returned, passed on, stored, or "
+                    "cancelled — the only strong reference dies with the "
+                    "frame and the task can be GC'd mid-flight; await it, "
+                    "keep it on the instance, or register it in a task set",
+                )
+
+    @staticmethod
+    def _escaped_names(fn_node: ast.AST, names) -> set[str]:
+        """Names from ``names`` that are used anywhere in ``fn_node``
+        beyond their spawn binding (loads, attribute access, deletes,
+        capture by a nested function — any observation counts)."""
+        names = set(names)
+        escaped: set[str] = set()
+        for node in ast.walk(fn_node):
+            # Nested defs/lambdas are walked too: a closure capture shows
+            # up as a Load in their body and counts. Stores (including the
+            # spawn binding itself) do not.
+            if isinstance(node, ast.Name) and node.id in names \
+                    and not isinstance(node.ctx, ast.Store):
+                escaped.add(node.id)
+        return escaped

@@ -1,0 +1,102 @@
+"""TPL010 — transitive blocking call reachable from ``async def``.
+
+TPL001 catches ``time.sleep`` written directly inside an async function.
+The production incidents look different: the sleep (or requests call, or
+subprocess) sits three helpers deep in a sync utility that an async RPC
+handler calls — each function locally innocent, the composition a stalled
+event loop. This rule walks the project call graph: starting from every
+``async def``, it follows ``"call"`` edges into synchronous functions and
+flags the first edge of any chain that reaches a blocking leaf.
+
+Propagation deliberately stops at:
+
+- ``"thread"`` edges (``asyncio.to_thread`` / ``run_in_executor`` /
+  ``threading.Thread``) — blocking work behind those runs off-loop, which
+  is exactly the recommended fix;
+- async callees — an awaited async function's own blocking calls are its
+  own TPL001/TPL010 findings (one report at the responsible function, not
+  one per transitive caller);
+- unresolved calls — dynamic dispatch produces silence, not guesses.
+
+Direct blocking calls inside the async function itself stay TPL001's;
+TPL010 only fires on chains of length >= 2, so the two rules partition the
+failure mode instead of double-reporting it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import FunctionInfo, Project
+from tpudfs.analysis.linter import Finding, ProjectRule, register
+from tpudfs.analysis.rules.blocking import blocking_call
+
+
+def _direct_blocking(fn: FunctionInfo) -> tuple[str, str] | None:
+    """First blocking leaf whose innermost enclosing function is ``fn``
+    (nested defs analyze as their own functions), suppression-aware."""
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if fn.module.enclosing_function(node) is not fn.node:
+            continue
+        hit = blocking_call(node)
+        if hit is None:
+            continue
+        line = getattr(node, "lineno", 0)
+        if fn.module.suppressed("TPL001", line) \
+                or fn.module.suppressed("TPL010", line):
+            continue
+        return hit
+    return None
+
+
+@register
+class TransitiveBlockingInAsync(ProjectRule):
+    id = "TPL010"
+    name = "transitive-blocking-in-async"
+    summary = ("a sync call chain reachable from `async def` ends in a "
+               "blocking leaf (time.sleep, requests, subprocess, sync file "
+               "I/O) — stalls the event loop just like a direct call")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        #: fn -> (chain of FunctionInfo down to the leaf, leaf what/hint)
+        memo: dict[FunctionInfo, tuple[list[FunctionInfo],
+                                       tuple[str, str]] | None] = {}
+
+        def reach(fn: FunctionInfo, stack: set[FunctionInfo]):
+            if fn in memo:
+                return memo[fn]
+            if fn in stack:
+                return None  # recursion: break the cycle, assume clean
+            stack.add(fn)
+            result = None
+            hit = _direct_blocking(fn)
+            if hit is not None:
+                result = ([fn], hit)
+            else:
+                for edge in project.sync_call_edges(fn):
+                    sub = reach(edge.callee, stack)
+                    if sub is not None:
+                        result = ([fn] + sub[0], sub[1])
+                        break
+            stack.discard(fn)
+            memo[fn] = result
+            return result
+
+        for fn in project.functions.values():
+            if not fn.is_async:
+                continue
+            for edge in project.sync_call_edges(fn):
+                sub = reach(edge.callee, set())
+                if sub is None:
+                    continue
+                chain, (what, hint) = sub
+                path = " -> ".join(f.short() for f in [fn] + chain)
+                yield self.finding(
+                    fn.module, edge.site,
+                    f"async `{fn.short()}` transitively blocks the event "
+                    f"loop: {path} -> `{what}`; {hint}, or move the chain "
+                    "behind `asyncio.to_thread`",
+                )

@@ -355,7 +355,8 @@ class ChunkServer:
             # every transport). Falls back to the asyncio blockport when
             # the native library — or its libssl — is unavailable; a TLS
             # cluster NEVER falls back to a plaintext engine.
-            lib = native.get_lib()
+            # build_and_load may run make on first use — off the loop.
+            lib = await asyncio.to_thread(native.build_and_load)
             if native.has_dataplane() and not self.python_data_plane \
                     and self._ici_group is None:
                 # ICI members run the asyncio blockport: its handlers

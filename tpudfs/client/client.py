@@ -885,7 +885,7 @@ class Client:
 
         # ReadBlock is the chunkserver's VERIFIED RPC path: the server
         # checks the sidecar CRC32C before the bytes leave disk.
-        async def read_from(addr: str) -> bytes:  # tpulint: disable=TPL005
+        async def read_from(addr: str) -> bytes:
             resp = await self._data_call(addr, "ReadBlock", req,
                                          timeout=max(self.rpc_timeout, 60.0))
             return resp["data"]
@@ -936,7 +936,7 @@ class Client:
             f"all replicas failed for block {block['block_id']}: {errors}"
         )
 
-    async def _fetch_ec_shards(self, block: dict, *,
+    async def _read_ec_shards(self, block: dict, *,
                                local_verify: bool = True,
                                reasons: list | None = None,
                                ) -> list[bytes | None]:
@@ -974,16 +974,16 @@ class Client:
 
         return list(await asyncio.gather(*(fetch(i) for i in range(k + m))))
 
-    # Shards arrive via _fetch_ec_shards → _read_local (sidecar-verified) or
+    # Shards arrive via _read_ec_shards → _read_local (sidecar-verified) or
     # the ReadBlock RPC (server-side verified); decode failures raise.
-    async def _read_ec_block(self, block: dict) -> bytes:  # tpulint: disable=TPL005
+    async def _read_ec_block(self, block: dict) -> bytes:
         """Concurrent shard fetch; concat fast path when all data shards
         arrive, RS decode otherwise (reference read_ec_block mod.rs:1110-1165)."""
         k = int(block["ec_data_shards"])
         m = int(block["ec_parity_shards"])
         original = int(block.get("original_size") or block.get("size") or 0)
         reasons: list = []
-        shards = await self._fetch_ec_shards(block, reasons=reasons)
+        shards = await self._read_ec_shards(block, reasons=reasons)
         if all(s is not None for s in shards[:k]):
             return b"".join(shards[:k])[:original]  # type: ignore[arg-type]
         try:

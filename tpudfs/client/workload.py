@@ -13,11 +13,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import random
 import time
 from dataclasses import dataclass, field
 
 from tpudfs.client.client import Client, DfsError, IndeterminateError
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -111,8 +114,10 @@ async def run_workload(client: Client, cfg: WorkloadConfig) -> list[dict]:
                     pass  # crash op: maybe-applied
                 except DfsError:
                     rec.record_return(dentry, {"ok": False})
-                except Exception:  # tpulint: disable=TPL003
-                    pass  # crash op: deliberately recorded as maybe-applied
+                except Exception as e:
+                    # Crash op: deliberately recorded as maybe-applied — the
+                    # checker needs the outcome left open, not an error entry.
+                    logger.debug("%s pre-delete left as crash op: %s", name, e)
             entry = await rec.record_invoke(name, op)
             # IndeterminateError (retries exhausted on transport failures)
             # means the op MAY have applied: leave return_ts None so the
@@ -153,11 +158,11 @@ async def run_workload(client: Client, cfg: WorkloadConfig) -> list[dict]:
                         pass
                     except DfsError:
                         rec.record_return(entry, {"ok": False})
-            except Exception:  # tpulint: disable=TPL003
+            except Exception as e:
                 # Left as a crash op: return_ts stays None (maybe-applied) —
-                # the linearizability checker REQUIRES silent indeterminacy
-                # here; logging is fine but recording an outcome is not.
-                pass
+                # the linearizability checker REQUIRES indeterminacy here;
+                # logging is fine but recording an outcome is not.
+                logger.debug("%s %s left as crash op: %s", name, kind, e)
 
     await asyncio.gather(*(
         run_client(f"c{i}", rng.randrange(1 << 30)) for i in range(cfg.clients)

@@ -21,6 +21,7 @@ _LIB_NAME = "libtpudfs_native.so"
 
 _lib: ctypes.CDLL | None = None
 _load_attempted = False
+_build_attempted = False
 
 
 def _try_build() -> bool:
@@ -40,17 +41,39 @@ def _try_build() -> bool:
         return False
 
 
+def build_and_load() -> ctypes.CDLL | None:
+    """Invoke make (a no-op when the .so is newer than its sources, so an
+    edited .cc is never shadowed by a stale binary), then load.
+
+    This is the ONLY entry point that runs the compiler, and it blocks for
+    up to two minutes on a cold build: call it from synchronous entry
+    points (benchmarks, the test session fixture) or from async code via
+    ``await asyncio.to_thread(native.build_and_load)``. Everything on the
+    event loop goes through :func:`get_lib`, which only ever mmaps an
+    already-built library.
+    """
+    global _load_attempted, _build_attempted
+    if _lib is None and not _build_attempted:
+        _build_attempted = True
+        if "TPUDFS_NATIVE_LIB" not in os.environ:
+            if _try_build():
+                # A failed earlier load may now succeed against the fresh .so.
+                _load_attempted = False
+    return get_lib()
+
+
 def get_lib() -> ctypes.CDLL | None:
-    """Load (building on first use if needed) the native library, or None."""
+    """Load the already-built native library, or None.
+
+    Never builds — loading an existing .so is fast enough for the event
+    loop, running make is not. Processes that want a guaranteed-fresh
+    build warm up through :func:`build_and_load` first.
+    """
     global _lib, _load_attempted
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
     path = os.environ.get("TPUDFS_NATIVE_LIB", str(_NATIVE_DIR / _LIB_NAME))
-    # Always invoke make (no-op when the .so is newer than its sources) so an
-    # edited .cc is never shadowed by a stale binary.
-    if "TPUDFS_NATIVE_LIB" not in os.environ or not Path(path).exists():
-        _try_build()
     try:
         lib = ctypes.CDLL(path)
     except OSError as e:

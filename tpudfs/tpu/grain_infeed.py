@@ -41,8 +41,8 @@ try:
         import grain.python as grain  # type: ignore[no-redef]
 
     _HAVE_GRAIN = True
-# tpulint: disable=TPL003  (optional-dependency import guard)
-except Exception:  # pragma: no cover - grain is installed in this image
+except Exception as e:  # pragma: no cover - grain is installed in this image
+    logger.debug("grain unavailable, DfsGrainSource disabled: %s", e)
     grain = None
     _HAVE_GRAIN = False
 
@@ -105,12 +105,27 @@ class DfsSourceBase:
     """Shared plumbing for DFS-backed grain sources: a lazily-built
     per-process client/event-loop (pickle-safe for grain workers) and the
     file-metadata prefetch. Subclasses implement ``_build_index`` and the
-    grain protocol."""
+    grain protocol.
+
+    Concurrency model (audited against tpulint TPL011): ``_lock`` is a
+    ``threading.Lock`` and must stay one. Every acquisition is on a
+    synchronous grain-worker thread (``_client_loop`` via
+    ``__getitem__``/``_fetch_metas``, and ``close``) — never on an event
+    loop. The async side of this class lives entirely inside
+    ``_ClientLoop``'s dedicated loop thread, which this lock guards the
+    creation and teardown of but is never itself entered while holding
+    it: ``_ClientLoop.__init__`` blocks the *worker* thread on
+    ``run_coroutine_threadsafe`` while the loop thread does the async
+    work. Converting to ``asyncio.Lock`` would be wrong (no loop exists
+    on the acquiring threads); adding an ``await`` under this lock is
+    impossible (no async defs in this module) and must stay that way.
+    """
 
     def __init__(self, master_addrs: Sequence[str],
                  client_kwargs: dict | None = None):
         self.master_addrs = list(master_addrs)
         self.client_kwargs = dict(client_kwargs or {})
+        # Held only on sync grain-worker threads; see class docstring.
         self._lock = threading.Lock()
         self._cl: _ClientLoop | None = None
 
@@ -149,6 +164,8 @@ class DfsSourceBase:
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        # Fresh lock per unpickled worker process — same sync-only
+        # discipline as the one dropped in __getstate__.
         self._lock = threading.Lock()
 
 

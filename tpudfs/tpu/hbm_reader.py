@@ -212,7 +212,7 @@ class HbmReader:
         m = int(block["ec_parity_shards"])
         size = int(block.get("original_size") or block.get("size") or 0)
         device_verify = bool(verify) and bool(block.get("checksum_crc32c"))
-        shards = await self.client._fetch_ec_shards(
+        shards = await self.client._read_ec_shards(
             block, local_verify=safe_local or not device_verify
         )
         if all(s is not None for s in shards[:k]):

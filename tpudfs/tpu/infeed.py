@@ -91,7 +91,6 @@ class DfsInfeed:
             try:
                 asyncio.run(pump())
                 out.put(_SENTINEL)
-            # tpulint: disable=TPL003  (error is propagated via the queue)
             except BaseException as e:  # surface errors to the consumer
                 if not stop.is_set():
                     out.put(e)

@@ -15,18 +15,21 @@ DfsRecordSource (the client/event-loop is re-created lazily per process).
 
 from __future__ import annotations
 
+import logging
 from collections.abc import Callable, Sequence
 from typing import Any
 
 from tpudfs.tpu.grain_infeed import DfsRecordSource
+
+logger = logging.getLogger(__name__)
 
 try:
     import torch
     from torch.utils.data import Dataset
 
     _HAVE_TORCH = True
-# tpulint: disable=TPL003  (optional-dependency import guard)
-except Exception:  # pragma: no cover - torch is installed in this image
+except Exception as e:  # pragma: no cover - torch is installed in this image
+    logger.debug("torch unavailable, DfsTorchDataset disabled: %s", e)
     torch = None
 
     class Dataset:  # type: ignore[no-redef]
