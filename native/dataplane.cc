@@ -275,9 +275,10 @@ struct Writer {
 
 // ------------------------------------------------------------------- tls
 //
-// The image ships the OpenSSL 3 RUNTIME (libssl.so.3) but no dev headers,
-// so the needed entry points — a stable C ABI — are declared here and
-// resolved with dlopen at first use. When libssl is absent or a context
+// Images ship an OpenSSL RUNTIME (libssl.so.3, or only libssl.so.1.1 on
+// older bases) but no dev headers, so the needed entry points — a C ABI
+// stable since 1.1.0 — are declared here and resolved with dlopen at
+// first use. When libssl is absent or a context
 // can't be built, engine start FAILS and the chunkserver falls back to
 // the asyncio blockport (which wraps Python's ssl) — never to plaintext.
 // Parity target: tpudfs/common/rpc.py ServerTls/ClientTls semantics
@@ -318,11 +319,22 @@ const SslApi* ssl_api() {
     // RTLD_LOCAL + an explicit same-generation libcrypto handle: the
     // hosting process (Python) may map a DIFFERENT OpenSSL generation;
     // global-scope symbol resolution could then mix ABIs on one object.
-    void* h = ::dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
-    void* hc = ::dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
-    if (!h || !hc) {
-      h = h ? h : ::dlopen("libssl.so", RTLD_NOW | RTLD_LOCAL);
-      hc = hc ? hc : ::dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
+    // Candidates are PAIRS for the same reason — every entry point bound
+    // below is present and ABI-stable from 1.1.0 on, so 1.1 images work.
+    static const char* kPairs[][2] = {
+        {"libssl.so.3", "libcrypto.so.3"},
+        {"libssl.so.1.1", "libcrypto.so.1.1"},
+        {"libssl.so", "libcrypto.so"},
+    };
+    void* h = nullptr;
+    void* hc = nullptr;
+    for (const auto& pair : kPairs) {
+      h = ::dlopen(pair[0], RTLD_NOW | RTLD_LOCAL);
+      hc = ::dlopen(pair[1], RTLD_NOW | RTLD_LOCAL);
+      if (h && hc) break;
+      if (h) ::dlclose(h);
+      if (hc) ::dlclose(hc);
+      h = hc = nullptr;
     }
     if (!h || !hc) return nullptr;
     auto sym = [&](const char* n) { return ::dlsym(h, n); };

@@ -12,9 +12,12 @@ whole-program view:
   and builds a symbol table of classes, methods, module functions and nested
   functions, keyed by dotted qualified name.
 - Self-type inference: ``self.attr`` receivers resolve through attribute
-  types inferred from ``self.attr = Ctor(...)`` assignments and
-  ``self.attr: Ctor`` / class-body annotations, so ``self.store.read()``
-  edges into ``BlockStore.read``.
+  types inferred from ``self.attr = Ctor(...)`` assignments,
+  ``self.attr: Ctor`` / class-body annotations, and ``self.attr = param``
+  where the parameter is annotated (``def __init__(self, store:
+  BlockStore)``), so ``self.store.read()`` edges into ``BlockStore.read``.
+  Receiver chains resolve to arbitrary depth
+  (``self.cs.store.stats`` walks two attribute hops before the method).
 - Call edges carry a ``kind``: ``"call"`` (same execution context),
   ``"thread"`` (``asyncio.to_thread`` / ``loop.run_in_executor`` /
   ``threading.Thread(target=...)`` — a worker thread, NOT the event loop)
@@ -254,8 +257,29 @@ class Project:
                             and isinstance(anno.value, str):
                         anno_name = anno.value.strip("'\" ").split("|")[0].strip()
                     resolved = self._resolve_class(modname, anno_name)
+                if resolved is None and isinstance(value, ast.Name):
+                    resolved = self._param_class(mod, modname, node, value.id)
                 if resolved is not None:
                     cls.attr_types.setdefault(attr, resolved.qualname)
+
+    def _param_class(self, mod: ModuleInfo, modname: str, node: ast.AST,
+                     var: str) -> ClassInfo | None:
+        """Type of ``var`` when it is an annotated parameter of the method
+        enclosing ``node`` — the ``self.store = store`` injection idiom."""
+        fn = mod.enclosing_function(node)
+        if fn is None:
+            return None
+        args = fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if a.arg != var or a.annotation is None:
+                continue
+            anno_name = dotted_name(a.annotation)
+            if anno_name is None and isinstance(a.annotation, ast.Constant) \
+                    and isinstance(a.annotation.value, str):
+                anno_name = a.annotation.value.strip("'\" ") \
+                    .split("|")[0].strip()
+            return self._resolve_class(modname, anno_name)
+        return None
 
     # ----------------------------------------------------------- resolution
 
@@ -354,15 +378,10 @@ class Project:
             return None
         parts = name.split(".")
 
-        # self.m(...) / cls.m(...) / self.attr.m(...)
+        # self.m(...) / cls.m(...) / self.attr[...attr].m(...) — the
+        # receiver chain walks inferred attribute types to any depth.
         if parts[0] in ("self", "cls") and caller.cls is not None:
-            if len(parts) == 2:
-                return self.method_on(caller.cls, parts[1])
-            if len(parts) == 3:
-                attr_cls = self.attr_class(caller.cls, parts[1])
-                if attr_cls is not None:
-                    return self.method_on(attr_cls, parts[2])
-            return None
+            return self._walk_chain(caller.cls, parts[1:])
 
         # Bare name: nested defs (walking out), then module functions,
         # then imports.
@@ -383,8 +402,10 @@ class Project:
 
         # Dotted: local-variable constructor types, imported modules/classes.
         local_cls = self._local_var_class(caller, parts[0])
-        if local_cls is not None and len(parts) == 2:
-            return self.method_on(local_cls, parts[1])
+        if local_cls is not None:
+            hit = self._walk_chain(local_cls, parts[1:])
+            if hit is not None:
+                return hit
         qual = self._qualify(modname, name)
         if qual is None:
             return None
@@ -396,6 +417,30 @@ class Project:
         if cls is not None:
             return self.method_on(cls, meth)
         return None
+
+    def _walk_chain(self, cls: ClassInfo,
+                    parts: list[str]) -> FunctionInfo | None:
+        """Resolve ``attr.attr...method`` against ``cls`` through inferred
+        attribute types; the last part is the method."""
+        if not parts:
+            return None
+        cur: ClassInfo | None = cls
+        for attr in parts[:-1]:
+            cur = self.attr_class(cur, attr)
+            if cur is None:
+                return None
+        return self.method_on(cur, parts[-1])
+
+    def attr_chain_class(self, cls: ClassInfo,
+                         parts: list[str]) -> ClassInfo | None:
+        """Class reached by following every attribute in ``parts`` from
+        ``cls`` (for attribute *access* resolution, not calls)."""
+        cur: ClassInfo | None = cls
+        for attr in parts:
+            cur = self.attr_class(cur, attr)
+            if cur is None:
+                return None
+        return cur
 
     def _local_var_class(self, caller: FunctionInfo,
                          var: str) -> ClassInfo | None:
@@ -453,6 +498,85 @@ class Project:
             caller.calls.append(
                 CallEdge(caller=caller, callee=callee, site=node, kind=kind)
             )
+
+    # ---------------------------------------------------- execution context
+
+    def execution_contexts(self) -> dict[FunctionInfo, frozenset[str]]:
+        """Classify where each function's body runs, from call-graph roots:
+
+        - ``"loop"`` — on the event loop: every coroutine, plus sync
+          functions (transitively) called from one;
+        - ``"worker"`` — on an executor thread: targets of ``to_thread`` /
+          ``run_in_executor`` / ``threading.Thread``, plus sync functions
+          they call;
+        - ``"task"`` — additionally entered via ``create_task`` /
+          ``ensure_future``: still the loop thread, but running concurrently
+          with its spawner at every await.
+
+        A function reachable several ways carries several labels; one with
+        no label is never called from analyzed code (tests, dead code) and
+        contributes nothing to cross-context reasoning. The thread
+        dimension is what races care about: ``"task"`` and ``"loop"`` share
+        one OS thread, ``"worker"`` does not.
+        """
+        cached = getattr(self, "_contexts", None)
+        if cached is not None:
+            return cached
+
+        ctx: dict[FunctionInfo, set[str]] = {}
+
+        def add(fn: FunctionInfo, labels: set[str]) -> bool:
+            have = ctx.setdefault(fn, set())
+            new = labels - have
+            if new:
+                have |= new
+                return True
+            return False
+
+        pending: list[FunctionInfo] = []
+        for fn in self.functions.values():
+            labels = set()
+            if fn.is_async:
+                labels.add("loop")
+            for edge in fn.calls:
+                if edge.kind == "thread":
+                    if add(edge.callee, {"worker"}):
+                        pending.append(edge.callee)
+                elif edge.kind == "task":
+                    if add(edge.callee, {"task", "loop"}):
+                        pending.append(edge.callee)
+            if labels and add(fn, labels):
+                pending.append(fn)
+
+        # Propagate along plain call edges: a sync callee runs wherever its
+        # caller runs; an async callee only ever runs on the loop (a worker
+        # cannot await), so it gains nothing from its callers.
+        while pending:
+            fn = pending.pop()
+            labels = ctx.get(fn, set())
+            if not labels:
+                continue
+            for edge in fn.calls:
+                if edge.kind != "call" or edge.callee.is_async:
+                    continue
+                if add(edge.callee, set(labels)):
+                    pending.append(edge.callee)
+
+        result = {fn: frozenset(labels)
+                  for fn, labels in ctx.items() if labels}
+        self._contexts = result
+        return result
+
+    @staticmethod
+    def thread_dim(labels: frozenset[str]) -> frozenset[str]:
+        """Collapse context labels to OS-thread identity: ``task`` runs on
+        the loop thread."""
+        dims = set()
+        if "worker" in labels:
+            dims.add("worker")
+        if "loop" in labels or "task" in labels:
+            dims.add("loop")
+        return frozenset(dims)
 
     # -------------------------------------------------------- reachability
 

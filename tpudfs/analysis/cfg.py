@@ -1,0 +1,462 @@
+"""Per-function control-flow graphs for tpulint's dataflow rules.
+
+PR 1 gave tpulint per-statement AST rules; PR 2 a project call graph. Both
+are flow-insensitive: they can say *that* a function touches a lock or a
+file descriptor, but not *on which paths* — and the bug classes that matter
+most in an asyncio + ``to_thread`` codebase (a lock leaked by an exception,
+a resource freed on the happy path only, a send racing a persist) are
+path properties. This module builds the missing layer: a conservative
+control-flow graph per function, one node per simple statement, with
+explicit exception edges, so :mod:`tpudfs.analysis.dataflow` can run
+fixed-point analyses over it.
+
+Design points (all biased toward over-approximating the path set —
+spurious paths may cost a finding its precision, but never soundness of
+"no path does X" claims):
+
+- **Nodes** are simple statements plus the evaluated "headers" of compound
+  statements: an ``if``/``while`` test, a ``for`` iterator, a ``with``
+  enter/exit pair, an ``except`` clause. :meth:`Node.exprs` returns exactly
+  the expressions evaluated *at* that node, so analyses never double-count
+  a compound statement's body.
+- **Exception edges** (kind ``"exc"``) leave every statement that can
+  plausibly raise (anything containing a call, attribute access, subscript,
+  await, or arithmetic) and run to each enclosing handler **and** to the
+  uncaught continuation (``finally`` entry, or the synthetic
+  :attr:`CFG.raise_exit`). Handler matching is not modeled — every handler
+  is a may-target.
+- **``finally``** blocks are built once and shared by all continuations
+  (normal, exceptional, ``return``/``break``/``continue`` routed through
+  them). The merge over-approximates: after a shared ``finally`` the walk
+  may continue along a continuation the concrete execution would not take.
+  Nested ``finally`` chains compose, because a routed jump is re-dispatched
+  when the inner ``finally`` frontier is wired, at which point the outer
+  frame is the innermost.
+- **``with``** bodies keep their normal exception edges (bypassing the
+  ``with_exit`` node): ``__exit__`` semantics — releasing a lock on the
+  exception path, suppressing — are the *rules'* business, keyed off the
+  ``with_enter``/``with_exit`` node kinds.
+- **Await points** are flagged per node (:attr:`Node.has_await`), covering
+  ``await`` expressions, ``async for`` iteration, and ``async with``
+  enter/exit.
+
+The graph is intraprocedural; calls are opaque (they may raise, nothing
+more). Interprocedural facts come from layering the call graph on top —
+see the TPL020 race detector.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+__all__ = ["CFG", "Node", "build_cfg", "cfg_for"]
+
+#: Expression node types that make a statement a may-raise point.
+_RAISING_EXPRS = (ast.Call, ast.Attribute, ast.Subscript, ast.Await,
+                  ast.BinOp, ast.Compare, ast.Yield, ast.YieldFrom)
+
+
+class Node:
+    """One CFG node: a simple statement or a compound-statement header."""
+
+    __slots__ = ("index", "kind", "stmt", "succs", "preds", "has_await",
+                 "lineno")
+
+    def __init__(self, index: int, kind: str, stmt: ast.AST | None) -> None:
+        self.index = index
+        #: "entry" | "exit" | "raise_exit" | "stmt" | "if_test" |
+        #: "while_test" | "for_iter" | "with_enter" | "with_exit" |
+        #: "except" | "finally_enter" | "match_subject"
+        self.kind = kind
+        self.stmt = stmt
+        self.succs: list[tuple["Node", str]] = []
+        self.preds: list[tuple["Node", str]] = []
+        self.has_await = False
+        self.lineno = getattr(stmt, "lineno", 0)
+
+    def exprs(self) -> list[ast.AST]:
+        """The ASTs evaluated at this node (never a compound body)."""
+        s = self.stmt
+        if s is None:
+            return []
+        if self.kind == "stmt":
+            return [s]
+        if self.kind in ("if_test", "while_test"):
+            return [s.test]  # type: ignore[union-attr]
+        if self.kind == "for_iter":
+            return [s.iter, s.target]  # type: ignore[union-attr]
+        if self.kind == "with_enter":
+            out: list[ast.AST] = []
+            for item in s.items:  # type: ignore[union-attr]
+                out.append(item.context_expr)
+                if item.optional_vars is not None:
+                    out.append(item.optional_vars)
+            return out
+        if self.kind == "except":
+            return [s.type] if s.type is not None else []  # type: ignore
+        if self.kind == "match_subject":
+            return [s.subject]  # type: ignore[union-attr]
+        return []
+
+    def walk(self) -> Iterator[ast.AST]:
+        for e in self.exprs():
+            yield from ast.walk(e)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Node {self.index} {self.kind} L{self.lineno}>"
+
+
+class CFG:
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self.fn = fn
+        self.nodes: list[Node] = []
+        self.entry = self._new("entry", None)
+        self.exit = self._new("exit", None)
+        self.raise_exit = self._new("raise_exit", None)
+
+    def _new(self, kind: str, stmt: ast.AST | None) -> Node:
+        node = Node(len(self.nodes), kind, stmt)
+        self.nodes.append(node)
+        return node
+
+    @staticmethod
+    def _edge(src: Node, dst: Node, kind: str) -> None:
+        if (dst, kind) not in src.succs:
+            src.succs.append((dst, kind))
+            dst.preds.append((src, kind))
+
+    # ------------------------------------------------------------- traversal
+
+    def rpo(self) -> list[Node]:
+        """Reverse post-order from entry (reachable nodes only)."""
+        seen: set[int] = set()
+        order: list[Node] = []
+
+        def visit(node: Node) -> None:
+            stack = [(node, iter(node.succs))]
+            seen.add(node.index)
+            while stack:
+                cur, it = stack[-1]
+                advanced = False
+                for succ, _kind in it:
+                    if succ.index not in seen:
+                        seen.add(succ.index)
+                        stack.append((succ, iter(succ.succs)))
+                        advanced = True
+                        break
+                if not advanced:
+                    order.append(cur)
+                    stack.pop()
+
+        visit(self.entry)
+        order.reverse()
+        return order
+
+    def back_edges(self) -> set[tuple[int, int]]:
+        """Edges (src, dst) closing a cycle in a DFS from entry — loop back
+        edges. Analyses of per-iteration ordering (TPL023) cut these."""
+        color: dict[int, int] = {}  # 1 = on stack, 2 = done
+        back: set[tuple[int, int]] = set()
+        stack: list[tuple[Node, int]] = [(self.entry, 0)]
+        color[self.entry.index] = 1
+        while stack:
+            node, i = stack.pop()
+            if i < len(node.succs):
+                stack.append((node, i + 1))
+                succ = node.succs[i][0]
+                state = color.get(succ.index)
+                if state == 1:
+                    back.add((node.index, succ.index))
+                elif state is None:
+                    color[succ.index] = 1
+                    stack.append((succ, 0))
+            else:
+                color[node.index] = 2
+        return back
+
+    def await_nodes(self) -> list[Node]:
+        return [n for n in self.nodes if n.has_await]
+
+
+class _Loop:
+    __slots__ = ("cont_target", "breaks", "fin_depth")
+
+    def __init__(self, cont_target: Node, fin_depth: int) -> None:
+        self.cont_target = cont_target
+        self.breaks: list[Node] = []
+        self.fin_depth = fin_depth
+
+
+class _FinallyFrame:
+    __slots__ = ("entry", "pending")
+
+    def __init__(self, entry: Node) -> None:
+        self.entry = entry
+        #: routed jumps to re-dispatch once the finally body is wired:
+        #: ("return", None) | ("break", loop) | ("continue", loop)
+        self.pending: list[tuple[str, "_Loop | None"]] = []
+
+
+def _contains_await(tree: ast.AST) -> bool:
+    return any(isinstance(n, ast.Await) for n in ast.walk(tree))
+
+
+def _can_raise(exprs: list[ast.AST]) -> bool:
+    for e in exprs:
+        for n in ast.walk(e):
+            if isinstance(n, _RAISING_EXPRS):
+                return True
+    return False
+
+
+class _Builder:
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self.cfg = CFG(fn)
+        #: innermost-last; each entry is the list of may-targets an
+        #: exception propagates to from the current position.
+        self._exc: list[list[Node]] = [[self.cfg.raise_exit]]
+        self._loops: list[_Loop] = []
+        self._finals: list[_FinallyFrame] = []
+
+    # --------------------------------------------------------------- helpers
+
+    def _node(self, kind: str, stmt: ast.AST | None,
+              frontier: list[Node]) -> Node:
+        node = self.cfg._new(kind, stmt)
+        for src in frontier:
+            CFG._edge(src, node, "flow")
+        return node
+
+    def _mark(self, node: Node) -> None:
+        exprs = node.exprs()
+        if _can_raise(exprs) or node.kind in ("except", "with_exit"):
+            for target in self._exc[-1]:
+                CFG._edge(node, target, "exc")
+        node.has_await = any(_contains_await(e) for e in exprs)
+
+    def _jump_return(self, sources: list[Node]) -> None:
+        if self._finals:
+            frame = self._finals[-1]
+            frame.pending.append(("return", None))
+            for s in sources:
+                CFG._edge(s, frame.entry, "flow")
+        else:
+            for s in sources:
+                CFG._edge(s, self.cfg.exit, "flow")
+
+    def _jump_break(self, sources: list[Node], loop: _Loop) -> None:
+        if len(self._finals) > loop.fin_depth:
+            frame = self._finals[-1]
+            frame.pending.append(("break", loop))
+            for s in sources:
+                CFG._edge(s, frame.entry, "flow")
+        else:
+            loop.breaks.extend(sources)
+
+    def _jump_continue(self, sources: list[Node], loop: _Loop) -> None:
+        if len(self._finals) > loop.fin_depth:
+            frame = self._finals[-1]
+            frame.pending.append(("continue", loop))
+            for s in sources:
+                CFG._edge(s, frame.entry, "flow")
+        else:
+            for s in sources:
+                CFG._edge(s, loop.cont_target, "flow")
+
+    # ----------------------------------------------------------------- build
+
+    def build(self) -> CFG:
+        frontier = self._body(self.cfg.fn.body, [self.cfg.entry])
+        for src in frontier:
+            CFG._edge(src, self.cfg.exit, "flow")
+        return self.cfg
+
+    def _body(self, stmts: list[ast.stmt],
+              frontier: list[Node]) -> list[Node]:
+        for stmt in stmts:
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _stmt(self, stmt: ast.stmt, frontier: list[Node]) -> list[Node]:
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, frontier)
+        if isinstance(stmt, (ast.While,)):
+            return self._while(stmt, frontier)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._for(stmt, frontier)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, frontier)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, frontier)
+        if isinstance(stmt, ast.Match):
+            return self._match(stmt, frontier)
+        if isinstance(stmt, ast.Return):
+            node = self._node("stmt", stmt, frontier)
+            self._mark(node)
+            self._jump_return([node])
+            return []
+        if isinstance(stmt, ast.Raise):
+            node = self._node("stmt", stmt, frontier)
+            node.has_await = False
+            for target in self._exc[-1]:
+                CFG._edge(node, target, "exc")
+            return []
+        if isinstance(stmt, ast.Break):
+            node = self._node("stmt", stmt, frontier)
+            if self._loops:
+                self._jump_break([node], self._loops[-1])
+            return []
+        if isinstance(stmt, ast.Continue):
+            node = self._node("stmt", stmt, frontier)
+            if self._loops:
+                self._jump_continue([node], self._loops[-1])
+            return []
+        # Simple statement (incl. nested def/class: a name binding whose
+        # body is someone else's CFG).
+        node = self._node("stmt", stmt, frontier)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if _can_raise(list(stmt.decorator_list)):
+                for target in self._exc[-1]:
+                    CFG._edge(node, target, "exc")
+        else:
+            self._mark(node)
+        if isinstance(stmt, ast.Assert):
+            for target in self._exc[-1]:
+                CFG._edge(node, target, "exc")
+        return [node]
+
+    def _if(self, stmt: ast.If, frontier: list[Node]) -> list[Node]:
+        test = self._node("if_test", stmt, frontier)
+        self._mark(test)
+        body_f = self._body(stmt.body, [test])
+        if stmt.orelse:
+            else_f = self._body(stmt.orelse, [test])
+        else:
+            else_f = [test]
+        return body_f + else_f
+
+    def _while(self, stmt: ast.While, frontier: list[Node]) -> list[Node]:
+        test = self._node("while_test", stmt, frontier)
+        self._mark(test)
+        loop = _Loop(test, len(self._finals))
+        self._loops.append(loop)
+        body_f = self._body(stmt.body, [test])
+        self._loops.pop()
+        for src in body_f:
+            CFG._edge(src, test, "flow")
+        out = [test]
+        if stmt.orelse:
+            out = self._body(stmt.orelse, [test])
+        return out + loop.breaks
+
+    def _for(self, stmt: ast.For | ast.AsyncFor,
+             frontier: list[Node]) -> list[Node]:
+        it = self._node("for_iter", stmt, frontier)
+        self._mark(it)
+        if isinstance(stmt, ast.AsyncFor):
+            it.has_await = True
+        loop = _Loop(it, len(self._finals))
+        self._loops.append(loop)
+        body_f = self._body(stmt.body, [it])
+        self._loops.pop()
+        for src in body_f:
+            CFG._edge(src, it, "flow")
+        out = [it]
+        if stmt.orelse:
+            out = self._body(stmt.orelse, [it])
+        return out + loop.breaks
+
+    def _with(self, stmt: ast.With | ast.AsyncWith,
+              frontier: list[Node]) -> list[Node]:
+        enter = self._node("with_enter", stmt, frontier)
+        self._mark(enter)
+        if isinstance(stmt, ast.AsyncWith):
+            enter.has_await = True
+        body_f = self._body(stmt.body, [enter])
+        exit_node = self._node("with_exit", stmt, body_f)
+        self._mark(exit_node)
+        if isinstance(stmt, ast.AsyncWith):
+            exit_node.has_await = True
+        return [exit_node]
+
+    def _match(self, stmt: ast.Match, frontier: list[Node]) -> list[Node]:
+        subject = self._node("match_subject", stmt, frontier)
+        self._mark(subject)
+        out: list[Node] = [subject]
+        for case in stmt.cases:
+            out.extend(self._body(case.body, [subject]))
+        return out
+
+    def _try(self, stmt: ast.Try, frontier: list[Node]) -> list[Node]:
+        outer = self._exc[-1]
+        fin_frame: _FinallyFrame | None = None
+        if stmt.finalbody:
+            fin_entry = self.cfg._new("finally_enter", stmt)
+            fin_frame = _FinallyFrame(fin_entry)
+        uncaught = [fin_frame.entry] if fin_frame else list(outer)
+
+        handler_nodes = [self.cfg._new("except", h) for h in stmt.handlers]
+
+        if fin_frame:
+            self._finals.append(fin_frame)
+
+        # Body: exceptions may land in any handler, or stay uncaught.
+        self._exc.append(handler_nodes + uncaught)
+        body_f = self._body(stmt.body, frontier)
+        self._exc.pop()
+
+        # Orelse and handler bodies: exceptions are no longer caught here.
+        self._exc.append(uncaught)
+        if stmt.orelse:
+            body_f = self._body(stmt.orelse, body_f)
+        after: list[Node] = list(body_f)
+        for hnode, handler in zip(handler_nodes, stmt.handlers):
+            self._mark(hnode)
+            after.extend(self._body(handler.body, [hnode]))
+        self._exc.pop()
+
+        if fin_frame is None:
+            return after
+
+        self._finals.pop()
+        for src in after:
+            CFG._edge(src, fin_frame.entry, "flow")
+        # Finally body: its own exceptions propagate outward, and the
+        # re-raise continuation of an uncaught body exception does too.
+        self._exc.append(list(outer))
+        fin_f = self._body(stmt.finalbody, [fin_frame.entry])
+        for src in fin_f:
+            for target in outer:
+                CFG._edge(src, target, "exc")
+        # Re-dispatch jumps that were routed through this finally; the
+        # frame is popped, so chained finallys compose naturally.
+        for kind, loop in fin_frame.pending:
+            if kind == "return":
+                self._jump_return(fin_f)
+            elif kind == "break" and loop is not None:
+                self._jump_break(fin_f, loop)
+            elif kind == "continue" and loop is not None:
+                self._jump_continue(fin_f, loop)
+        self._exc.pop()
+        return fin_f
+
+
+def build_cfg(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+    """Build the control-flow graph for one function body."""
+    return _Builder(fn).build()
+
+
+def cfg_for(module, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+    """Memoized :func:`build_cfg` — several rules walk the same functions,
+    and the cache lives on the ModuleInfo so it dies with the run."""
+    cache = getattr(module, "_cfg_cache", None)
+    if cache is None:
+        cache = {}
+        module._cfg_cache = cache
+    cfg = cache.get(fn)
+    if cfg is None:
+        cfg = build_cfg(fn)
+        cache[fn] = cfg
+    return cfg

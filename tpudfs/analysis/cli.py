@@ -7,7 +7,10 @@
                                               # `git merge-base HEAD main`
     python -m tpudfs.analysis --write-baseline
     python -m tpudfs.analysis --list-rules
+    python -m tpudfs.analysis --explain TPL020  # why + example + fix
+    python -m tpudfs.analysis --stats         # per-rule wall-time report
     python -m tpudfs.analysis --no-baseline   # show grandfathered too
+    python -m tpudfs.analysis --write-rule-table  # sync docs table
 
 Full-tree runs reuse a content-hash cache (``.tpulint_cache.json`` at the
 repo root, git-ignored) so the common nothing-changed case costs file
@@ -52,6 +55,14 @@ def _parser() -> argparse.ArgumentParser:
                    help="regenerate the baseline from current findings")
     p.add_argument("--list-rules", action="store_true",
                    help="print every registered rule and exit")
+    p.add_argument("--explain", metavar="TPLxxx",
+                   help="print a rule's full documentation (what it "
+                        "catches, a flagged example, how to fix) and exit")
+    p.add_argument("--stats", action="store_true",
+                   help="after linting, print wall time spent per rule")
+    p.add_argument("--write-rule-table", action="store_true",
+                   help="regenerate the rule table in "
+                        "docs/static-analysis.md from rule metadata")
     p.add_argument("--rule", action="append", dest="rules", metavar="TPLxxx",
                    help="run only these rule ids (repeatable)")
     p.add_argument("--format", choices=("text", "json", "sarif"),
@@ -105,6 +116,24 @@ def main(argv: list[str] | None = None) -> int:
             print(f"        {rule.summary}")
         return 0
 
+    if args.explain:
+        rule = rules.get(args.explain.upper())
+        if rule is None:
+            print(f"unknown rule id: {args.explain} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+        print(rule.explain(), end="")
+        return 0
+
+    if args.write_rule_table:
+        from tpudfs.analysis import docgen
+
+        doc = args.root / docgen.DOC_REL_PATH
+        changed = docgen.sync_rule_table(doc)
+        print(f"{doc}: rule table "
+              f"{'updated' if changed else 'already in sync'}")
+        return 0
+
     selected = None
     if args.rules:
         wanted = {r.upper() for r in args.rules}
@@ -115,20 +144,39 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         selected = [rules[r] for r in sorted(wanted)]
 
-    paths = args.paths or [DEFAULT_TARGET]
+    if args.paths:
+        paths = args.paths
+    elif args.root.resolve() == REPO_ROOT:
+        paths = [DEFAULT_TARGET]
+    else:
+        # Custom --root: lint its tpudfs package (or the whole root) —
+        # DEFAULT_TARGET lives under THIS repo and would not be relative
+        # to a foreign root, which matters when --changed falls back here.
+        custom = args.root / "tpudfs"
+        paths = [custom if custom.is_dir() else args.root]
+    changed_subset = False
     if args.changed:
         if args.paths:
             print("--changed and explicit paths are mutually exclusive",
                   file=sys.stderr)
             return 2
         subset = changed_paths(args.root)
-        if subset is not None:
-            if not subset:
-                if not args.quiet:
-                    print("tpulint: no python files changed since "
-                          "merge-base with main")
-                return 0
+        if subset is None:
+            # Detached-HEAD CI, shallow clones, exported trees: there is
+            # no merge-base to diff against. Degrade to a full-tree lint
+            # (strictly more coverage) instead of crashing or silently
+            # linting nothing.
+            print("tpulint: --changed: cannot determine a merge-base "
+                  "with main (detached HEAD or not a git checkout); "
+                  "falling back to a full-tree lint", file=sys.stderr)
+        elif not subset:
+            if not args.quiet:
+                print("tpulint: no python files changed since "
+                      "merge-base with main")
+            return 0
+        else:
             paths = subset
+            changed_subset = True
     for p in paths:
         if not p.exists():
             print(f"no such path: {p}", file=sys.stderr)
@@ -147,8 +195,26 @@ def main(argv: list[str] | None = None) -> int:
         cache_path = args.root / ".tpulint_cache.json"
 
     baseline = None if args.no_baseline else args.baseline
+    linter.reset_rule_timings()
+    import time as _time
+    t0 = _time.perf_counter()
     result = linter.run(paths, args.root, baseline, selected,
                         cache_path=cache_path)
+    wall = _time.perf_counter() - t0
+
+    if args.stats:
+        # Stderr: --format sarif/json write a document to stdout.
+        timings = sorted(linter.RULE_TIMINGS.items(),
+                         key=lambda kv: kv[1], reverse=True)
+        ruled = sum(t for _, t in timings)
+        print(f"tpulint --stats: {wall:.3f}s wall, {ruled:.3f}s in rules "
+              f"({len(timings)} rule(s) executed; cached files run no "
+              "rules)", file=sys.stderr)
+        for rule_id, secs in timings:
+            rule = rules.get(rule_id)
+            name = rule.name if rule is not None else ""
+            print(f"  {rule_id}  {secs * 1000:8.1f} ms  {name}",
+                  file=sys.stderr)
 
     if args.format != "text":
         from tpudfs.analysis import output as output_mod
@@ -175,8 +241,8 @@ def main(argv: list[str] | None = None) -> int:
         for line in lines:
             print(line)
     if not args.quiet:
-        n_files = "" if args.paths and not args.changed else \
-            (" (changed files only)" if args.changed else " across tpudfs/")
+        n_files = "" if args.paths else \
+            (" (changed files only)" if changed_subset else " across tpudfs/")
         print(
             f"tpulint: {len(result.new)} new finding(s), "
             f"{len(result.baselined)} baselined{n_files}"

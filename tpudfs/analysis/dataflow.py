@@ -1,0 +1,146 @@
+"""Generic forward fixed-point dataflow over :mod:`tpudfs.analysis.cfg`.
+
+Rules plug a small lattice into :class:`ForwardAnalysis` and call
+:func:`solve`; the solver runs a worklist to a fixed point and hands back
+per-node in/out values. Two lattice families cover every current rule:
+
+- **may** analyses (union join, e.g. "a resource acquired on *some* path
+  into this node is still unreleased") — used by TPL021's leak check,
+  TPL022, TPL023;
+- **must** analyses (intersection join, e.g. "a lock is held on *every*
+  path into this node") — used by the TPL020 race detector's
+  is-this-access-guarded question.
+
+Values must be hashable immutable sets (``frozenset``) or ``None``;
+``None`` is the "unreached" bottom that any join absorbs, which is what
+makes intersection-style must-analyses startable from an empty worklist
+seed without poisoning every meet with the empty set.
+
+Termination: transfer functions must be monotone and the value domain
+finite (site sets within one function), so the worklist settles in
+O(nodes × domain) steps; a generous iteration cap turns a buggy lattice
+into a loud failure instead of a hang.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Hashable
+
+from tpudfs.analysis.cfg import CFG, Node
+
+__all__ = ["ForwardAnalysis", "MayAnalysis", "MustAnalysis", "solve"]
+
+Value = Hashable  # frozenset in practice; None = unreached
+
+
+class ForwardAnalysis:
+    """Override :meth:`transfer`; pick a join by subclassing
+    :class:`MayAnalysis` or :class:`MustAnalysis`."""
+
+    def initial(self) -> Value:
+        """Value at function entry."""
+        return frozenset()
+
+    def join(self, a: Value, b: Value) -> Value:
+        raise NotImplementedError
+
+    def transfer(self, node: Node, value: Value) -> Value:
+        """Out-value of ``node`` given its in-value. ``value`` is never
+        None (unreached nodes are not transferred)."""
+        return value
+
+    def edge_filter(self, src: Node, dst: Node, kind: str) -> bool:
+        """Return False to ignore an edge (e.g. cut loop back edges)."""
+        return True
+
+    def edge_value(self, src: Node, dst: Node, kind: str,
+                   value: Value) -> Value:
+        """Value carried along one outgoing edge; defaults to the node's
+        out-value. Lets a rule model e.g. "if the acquire statement itself
+        raised, nothing was acquired" on ``exc`` edges."""
+        return value
+
+
+class MayAnalysis(ForwardAnalysis):
+    """Union join: a fact holds at a node if it holds on some path in."""
+
+    def join(self, a: Value, b: Value) -> Value:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a | b  # type: ignore[operator]
+
+
+class MustAnalysis(ForwardAnalysis):
+    """Intersection join: a fact holds only if it holds on every path in."""
+
+    def join(self, a: Value, b: Value) -> Value:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a & b  # type: ignore[operator]
+
+
+def solve(
+    cfg: CFG,
+    analysis: ForwardAnalysis,
+    skip_edges: set[tuple[int, int]] | None = None,
+) -> dict[int, tuple[Value, Value]]:
+    """Run ``analysis`` forward over ``cfg`` to a fixed point.
+
+    Returns ``{node.index: (in_value, out_value)}`` for reachable nodes;
+    an in/out of ``None`` means the node was never reached under the
+    (possibly edge-filtered) path set. ``skip_edges`` removes specific
+    ``(src_index, dst_index)`` edges — pass ``cfg.back_edges()`` for
+    per-iteration ordering properties.
+    """
+    order = cfg.rpo()
+    position = {n.index: i for i, n in enumerate(order)}
+
+    in_vals: dict[int, Value] = {cfg.entry.index: analysis.initial()}
+    out_vals: dict[int, Value] = {}
+
+    # Worklist seeded in RPO; a priority re-queue keeps passes near-linear
+    # on reducible graphs.
+    pending = list(order)
+    queued = {n.index for n in pending}
+    steps = 0
+    cap = 64 * (len(order) + 8) * (len(order) + 8)
+
+    while pending:
+        pending.sort(key=lambda n: position[n.index], reverse=True)
+        node = pending.pop()
+        queued.discard(node.index)
+        steps += 1
+        if steps > cap:  # pragma: no cover - lattice bug guard
+            raise RuntimeError(
+                f"dataflow did not converge in {cap} steps "
+                f"({cfg.fn.name} at line {cfg.fn.lineno})")
+
+        in_val = in_vals.get(node.index)
+        if in_val is None:
+            continue
+        out_val = analysis.transfer(node, in_val)
+        if node.index in out_vals and out_vals[node.index] == out_val:
+            continue
+        out_vals[node.index] = out_val
+
+        for succ, kind in node.succs:
+            if skip_edges and (node.index, succ.index) in skip_edges:
+                continue
+            if not analysis.edge_filter(node, succ, kind):
+                continue
+            carried = analysis.edge_value(node, succ, kind, out_val)
+            merged = analysis.join(in_vals.get(succ.index), carried)
+            if merged != in_vals.get(succ.index):
+                in_vals[succ.index] = merged
+                if succ.index not in queued:
+                    pending.append(succ)
+                    queued.add(succ.index)
+
+    return {
+        idx: (in_vals.get(idx), out_vals.get(idx))
+        for idx in set(in_vals) | set(out_vals)
+    }

@@ -35,6 +35,7 @@ import hashlib
 import json
 import re
 import pathlib
+import time
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator
 
@@ -51,7 +52,19 @@ __all__ = [
     "write_baseline",
     "run",
     "scan_suppressions",
+    "RULE_TIMINGS",
+    "reset_rule_timings",
 ]
+
+#: Cumulative wall-clock seconds per rule id, accumulated across every
+#: ``check``/``check_project`` call in this process (cached files skip
+#: rule execution and so contribute nothing — which is exactly what
+#: ``tpulint --stats`` should show). Reset with :func:`reset_rule_timings`.
+RULE_TIMINGS: dict[str, float] = {}
+
+
+def reset_rule_timings() -> None:
+    RULE_TIMINGS.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -232,11 +245,35 @@ def dotted_name(node: ast.AST) -> str | None:
 class Rule:
     """Base class for tpulint rules. Subclasses set ``id``/``name``/``summary``
     and implement ``check``; registration is via the :func:`register`
-    decorator so importing ``tpudfs.analysis.rules`` is the only wiring."""
+    decorator so importing ``tpudfs.analysis.rules`` is the only wiring.
+
+    ``doc``/``example``/``fix`` feed ``tpulint --explain TPLxxx`` and the
+    generated rule table in docs/static-analysis.md (tpudfs/analysis/
+    docgen.py): ``doc`` says why the pattern is a bug in *this* codebase,
+    ``example`` shows minimal flagged code, ``fix`` says what to write
+    instead."""
 
     id: str = ""
     name: str = ""
     summary: str = ""
+    doc: str = ""
+    example: str = ""
+    fix: str = ""
+
+    def explain(self) -> str:
+        """Render the --explain text for this rule."""
+        scope = "project" if isinstance(self, ProjectRule) else "module"
+        parts = [f"{self.id} ({self.name}) — {scope}-scoped",
+                 "", " ".join(self.summary.split())]
+        if self.doc:
+            parts += ["", self.doc.strip()]
+        if self.example:
+            parts += ["", "Example (flagged):", "",
+                      "    " + "\n    ".join(
+                          self.example.strip("\n").rstrip().splitlines())]
+        if self.fix:
+            parts += ["", f"Fix: {self.fix.strip()}"]
+        return "\n".join(parts) + "\n"
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         raise NotImplementedError
@@ -318,9 +355,12 @@ def _module_findings(module: ModuleInfo,
                      rules: Iterable[Rule]) -> list[Finding]:
     findings: list[Finding] = []
     for rule in rules:
+        t0 = time.perf_counter()
         for f in rule.check(module):
             if not module.suppressed(f.rule, f.line):
                 findings.append(f)
+        RULE_TIMINGS[rule.id] = RULE_TIMINGS.get(rule.id, 0.0) \
+            + time.perf_counter() - t0
     return findings
 
 
@@ -331,11 +371,14 @@ def _project_findings(modules: dict[str, ModuleInfo],
     project = Project(modules)
     findings: list[Finding] = []
     for rule in rules:
+        t0 = time.perf_counter()
         for f in rule.check_project(project):
             mod = modules.get(f.path)
             if mod is not None and mod.suppressed(f.rule, f.line):
                 continue
             findings.append(f)
+        RULE_TIMINGS[rule.id] = RULE_TIMINGS.get(rule.id, 0.0) \
+            + time.perf_counter() - t0
     return findings
 
 

@@ -1,0 +1,275 @@
+"""Project-wide lock identity and path-sensitive held-lock facts.
+
+Three layers of tpulint need to agree on what "a lock" is and when one is
+held:
+
+- TPL011 (lock-order inversion) needs acquisition *sites* per function;
+- TPL020 (cross-executor races) needs "which ``threading`` locks are held,
+  on every path, at this attribute access" — including locks taken by a
+  *caller* (the ``_locked_helper`` idiom, where a method touches shared
+  state and documents that its callers hold the mutex);
+- TPL021 (lock hygiene) needs per-module lock kinds.
+
+:class:`LockRegistry` is the shared identity layer, extracted from PR 2's
+TPL011 implementation: a lock is the owning scope plus attribute
+(``pkg.mod.Class._mu`` / ``pkg.mod.global_mu``), registered from
+``threading.Lock()`` / ``asyncio.Lock()``-style constructor assignments
+anywhere in the project, and resolved from a use site through the call
+graph's inferred attribute types (receiver chains to any depth).
+
+:class:`HeldLockMap` layers the CFG + dataflow engine on top: a forward
+**must** analysis per function (a lock counts only if held on *every* path
+into a node), with interprocedural entry states — the locks a function can
+assume held on entry are the intersection of the locks held at each of its
+resolved same-context call sites. ``to_thread``/``create_task`` edges
+contribute the empty set: a worker thread or a fresh task starts with no
+inherited holds, whatever its spawner held at the spawn site. Everything
+degrades toward the empty set, i.e. toward "not provably guarded".
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudfs.analysis.callgraph import (
+    ClassInfo,
+    FunctionInfo,
+    Project,
+    module_qualname,
+)
+from tpudfs.analysis.cfg import CFG, Node, cfg_for
+from tpudfs.analysis.dataflow import MustAnalysis, solve
+from tpudfs.analysis.linter import dotted_name
+
+__all__ = ["LockRegistry", "HeldLockMap", "THREAD_CTORS", "ASYNC_CTORS"]
+
+THREAD_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+ASYNC_CTORS = {
+    "asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+    "asyncio.BoundedSemaphore",
+}
+
+
+class LockRegistry:
+    """Lock id -> kind (``"thread"`` | ``"async"``), plus use-site
+    resolution."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.locks: dict[str, str] = {}
+        self._register()
+
+    def _register(self) -> None:
+        for mod in self.project.modules.values():
+            modname = module_qualname(mod.rel_path)
+            for node in ast.walk(mod.tree):
+                value = None
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    value, targets = node.value, [node.target]
+                if not isinstance(value, ast.Call):
+                    continue
+                ctor = dotted_name(value.func)
+                if ctor in THREAD_CTORS:
+                    kind = "thread"
+                elif ctor in ASYNC_CTORS:
+                    kind = "async"
+                else:
+                    continue
+                for t in targets:
+                    name = dotted_name(t)
+                    if not name:
+                        continue
+                    if name.startswith("self.") and name.count(".") == 1:
+                        cls = self._enclosing_class(mod, node)
+                        if cls is None:
+                            continue
+                        lock_id = f"{cls.qualname}.{name.split('.', 1)[1]}"
+                    elif "." not in name:
+                        lock_id = f"{modname}.{name}"
+                    else:
+                        continue
+                    self.locks[lock_id] = kind
+
+    def _enclosing_class(self, mod, node: ast.AST) -> ClassInfo | None:
+        modname = module_qualname(mod.rel_path)
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return self.project.classes.get(
+                    f"{modname}.{mod.qualname(anc)}")
+        return None
+
+    def resolve_lock(self, fn: FunctionInfo, expr: ast.AST) -> str | None:
+        """Lock id for a with-item / ``.acquire()`` receiver expression,
+        as seen from inside ``fn``."""
+        target = expr.func if isinstance(expr, ast.Call) else expr
+        if isinstance(target, ast.Attribute) \
+                and target.attr in ("acquire", "locked", "release"):
+            target = target.value
+        name = dotted_name(target)
+        if not name:
+            return None
+        parts = name.split(".")
+        candidates: list[str] = []
+        if parts[0] in ("self", "cls") and fn.cls is not None:
+            if len(parts) == 2:
+                candidates.append(f"{fn.cls.qualname}.{parts[1]}")
+                for base in fn.cls.bases:
+                    base_cls = self.project._resolve_class(
+                        module_qualname(fn.module.rel_path), base)
+                    if base_cls is not None:
+                        candidates.append(f"{base_cls.qualname}.{parts[1]}")
+            elif len(parts) >= 3:
+                owner = self.project.attr_chain_class(fn.cls, parts[1:-1])
+                if owner is not None:
+                    candidates.append(f"{owner.qualname}.{parts[-1]}")
+        elif len(parts) == 1:
+            candidates.append(
+                f"{module_qualname(fn.module.rel_path)}.{parts[0]}")
+        for cand in candidates:
+            if cand in self.locks:
+                return cand
+        return None
+
+
+class _MustHeld(MustAnalysis):
+    """Per-node must-held lock ids within one function."""
+
+    def __init__(self, registry: LockRegistry, fn: FunctionInfo,
+                 entry: frozenset):
+        self._registry = registry
+        self._fn = fn
+        self._entry = entry
+
+    def initial(self):
+        return self._entry
+
+    def _locks_of_with(self, node: Node) -> frozenset:
+        out = set()
+        for item in node.stmt.items:  # type: ignore[union-attr]
+            lock = self._registry.resolve_lock(self._fn, item.context_expr)
+            if lock is not None:
+                out.add(lock)
+        return frozenset(out)
+
+    def transfer(self, node: Node, value):
+        if node.kind == "with_enter":
+            return value | self._locks_of_with(node)
+        if node.kind == "with_exit":
+            return value - self._locks_of_with(node)
+        for sub in node.walk():
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute):
+                if sub.func.attr == "acquire":
+                    lock = self._registry.resolve_lock(self._fn, sub.func)
+                    if lock is not None:
+                        value = value | {lock}
+                elif sub.func.attr == "release":
+                    lock = self._registry.resolve_lock(self._fn, sub.func)
+                    if lock is not None:
+                        value = value - {lock}
+        return value
+
+
+class HeldLockMap:
+    """Lazy per-function must-held-locks maps with interprocedural entry
+    states, queried by AST site."""
+
+    def __init__(self, project: Project, registry: LockRegistry):
+        self.project = project
+        self.registry = registry
+        self._maps: dict[FunctionInfo, dict[int, frozenset]] = {}
+        self._locators: dict[FunctionInfo, dict[int, Node]] = {}
+        self._entries: dict[FunctionInfo, frozenset] = {}
+        self._in_edges: dict[FunctionInfo, list] | None = None
+
+    # ----------------------------------------------------------- public API
+
+    def held_at(self, fn: FunctionInfo, site: ast.AST) -> frozenset:
+        """Lock ids provably held whenever ``site`` (an AST node inside
+        ``fn``) evaluates — the in-value of its CFG node, empty when the
+        site cannot be located or the node is unreached."""
+        node = self._locator(fn).get(id(site))
+        if node is None:
+            return frozenset()
+        value = self._map(fn).get(node.index)
+        return value if value is not None else frozenset()
+
+    def thread_locks_at(self, fn: FunctionInfo, site: ast.AST) -> frozenset:
+        return frozenset(
+            lock for lock in self.held_at(fn, site)
+            if self.registry.locks.get(lock) == "thread")
+
+    # ------------------------------------------------------------ internals
+
+    def _locator(self, fn: FunctionInfo) -> dict[int, Node]:
+        loc = self._locators.get(fn)
+        if loc is None:
+            cfg = cfg_for(fn.module, fn.node)
+            loc = {}
+            for node in cfg.nodes:
+                for sub in node.walk():
+                    loc.setdefault(id(sub), node)
+            self._locators[fn] = loc
+        return loc
+
+    def _map(self, fn: FunctionInfo) -> dict[int, frozenset]:
+        cached = self._maps.get(fn)
+        if cached is None:
+            cached = self._solve(fn, self._entry(fn, frozenset()))
+            self._maps[fn] = cached
+        return cached
+
+    def _solve(self, fn: FunctionInfo,
+               entry: frozenset) -> dict[int, frozenset]:
+        cfg = cfg_for(fn.module, fn.node)
+        res = solve(cfg, _MustHeld(self.registry, fn, entry))
+        return {idx: iv for idx, (iv, _ov) in res.items() if iv is not None}
+
+    def _edges_in(self) -> dict[FunctionInfo, list]:
+        if self._in_edges is None:
+            rev: dict[FunctionInfo, list] = {}
+            for fn in self.project.functions.values():
+                for edge in fn.calls:
+                    rev.setdefault(edge.callee, []).append(edge)
+            self._in_edges = rev
+        return self._in_edges
+
+    def _entry(self, fn: FunctionInfo, stack: frozenset) -> frozenset:
+        """Locks held at every resolved call site of ``fn``. Cycles are
+        broken optimistically (the cyclic contribution is skipped);
+        thread/task spawn edges contribute the empty set."""
+        cached = self._entries.get(fn)
+        if cached is not None:
+            return cached
+        if fn in stack:
+            return frozenset()
+        contributions: list[frozenset] = []
+        for edge in self._edges_in().get(fn, ()):
+            if edge.kind != "call":
+                contributions.append(frozenset())
+                continue
+            caller = edge.caller
+            if caller in stack:
+                continue
+            caller_map = self._maps.get(caller)
+            if caller_map is None:
+                caller_map = self._solve(
+                    caller, self._entry(caller, stack | {fn}))
+                self._maps.setdefault(caller, caller_map)
+            node = self._locator(caller).get(id(edge.site))
+            value = caller_map.get(node.index) if node is not None else None
+            contributions.append(value if value is not None else frozenset())
+        if contributions:
+            entry = contributions[0]
+            for c in contributions[1:]:
+                entry = entry & c
+        else:
+            entry = frozenset()
+        self._entries[fn] = entry
+        return entry

@@ -19,4 +19,9 @@ from tpudfs.analysis.rules import (  # noqa: F401
     rpc_contract,
     checksum_taint,
     task_escape,
+    # CFG/dataflow rules (see tpudfs/analysis/cfg.py + dataflow.py)
+    races,
+    lock_hygiene,
+    resources,
+    raft_durability,
 )

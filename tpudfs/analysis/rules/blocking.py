@@ -73,6 +73,23 @@ class BlockingCallInAsync(Rule):
     name = "blocking-call-in-async"
     summary = ("time.sleep / sync I/O / subprocess inside `async def` stalls "
                "the event loop (heartbeats, elections, replication)")
+    doc = (
+        "Everything in tpudfs shares one event loop per process: Raft "
+        "ticks, heartbeats, RPC dispatch, replication pipelines. One "
+        "blocking call in any coroutine freezes all of them — a 200ms "
+        "disk read in a handler delays every election timer on the node. "
+        "The rule flags known-blocking leaves (time.sleep, requests, "
+        "subprocess, sync file I/O methods) lexically inside `async def`. "
+        "Sync `def`s nested in a coroutine are exempt: that is the "
+        "to_thread worker idiom."
+    )
+    example = """\
+async def pump(path):
+    time.sleep(0.5)            # stalls every coroutine on the loop
+    return path.read_bytes()   # sync disk I/O on the loop
+"""
+    fix = ("`await asyncio.sleep(...)` for delays; wrap blocking work in "
+           "`await asyncio.to_thread(fn, ...)` (or an executor).")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         for node in ast.walk(module.tree):

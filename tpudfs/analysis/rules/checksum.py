@@ -155,6 +155,25 @@ class UnverifiedBlockRead(Rule):
     name = "unverified-block-read"
     summary = ("data-plane read path returns bytes without a CRC32C/verify "
                "call or a delegation to a verified read")
+    doc = (
+        "End-to-end CRC32C is the paper's integrity story: every byte "
+        "leaving the data plane (chunkserver/client/tpu packages) must "
+        "have been verified against its sidecar checksum somewhere on "
+        "the read path. This per-function heuristic accepts a verify "
+        "call, a corruption raise, or delegation to a read-named callee; "
+        "intentionally-raw primitives carry `# tpulint: disable=TPL005` "
+        "on their `def` line with justification, which TPL013 then "
+        "treats as a taint source for whole-program tracking."
+    )
+    example = """\
+def read_block(path):          # tpudfs/chunkserver/...
+    with open(path, "rb") as f:
+        return f.read()        # no verify, no corruption raise
+"""
+    fix = ("Verify before returning (compare crc32c, raise "
+           "BlockCorruptionError on mismatch), or delegate to a "
+           "*_verified read; mark a deliberate raw primitive on its "
+           "`def` line.")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if not module.rel_path.startswith(DATA_PLANE_PREFIXES):

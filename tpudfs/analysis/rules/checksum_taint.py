@@ -21,12 +21,23 @@ checked): a function is flagged when it performs no verification of its
 own and some resolved chain reaches a declared-raw read with no
 verification anywhere between. The full chain appears in the message.
 
+One exemption mirrors ``_VERIFIED_RPC_METHODS`` in checksum.py: some RPC
+methods ship raw payloads *by contract* — every consumer re-verifies
+per-slot (the batch ``ReadBlocks`` path: read_combiner checks
+``expected_crc`` before any byte reaches a caller). The server handler
+registered for such a method is the server half of that contract, so its
+chain down to the raw primitive is the documented design, not an escape.
+The contract is codified here, in the handler-table registration — not
+with a suppression, which would hide genuinely new escapes in the same
+function.
+
 Unresolved delegation stays TPL005's territory — no resolution, no
 finding.
 """
 
 from __future__ import annotations
 
+import ast
 from typing import Iterator
 
 from tpudfs.analysis.callgraph import FunctionInfo, Project
@@ -38,9 +49,33 @@ from tpudfs.analysis.rules.checksum import (
     _returns_value,
 )
 
+#: RPC methods whose payloads ship unverified by documented contract:
+#: every consumer re-verifies per-slot before bytes escape. Keep in sync
+#: with the "deliberately absent" note on checksum.py's
+#: ``_VERIFIED_RPC_METHODS``.
+_CONSUMER_VERIFIED_RPCS = {"ReadBlocks"}
+
 
 def _declared_raw(fn: FunctionInfo) -> bool:
     return fn.module.suppressed("TPL005", fn.node.lineno)
+
+
+def _serves_consumer_verified_rpc(fn: FunctionInfo) -> bool:
+    """True when ``fn`` is registered in a handler table as the server
+    handler for a consumer-verified RPC method (``{"ReadBlocks":
+    self.rpc_read_blocks}``)."""
+    for node in ast.walk(fn.module.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            if not (isinstance(key, ast.Constant)
+                    and key.value in _CONSUMER_VERIFIED_RPCS):
+                continue
+            if isinstance(value, ast.Attribute) and value.attr == fn.name:
+                return True
+            if isinstance(value, ast.Name) and value.id == fn.name:
+                return True
+    return False
 
 
 def _is_read_fn(fn: FunctionInfo) -> bool:
@@ -54,6 +89,26 @@ class ChecksumTaintEscape(ProjectRule):
     summary = ("data-plane read path resolves (transitively) to a "
                "declared-raw read with no CRC32C verification on the way — "
                "unverified bytes escape the data plane")
+    doc = (
+        "TPL005 credits any delegation to a read-named callee, so a "
+        "wrapper over the *declared-raw* primitive (`# tpulint: "
+        "disable=TPL005` on its `def` line) passes both checks while "
+        "returning unverified bytes. This rule follows the resolved "
+        "call graph instead of names: taint flows from declared-raw "
+        "reads up through unverified read hops (to_thread bridges "
+        "included — threading moves code, not verification) until a "
+        "verifying hop stops it. Handlers registered for "
+        "consumer-verified RPCs (ReadBlocks: every consumer re-verifies "
+        "per-slot) are the codified exception."
+    )
+    example = """\
+def read_cached(self, block_id):
+    # Store.read is declared raw (disable=TPL005 on its def line)
+    return self.store.read(block_id)   # unverified bytes escape
+"""
+    fix = ("Verify in the wrapper, route through a verified variant, or "
+           "— for a genuinely raw-by-contract API — declare the wrapper "
+           "raw on its own `def` line with justification.")
 
     def check_project(self, project: Project) -> Iterator[Finding]:
         #: fn -> chain down to the raw primitive, or None if clean
@@ -94,6 +149,8 @@ class ChecksumTaintEscape(ProjectRule):
             if not _is_read_fn(fn) or _declared_raw(fn):
                 continue
             if _has_verification(fn.node):
+                continue
+            if _serves_consumer_verified_rpc(fn):
                 continue
             chain = raw_chain(fn, set())
             if chain is None:

@@ -49,6 +49,21 @@ class NondeterminismInPureCore(Rule):
     name = "nondeterminism-in-pure-core"
     summary = ("wall-clock / module-level random / uuid inside raft/core.py "
                "breaks deterministic simulation replay")
+    doc = (
+        "The Raft core is tested by deterministic simulation (seeded "
+        "schedules, replayable histories — tests/raft_sim.py). That only "
+        "works if the core's behavior is a pure function of its inputs: "
+        "time comes in as a parameter, randomness from an injected "
+        "seeded rng. A stray time.monotonic() or random.uniform() makes "
+        "a failing schedule unreproducible — the one property that makes "
+        "consensus bugs debuggable."
+    )
+    example = """\
+def election_timeout(self):    # tpudfs/raft/core.py
+    return time.monotonic() + random.uniform(1, 2)
+"""
+    fix = ("Take `now` as an argument (the node passes it in) and draw "
+           "jitter from the injected `random.Random(seed)`.")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if module.rel_path not in PURE_MODULES:

@@ -107,6 +107,25 @@ class SilentBroadExcept(Rule):
     name = "silent-broad-except"
     summary = ("bare/broad `except` that neither logs, re-raises, propagates "
                "nor counts — can silently swallow data-plane corruption")
+    doc = (
+        "A distributed file system's worst failure mode is silent: a "
+        "swallowed BlockCorruptionError is a read that returned garbage "
+        "and told no one. `except Exception: pass` (or bare `except`) is "
+        "acceptable only when the handler leaves a trace — a log line, a "
+        "metrics counter, a re-raise — so operators can see the failure "
+        "rate. Narrow excepts ((OSError, ValueError)) are always fine: "
+        "naming the exception is itself the evidence of intent."
+    )
+    example = """\
+def read_meta(path):
+    try:
+        return load(path)
+    except Exception:
+        pass           # corruption, ENOSPC, bugs: all invisible
+"""
+    fix = ("Narrow the exception types, or keep the breadth but log "
+           "(`logger.exception`), count (`self.metrics.x += 1`), or "
+           "re-raise a wrapped error.")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         for node in ast.walk(module.tree):

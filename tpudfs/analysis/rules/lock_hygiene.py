@@ -1,0 +1,235 @@
+"""TPL021 — path-sensitive lock hygiene over the function CFG.
+
+TPL002 is lexical: it sees an ``await`` written inside a ``with lock:``
+body, or a bare ``.acquire()`` in async code, and fires on the shape. This
+rule runs a may-analysis over :mod:`tpudfs.analysis.cfg` and reasons about
+*paths*, which catches what shapes cannot:
+
+- a ``threading`` lock acquired with a bare ``.acquire()`` and provably
+  still held when control reaches an ``await`` — the event-loop thread
+  parks with the mutex locked, and every other thread (and any coroutine
+  reaching the same lock) blocks behind a suspended coroutine;
+- **any** lock (``threading`` or ``asyncio``) acquired without ``with``
+  on a path that can raise before the matching ``.release()`` — the
+  exception unwinds, nothing releases, and the lock is dead forever; also
+  the plain multi-path variant where an early ``return`` skips the
+  release.
+
+``with``-based acquisitions are exempt everywhere here: the context
+manager releases on all paths by construction (their await-crossing case
+is TPL002's). A function that never calls ``.release()`` on the lock is
+also exempt from the leak checks — that is the cross-function hand-off
+protocol, someone else's release, and flow analysis inside one function
+cannot judge it.
+
+Lock identity is module-local (names and ``self.attr`` targets assigned
+from ``threading.*``/``asyncio.*`` lock constructors), like TPL002 —
+which keeps this rule per-module and content-cacheable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.cfg import Node, cfg_for
+from tpudfs.analysis.dataflow import MayAnalysis, solve
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+from tpudfs.analysis.lockinfo import ASYNC_CTORS, THREAD_CTORS
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _lock_kinds(module: ModuleInfo) -> dict[str, str]:
+    """Module-local lock symbols: dotted name -> "thread" | "async"."""
+    kinds: dict[str, str] = {}
+    for node in ast.walk(module.tree):
+        value = None
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if not isinstance(value, ast.Call):
+            continue
+        ctor = dotted_name(value.func)
+        if ctor in THREAD_CTORS:
+            kind = "thread"
+        elif ctor in ASYNC_CTORS:
+            kind = "async"
+        else:
+            continue
+        for t in targets:
+            name = dotted_name(t)
+            if name:
+                kinds[name] = kind
+    return kinds
+
+
+def _receiver_name(call: ast.Call) -> str | None:
+    if isinstance(call.func, ast.Attribute):
+        return dotted_name(call.func.value)
+    return None
+
+
+class _HeldMay(MayAnalysis):
+    """May-held lock entries: (name, kind, origin, acquire_lineno)."""
+
+    def __init__(self, kinds: dict[str, str]):
+        self._kinds = kinds
+
+    def _with_entries(self, node: Node) -> frozenset:
+        out = set()
+        for item in node.stmt.items:  # type: ignore[union-attr]
+            expr = item.context_expr
+            target = expr.func if isinstance(expr, ast.Call) else expr
+            if isinstance(target, ast.Attribute) \
+                    and target.attr in ("acquire", "locked"):
+                target = target.value
+            name = dotted_name(target)
+            kind = self._kinds.get(name or "")
+            if kind is not None:
+                out.add((name, kind, "with", node.stmt.lineno))
+        return frozenset(out)
+
+    def transfer(self, node: Node, value):
+        if node.kind == "with_enter":
+            return value | self._with_entries(node)
+        if node.kind == "with_exit":
+            return value - self._with_entries(node)
+        for sub in node.walk():
+            if not isinstance(sub, ast.Call) \
+                    or not isinstance(sub.func, ast.Attribute):
+                continue
+            name = _receiver_name(sub)
+            kind = self._kinds.get(name or "")
+            if kind is None:
+                continue
+            if sub.func.attr == "acquire":
+                value = value | {(name, kind, "bare", sub.lineno)}
+            elif sub.func.attr == "release":
+                value = frozenset(e for e in value if e[0] != name)
+        return value
+
+    def edge_value(self, src: Node, dst: Node, kind: str, value):
+        if kind != "exc":
+            return value
+        # If the acquire statement itself raised, the lock was not taken.
+        return frozenset(e for e in value
+                         if not (e[2] == "bare" and e[3] == src.lineno))
+
+
+@register
+class PathSensitiveLockHygiene(Rule):
+    id = "TPL021"
+    name = "lock-leak-on-path"
+    summary = ("bare .acquire() held across an await, or a lock acquired "
+               "on a path that can raise (or return) before release — "
+               "use `with` so every path releases")
+    doc = (
+        "Path-sensitive companion to TPL002: a may-analysis over the "
+        "function CFG tracks which bare `.acquire()` calls are still "
+        "unreleased at each node, including the exception edges the "
+        "lexical check cannot see. A threading lock provably held when "
+        "control reaches an `await` parks the loop thread with the "
+        "mutex locked; any lock still held at the raise-exit leaks "
+        "permanently when an exception unwinds before the `.release()`; "
+        "one still held at a `return` means some branch skips the "
+        "release. `with`-based acquisitions are exempt (the context "
+        "manager releases on all paths), as are functions that never "
+        "release the lock at all (the cross-function hand-off protocol)."
+    )
+    example = """\
+def charge(self, n):
+    self._mu.acquire()
+    self._balance -= n        # raises on bad n -> _mu locked forever
+    self._mu.release()
+"""
+    fix = ("`with self._mu:` — or release in a `finally`; never hold a "
+           "threading lock across an `await`.")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        kinds = _lock_kinds(module)
+        if not kinds:
+            return
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, _FUNC_NODES):
+                yield from self._check_fn(module, kinds, fn)
+
+    def _check_fn(self, module: ModuleInfo, kinds: dict[str, str],
+                  fn: ast.FunctionDef | ast.AsyncFunctionDef) -> \
+            Iterator[Finding]:
+        # Pre-scan this function only: acquire sites and released names.
+        acquire_sites: dict[tuple[str, int], ast.Call] = {}
+        released: set[str] = set()
+        for sub in ast.walk(fn):
+            if module.enclosing_function(sub) is not fn:
+                continue
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute):
+                name = _receiver_name(sub)
+                if name in kinds:
+                    if sub.func.attr == "acquire":
+                        acquire_sites[(name, sub.lineno)] = sub
+                    elif sub.func.attr == "release":
+                        released.add(name)
+        if not acquire_sites and not released:
+            return
+
+        cfg = cfg_for(module, fn)
+        res = solve(cfg, _HeldMay(kinds))
+
+        def in_value(node: Node) -> frozenset:
+            pair = res.get(node.index)
+            return pair[0] if pair and pair[0] is not None else frozenset()
+
+        # -- bare thread-lock holds across a suspension point
+        reported_awaits: set[tuple[str, int]] = set()
+        for node in cfg.await_nodes():
+            for name, kind, origin, line in sorted(in_value(node)):
+                if origin != "bare" or kind != "thread":
+                    continue
+                site = (name, line)
+                if site in reported_awaits:
+                    continue
+                reported_awaits.add(site)
+                yield self.finding(
+                    module, node.stmt if node.stmt is not None else fn,
+                    f"threading lock `{name}` (bare .acquire() at line "
+                    f"{line}) is still held when this path reaches the "
+                    f"`await` at line {node.lineno} — the loop thread "
+                    "parks with the mutex locked; release before "
+                    "awaiting, or use `with` + asyncio.to_thread",
+                )
+
+        # -- bare acquisitions that leak on some path
+        leak_exc = {(e[0], e[3]) for e in in_value(cfg.raise_exit)
+                    if e[2] == "bare"}
+        leak_ret = {(e[0], e[3]) for e in in_value(cfg.exit)
+                    if e[2] == "bare"}
+        for (name, line) in sorted(leak_exc | leak_ret):
+            if name not in released:
+                continue  # hand-off protocol: released elsewhere
+            site = acquire_sites.get((name, line))
+            if site is None:
+                continue
+            if (name, line) in leak_exc and (name, line) in leak_ret:
+                how = ("on some paths — including an exception unwinding "
+                       "before the release")
+            elif (name, line) in leak_exc:
+                how = ("when an exception is raised between the acquire "
+                       "and the release")
+            else:
+                how = "on an early-return path that skips the release"
+            yield self.finding(
+                module, site,
+                f"lock `{name}` acquired here is left locked {how} — "
+                "every later acquirer deadlocks; use `with {0}:` or "
+                "release in a `finally`".format(name),
+            )

@@ -20,9 +20,11 @@ the project-wide lock-acquisition graph and reports:
    across slow work anywhere) are deliberately NOT flagged: guarding a few
    assignments with a mutex from async code is harmless and common.
 
-Lock identity is the owning scope plus attribute (``pkg.mod.Class._mu`` /
+Lock identity — the owning scope plus attribute (``pkg.mod.Class._mu`` /
 ``pkg.mod.global_mu``), registered from ``threading.Lock()`` /
-``asyncio.Lock()``-style constructor assignments anywhere in the project.
+``asyncio.Lock()``-style constructor assignments anywhere in the project —
+lives in the shared :class:`~tpudfs.analysis.lockinfo.LockRegistry`, which
+the TPL020 race detector reuses.
 """
 
 from __future__ import annotations
@@ -31,28 +33,14 @@ import ast
 from dataclasses import dataclass
 from typing import Iterator
 
-from tpudfs.analysis.callgraph import (
-    ClassInfo,
-    FunctionInfo,
-    Project,
-    module_qualname,
-)
+from tpudfs.analysis.callgraph import FunctionInfo, Project
 from tpudfs.analysis.linter import (
     Finding,
     ProjectRule,
-    dotted_name,
     register,
 )
+from tpudfs.analysis.lockinfo import LockRegistry
 from tpudfs.analysis.rules.blocking import blocking_call
-
-_THREAD_CTORS = {
-    "threading.Lock", "threading.RLock", "threading.Condition",
-    "threading.Semaphore", "threading.BoundedSemaphore",
-}
-_ASYNC_CTORS = {
-    "asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
-    "asyncio.BoundedSemaphore",
-}
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
@@ -69,94 +57,25 @@ class _Acq:
 
 
 class _LockWorld:
-    """Registry + per-function acquisitions + transitive closures."""
+    """Shared registry + per-function acquisitions + transitive closures."""
 
     def __init__(self, project: Project):
         self.project = project
-        self.locks: dict[str, str] = {}  # lock id -> kind
+        self.registry = LockRegistry(project)
         self.acqs: dict[FunctionInfo, list[_Acq]] = {}
         self._closure_memo: dict[FunctionInfo, dict[str, list[str]]] = {}
         self._slow_memo: dict[FunctionInfo, bool] = {}
-        self._register_locks()
         for fn in project.functions.values():
             self.acqs[fn] = list(self._function_acqs(fn))
 
-    # -- lock registry ------------------------------------------------------
-
-    def _register_locks(self) -> None:
-        for mod in self.project.modules.values():
-            modname = module_qualname(mod.rel_path)
-            for node in ast.walk(mod.tree):
-                value = None
-                targets: list[ast.AST] = []
-                if isinstance(node, ast.Assign):
-                    value, targets = node.value, node.targets
-                elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                    value, targets = node.value, [node.target]
-                if not isinstance(value, ast.Call):
-                    continue
-                ctor = dotted_name(value.func)
-                if ctor in _THREAD_CTORS:
-                    kind = "thread"
-                elif ctor in _ASYNC_CTORS:
-                    kind = "async"
-                else:
-                    continue
-                for t in targets:
-                    name = dotted_name(t)
-                    if not name:
-                        continue
-                    if name.startswith("self.") and name.count(".") == 1:
-                        cls = self._enclosing_class(mod, node)
-                        if cls is None:
-                            continue
-                        lock_id = f"{cls.qualname}.{name.split('.', 1)[1]}"
-                    elif "." not in name:
-                        lock_id = f"{modname}.{name}"
-                    else:
-                        continue
-                    self.locks[lock_id] = kind
-
-    def _enclosing_class(self, mod, node: ast.AST) -> ClassInfo | None:
-        modname = module_qualname(mod.rel_path)
-        for anc in mod.ancestors(node):
-            if isinstance(anc, ast.ClassDef):
-                return self.project.classes.get(
-                    f"{modname}.{mod.qualname(anc)}")
-        return None
-
-    # -- acquisition sites --------------------------------------------------
+    @property
+    def locks(self) -> dict[str, str]:
+        return self.registry.locks
 
     def resolve_lock(self, fn: FunctionInfo, expr: ast.AST) -> str | None:
-        """Lock id for a with-item / acquire receiver expression."""
-        target = expr.func if isinstance(expr, ast.Call) else expr
-        if isinstance(target, ast.Attribute) \
-                and target.attr in ("acquire", "locked"):
-            target = target.value
-        name = dotted_name(target)
-        if not name:
-            return None
-        parts = name.split(".")
-        candidates: list[str] = []
-        if parts[0] in ("self", "cls") and fn.cls is not None:
-            if len(parts) == 2:
-                candidates.append(f"{fn.cls.qualname}.{parts[1]}")
-                for base in fn.cls.bases:
-                    base_cls = self.project._resolve_class(
-                        module_qualname(fn.module.rel_path), base)
-                    if base_cls is not None:
-                        candidates.append(f"{base_cls.qualname}.{parts[1]}")
-            elif len(parts) == 3:
-                attr_cls = self.project.attr_class(fn.cls, parts[1])
-                if attr_cls is not None:
-                    candidates.append(f"{attr_cls.qualname}.{parts[2]}")
-        elif len(parts) == 1:
-            candidates.append(
-                f"{module_qualname(fn.module.rel_path)}.{parts[0]}")
-        for cand in candidates:
-            if cand in self.locks:
-                return cand
-        return None
+        return self.registry.resolve_lock(fn, expr)
+
+    # -- acquisition sites --------------------------------------------------
 
     def _function_acqs(self, fn: FunctionInfo) -> Iterator[_Acq]:
         for node in ast.walk(fn.node):
@@ -294,6 +213,26 @@ class LockOrderInversion(ProjectRule):
     summary = ("cyclic lock-acquisition order across the project, or a "
                "threading.Lock that async code can block on while another "
                "path holds it across slow work")
+    doc = (
+        "ABBA deadlocks that survive review are split across files: one "
+        "module takes lock A then calls into another that takes B, while "
+        "a reverse path takes B then A — no single file contains the "
+        "cycle. This rule builds the project-wide held->acquired graph "
+        "(with acquisitions reached through resolved calls inside `with` "
+        "bodies) and reports cycles; it also flags async code that can "
+        "block on a threading lock which some other path holds across "
+        "slow work. Lock identity lives in the shared LockRegistry "
+        "(lockinfo.py), the same one TPL020 uses."
+    )
+    example = """\
+# alpha.py                       # beta.py
+def fwd():                       def rev():
+    with LOCK_A:                     with LOCK_B:
+        beta.take_b()                    alpha.take_a()
+"""
+    fix = ("Pick one global acquisition order (document it where the "
+           "locks are defined) or merge the locks; keep thread locks "
+           "reachable from async code short-hold only.")
 
     def check_project(self, project: Project) -> Iterator[Finding]:
         world = _LockWorld(project)

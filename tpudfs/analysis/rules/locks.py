@@ -97,6 +97,27 @@ class AwaitUnderThreadLock(Rule):
     name = "await-under-thread-lock"
     summary = ("`await` while holding a threading.Lock (or acquiring one "
                "from async code) can deadlock the event loop")
+    doc = (
+        "A threading.Lock blocks the whole OS thread. Awaiting while "
+        "holding one parks the coroutine but keeps the mutex locked, so "
+        "every other coroutine (and thread) that wants it stalls — and if "
+        "the awaited work itself needs the lock, the loop deadlocks. "
+        "Acquiring a threading lock from async code has the same hazard "
+        "in the other direction: the loop thread can block on acquire. "
+        "This rule is the lexical check; TPL021 proves the path-sensitive "
+        "variants over the CFG."
+    )
+    example = """\
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+    async def flush(self, sink):
+        with self._mu:
+            await sink.drain()   # loop parks holding the mutex
+"""
+    fix = ("Use asyncio.Lock for coroutine-only state; for state shared "
+           "with worker threads, keep the threading.Lock but only touch "
+           "it from sync code via `await asyncio.to_thread(...)`.")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         locks = _lock_symbols(module)

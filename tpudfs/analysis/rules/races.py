@@ -1,0 +1,350 @@
+"""TPL020 — static cross-executor race detector.
+
+tpudfs runs its control plane on one asyncio loop and offloads disk I/O
+with ``asyncio.to_thread`` / ``run_in_executor``. That split creates the
+codebase's highest-risk bug class: instance attributes and module globals
+touched both *on the loop* and *on a worker thread*. Coroutines interleave
+only at ``await``, so loop-only state needs no lock at all — which makes
+it easy to forget that the moment one access moves behind ``to_thread``,
+that comfortable model is gone and only a ``threading.Lock`` (held on
+BOTH sides) restores it. ``asyncio.Lock`` does not help: it serializes
+coroutines on the loop and cannot even be acquired from a worker thread.
+
+The detector:
+
+1. classifies every function's execution context from call-graph roots
+   (:meth:`Project.execution_contexts`): event-loop coroutine, ``to_thread``
+   / executor / ``threading.Thread`` worker, background ``create_task``
+   task — collapsed to the OS-thread dimension (task == loop thread);
+2. collects every ``self.*`` attribute access (receiver chains resolved
+   through inferred attribute types, mutator calls and subscript stores
+   count as writes) and every module-global access (a global is tracked
+   once some function declares ``global X`` and writes it) per context;
+3. flags state written in one thread dimension and accessed in the other
+   when no common ``threading`` lock is provably held on both paths —
+   "provably held" is the interprocedural must-analysis in
+   :class:`~tpudfs.analysis.lockinfo.HeldLockMap`, so the
+   ``_locked_helper`` idiom (callers hold the mutex) is credited.
+
+Out of scope, deliberately: worker-vs-worker races (the executor pool is
+ours; today every offloaded callable touches disjoint state — a dedicated
+pass can ratchet this later), writes inside ``__init__``-family methods
+(construction happens-before publication), and containers whose
+thread-safety comes from the GIL'd method granularity — a single ``dict``
+get/set is atomic, but the rule still flags it because check-then-act
+sequences on it are not.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import FunctionInfo, Project, module_qualname
+from tpudfs.analysis.linter import Finding, ProjectRule, dotted_name, register
+from tpudfs.analysis.lockinfo import HeldLockMap, LockRegistry
+
+#: Writes in these methods happen before the object is visible to any
+#: other context.
+_CTOR_NAMES = {"__init__", "__new__", "__post_init__", "__setstate__"}
+
+#: Receiver-method calls that mutate the receiver's state.
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "popitem",
+    "clear", "sort", "reverse", "update", "setdefault", "add", "discard",
+    "appendleft", "extendleft", "difference_update", "intersection_update",
+    "symmetric_difference_update", "put_nowait", "__setitem__",
+}
+
+_DIM_LABEL = {
+    "worker": "a to_thread/executor worker thread",
+    "loop": "the event loop",
+}
+
+
+@dataclass
+class _Access:
+    fn: FunctionInfo
+    site: ast.AST
+    kind: str  # "read" | "write"
+    dims: frozenset  # OS-thread dimensions of fn
+    labels: frozenset  # full context labels, for the message
+
+
+def _chain_parts(node: ast.Attribute) -> list[str] | None:
+    name = dotted_name(node)
+    return name.split(".") if name else None
+
+
+def _module_globals(project: Project) -> dict[str, set[str]]:
+    """Per module (dotted name): globals some function writes via a
+    ``global`` declaration — the only module state that can race."""
+    out: dict[str, set[str]] = {}
+    for mod in project.modules.values():
+        modname = module_qualname(mod.rel_path)
+        names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Global):
+                names.update(node.names)
+        if names:
+            out[modname] = names
+    return out
+
+
+def _fn_local_names(fn: FunctionInfo) -> set[str]:
+    """Names that are local to ``fn`` (params + stores without a global
+    declaration) — accesses to these shadow any module global."""
+    node = fn.node
+    args = node.args
+    local = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        local.add(args.vararg.arg)
+    if args.kwarg:
+        local.add(args.kwarg.arg)
+    declared_global: set[str] = set()
+    for sub in ast.walk(node):
+        if fn.module.enclosing_function(sub) is not node:
+            continue
+        if isinstance(sub, ast.Global):
+            declared_global.update(sub.names)
+        elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            local.add(sub.id)
+    return local - declared_global
+
+
+@register
+class CrossExecutorRace(ProjectRule):
+    id = "TPL020"
+    name = "cross-executor-race"
+    summary = ("state written on one side of the loop/worker-thread "
+               "boundary and accessed on the other with no common "
+               "threading.Lock held on both paths")
+    doc = (
+        "Coroutines interleave only at `await`, so loop-only state needs "
+        "no lock — until one access moves behind asyncio.to_thread and "
+        "the comfortable model silently stops applying. The detector "
+        "classifies every function's execution context from call-graph "
+        "roots (loop coroutine / to_thread-executor worker / create_task "
+        "task, collapsed to the OS-thread dimension), collects self.* "
+        "and module-global accesses per context, and flags state written "
+        "in one thread dimension and touched in the other unless one "
+        "threading.Lock is provably held on every path at both sites "
+        "(interprocedural must-analysis, so the `_locked_helper` idiom "
+        "is credited). asyncio.Lock does NOT count: it serializes "
+        "coroutines on the loop and cannot be held by executor code."
+    )
+    example = """\
+class Cache:
+    async def refresh(self):
+        await asyncio.to_thread(self._scan)   # worker thread...
+    def _scan(self):
+        self.stats = compute()                # ...writes self.stats
+    async def report(self):
+        return self.stats                     # loop reads it, no lock
+"""
+    fix = ("Guard both sides with one threading.Lock (short holds only), "
+           "or confine the state to one context and pass snapshots "
+           "across the boundary.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        contexts = project.execution_contexts()
+        classified = {
+            fn: labels for fn, labels in contexts.items()
+            if project.thread_dim(labels)
+        }
+        if not classified:
+            return
+
+        #: access key -> accesses. Keys: ("attr", class_qualname, attr) |
+        #: ("global", module, name)
+        by_key: dict[tuple, list[_Access]] = {}
+        globals_by_mod = _module_globals(project)
+
+        for fn, labels in classified.items():
+            dims = project.thread_dim(labels)
+            self._collect_attr_accesses(project, fn, dims, labels, by_key)
+            self._collect_global_accesses(
+                project, fn, dims, labels, globals_by_mod, by_key)
+
+        # Candidate races first; the lock analysis only runs for them.
+        held: HeldLockMap | None = None
+        for key in sorted(by_key, key=str):
+            accesses = by_key[key]
+            writes = [a for a in accesses if a.kind == "write"]
+            if not writes:
+                continue
+            racy = self._racy_pair(writes, accesses)
+            if racy is None:
+                continue
+            if held is None:
+                held = HeldLockMap(project, LockRegistry(project))
+            finding = self._verify_pair(key, racy, accesses, writes, held)
+            if finding is not None:
+                yield finding
+
+    # ------------------------------------------------------------ collection
+
+    @staticmethod
+    def _self_class(project: Project, fn: FunctionInfo):
+        """The class ``self`` refers to inside ``fn`` — its own class, or
+        for a closure nested in a method (the ``to_thread(scan)`` idiom),
+        the enclosing method's class via the captured ``self``."""
+        if fn.cls is not None:
+            return fn.cls
+        mod = fn.module
+        modname = module_qualname(mod.rel_path)
+        for anc in mod.ancestors(fn.node):
+            if isinstance(anc, ast.ClassDef):
+                return project.classes.get(f"{modname}.{mod.qualname(anc)}")
+        return None
+
+    def _collect_attr_accesses(self, project: Project, fn: FunctionInfo,
+                               dims: frozenset, labels: frozenset,
+                               by_key: dict) -> None:
+        self_cls = self._self_class(project, fn)
+        if self_cls is None:
+            return
+        exempt_writes = fn.name in _CTOR_NAMES
+        mod = fn.module
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if mod.enclosing_function(node) is not fn.node:
+                continue
+            parent = mod.parent(node)
+            if isinstance(parent, ast.Attribute) \
+                    and _chain_parts(parent) is not None:
+                continue  # handled at the maximal chain
+            parts = _chain_parts(node)
+            if parts is None or parts[0] not in ("self", "cls"):
+                continue
+
+            def record(owner_parts: list[str], attr: str, kind: str,
+                       site: ast.AST) -> None:
+                if kind == "write" and exempt_writes:
+                    return
+                owner = project.attr_chain_class(self_cls, owner_parts) \
+                    if owner_parts else self_cls
+                if owner is None:
+                    return
+                key = ("attr", owner.qualname, attr)
+                by_key.setdefault(key, []).append(
+                    _Access(fn, site, kind, dims, labels))
+
+            # Intermediate hops of the chain are reads of those attrs.
+            for i in range(1, len(parts) - 1):
+                record(parts[1:i], parts[i], "read", node)
+
+            last = parts[-1]
+            if isinstance(parent, ast.Call) and parent.func is node:
+                # self.a.b.m(...) — a method call: `m` is behavior, the
+                # accessed state is `b`; mutator names make it a write.
+                if len(parts) >= 3:
+                    kind = "write" if last in _MUTATORS else "read"
+                    record(parts[1:-2], parts[-2], kind, node)
+                # self.m(...) contributes nothing: the method's own
+                # accesses are collected under its own contexts.
+                return_read = False
+            elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                record(parts[1:-1], last, "write", node)
+                return_read = False
+            elif isinstance(parent, ast.Subscript) and parent.value is node:
+                sub_parent = mod.parent(parent)
+                stored = isinstance(parent.ctx, (ast.Store, ast.Del))
+                aug = isinstance(sub_parent, ast.AugAssign) \
+                    and sub_parent.target is parent
+                record(parts[1:-1], last,
+                       "write" if stored or aug else "read", node)
+                return_read = False
+            else:
+                return_read = True
+            if return_read:
+                aug_parent = mod.parent(node)
+                if isinstance(aug_parent, ast.AugAssign) \
+                        and aug_parent.target is node:
+                    record(parts[1:-1], last, "write", node)
+                else:
+                    record(parts[1:-1], last, "read", node)
+
+    def _collect_global_accesses(self, project: Project, fn: FunctionInfo,
+                                 dims: frozenset, labels: frozenset,
+                                 globals_by_mod: dict,
+                                 by_key: dict) -> None:
+        modname = module_qualname(fn.module.rel_path)
+        tracked = globals_by_mod.get(modname)
+        if not tracked:
+            return
+        local = _fn_local_names(fn)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Name) or node.id not in tracked:
+                continue
+            if node.id in local:
+                continue
+            if fn.module.enclosing_function(node) is not fn.node:
+                continue
+            kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                else "read"
+            key = ("global", modname, node.id)
+            by_key.setdefault(key, []).append(
+                _Access(fn, node, kind, dims, labels))
+
+    # ---------------------------------------------------------- verification
+
+    @staticmethod
+    def _racy_pair(writes: list[_Access],
+                   accesses: list[_Access]) -> tuple[_Access, _Access] | None:
+        for w in writes:
+            for a in accesses:
+                if a is w:
+                    continue
+                if ("worker" in w.dims and "loop" in a.dims) or \
+                        ("loop" in w.dims and "worker" in a.dims):
+                    return w, a
+        return None
+
+    def _verify_pair(self, key: tuple, racy: tuple[_Access, _Access],
+                     accesses: list[_Access], writes: list[_Access],
+                     held: HeldLockMap) -> Finding | None:
+        # A pair is safe when one threading lock is must-held at both
+        # sites; the finding needs one UNSAFE pair.
+        def guarded(w: _Access, a: _Access) -> bool:
+            common = held.thread_locks_at(w.fn, w.site) \
+                & held.thread_locks_at(a.fn, a.site)
+            return bool(common)
+
+        unsafe: tuple[_Access, _Access] | None = None
+        for w in writes:
+            for a in accesses:
+                if a is w:
+                    continue
+                if not (("worker" in w.dims and "loop" in a.dims)
+                        or ("loop" in w.dims and "worker" in a.dims)):
+                    continue
+                if not guarded(w, a):
+                    unsafe = (w, a)
+                    break
+            if unsafe:
+                break
+        if unsafe is None:
+            return None
+
+        w, a = unsafe
+        w_dim = "worker" if "worker" in w.dims else "loop"
+        a_dim = "loop" if w_dim == "worker" else "worker"
+        if key[0] == "attr":
+            what = f"`{key[1].rsplit('.', 1)[-1]}.{key[2]}`"
+        else:
+            what = f"module global `{key[2]}` ({key[1]})"
+        other = (f"{a.fn.module.rel_path}:"
+                 f"{getattr(a.site, 'lineno', 0)} in `{a.fn.short()}`")
+        return self.finding(
+            w.fn.module, w.site,
+            f"{what} is written on {_DIM_LABEL[w_dim]} in `{w.fn.short()}` "
+            f"but {'written' if a.kind == 'write' else 'read'} on "
+            f"{_DIM_LABEL[a_dim]} at {other} with no common threading.Lock "
+            "held on both paths — a schedule-dependent race; guard both "
+            "sides with one threading.Lock (asyncio.Lock does not protect "
+            "against worker threads: it serializes coroutines on the loop "
+            "and cannot be held by executor code)",
+        )

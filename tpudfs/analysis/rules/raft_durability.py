@@ -1,0 +1,224 @@
+"""TPL023 — Raft durability ordering, proven with dataflow.
+
+Raft's safety argument leans on one storage invariant: hard state (term,
+vote, log entries) must be durable **before** any message advertising it
+leaves the node. Grant a vote, reply "granted", then crash before the
+vote hits disk — after restart the node votes again in the same term and
+two leaders can be elected. Acknowledge an AppendEntries before the
+entries are fsync'd and a crashed follower silently forgets entries the
+leader already counted toward commit.
+
+The old heuristic (raft_state.py) checked shapes: a reply statement
+lexically before a persist statement in the same function body. This
+rule upgrades that to a CFG property: a forward may-analysis accumulates
+outbound-send sites along paths, and any persist call whose in-state
+already contains a send is flagged — across branches, early returns and
+try/except routing, which the lexical check could not see. Loop back
+edges are cut before solving (``solve(..., skip_edges=cfg.back_edges())``)
+so the ordering is judged *per iteration*: persisting at the top of
+iteration N+1 after sending at the bottom of iteration N is the normal
+drive-loop shape, not a violation.
+
+A second check catches fire-and-forget persistence: a persist wrapped in
+``asyncio.to_thread(...)`` (or scheduled via ``create_task``) whose
+result is not awaited on the spot — the write has merely been *scheduled*
+when execution continues toward the send.
+
+Persist calls: a receiver chain through a storage/WAL attribute ending in
+a durability method (``save_hard_state``, ``append_entries``,
+``truncate_from``, ``save_snapshot``, or any ``save_*``/``append_*``/
+``persist*`` name), either called directly or passed as the callable to
+``asyncio.to_thread`` / ``run_in_executor``. Sends: ``_send`` / ``send``
+/ ``send_message`` / ``broadcast`` calls, or ``.call(...)`` on an
+rpc/client receiver — including ones wrapped in ``create_task``.
+
+Scoped to ``tpudfs/raft/``: these method names are only a contract there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.cfg import Node, cfg_for
+from tpudfs.analysis.dataflow import MayAnalysis, solve
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_STORAGE_PARTS = {"storage", "_storage", "wal", "_wal"}
+_PERSIST_METHODS = {"save_hard_state", "append_entries", "truncate_from",
+                    "save_snapshot"}
+_PERSIST_PREFIXES = ("save_", "append_", "persist")
+_SEND_NAMES = {"_send", "send", "send_message", "broadcast"}
+_RPC_RECEIVER_PARTS = {"client", "clients", "rpc", "transport", "peer",
+                       "peers"}
+_OFFLOAD_TAILS = {"to_thread", "run_in_executor"}
+
+
+def _persist_target(expr: ast.AST) -> str | None:
+    """The persisted method name if ``expr`` is a storage durability
+    method reference/call, else None."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr)
+    if not name:
+        return None
+    parts = name.split(".")
+    method = parts[-1]
+    if not any(p in _STORAGE_PARTS for p in parts[:-1]):
+        return None
+    if method in _PERSIST_METHODS or method.startswith(_PERSIST_PREFIXES):
+        return method
+    return None
+
+
+def _classify_call(call: ast.Call) -> tuple[str, str] | None:
+    """("persist"|"persist_offload"|"send", description) or None."""
+    func_name = dotted_name(call.func) or ""
+    tail = func_name.split(".")[-1]
+
+    if tail in _OFFLOAD_TAILS and call.args:
+        # asyncio.to_thread(self.storage.save_hard_state, ...) /
+        # loop.run_in_executor(None, self._storage.append_entries, ...)
+        for arg in call.args[:2]:
+            method = _persist_target(arg)
+            if method is not None:
+                return ("persist_offload", method)
+        return None
+
+    method = _persist_target(call)
+    if method is not None:
+        return ("persist", method)
+
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        recv = dotted_name(call.func.value) or ""
+        recv_parts = set(recv.split("."))
+        if attr in _SEND_NAMES:
+            return ("send", func_name or attr)
+        if attr == "call" and recv_parts & _RPC_RECEIVER_PARTS:
+            return ("send", func_name)
+    return None
+
+
+class _SendsSeen(MayAnalysis):
+    """May-set of send-call ids already executed on some path in."""
+
+    def __init__(self, sends: dict[int, ast.Call]):
+        self._sends = sends
+
+    def transfer(self, node: Node, value):
+        for sub in node.walk():
+            if id(sub) in self._sends:
+                value = value | {id(sub)}
+        return value
+
+
+@register
+class RaftDurabilityOrdering(Rule):
+    id = "TPL023"
+    name = "raft-durability-ordering"
+    summary = ("a Raft storage write (term/vote/log) happens after an "
+               "outbound message on some path, or is scheduled without "
+               "being awaited — state is advertised before it is durable")
+    doc = (
+        "Raft's safety proof assumes hard state is durable before any "
+        "message advertising it leaves the node: reply \"vote granted\" "
+        "before the vote hits disk and a crash+restart votes again in "
+        "the same term — two leaders. This rule proves the ordering on "
+        "the CFG: a may-analysis accumulates outbound sends along paths "
+        "(loop back edges cut, so iteration N's send does not poison "
+        "iteration N+1's persist) and flags any storage write whose "
+        "in-state already contains a send. It also flags persistence "
+        "offloaded via to_thread/create_task but not awaited — merely "
+        "scheduled is not durable. Scoped to tpudfs/raft/."
+    )
+    example = """\
+async def on_vote(self, req):
+    await self._send(req.frm, granted_reply())       # reply first...
+    await asyncio.to_thread(
+        self.storage.save_hard_state, t, v)          # ...persist after
+"""
+    fix = ("`await` the storage write first, then send; never wrap "
+           "hard-state persistence in fire-and-forget create_task.")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not module.rel_path.startswith("tpudfs/raft/"):
+            return
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, ast.AsyncFunctionDef):
+                yield from self._check_fn(module, fn)
+
+    def _check_fn(self, module: ModuleInfo,
+                  fn: ast.AsyncFunctionDef) -> Iterator[Finding]:
+        persists: dict[int, tuple[ast.Call, str, str]] = {}
+        sends: dict[int, ast.Call] = {}
+        parents: dict[int, ast.AST] = {}
+        for sub in ast.walk(fn):
+            if module.enclosing_function(sub) is not fn:
+                continue
+            for child in ast.iter_child_nodes(sub):
+                parents[id(child)] = sub
+            if not isinstance(sub, ast.Call):
+                continue
+            kind = _classify_call(sub)
+            if kind is None:
+                continue
+            if kind[0] == "send":
+                sends[id(sub)] = sub
+            else:
+                persists[id(sub)] = (sub, kind[0], kind[1])
+        if not persists:
+            return
+
+        # -- fire-and-forget persistence: offloaded but not awaited here.
+        for call, kind, method in persists.values():
+            if kind != "persist_offload":
+                continue
+            parent = parents.get(id(call))
+            if isinstance(parent, ast.Await) and parent.value is call:
+                continue
+            yield self.finding(
+                module, call,
+                f"storage write `{method}` is offloaded here but its "
+                "result is never awaited at this point — execution "
+                "continues (and may reply) while the write is merely "
+                "scheduled; `await` the offload before advertising the "
+                "state it persists",
+            )
+
+        if not sends:
+            return
+
+        # -- send-before-persist on some same-iteration path.
+        cfg = cfg_for(module, fn)
+        res = solve(cfg, _SendsSeen(sends), skip_edges=cfg.back_edges())
+        locator: dict[int, Node] = {}
+        for node in cfg.nodes:
+            for sub in node.walk():
+                locator.setdefault(id(sub), node)
+
+        for call, _kind, method in sorted(
+                persists.values(), key=lambda p: p[0].lineno):
+            node = locator.get(id(call))
+            if node is None:
+                continue
+            pair = res.get(node.index)
+            seen = pair[0] if pair and pair[0] is not None else frozenset()
+            if not seen:
+                continue
+            first = min(sends[sid].lineno for sid in seen)
+            yield self.finding(
+                module, call,
+                f"Raft durability ordering: `{method}` persists hard "
+                f"state here, but an outbound message already left on "
+                f"this path (send at line {first}) — a peer can observe "
+                "a vote/term/log entry that a crash right now would "
+                "forget, which breaks Raft's safety argument; await the "
+                "storage write first, then send",
+            )

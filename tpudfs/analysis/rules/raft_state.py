@@ -72,6 +72,23 @@ class RaftStateMutation(Rule):
     name = "raft-state-mutation"
     summary = ("Raft core state (term/voted_for/log/commit_index) mutated "
                "outside raft/core.py — bypasses the persistence effects")
+    doc = (
+        "The Raft core is sans-io: state transitions happen only inside "
+        "raft/core.py step functions, which emit explicit persistence "
+        "effects the node must apply (and fsync) before acting. A direct "
+        "`core.term = x` from node/transport code skips that contract — "
+        "the change is never persisted, and a crash restores the old "
+        "term, which can double-vote. TPL023 proves the complementary "
+        "runtime property: effects are persisted before messages leave."
+    )
+    example = """\
+def on_vote(core, req):
+    core.term = req["term"]      # no persistence effect emitted
+    core.log.append(req["e"])    # WAL never sees this entry
+"""
+    fix = ("Route every mutation through the core's step functions and "
+           "apply the returned effects; read-only access from outside is "
+           "fine.")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if module.rel_path in EXEMPT_MODULES:

@@ -1,0 +1,282 @@
+"""TPL022 — resource liveness over all CFG paths, exception edges included.
+
+A chunkserver that leaks one file descriptor per failed read eventually
+cannot open its own WAL; a forgotten ``create_task`` handle means the
+coroutine's exception is never retrieved and the task can be garbage
+collected mid-flight. The classic shape is *almost* right code::
+
+    fd = os.open(path, os.O_RDONLY)
+    data = os.read(fd, n)        # can raise — fd leaks on this edge
+    os.close(fd)
+
+This rule runs a may-analysis over the function CFG: an acquisition site
+stays live until a release kills it, and the ``exc`` edges give exception
+unwinding its own paths — so the example above is flagged even though the
+happy path closes, while the ``try/finally`` version is clean because the
+exception edges route through the ``finally`` close. An acquisition whose
+own statement raises is not charged (the ``edge_value`` hook subtracts the
+site on its ``exc`` edge: if ``os.open`` raised, there is nothing to
+leak).
+
+Tracked acquisitions (a simple ``name = <acquire>()`` binding): files and
+sockets (``open``, ``os.open``, ``os.fdopen``, ``socket.socket``,
+``socket.create_connection``), temp state (``tempfile.mkdtemp`` /
+``TemporaryDirectory`` / ``NamedTemporaryFile``), and task handles
+(``asyncio.create_task`` / ``ensure_future``, including the
+``loop.create_task`` attribute form; TaskGroup-style receivers are exempt
+because the group owns its children). Releases: using the variable in a
+``with``, ``await var``, ``os.close(var)``, or a method call from the
+release vocabulary (``close``, ``cancel``, ``join``,
+``add_done_callback``, ...).
+
+Any *other* use — returned, stored on ``self``, passed to a non-``os``
+call, yielded — is an **escape**: ownership moved somewhere flow analysis
+cannot follow, and the rule drops the variable entirely rather than
+guess. The rule is therefore precise exactly on the pattern that
+matters: a resource that provably never leaves the function must be
+released inside it, on every path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.cfg import Node, cfg_for
+from tpudfs.analysis.dataflow import MayAnalysis, solve
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: Dotted callable names whose result is an owned resource.
+_ACQUIRE_CALLS = {
+    "open": "file",
+    "os.open": "file descriptor",
+    "os.fdopen": "file",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "tempfile.mkdtemp": "temporary directory",
+    "tempfile.TemporaryDirectory": "temporary directory",
+    "tempfile.NamedTemporaryFile": "temporary file",
+    "asyncio.create_task": "task handle",
+    "asyncio.ensure_future": "task handle",
+}
+
+#: Attribute-call tails that also acquire (``loop.create_task(...)``),
+#: unless the receiver is a task group that owns its children.
+_ACQUIRE_ATTRS = {"create_task": "task handle", "ensure_future": "task handle"}
+_GROUP_RECEIVERS = {"tg", "taskgroup", "task_group", "group", "nursery"}
+
+#: Method names on the resource variable that end ownership.
+_RELEASE_METHODS = {
+    "close", "aclose", "cancel", "cleanup", "terminate", "kill", "join",
+    "shutdown", "release", "stop", "detach", "unlink", "add_done_callback",
+}
+
+#: Parents under which a bare Load of the variable is just a test,
+#: not a transfer of ownership.
+_NEUTRAL_PARENTS = (ast.Compare, ast.BoolOp, ast.UnaryOp, ast.If, ast.While,
+                    ast.Assert, ast.IfExp)
+
+
+def _acquire_kind(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in _ACQUIRE_CALLS:
+        return _ACQUIRE_CALLS[name]
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in _ACQUIRE_ATTRS:
+        recv = dotted_name(call.func.value) or ""
+        if recv.split(".")[-1].lower() in _GROUP_RECEIVERS:
+            return None
+        return _ACQUIRE_ATTRS[call.func.attr]
+    return None
+
+
+class _Site:
+    """One acquisition: variable name + the binding statement."""
+
+    __slots__ = ("var", "kind", "stmt", "lineno")
+
+    def __init__(self, var: str, kind: str, stmt: ast.stmt):
+        self.var = var
+        self.kind = kind
+        self.stmt = stmt
+        self.lineno = stmt.lineno
+
+
+class _FnFacts:
+    """Escape-checked acquire sites and release uses for one function."""
+
+    def __init__(self, module: ModuleInfo, fn: ast.AST):
+        self.sites: dict[int, _Site] = {}        # id(assign stmt) -> site
+        self.by_var: dict[str, set[int]] = {}    # var -> site ids
+        self.release_uses: dict[int, str] = {}   # id(Name load) -> var
+        parents: dict[int, ast.AST] = {}
+        subs: list[ast.AST] = []
+        for sub in ast.walk(fn):
+            if module.enclosing_function(sub) is not fn:
+                continue
+            subs.append(sub)
+            for child in ast.iter_child_nodes(sub):
+                parents[id(child)] = sub
+
+        for sub in subs:
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call):
+                kind = _acquire_kind(sub.value)
+                if kind is not None:
+                    site = _Site(sub.targets[0].id, kind, sub)
+                    self.sites[id(sub)] = site
+                    self.by_var.setdefault(site.var, set()).add(id(sub))
+        if not self.sites:
+            return
+
+        escaped: set[str] = set()
+        for sub in subs:
+            if not (isinstance(sub, ast.Name) and sub.id in self.by_var):
+                continue
+            if isinstance(sub.ctx, ast.Del):
+                escaped.add(sub.id)
+                continue
+            if isinstance(sub.ctx, ast.Store):
+                parent = parents.get(id(sub))
+                if not (isinstance(parent, ast.Assign)
+                        and id(parent) in self.sites):
+                    escaped.add(sub.id)  # rebound from something untracked
+                continue
+            use = self._classify_use(sub, parents)
+            if use == "release":
+                self.release_uses[id(sub)] = sub.id
+            elif use == "escape":
+                escaped.add(sub.id)
+        for var in escaped:
+            for sid in self.by_var.pop(var, ()):
+                self.sites.pop(sid, None)
+            self.release_uses = {
+                k: v for k, v in self.release_uses.items() if v != var}
+
+    @staticmethod
+    def _classify_use(sub: ast.Name,
+                      parents: dict[int, ast.AST]) -> str:
+        parent = parents.get(id(sub))
+        if isinstance(parent, ast.Await) and parent.value is sub:
+            return "release"
+        if isinstance(parent, ast.withitem) and parent.context_expr is sub:
+            return "release"
+        if isinstance(parent, ast.Attribute) and parent.value is sub:
+            grand = parents.get(id(parent))
+            if isinstance(grand, ast.Call) and grand.func is parent \
+                    and parent.attr in _RELEASE_METHODS:
+                return "release"
+            return "neutral"  # fd-less method/attr use: f.read(), t.done()
+        if isinstance(parent, ast.Call) and sub in parent.args:
+            func = dotted_name(parent.func) or ""
+            if func == "os.close":
+                return "release"
+            if func.startswith("os."):
+                return "neutral"  # os.read(fd, ...) and friends
+            return "escape"
+        if isinstance(parent, _NEUTRAL_PARENTS):
+            return "neutral"
+        return "escape"
+
+
+class _LiveResources(MayAnalysis):
+    """May-unreleased acquisition sites (tracked by ``id(stmt)``)."""
+
+    def __init__(self, facts: _FnFacts):
+        self._facts = facts
+
+    def transfer(self, node: Node, value):
+        facts = self._facts
+        for sub in node.walk():
+            var = facts.release_uses.get(id(sub))
+            if var is not None:
+                value = frozenset(
+                    s for s in value if s not in facts.by_var[var])
+        if node.stmt is not None and id(node.stmt) in facts.sites:
+            value = value | {id(node.stmt)}
+        return value
+
+    def edge_value(self, src: Node, dst: Node, kind: str, value):
+        if kind == "exc" and src.stmt is not None \
+                and id(src.stmt) in self._facts.sites:
+            # The acquire call itself raised: nothing was acquired.
+            return value - {id(src.stmt)}
+        return value
+
+
+@register
+class ResourceLiveness(Rule):
+    id = "TPL022"
+    name = "resource-leak-on-path"
+    summary = ("file/socket/tempdir/task handle acquired here is not "
+               "released on every CFG path out of the function, "
+               "exception edges included")
+    doc = (
+        "A chunkserver leaking one fd per failed read eventually cannot "
+        "open its own WAL. The classic shape is almost-right code: "
+        "open, use, close — where the use can raise and the close never "
+        "runs. A may-analysis over the CFG keeps each acquisition live "
+        "until a release kills it; exception edges give unwinding its "
+        "own paths, so the happy-path close does not excuse the leak. "
+        "Tracked: open/os.open/sockets/tempfiles and task handles "
+        "(create_task without a TaskGroup). Any use the rule cannot "
+        "prove safe — returned, stored, passed to a non-os call — is an "
+        "escape: ownership left the function and the rule goes quiet."
+    )
+    example = """\
+def probe(path):
+    fd = os.open(path, os.O_RDONLY)
+    data = os.read(fd, 64)     # raises on EIO -> fd leaks
+    os.close(fd)
+    return data
+"""
+    fix = ("`with open(...)` / try-finally around the use; await, "
+           "cancel, or register task handles so something owns them.")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, _FUNC_NODES):
+                yield from self._check_fn(module, fn)
+
+    def _check_fn(self, module: ModuleInfo,
+                  fn: ast.FunctionDef | ast.AsyncFunctionDef) -> \
+            Iterator[Finding]:
+        facts = _FnFacts(module, fn)
+        if not facts.sites:
+            return
+        cfg = cfg_for(module, fn)
+        res = solve(cfg, _LiveResources(facts))
+
+        def in_value(node: Node) -> frozenset:
+            pair = res.get(node.index)
+            return pair[0] if pair and pair[0] is not None else frozenset()
+
+        leak_exc = in_value(cfg.raise_exit)
+        leak_ret = in_value(cfg.exit)
+        for sid in sorted(leak_exc | leak_ret,
+                          key=lambda s: facts.sites[s].lineno):
+            site = facts.sites[sid]
+            if sid in leak_exc and sid in leak_ret:
+                how = ("is not released on every path — including when an "
+                       "exception unwinds past it")
+            elif sid in leak_exc:
+                how = ("leaks when an exception is raised before the "
+                       "release — close it in a `finally` or use `with`")
+            else:
+                how = ("is not released on every return path — some branch "
+                       "skips the close")
+            yield self.finding(
+                module, site.stmt,
+                f"{site.kind} `{site.var}` acquired here {how}; every "
+                "acquisition needs a release on all paths (with/try-finally"
+                ", or await/cancel for task handles)",
+            )

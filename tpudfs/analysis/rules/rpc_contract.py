@@ -87,6 +87,22 @@ class RpcContractConformance(ProjectRule):
     name = "rpc-contract-conformance"
     summary = ("client RPC call names a method no server registers for that "
                "service, or a registered handler has the wrong signature")
+    doc = (
+        "RPC methods are strings: a typo'd method name type-checks, "
+        "imports, and fails only at runtime — usually as a timeout on "
+        "the first call, in production. This rule cross-references every "
+        "`rpc.call(addr, SERVICE, \"Method\", req)` against the handler "
+        "tables servers register (`add_service`), flags unknown methods "
+        "with a did-you-mean suggestion, and checks registered handlers "
+        "take exactly one request argument. Dynamic method variables and "
+        "services not registered in the tree are out of scope."
+    )
+    example = """\
+await rpc.call(addr, CS, "ReadBlok", req)   # server registers "ReadBlock"
+"""
+    fix = ("Fix the method string (the finding suggests the closest "
+           "registered name), or register the handler with the standard "
+           "`async def rpc_x(self, req)` shape.")
 
     def check_project(self, project: Project) -> Iterator[Finding]:
         #: service name -> method name -> handler (or None if unresolved)

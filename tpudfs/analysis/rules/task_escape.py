@@ -44,6 +44,23 @@ class CoroutineEscape(ProjectRule):
     summary = ("a local bound to asyncio.create_task(...) is never awaited, "
                "returned, passed on, stored, or cancelled — the handle dies "
                "with the frame and the task can be GC'd mid-flight")
+    doc = (
+        "Binding the task handle to a local satisfies TPL007 but saves "
+        "nothing: when the frame returns, the only strong reference "
+        "dies and the loop's weak reference cannot keep the task alive. "
+        "This rule checks what happens to the binding — awaited, "
+        "returned, stored on self, passed to another call, registered, "
+        "or cancelled all count as escapes that transfer ownership; a "
+        "binding with none of them is a dressed-up fire-and-forget."
+    )
+    example = """\
+async def fire(work):
+    task = asyncio.create_task(work())   # bound...
+    return 1                             # ...and dead with the frame
+"""
+    fix = ("Await it, return it, store it (`self._t = task`), or "
+           "register it with a collection/TaskGroup that outlives the "
+           "frame.")
 
     def check_project(self, project: Project) -> Iterator[Finding]:
         for fn in project.functions.values():

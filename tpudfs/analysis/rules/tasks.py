@@ -55,6 +55,23 @@ class DroppedTaskHandle(Rule):
     name = "dropped-task-handle"
     summary = ("fire-and-forget asyncio.create_task — a weakly-referenced "
                "task can be GC'd mid-flight and its exception lost")
+    doc = (
+        "The event loop keeps only a weak reference to tasks: a "
+        "`create_task` whose result is dropped on the floor can be "
+        "garbage collected mid-flight, and if it fails, the exception "
+        "is reported to nobody. A background scrubber that dies this "
+        "way looks exactly like a healthy one. The rule flags spawns "
+        "whose handle is not bound, stored, or group-owned; TPL014 "
+        "chases the harder case where a handle is bound but still dies "
+        "with its frame."
+    )
+    example = """\
+async def start(self):
+    asyncio.create_task(self.scrub_loop())   # handle dropped
+"""
+    fix = ("Store the handle (`self._scrub_task = asyncio.create_task("
+           "...)`) and cancel/await it on stop, or spawn through a "
+           "TaskGroup that owns it.")
 
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         for node in ast.walk(module.tree):

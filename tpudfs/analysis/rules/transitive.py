@@ -59,6 +59,25 @@ class TransitiveBlockingInAsync(ProjectRule):
     summary = ("a sync call chain reachable from `async def` ends in a "
                "blocking leaf (time.sleep, requests, subprocess, sync file "
                "I/O) — stalls the event loop just like a direct call")
+    doc = (
+        "TPL001 sees the blocking call only when it is written inside "
+        "the `async def`. The ones that survive review hide two hops "
+        "away: the coroutine calls a helper, the helper calls a leaf "
+        "that sleeps. This rule walks the resolved call graph from every "
+        "coroutine through same-thread sync calls and reports the full "
+        "chain down to the blocking leaf. to_thread/executor bridges "
+        "end the chain — that is the sanctioned way to run such code."
+    )
+    example = """\
+# util.py
+def fetch_meta(req):
+    return slow_probe(req)     # -> time.sleep(0.2)
+# handler.py
+async def handle(req):
+    return fetch_meta(req)     # blocks the loop, two files away
+"""
+    fix = ("Offload the sync entry point: `await asyncio.to_thread("
+           "fetch_meta, req)` — or make the chain truly async.")
 
     def check_project(self, project: Project) -> Iterator[Finding]:
         #: fn -> (chain of FunctionInfo down to the leaf, leaf what/hint)

@@ -12,12 +12,19 @@ import ctypes
 import logging
 import os
 import subprocess
+import threading
 from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 _LIB_NAME = "libtpudfs_native.so"
+
+#: Guards _lib/_load_attempted/_build_attempted. get_lib runs on the event
+#: loop while build_and_load runs on a to_thread worker, so this must be a
+#: threading.Lock — and it is never held across the compiler (make runs
+#: outside it), only across flag flips and the cheap dlopen.
+_state_lock = threading.Lock()
 
 _lib: ctypes.CDLL | None = None
 _load_attempted = False
@@ -53,10 +60,13 @@ def build_and_load() -> ctypes.CDLL | None:
     already-built library.
     """
     global _load_attempted, _build_attempted
-    if _lib is None and not _build_attempted:
-        _build_attempted = True
-        if "TPUDFS_NATIVE_LIB" not in os.environ:
-            if _try_build():
+    with _state_lock:
+        need_build = _lib is None and not _build_attempted
+        if need_build:
+            _build_attempted = True
+    if need_build and "TPUDFS_NATIVE_LIB" not in os.environ:
+        if _try_build():
+            with _state_lock:
                 # A failed earlier load may now succeed against the fresh .so.
                 _load_attempted = False
     return get_lib()
@@ -69,6 +79,12 @@ def get_lib() -> ctypes.CDLL | None:
     loop, running make is not. Processes that want a guaranteed-fresh
     build warm up through :func:`build_and_load` first.
     """
+    with _state_lock:
+        return _locked_load()
+
+
+def _locked_load() -> ctypes.CDLL | None:
+    """Load + bind symbols. Callers hold ``_state_lock``."""
     global _lib, _load_attempted
     if _lib is not None or _load_attempted:
         return _lib

@@ -130,7 +130,9 @@ class RaftNode:
                 item[1].set_exception(NotLeaderError(self.core.leader_id))
         for t in list(self._send_tasks):
             t.cancel()
-        self.storage.close()
+        # close() takes the storage I/O lock, which WAL fsyncs hold on
+        # worker threads — never block the loop on it.
+        await asyncio.to_thread(self.storage.close)
         if self._owns_client:
             await self.client.close()
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 from pathlib import Path
 
 import msgpack
@@ -52,6 +53,10 @@ class RaftStorage:
         self._wal = self.dir / "wal.bin"
         self._snap = self.dir / "snapshot.bin"
         self._wal_fd: int | None = None
+        # WAL writes run on to_thread workers while close() can come from
+        # the node's stop path — a threading.Lock serializes the fd's
+        # open/append/compact/close lifecycle across those threads.
+        self._io_lock = threading.Lock()
 
     # ------------------------------------------------------------------ load
 
@@ -100,6 +105,7 @@ class RaftStorage:
         )
 
     def _wal_handle(self) -> int:
+        """Callers hold ``_io_lock``."""
         if self._wal_fd is None:
             self._wal_fd = os.open(
                 self._wal, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
@@ -108,9 +114,10 @@ class RaftStorage:
 
     def _wal_append(self, rec: dict) -> None:
         payload = msgpack.packb(rec)
-        fd = self._wal_handle()
-        _write_all(fd, _LEN.pack(len(payload)) + payload)
-        os.fsync(fd)
+        with self._io_lock:
+            fd = self._wal_handle()
+            _write_all(fd, _LEN.pack(len(payload)) + payload)
+            os.fsync(fd)
 
     def append_entries(self, entries: list[LogEntry]) -> None:
         if entries:
@@ -122,16 +129,19 @@ class RaftStorage:
     def save_snapshot(self, snapshot: Snapshot, remaining: list[LogEntry]) -> None:
         """Persist snapshot and compact the WAL down to ``remaining``."""
         _atomic_write(self._snap, msgpack.packb(snapshot.to_dict()))
-        if self._wal_fd is not None:
-            os.close(self._wal_fd)
-            self._wal_fd = None
-        buf = b""
-        if remaining:
-            payload = msgpack.packb({"t": "a", "e": [e.to_dict() for e in remaining]})
-            buf = _LEN.pack(len(payload)) + payload
-        _atomic_write(self._wal, buf)
+        with self._io_lock:
+            if self._wal_fd is not None:
+                os.close(self._wal_fd)
+                self._wal_fd = None
+            buf = b""
+            if remaining:
+                payload = msgpack.packb(
+                    {"t": "a", "e": [e.to_dict() for e in remaining]})
+                buf = _LEN.pack(len(payload)) + payload
+            _atomic_write(self._wal, buf)
 
     def close(self) -> None:
-        if self._wal_fd is not None:
-            os.close(self._wal_fd)
-            self._wal_fd = None
+        with self._io_lock:
+            if self._wal_fd is not None:
+                os.close(self._wal_fd)
+                self._wal_fd = None
