@@ -19,6 +19,8 @@ Timeline against a two-shard-HA cluster (6 masters, 6 chunkservers):
   t6   workload drains; WGL-check its history (crash ops = maybe-applied)
   t7   md5-verify the payload (reads must fail over around the dead CS)
   t8   post-chaos write/read sanity on a fresh key
+  t9   bandwidth-shape one chunkserver (overload); budgeted hedged reads
+       must stay inside their deadline budget and recover after the heal
 
 Run directly or via scripts/run_all_tests.py (the CI live tier).
 """
@@ -185,6 +187,50 @@ async def chaos(eps: dict) -> None:
                 await asyncio.sleep(1.0)
         assert await v_client.get_file(f"{prefix}post-chaos") == b"alive"
     print("t8: post-chaos writes/reads ok on both shards")
+
+    # t9: overload — bandwidth-shape one LIVE chunkserver's data path
+    # (256 KiB/s + 0.3 s per chunk, the netem bandwidth/latency toxics) and
+    # drive deadline-budgeted hedged reads through it. The resilience
+    # contract: ops stay inside budget + grace (hedges dodge the slow
+    # replica, the budget bounds whatever is left), retry volume stays
+    # within 2x first tries, and throughput recovers once the shaping lifts.
+    dead_cs = [n for n in procs if n.startswith("cs")][0]
+    slow_addr = next(v["addr"] for k, v in procs.items()
+                     if k.startswith("cs") and k != dead_cs and v["addr"])
+    sh, sp = slow_addr.rsplit(":", 1)
+    ov_proxy = FaultProxy(sh, int(sp))
+    ov_addr = await ov_proxy.start()
+    ov_proxy.set_latency(0.3)
+    ov_proxy.set_bandwidth(256 * 1024)
+    # 8 s budget: generous against CI contention for a 6 MiB payload, yet
+    # far below the ~24 s the shaped path alone would take — only hedging
+    # away from the slow replica can make these reads.
+    ov_client = Client(masters, config_addrs=[eps["config_server"]],
+                       block_size=256 * 1024, op_budget=8.0,
+                       rpc_timeout=0.5, hedge_delay=0.15,
+                       host_aliases={slow_addr: ov_addr}, tls=tls)
+    print(f"t9: shaping {slow_addr} to 256 KiB/s (+0.3 s/chunk)")
+    budget_grace = 8.0 + 1.0
+    for i in range(3):
+        t0 = time.monotonic()
+        back = await ov_client.get_file("/a/chaos-payload")
+        wall = time.monotonic() - t0
+        assert hashlib.md5(back).hexdigest() == payload_md5
+        assert wall <= budget_grace, (
+            f"overloaded read {i} blew the deadline budget: {wall:.2f}s"
+        )
+    rc = ov_client.retry_budget.counters()
+    assert rc["retry_budget_retries_total"] \
+        <= 2 * rc["retry_budget_first_tries_total"], rc
+    ov_proxy.set_latency(0.0)
+    ov_proxy.set_bandwidth(0)
+    t0 = time.monotonic()
+    back = await ov_client.get_file("/a/chaos-payload")
+    assert hashlib.md5(back).hexdigest() == payload_md5
+    print(f"t9: overload reads bounded (retries {rc}), healed read in "
+          f"{time.monotonic() - t0:.2f}s")
+    await ov_proxy.stop()
+    await ov_client.close()
 
     await proxy.stop()
     await client.close()

@@ -23,6 +23,13 @@ AXES (round-5 expansion — the round-4 plans centered on kills):
   tiering scanner converts the replicated payload to RS(3,2) DURING the
   fault window; the md5 must hold whether or not conversion completed
   (the conversion state is printed per round).
+- ``overload``: one chunkserver the plan will NOT kill is bandwidth-
+  shaped (256 KiB/s + 0.3 s/chunk) for the whole fault window while a
+  deadline-budgeted client (op_budget, short rpc_timeout, eager hedges)
+  reads the payload through it. Every such read must stay inside
+  budget + grace — bounded failure is acceptable under combined faults,
+  hanging is not — retry volume must stay within the 2x retry budget,
+  and the read must succeed after the shaping lifts.
 
 Safety caps keep every plan survivable by design, so any failure is a
 REAL bug, not an over-killed cluster: at most 2 of the 5 chunkservers
@@ -111,13 +118,14 @@ def make_axes(rng: random.Random) -> dict:
         "ec": "ec" in forced or rng.random() < 0.5,
         "torn": "torn" in forced or rng.random() < 0.5,
         "tiering": "tiering" in forced or rng.random() < 0.4,
+        "overload": "overload" in forced or rng.random() < 0.4,
     }
 
 
 async def run_round(eps: dict, rng: random.Random, rnd: int,
                     axes: dict | None = None) -> None:
     from tpudfs.client.checker import check_linearizability
-    from tpudfs.client.client import Client
+    from tpudfs.client.client import Client, DfsError
     from tpudfs.client.workload import (
         WorkloadConfig, dump_history, run_workload,
     )
@@ -171,6 +179,30 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
         proxy = FaultProxy(host, int(port))
         aliases[leaders[sid]] = await proxy.start()
         proxies[sid] = proxy
+
+    # Overload axis: shape a chunkserver the plan leaves alive, so the
+    # budgeted reads exercise hedging-around-a-slow-replica rather than
+    # plain failover around a dead one.
+    ov_proxy = ov_client = None
+    ov_walls: list[float] = []
+    ov_budget_grace = 8.0 + 1.0
+    if axes.get("overload"):
+        killed = {p for _, k, p in plan if k == "kill_cs"}
+        live_cs = sorted(n for n in procs
+                         if n.startswith("cs") and n not in killed)
+        slow = rng.choice(live_cs)
+        slow_addr = procs[slow]["addr"]
+        sh, sp = slow_addr.rsplit(":", 1)
+        ov_proxy = FaultProxy(sh, int(sp))
+        ov_alias = await ov_proxy.start()
+        ov_proxy.set_latency(0.3)
+        ov_proxy.set_bandwidth(256 * 1024)
+        ov_client = Client(masters, config_addrs=[eps["config_server"]],
+                           block_size=256 * 1024, op_budget=8.0,
+                           rpc_timeout=0.5, hedge_delay=0.15,
+                           host_aliases={slow_addr: ov_alias}, tls=tls)
+        print(f"  overload axis: shaping {slow} ({slow_addr}) to "
+              f"256 KiB/s (+0.3 s/chunk)")
 
     wl_client = Client(masters, config_addrs=[eps["config_server"]],
                        rpc_timeout=3.0, max_retries=8,
@@ -246,7 +278,25 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
             print(f"  +{torn_cancel_at:.1f}s cancelled torn write "
                   f"mid-session")
 
-    await asyncio.gather(workload, injector(), torn_killer())
+    async def overloaded_reader() -> None:
+        if ov_client is None:
+            return
+        for _ in range(3):
+            t0 = time.monotonic()
+            try:
+                back = await ov_client.get_file("/a/roulette-payload")
+                assert hashlib.md5(back).hexdigest() == payload_md5, \
+                    f"overloaded read corrupt (round {rnd}); plan: {plan}"
+            except DfsError:
+                # Bounded failure under overload + concurrent kills and
+                # partitions is the contract working; a hang would blow
+                # the wall-clock assert below.
+                pass
+            ov_walls.append(time.monotonic() - t0)
+            await asyncio.sleep(0.5)
+
+    await asyncio.gather(workload, injector(), torn_killer(),
+                         overloaded_reader())
     entries = workload.result()
     ok_ops = sum(1 for e in entries if e.get("return_ts") is not None)
     print(f"  workload: {len(entries)} ops ({ok_ops} returned)")
@@ -271,8 +321,6 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
     # errors — fails immediately, and whatever succeeds must be
     # byte-identical.
     from tpudfs.client.client import IndeterminateError
-
-    from tpudfs.client.client import DfsError
 
     async def settle(what: str, op):
         deadline = time.time() + 45
@@ -353,6 +401,25 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
             # claiming coverage the seed never exercised.
             print("  torn axis DEGENERATE (write finished before the "
                   "cancel); overwrite still byte-exact")
+    if ov_client is not None:
+        assert ov_walls and max(ov_walls) <= ov_budget_grace, (
+            f"overload axis: read blew its deadline budget "
+            f"(walls {['%.2f' % w for w in ov_walls]}, round {rnd}); "
+            f"plan: {plan}")
+        orc = ov_client.retry_budget.counters()
+        assert orc["retry_budget_retries_total"] \
+            <= 2 * orc["retry_budget_first_tries_total"], \
+            f"overload axis: retry amplification > 2x: {orc}"
+        ov_proxy.set_latency(0.0)
+        ov_proxy.set_bandwidth(0)
+        healed = await settle(
+            "overload healed read",
+            lambda: ov_client.get_file("/a/roulette-payload"))
+        assert hashlib.md5(healed).hexdigest() == payload_md5, \
+            f"overload axis: healed read corrupt (round {rnd})"
+        print(f"  overload axis: walls "
+              f"{['%.2f' % w for w in ov_walls]} <= {ov_budget_grace}s, "
+              f"retries {orc}, healed read ok")
     for prefix in ("/a/", "/z/"):
         deadline = time.time() + 45
         while True:
@@ -370,6 +437,10 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
 
     for proxy in proxies.values():
         await proxy.stop()
+    if ov_proxy is not None:
+        await ov_proxy.stop()
+    if ov_client is not None:
+        await ov_client.close()
     await client.close()
     await wl_client.close()
     await v_client.close()
@@ -401,7 +472,15 @@ def main() -> None:
     ap.add_argument("--tls", action="store_true")
     ap.add_argument("--topology",
                     default=str(REPO / "deploy/topologies/two-shard-ha.json"))
+    ap.add_argument("--force-axes", default="",
+                    help="comma-separated axes pinned on every round "
+                         "(same as CHAOS_FORCE_AXES env)")
     args = ap.parse_args()
+    if args.force_axes:
+        merged = set(filter(None, os.environ.get(
+            "CHAOS_FORCE_AXES", "").split(",")))
+        merged |= set(filter(None, args.force_axes.split(",")))
+        os.environ["CHAOS_FORCE_AXES"] = ",".join(sorted(merged))
     rng = random.Random(args.seed)
     for rnd in range(1, args.rounds + 1):
         axes = make_axes(rng)

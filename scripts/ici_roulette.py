@@ -90,9 +90,9 @@ async def run_round(rnd: int, rng: random.Random, rng_seed: int) -> None:
                 (or the writers finished / cap expired) — time-boxed
                 windows under heavy host load often closed before any
                 round passed through them."""
-                deadline = asyncio.get_event_loop().time() + max_s
+                deadline = asyncio.get_running_loop().time() + max_s
                 while (not probe() and not done.is_set()
-                       and asyncio.get_event_loop().time() < deadline):
+                       and asyncio.get_running_loop().time() < deadline):
                     await asyncio.sleep(0.05)
                 # Let an in-flight round resolve against the fault.
                 await asyncio.sleep(0.1)

@@ -39,14 +39,14 @@ async def run() -> None:
     try:
         rpc = RpcClient()
         client = Client([maddr], rpc_client=rpc, block_size=1 << 20)
-        deadline = asyncio.get_event_loop().time() + 60
+        deadline = asyncio.get_running_loop().time() + 60
         while True:
             try:
                 await client.create_file("/p/probe", b"x")
                 await client.delete_file("/p/probe")
                 break
             except Exception:
-                if asyncio.get_event_loop().time() > deadline:
+                if asyncio.get_running_loop().time() > deadline:
                     raise
                 await asyncio.sleep(0.3)
         data = np.random.default_rng(0).integers(

@@ -238,6 +238,14 @@ def main() -> None:
         run("live chaos roulette (seeded)",
             [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
              "--seed=1234", "--topology", args.topology])
+        # Overload-pinned round: one chunkserver bandwidth-shaped while a
+        # deadline-budgeted client reads through it — asserts bounded op
+        # latency, <= 2x retry amplification, and post-heal recovery on
+        # top of whatever kills/partitions the seeded plan draws.
+        run("live chaos roulette (overload axis)",
+            [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
+             "--seed=2468", "--force-axes=overload",
+             "--topology", args.topology])
         # Add a 4th master to a RUNNING group under workload, remove the
         # old leader, verify discovery + no write loss (reference
         # dynamic_membership_test.sh / cluster_membership_test.sh).

@@ -36,14 +36,14 @@ async def run() -> None:
         rpc = RpcClient()
         client = Client([maddr], rpc_client=rpc,
                         block_size=bench.BLOCK_MB << 20, etag_mode="crc64")
-        deadline = asyncio.get_event_loop().time() + 60
+        deadline = asyncio.get_running_loop().time() + 60
         while True:
             try:
                 await client.create_file("/lab/probe", b"x")
                 await client.delete_file("/lab/probe")
                 break
             except Exception:
-                if asyncio.get_event_loop().time() > deadline:
+                if asyncio.get_running_loop().time() > deadline:
                     raise
                 await asyncio.sleep(0.3)
         import numpy as np

@@ -374,3 +374,93 @@ async def test_linearizable_history_with_leader_partitioned_lease_window(
         for p in proxies:
             await p.stop()
         await rpc.close()
+
+
+# ----------------------------------------------------------------- overload
+
+
+async def test_overload_shed_bounded_latency_and_recovery(tmp_path):
+    """Overload fault: one chunkserver turns slow (1 s injected stall per
+    data RPC, tight admission limit) while every client op runs under a 2 s
+    deadline budget. Assertions are the resilience contract: no op exceeds
+    budget + 0.5 s grace (bounded, never a hang), retry volume stays within
+    2x first-try volume (no metastable retry storm), sheds surface as
+    RESOURCE_EXHAUSTED with a retry-after hint, and throughput recovers
+    after heal. ``python_data_plane`` forces reads/writes through the
+    Python handlers the failpoint and shedder live in — the native C++
+    dataplane would bypass both."""
+    import time as _time
+
+    import grpc
+    import pytest
+
+    from tpudfs.client.client import DfsError
+    from tpudfs.common.resilience import LoadShedder
+    from tpudfs.common.rpc import RpcError
+    from tpudfs.testing.netem import heal_server, slow_server
+
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3,
+                    cs_kw={"python_data_plane": True})
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+        client = Client(list(c.masters), rpc_client=c.client,
+                        block_size=64 * 1024, op_budget=2.0,
+                        rpc_timeout=0.5, hedge_delay=0.15,
+                        initial_backoff=0.05)
+        payloads = {}
+        for i in range(4):
+            path = f"/overload/f{i}.bin"
+            payloads[path] = bytes([i]) * (2 * 64 * 1024)  # 2 blocks each
+            await client.create_file(path, payloads[path])
+
+        victim = c.chunkservers[0]
+        slow_server(victim, 1.0)
+        victim.shedder = LoadShedder(max_inflight=2)
+
+        budget_grace = 2.0 + 0.5
+        failures: list[DfsError] = []
+
+        async def read_once(path: str) -> float:
+            t0 = _time.monotonic()
+            try:
+                assert await client.get_file(path) == payloads[path]
+            except DfsError as e:
+                failures.append(e)  # bounded failure beats an unbounded hang
+            return _time.monotonic() - t0
+
+        walls: list[float] = []
+        for _ in range(3):
+            walls.extend(await asyncio.gather(
+                *(read_once(p) for p in payloads for _ in range(2))))
+        assert max(walls) <= budget_grace, \
+            f"op exceeded deadline budget + grace: {max(walls):.2f}s"
+
+        rc = client.retry_budget.counters()
+        assert rc["retry_budget_retries_total"] \
+            <= 2 * rc["retry_budget_first_tries_total"], rc
+
+        # Sheds are loud and machine-readable, not hangs: an admission-full
+        # server answers RESOURCE_EXHAUSTED with a retry-after hint before
+        # even parsing the request.
+        victim.shedder = LoadShedder(max_inflight=0)
+        t0 = _time.monotonic()
+        with pytest.raises(RpcError) as ei:
+            await c.client.call(victim.address, "ChunkServerService",
+                                "ReadBlock", {"block_id": "any"}, timeout=2.0)
+        assert ei.value.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert ei.value.retry_after is not None
+        assert _time.monotonic() - t0 < 1.0
+        assert victim.shedder.counters()["shed_total"] >= 1
+
+        # Heal: stall lifted, admission restored — everything succeeds
+        # inside the same bound again.
+        heal_server(victim)
+        victim.shedder = LoadShedder(max_inflight=64)
+        failures.clear()
+        walls = await asyncio.gather(*(read_once(p) for p in payloads))
+        assert not failures, failures
+        assert max(walls) <= budget_grace
+    finally:
+        await c.stop()

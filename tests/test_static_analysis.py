@@ -390,7 +390,7 @@ def test_every_rule_is_registered():
     ids = set(all_rules())
     assert {"TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
             "TPL007", "TPL010", "TPL011", "TPL012", "TPL013", "TPL014",
-            "TPL020", "TPL021", "TPL022", "TPL023"} <= ids
+            "TPL020", "TPL021", "TPL022", "TPL023", "TPL024"} <= ids
 
 
 def test_every_rule_carries_explain_metadata():
@@ -1268,6 +1268,124 @@ def test_tpl023_is_scoped_to_the_raft_package(tmp_path):
                 await self._send(req.frm, "granted")
                 await self.storage.save_hard_state(req.term, req.frm)
     """, rel="tpudfs/chunkserver/mod.py", rule="TPL023") == []
+
+
+# ------------------------------------------------------------------ TPL024
+
+
+_TPL024_SERVER = """
+    SERVICE = "cs"
+    class Server:
+        def attach(self, server):
+            server.add_service(SERVICE, {"ReadBlock": self.rpc_read_block})
+        async def rpc_read_block(self, req):
+            return {}
+"""
+
+
+def test_tpl024_flags_missing_timeout_without_budget(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "server.py": _TPL024_SERVER,
+        "client.py": """
+            CS = "cs"
+            class Client:
+                async def fetch(self, rpc, addr):
+                    return await rpc.call(addr, CS, "ReadBlock", {})
+        """,
+    }, rules=["TPL024"])
+    assert rule_ids(findings) == ["TPL024"]
+    assert "no `timeout`" in findings[0].message
+
+
+def test_tpl024_timeout_none_is_still_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "server.py": _TPL024_SERVER,
+        "client.py": """
+            class Client:
+                async def fetch(self, rpc, addr):
+                    return await rpc.call(addr, "cs", "ReadBlock", {},
+                                          timeout=None)
+        """,
+    }, rules=["TPL024"])
+    assert rule_ids(findings) == ["TPL024"]
+
+
+def test_tpl024_explicit_timeout_kwarg_or_positional_ok(tmp_path):
+    assert lint_tree(tmp_path, {
+        "server.py": _TPL024_SERVER,
+        "client.py": """
+            class Client:
+                async def kw(self, rpc, addr):
+                    return await rpc.call(addr, "cs", "ReadBlock", {},
+                                          timeout=5.0)
+                async def pos(self, rpc, addr):
+                    return await rpc.call(addr, "cs", "ReadBlock", {}, 5.0)
+                async def derived(self, rpc, addr, budget):
+                    # any expression counts: RpcClient.call clamps it to the
+                    # remaining deadline budget anyway
+                    return await rpc.call(addr, "cs", "ReadBlock", {},
+                                          timeout=min(budget, 5.0))
+        """,
+    }, rules=["TPL024"]) == []
+
+
+def test_tpl024_local_deadline_scope_suppresses(tmp_path):
+    assert lint_tree(tmp_path, {
+        "server.py": _TPL024_SERVER,
+        "client.py": """
+            from tpudfs.common.resilience import deadline_scope
+            class Client:
+                async def fetch(self, rpc, addr):
+                    with deadline_scope(2.0):
+                        return await rpc.call(addr, "cs", "ReadBlock", {})
+        """,
+    }, rules=["TPL024"]) == []
+
+
+def test_tpl024_interprocedural_budgeted_caller_suppresses(tmp_path):
+    # The budget is installed two frames up — reverse-call-graph walk,
+    # like TPL010's transitive reachability but upward.
+    assert lint_tree(tmp_path, {
+        "server.py": _TPL024_SERVER,
+        "client.py": """
+            from tpudfs.common.resilience import deadline_scope
+            class Client:
+                async def read(self, rpc, addr):
+                    with deadline_scope(2.0):
+                        return await self._mid(rpc, addr)
+                async def _mid(self, rpc, addr):
+                    return await self._leaf(rpc, addr)
+                async def _leaf(self, rpc, addr):
+                    return await rpc.call(addr, "cs", "ReadBlock", {})
+        """,
+    }, rules=["TPL024"]) == []
+
+
+def test_tpl024_budgeted_decorator_suppresses(tmp_path):
+    assert lint_tree(tmp_path, {
+        "server.py": _TPL024_SERVER,
+        "client.py": """
+            def _budgeted(fn):
+                return fn
+            class Client:
+                @_budgeted
+                async def fetch(self, rpc, addr):
+                    return await rpc.call(addr, "cs", "ReadBlock", {})
+        """,
+    }, rules=["TPL024"]) == []
+
+
+def test_tpl024_skips_dynamic_methods_and_unknown_services(tmp_path):
+    assert lint_tree(tmp_path, {
+        "server.py": _TPL024_SERVER,
+        "client.py": """
+            class Client:
+                async def relay(self, rpc, addr, method):
+                    return await rpc.call(addr, "cs", method, {})
+                async def external(self, rpc, addr):
+                    return await rpc.call(addr, "s3", "PutObject", {})
+        """,
+    }, rules=["TPL024"]) == []
 
 
 # --------------------------------------------------- explain + rule table

@@ -19,6 +19,7 @@ from tpudfs.analysis.rules import (  # noqa: F401
     rpc_contract,
     checksum_taint,
     task_escape,
+    deadline,
     # CFG/dataflow rules (see tpudfs/analysis/cfg.py + dataflow.py)
     races,
     lock_hygiene,

@@ -1,0 +1,199 @@
+"""TPL024 — RPC call site with no explicit timeout and no deadline budget.
+
+``RpcClient.call`` and ``BlockConnPool.call`` default their ``timeout``
+(10 s / 30 s). A call site that omits it inherits that flat default — and
+when nothing above it installs a deadline budget
+(``tpudfs.common.resilience.deadline_scope``), nothing clamps the attempt
+to the caller's remaining time either. Under overload that is exactly the
+site that turns a 2-second user budget into a 10-second hang: every other
+hop finishes fast, this one parks on the default.
+
+Detection mirrors TPL012's call-site shape (a resolvable service string
+followed by a method string among the positional args, cross-checked
+against registered ``add_service`` tables), so it tracks the same set of
+real RPC invocations and skips unrelated ``.call(...)`` methods.
+
+A site is compliant when any of:
+
+- it passes ``timeout`` (keyword or positional — constant or derived, the
+  clamp inside ``RpcClient.call`` bounds it to the remaining budget);
+- its enclosing function installs a deadline budget itself
+  (``deadline_scope(...)`` / ``set_deadline(...)`` in the body, or a
+  ``@_budgeted`` decorator);
+- interprocedurally (like TPL010's transitive reachability, but walked
+  against the reverse call graph): **some** analyzed caller chain installs
+  a budget above it. Conservative by design — one budgeted path means the
+  site was written deadline-aware, and flagging it anyway would train
+  people to scatter redundant constants.
+
+``timeout=None`` is NOT compliant: it removes the transport bound
+entirely, which is the hang this rule exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import FunctionInfo, Project
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    ProjectRule,
+    dotted_name,
+    register,
+)
+
+#: Calls that install a deadline budget for everything beneath them.
+_BUDGET_CALLS = {"deadline_scope", "set_deadline"}
+#: Decorators that wrap a method in a deadline scope (client.py idiom).
+_BUDGET_DECORATORS = {"_budgeted", "budgeted"}
+
+
+def _installs_budget(fn: FunctionInfo) -> bool:
+    """Does this function put a deadline budget in scope — via decorator or
+    by calling the resilience primitives directly?"""
+    for dec in fn.node.decorator_list:
+        name = dotted_name(dec) or (
+            dotted_name(dec.func) if isinstance(dec, ast.Call) else None
+        )
+        if name is not None and name.rsplit(".", 1)[-1] in _BUDGET_DECORATORS:
+            return True
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None \
+                    and name.rsplit(".", 1)[-1] in _BUDGET_CALLS:
+                return True
+    return False
+
+
+@register
+class RpcDeadlineDiscipline(ProjectRule):
+    id = "TPL024"
+    name = "rpc-deadline-discipline"
+    summary = ("RPC call site passes no timeout and no caller installs a "
+               "deadline budget — the call parks on the transport default "
+               "under overload")
+    doc = (
+        "`RpcClient.call`/`BlockConnPool.call` clamp each attempt to the "
+        "caller's remaining deadline budget, but only if a budget exists. "
+        "A site with no explicit `timeout` and no `deadline_scope(...)` "
+        "anywhere up its (analyzed) call chains falls back to the flat "
+        "transport default — 10 s — which is how a 2 s end-to-end budget "
+        "quietly becomes a 10 s hang on the one slow hop. `timeout=None` "
+        "is flagged too: it removes the bound entirely. Call sites whose "
+        "method/service strings are dynamic, or that talk to services not "
+        "registered in this tree, are out of scope (TPL012 shares the "
+        "same horizon)."
+    )
+    example = """\
+async def fetch(self):
+    # no timeout=, and no deadline_scope() on any path to fetch()
+    return await self.rpc.call(addr, CS, "ReadBlock", req)
+"""
+    fix = ("Pass an explicit `timeout=` sized for the hop, or run the "
+           "operation under `deadline_scope(budget)` (the client's "
+           "`op_budget` / `@_budgeted` idiom) so `RpcClient.call` derives "
+           "per-attempt timeouts from the remaining budget.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        tables = _server_tables(project)
+        if not tables:
+            return
+        budgeted = {fn for fn in project.functions.values()
+                    if _installs_budget(fn)}
+        callers = _reverse_edges(project)
+
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute) \
+                        or node.func.attr != "call":
+                    continue
+                idx = _service_index(project, mod, node, tables)
+                if idx is None:
+                    continue
+                if _has_timeout(node, idx):
+                    continue
+                fn = project.enclosing_function_info(mod, node)
+                if fn is not None and _budget_reaches(fn, budgeted, callers):
+                    continue
+                yield self.finding(
+                    mod, node,
+                    "RPC call passes no `timeout` and no analyzed caller "
+                    "installs a deadline budget (`deadline_scope`) — under "
+                    "overload this attempt parks on the flat transport "
+                    "default instead of the caller's remaining budget",
+                )
+
+
+def _server_tables(project: Project) -> set[str]:
+    """Service names registered anywhere via ``add_service`` — the same
+    horizon TPL012 uses, so both rules skip out-of-tree services."""
+    names: set[str] = set()
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "add_service" \
+                    and node.args:
+                service = project.resolve_str_const(mod, node.args[0])
+                if service is not None:
+                    names.add(service)
+    return names
+
+
+def _service_index(project: Project, mod: ModuleInfo, node: ast.Call,
+                   tables: set[str]) -> int | None:
+    """Positional index of the service-name arg when this ``*.call(...)``
+    names a registered service followed by a method string."""
+    for i in range(len(node.args) - 1):
+        service = project.resolve_str_const(mod, node.args[i])
+        if service is None or service not in tables:
+            continue
+        if project.resolve_str_const(mod, node.args[i + 1]) is None:
+            return None  # dynamic method variable: stay silent (TPL012 too)
+        return i
+    return None
+
+
+def _has_timeout(node: ast.Call, service_idx: int) -> bool:
+    """Explicit timeout at this site. Both transports place ``timeout``
+    three positions after the service name (addr/_, service, method, req,
+    timeout). ``timeout=None`` does not count — it UNbounds the call."""
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    if len(node.args) > service_idx + 3:
+        extra = node.args[service_idx + 3]
+        return not (isinstance(extra, ast.Constant) and extra.value is None)
+    return False
+
+
+def _reverse_edges(
+    project: Project,
+) -> dict[FunctionInfo, list[FunctionInfo]]:
+    rev: dict[FunctionInfo, list[FunctionInfo]] = {}
+    for fn in project.functions.values():
+        for edge in fn.calls:
+            rev.setdefault(edge.callee, []).append(edge.caller)
+    return rev
+
+
+def _budget_reaches(fn: FunctionInfo, budgeted: set[FunctionInfo],
+                    callers: dict) -> bool:
+    """Walk the reverse call graph from ``fn``: is any (transitive) caller
+    a budget-installing function?"""
+    seen = {fn}
+    stack = [fn]
+    while stack:
+        cur = stack.pop()
+        if cur in budgeted:
+            return True
+        for parent in callers.get(cur, ()):
+            if parent not in seen:
+                seen.add(parent)
+                stack.append(parent)
+    return False

@@ -35,6 +35,11 @@ from tpudfs.common import blocknet, native
 from tpudfs.common.blocknet import BlockConnPool
 from tpudfs.common.checksum import crc32c
 from tpudfs.common.erasure import encode as ec_encode, reconstruct
+from tpudfs.common.resilience import (
+    LoadShedder,
+    admission_controlled,
+    shielded_from_deadline,
+)
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer, ServerTls
 from tpudfs.chunkserver.blockstore import (
     BlockCorruptionError,
@@ -145,6 +150,12 @@ class GroupCommitter:
             self.store.discard_staged(bid, token)
 
     async def _drain(self) -> None:
+        # Spawned from whichever writer arrived first, but publishes every
+        # writer's batch — it must not carry that one writer's deadline.
+        with shielded_from_deadline():
+            await self._drain_batches()
+
+    async def _drain_batches(self) -> None:
         while self._pending:
             batch, self._pending = self._pending, []
             publish = asyncio.ensure_future(asyncio.to_thread(
@@ -231,6 +242,18 @@ class ChunkServer:
         #: recovery, EC shard distribution); falls back to gRPC per peer.
         self.blocks = BlockConnPool(tls=self.client.tls)
         self.committer = GroupCommitter(store)
+        #: Inflight-bounded admission control for the DATA-path RPCs (reads,
+        #: writes, chain forwards). Over the limit, requests fail fast with
+        #: RESOURCE_EXHAUSTED + retry-after instead of queueing — control
+        #: RPCs (DataPort/Stats/LocalAccess) stay exempt so discovery and
+        #: liveness keep working while the data plane sheds.
+        self.shedder = LoadShedder(
+            max_inflight=int(os.environ.get("TPUDFS_CS_MAX_INFLIGHT", "64"))
+        )
+        #: Testing failpoint (seconds of injected delay on data-path RPCs).
+        #: Set/cleared via tpudfs.testing.netem.slow_server()/heal_server()
+        #: — the overload chaos tiers use it to model a degraded disk/NIC.
+        self.fault_delay = 0.0
         #: Collective write group (tpudfs.tpu.write_group): when attached
         #: (chunkservers colocated on one pod's TPU hosts), chain writes
         #: whose replica set matches the group's ring successors ride ICI
@@ -264,6 +287,7 @@ class ChunkServer:
     READ_BATCH_MAX_SLOTS = 256
     READ_BATCH_MAX_BYTES = 96 << 20
 
+    @admission_controlled
     async def rpc_read_blocks(self, req: dict) -> dict:
         """Batched full reads for a remote reader's fused round: one
         frame/RPC instead of one per block. Per-slot ``sizes`` (-1 =
@@ -404,7 +428,15 @@ class ChunkServer:
         return self.address
 
     def _spawn(self, coro) -> asyncio.Task:
-        task = asyncio.create_task(coro)
+        # Background work (scrubber, silent recovery, EC conversion) is
+        # spawned from request contexts but outlives the request — shield
+        # it from the spawning caller's deadline budget or its RPCs would
+        # start failing the moment that one caller's budget ran out.
+        async def _detached():
+            with shielded_from_deadline():
+                await coro
+
+        task = asyncio.create_task(_detached())
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
         return task
@@ -543,13 +575,17 @@ class ChunkServer:
 
     # ------------------------------------------------------------ write path
 
+    @admission_controlled
     async def rpc_write_block(self, req: dict) -> dict:
         return await self._write_and_forward(req)
 
+    @admission_controlled
     async def rpc_replicate_block(self, req: dict) -> dict:
         return await self._write_and_forward(req)
 
     async def _write_and_forward(self, req: dict) -> dict:
+        if self.fault_delay:
+            await asyncio.sleep(self.fault_delay)
         stale = self._check_term(int(req.get("master_term", 0)),
                                  str(req.get("master_shard") or ""))
         if stale:
@@ -734,7 +770,10 @@ class ChunkServer:
 
     # ------------------------------------------------------------- read path
 
+    @admission_controlled
     async def rpc_read_block(self, req: dict) -> dict:
+        if self.fault_delay:
+            await asyncio.sleep(self.fault_delay)
         block_id = req["block_id"]
         offset = int(req.get("offset", 0))
         length = int(req.get("length", 0))
@@ -875,6 +914,8 @@ class ChunkServer:
             "dataplane_reads_total": dp["reads"],
             "dataplane_forwards_total": dp["forwards"],
             "dataplane_errors_total": dp["errors"],
+            **self.shedder.counters(),
+            **self.blocks.breakers.counters(),
             **self._ici_gauges(),
         }
 

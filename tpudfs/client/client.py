@@ -36,6 +36,14 @@ from tpudfs.common.checksum import crc32c
 from tpudfs.common.erasure import decode as ec_decode
 from tpudfs.common.erasure import encode as ec_encode
 from tpudfs.common.erasure import shard_len
+from tpudfs.common.resilience import (
+    BreakerBoard,
+    BudgetExhausted,
+    RetryBudget,
+    deadline_scope,
+    remaining_budget,
+    shielded_from_deadline,
+)
 from tpudfs.common.rpc import ClientTls, RpcClient, RpcError
 from tpudfs.common.sharding import ShardMap
 
@@ -72,6 +80,32 @@ class ChecksumMismatchError(DfsError):
     verified-path retry against healthy replicas is worthwhile."""
 
 
+class OverloadedError(DfsError):
+    """The cluster shed this request (RESOURCE_EXHAUSTED) and in-call
+    retries were used up. DETERMINATE — shed work was never executed. The
+    S3 gateway maps this to 503 SlowDown; batch callers should back off and
+    retry with jitter."""
+
+
+def _budgeted(fn):
+    """Public-op decorator: run inside the client's per-op deadline scope.
+
+    With ``op_budget`` set, every RPC attempt, retry sleep and hedge under
+    this operation is clamped to one shared remaining budget that also rides
+    RPC metadata to every downstream hop. An ambient deadline from an outer
+    caller always wins (deadline_scope only installs when none is active)."""
+
+    async def wrapped(self, *args, **kwargs):
+        with deadline_scope(self.op_budget):
+            return await fn(self, *args, **kwargs)
+
+    wrapped.__name__ = fn.__name__
+    wrapped.__qualname__ = fn.__qualname__
+    wrapped.__doc__ = fn.__doc__
+    wrapped.__wrapped__ = fn
+    return wrapped
+
+
 class Client:
     def __init__(
         self,
@@ -85,6 +119,7 @@ class Client:
         rpc_client: RpcClient | None = None,
         tls: ClientTls | None = None,
         rpc_timeout: float = 30.0,
+        op_budget: float | None = None,
         host_aliases: dict[str, str] | None = None,
         local_reads: bool | None = None,
         etag_mode: str = "md5",
@@ -99,6 +134,20 @@ class Client:
         self.max_retries = max_retries
         self.initial_backoff = initial_backoff
         self.rpc_timeout = rpc_timeout
+        #: Per-operation deadline budget (seconds). When set, every public
+        #: op runs inside a deadline scope: per-attempt RPC timeouts and
+        #: retry sleeps are clamped to the remaining budget, the budget
+        #: rides RPC metadata to every downstream hop, and the op fails
+        #: (bounded) instead of overshooting. None = legacy flat timeouts.
+        self.op_budget = op_budget
+        #: Token-bucket retry throttle per target address: retries/hedges
+        #: are capped at a fixed fraction of first-try volume so a slow
+        #: server sees shrinking — not amplified — load.
+        self.retry_budget = RetryBudget()
+        #: Per-replica-address circuit breakers biasing read ordering away
+        #: from addresses that keep failing (ordering only — never drops
+        #: the last candidate).
+        self.breakers = BreakerBoard()
         #: "md5" (default — S3 md5-ETag conformance, reference mod.rs:430)
         #: or "crc64" (hardware CRC-64/NVME, ~50x cheaper on the put path;
         #: ETags then carry a "-crc64" suffix and are NOT content md5s).
@@ -151,14 +200,14 @@ class Client:
         if cached is not None:
             store, retry_at = cached
             if store is not None or retry_at is None or \
-                    asyncio.get_event_loop().time() < retry_at:
+                    asyncio.get_running_loop().time() < retry_at:
                 return store
         async with self._local_probe_lock:  # no handshake stampede
             cached = self._local_stores.get(addr)
             if cached is not None:
                 store, retry_at = cached
                 if store is not None or retry_at is None or \
-                        asyncio.get_event_loop().time() < retry_at:
+                        asyncio.get_running_loop().time() < retry_at:
                     return store
             store = None
             retry_at = None
@@ -177,7 +226,7 @@ class Client:
                 logger.debug("short-circuit probe of %s failed: %s",
                              addr, e.message)
                 self._local_stores[addr] = (
-                    None, asyncio.get_event_loop().time() + 30.0
+                    None, asyncio.get_running_loop().time() + 30.0
                 )
                 return None
             probe = Path(resp["probe"])
@@ -288,6 +337,23 @@ class Client:
 
     # --------------------------------------------------------- RPC executor
 
+    def _op_scope(self):
+        """Deadline scope for one public operation (no-op when unbudgeted;
+        an ambient deadline from an outer caller always wins)."""
+        return deadline_scope(self.op_budget)
+
+    @staticmethod
+    async def _paced_sleep(delay: float) -> None:
+        """Backoff sleep clamped to the remaining deadline budget. Raises
+        BudgetExhausted when no budget remains — sleeping past the op's
+        give-up point only converts a bounded failure into a late one."""
+        rem = remaining_budget()
+        if rem is not None:
+            if rem <= 0:
+                raise BudgetExhausted("deadline budget exhausted")
+            delay = min(delay, rem)
+        await asyncio.sleep(delay)
+
     async def _execute(self, method: str, req: dict, *, path: str | None = None,
                        masters: list[str] | None = None,
                        retry_benign: tuple[str, ...] = ()) -> tuple[dict, str]:
@@ -305,8 +371,6 @@ class Client:
         if not targets:
             raise DfsError("no master addresses known")
         backoff = self.initial_backoff
-        last_err: RpcError | None = None
-        indeterminate = False  # a previous attempt may have applied
         idx = 0
         #: Targets that refused/timed out recently, with EXPIRY times. A
         #: freshly killed leader keeps being named by its followers' "Not
@@ -343,8 +407,24 @@ class Client:
             return i
 
         hint_follows = 0  # free immediate hint-follows used so far
+        try:
+            return await self._execute_attempts(
+                method, req, targets, idx, refused, _refused, _rotate,
+                hint_follows, backoff, retry_benign)
+        except BudgetExhausted:
+            raise IndeterminateError(
+                f"{method}: deadline budget exhausted mid-retry"
+            ) from None
+
+    async def _execute_attempts(self, method, req, targets, idx, refused,
+                                _refused, _rotate, hint_follows, backoff,
+                                retry_benign) -> tuple[dict, str]:
+        last_err: RpcError | None = None
+        indeterminate = False  # a previous attempt may have applied
         for attempt in range(self.max_retries + 1):
             target = targets[idx % len(targets)]
+            if attempt == 0:
+                self.retry_budget.on_first_attempt(target)
             try:
                 resp = await self.rpc.call(
                     self._dial(target), MASTER, method, req, timeout=self.rpc_timeout
@@ -356,6 +436,21 @@ class Client:
                 redirect = e.redirect_hint
                 if e.code.name in ("UNAVAILABLE", "DEADLINE_EXCEEDED"):
                     refused[target] = time.monotonic() + REFUSED_TTL
+                if e.code.name == "RESOURCE_EXHAUSTED":
+                    # Load-shed: DETERMINATE (the server refused before
+                    # executing). Honor its retry-after pacing against the
+                    # SAME target — rotating to a follower of the same Raft
+                    # group only buys a Not-Leader bounce — and draw from
+                    # the retry budget so shed->retry can't itself storm.
+                    if attempt < self.max_retries and \
+                            self.retry_budget.acquire_retry(target):
+                        await self._paced_sleep(
+                            max(e.retry_after or 0.0, backoff))
+                        backoff = min(backoff * 2, BACKOFF_CAP)
+                        continue
+                    raise OverloadedError(
+                        f"{method} shed by {target}: {e.message}"
+                    ) from None
                 if hint and not _refused(hint):
                     # Leader hint: try it next. The first couple of
                     # follows are free (the normal one-hop redirect);
@@ -371,7 +466,7 @@ class Client:
                         idx = 0
                     hint_follows += 1
                     if hint_follows > 2 and attempt < self.max_retries:
-                        await asyncio.sleep(max(self.initial_backoff, 0.3))
+                        await self._paced_sleep(max(self.initial_backoff, 0.3))
                     continue
                 if hint:
                     # Stale hint naming a recently-unreachable node: the
@@ -387,7 +482,7 @@ class Client:
                     # generic fallthrough below).
                     idx = _rotate(idx)
                     if attempt < self.max_retries:
-                        await asyncio.sleep(max(self.initial_backoff, 0.3))
+                        await self._paced_sleep(max(self.initial_backoff, 0.3))
                     continue
                 if redirect is not None:
                     # Wrong shard: refresh the map FIRST, fall back to the
@@ -412,7 +507,19 @@ class Client:
                 indeterminate = True
                 idx = _rotate(idx)
             if attempt < self.max_retries:
-                await asyncio.sleep(backoff)
+                # Every transport-error retry draws a token deposited by
+                # first attempts (not-leader/redirect follows above are
+                # ROUTING, exempt) — exhaustion means this client is in a
+                # retry storm and the kindest thing is a fast bounded
+                # failure.
+                if not self.retry_budget.acquire_retry(
+                        targets[idx % len(targets)]):
+                    raise IndeterminateError(
+                        f"{method}: retry budget exhausted after attempt "
+                        f"{attempt + 1}: "
+                        f"{last_err.message if last_err else 'unknown'}"
+                    )
+                await self._paced_sleep(backoff)
                 backoff = min(backoff * 2, BACKOFF_CAP)
         raise IndeterminateError(
             f"{method} failed after {self.max_retries + 1} attempts: "
@@ -421,6 +528,7 @@ class Client:
 
     # ------------------------------------------------------------ write path
 
+    @_budgeted
     async def create_file(self, path: str, data: bytes,
                           ec: tuple[int, int] | None = None,
                           etag: str | None = None,
@@ -627,6 +735,7 @@ class Client:
                 if e.code.name != "UNAVAILABLE":
                     raise
                 last_err = e
+                self.breakers.record_failure(chain[0])
                 logger.warning("chain entry %s unreachable (%s); rotating",
                                chain[0], e.message)
         if resp is None:
@@ -669,6 +778,7 @@ class Client:
 
     # ------------------------------------------------------------- read path
 
+    @_budgeted
     async def get_file_info(self, path: str) -> dict | None:
         """File metadata, transparently coalescing CONCURRENT callers into
         BatchGetFileInfo RPCs (one master round-trip, one ReadIndex/lease
@@ -686,7 +796,19 @@ class Client:
         self._meta_pending.append((path, fut))
         if self._meta_drainer is None or self._meta_drainer.done():
             self._meta_drainer = asyncio.create_task(self._drain_meta())
-        return await asyncio.shield(fut)
+        # The drainer is shared and deadline-shielded; each WAITER applies
+        # its own budget here so a budgeted op stays bounded even when its
+        # batch is stuck behind a slow shard.
+        rem = remaining_budget()
+        if rem is None:
+            return await asyncio.shield(fut)
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), max(rem, 0.01))
+        except asyncio.TimeoutError:
+            raise IndeterminateError(
+                f"get_file_info({path}): deadline budget exhausted waiting "
+                "on metadata batch"
+            ) from None
 
     async def _get_file_info_single(self, path: str) -> dict | None:
         resp, _ = await self._execute("GetFileInfo", {"path": path}, path=path)
@@ -697,6 +819,14 @@ class Client:
         the previous batch RPC was in flight (same pattern as the TPU read
         combiner). Paths are grouped by routing target set — different
         shards never share a batch."""
+        # The drainer task inherits the contextvars of whichever caller
+        # happened to spawn it, but it serves EVERY concurrent caller — one
+        # op's deadline must not bound the shared batch RPC (waiters apply
+        # their own budgets in get_file_info).
+        with shielded_from_deadline():
+            await self._drain_meta_rounds()
+
+    async def _drain_meta_rounds(self) -> None:
         aborted = True
         try:
             while self._meta_pending:
@@ -789,6 +919,7 @@ class Client:
         if not fut.done():
             fut.set_result(result)
 
+    @_budgeted
     async def get_file(self, path: str) -> bytes:
         """Concurrent block fan-out + reorder (reference mod.rs:856-917)."""
         meta = await self.get_file_info(path)
@@ -806,6 +937,7 @@ class Client:
             data = data[: meta["size"]]
         return data
 
+    @_budgeted
     async def read_file_range(self, path: str, offset: int, length: int) -> bytes:
         """Byte range → per-block (offset, length) reads (reference
         mod.rs:731-844)."""
@@ -814,6 +946,7 @@ class Client:
             raise DfsError(f"file not found: {path}")
         return await self.read_meta_range(meta, offset, length)
 
+    @_budgeted
     async def read_meta_range(self, meta: dict, offset: int, length: int) -> bytes:
         """Range read against already-fetched file metadata. Hot-path variant
         for callers (e.g. the grain infeed) that cache the immutable block
@@ -871,6 +1004,11 @@ class Client:
         locations = [l for l in block["locations"] if l]
         if not locations:
             raise DfsError(f"no locations for block {block['block_id']}")
+        # Breaker bias: replicas whose breakers are open (recent repeated
+        # transport failures) go to the back of the candidate order. Pure
+        # reordering — an all-open set is tried in place, so breakers can
+        # never cost availability, only tail latency on known-bad peers.
+        locations = self.breakers.healthy_first(locations)
 
         # Short-circuit: a colocated replica is read straight off disk
         # (verified against its sidecar) — no gRPC byte shuffling.
@@ -886,11 +1024,21 @@ class Client:
         # ReadBlock is the chunkserver's VERIFIED RPC path: the server
         # checks the sidecar CRC32C before the bytes leave disk.
         async def read_from(addr: str) -> bytes:
-            resp = await self._data_call(addr, "ReadBlock", req,
-                                         timeout=max(self.rpc_timeout, 60.0))
+            try:
+                resp = await self._data_call(addr, "ReadBlock", req,
+                                             timeout=max(self.rpc_timeout, 60.0))
+            except RpcError as e:
+                # Only transport-shaped failures feed the breaker — a
+                # NOT_FOUND replica is a placement problem, not a sick peer.
+                if e.code.name in ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                                   "RESOURCE_EXHAUSTED"):
+                    self.breakers.record_failure(addr)
+                raise
+            self.breakers.record_success(addr)
             return resp["data"]
 
         errors: list[str] = []
+        self.retry_budget.on_first_attempt(locations[0])
         if self.hedge_delay is not None and len(locations) > 1:
             primary = asyncio.create_task(read_from(locations[0]))
             try:
@@ -898,27 +1046,38 @@ class Client:
                     asyncio.shield(primary), self.hedge_delay
                 )
             except asyncio.TimeoutError:
-                hedge = asyncio.create_task(read_from(locations[1]))
-                done, pending = await asyncio.wait(
-                    {primary, hedge}, return_when=asyncio.FIRST_COMPLETED
-                )
-                # Prefer any successful completion; cancel the loser.
-                winner: bytes | None = None
-                for t in done:
-                    if t.exception() is None:
-                        winner = t.result()
-                if winner is None and pending:
-                    t2 = await asyncio.wait(pending)
-                    for t in t2[0]:
+                # A hedge is a speculative retry: it fires only if a budget
+                # token is available, so hedge volume obeys the same
+                # amplification cap as failure retries — under overload the
+                # hedges are the first thing to go (graceful degradation).
+                if not self.retry_budget.acquire_retry(locations[1]):
+                    try:
+                        return await primary
+                    except RpcError as e:
+                        errors.append(f"{locations[0]}: {e.message}")
+                        rest = locations[1:]
+                else:
+                    hedge = asyncio.create_task(read_from(locations[1]))
+                    done, pending = await asyncio.wait(
+                        {primary, hedge}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    # Prefer any successful completion; cancel the loser.
+                    winner: bytes | None = None
+                    for t in done:
                         if t.exception() is None:
                             winner = t.result()
-                    pending = set()
-                for t in pending:
-                    t.cancel()
-                if winner is not None:
-                    return winner
-                errors.append("hedged reads failed")
-                rest = locations[2:]
+                    if winner is None and pending:
+                        t2 = await asyncio.wait(pending)
+                        for t in t2[0]:
+                            if t.exception() is None:
+                                winner = t.result()
+                        pending = set()
+                    for t in pending:
+                        t.cancel()
+                    if winner is not None:
+                        return winner
+                    errors.append("hedged reads failed")
+                    rest = locations[2:]
             except RpcError as e:
                 errors.append(f"{locations[0]}: {e.message}")
                 rest = locations[1:]
@@ -997,10 +1156,12 @@ class Client:
 
     # -------------------------------------------------------- namespace ops
 
+    @_budgeted
     async def delete_file(self, path: str) -> None:
         await self._execute("DeleteFile", {"path": path}, path=path,
                             retry_benign=("NOT_FOUND",))
 
+    @_budgeted
     async def rename_file(self, src: str, dst: str,
                           replace: bool = False) -> None:
         """``replace=True`` atomically swaps out an existing destination
@@ -1009,10 +1170,12 @@ class Client:
                                        "replace": replace}, path=src,
                             retry_benign=("NOT_FOUND",))
 
+    @_budgeted
     async def list_files(self, prefix: str = "") -> list[str]:
         """Per-shard fan-out union (reference mod.rs:125-200)."""
         return [p for p, _ in await self.list_files_with_meta(prefix, meta=False)]
 
+    @_budgeted
     async def list_files_with_meta(
         self, prefix: str = "", *, meta: bool = True,
         basename: str | None = None,

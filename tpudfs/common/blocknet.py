@@ -43,6 +43,14 @@ import struct
 import grpc
 import msgpack
 
+from tpudfs.common.resilience import (
+    BreakerBoard,
+    BudgetExhausted,
+    Deadline,
+    attempt_timeout,
+    remaining_budget,
+    set_deadline,
+)
 from tpudfs.common.rpc import ClientTls, RpcClient, RpcError, ServerTls
 
 import socket as _socket
@@ -216,8 +224,24 @@ class BlockPortServer:
                     await w.drain()
                     continue
                 req = header
+                # Deadline parity with the gRPC plane: adopt the caller's
+                # remaining budget (`_db`, relative seconds) and reject
+                # expired work before executing it.
+                budget = req.pop("_db", None)
+                if not isinstance(budget, (int, float)):
+                    budget = None
+                if budget is not None and budget <= 0:
+                    w.writelines(_pack_frame(
+                        {"ok": False, "code": "DEADLINE_EXCEEDED",
+                         "message": "deadline budget exhausted before "
+                                    f"blockport {method} executed"}, None))
+                    await w.drain()
+                    continue
                 if req.pop("_d", 0):
                     req["data"] = payload
+                dl_token = set_deadline(
+                    Deadline.after(budget) if budget is not None else None
+                )
                 try:
                     resp = await fn(req)
                 except RpcError as e:
@@ -235,6 +259,11 @@ class BlockPortServer:
                          "message": "internal error"}, None))
                     await w.drain()
                     continue
+                finally:
+                    try:
+                        dl_token.var.reset(dl_token)
+                    except ValueError:
+                        pass
                 out = dict(resp)
                 data = out.pop("data", None) if "data" in out else None
                 out["ok"] = True
@@ -252,9 +281,10 @@ class BlockConnPool:
     transparent gRPC fallback.
 
     ``call(rpc, addr, method, req)`` sends over the peer's blockport when
-    one is advertised (``DataPort`` probe, cached; failures negative-cached
-    for 30 s) and over ``rpc`` otherwise — so every caller keeps exactly
-    one code path and legacy/faulted peers degrade gracefully."""
+    one is advertised (``DataPort`` probe, cached; transport failures open
+    a per-address circuit breaker) and over ``rpc`` otherwise — so every
+    caller keeps exactly one code path and legacy/faulted peers degrade
+    gracefully."""
 
     #: idle connections kept per peer; extras close on release.
     MAX_IDLE_PER_PEER = 8
@@ -262,13 +292,19 @@ class BlockConnPool:
     def __init__(self, tls: ClientTls | None = None):
         self._tls = tls
         self._free: dict[str, list] = {}
-        #: addr -> (port | None). None = peer has no blockport (final) —
-        #: probe transport errors get a retry deadline instead.
+        #: addr -> (port | None). None = peer has no blockport (final,
+        #: from an UNIMPLEMENTED probe). Transport-level probe/call
+        #: failures instead open the per-address breaker below.
         self._ports: dict[str, int | None] = {}
         #: addr -> whether the advertised blockport is the native engine
         #: (chain-forwards only to blockports; see chain_info()).
         self._native: dict[str, bool] = {}
-        self._retry_at: dict[str, float] = {}
+        #: Per-address breakers replacing the old flat retry-at negative
+        #: cache: one failure opens for 5 s, consecutive opens double the
+        #: window up to 30 s, and a single half-open probe per window
+        #: re-tests the peer (the old cache re-probed blind on expiry).
+        self.breakers = BreakerBoard(failure_threshold=1, reset_timeout=5.0,
+                                     max_reset=30.0)
         #: in-flight DataPort probes, shared so a concurrent first burst
         #: fires ONE probe per peer instead of one per caller.
         self._probes: dict[str, asyncio.Task] = {}
@@ -290,9 +326,8 @@ class BlockConnPool:
                          service: str) -> int | None:
         if addr in self._ports:
             return self._ports[addr]
-        now = asyncio.get_running_loop().time()
-        if self._retry_at.get(addr, 0) > now:
-            return None
+        if not self.breakers.allow(addr):
+            return None  # breaker open: stay on gRPC until a probe heals it
         probe = self._probes.get(addr)
         if probe is None:
             probe = asyncio.create_task(self._probe(rpc, addr, service))
@@ -310,16 +345,17 @@ class BlockConnPool:
 
     async def _probe(self, rpc: RpcClient, addr: str,
                      service: str) -> int | None:
-        now = asyncio.get_running_loop().time()
         try:
             resp = await rpc.call(addr, service, "DataPort", {}, timeout=5.0)
             port = int(resp.get("port") or 0) or None
         except RpcError as e:
             if e.code == grpc.StatusCode.UNIMPLEMENTED:
                 self._ports[addr] = None  # pre-blockport peer: final
+                self.breakers.record_success(addr)
             else:
-                self._retry_at[addr] = now + 30.0
+                self.breakers.record_failure(addr)
             return None
+        self.breakers.record_success(addr)
         self._ports[addr] = port
         # FAIL CLOSED on version skew: a peer that advertises a blockport
         # but predates the `native` field might still be the native engine
@@ -364,6 +400,13 @@ class BlockConnPool:
         the blockport transport only; the gRPC path (and a None callback
         result) returns the payload as ``resp["data"]`` and the caller
         copies it itself."""
+        try:
+            timeout = attempt_timeout(timeout)
+        except BudgetExhausted:
+            raise RpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"deadline budget exhausted before {method} to {addr}",
+            ) from None
         port = None
         if enabled():
             port = await self._data_port(rpc, addr, service)
@@ -371,7 +414,7 @@ class BlockConnPool:
             return await rpc.call(addr, service, method, req, timeout=timeout)
         host = addr.rsplit(":", 1)[0]
         try:
-            return await asyncio.wait_for(
+            resp = await asyncio.wait_for(
                 self._call_blockport(f"{host}:{port}", method, req,
                                      payload_into),
                 timeout=timeout,
@@ -385,14 +428,16 @@ class BlockConnPool:
         except (OSError, ConnectionError, asyncio.IncompleteReadError,
                 ValueError, msgpack.exceptions.UnpackException) as e:
             # Connection-level OR framing failure (a corrupt/desynced frame
-            # surfaces as an unpack error): drop the cached port so the
-            # next call re-probes (the peer may have restarted on a new
-            # port), and surface the same UNAVAILABLE the gRPC path would
-            # so caller failover loops keep working.
+            # surfaces as an unpack error): drop the cached port so a later
+            # probe re-resolves it (the peer may have restarted on a new
+            # port), open the breaker, and surface the same UNAVAILABLE the
+            # gRPC path would so caller failover loops keep working.
             self._ports.pop(addr, None)
-            self._retry_at[addr] = asyncio.get_running_loop().time() + 5.0
+            self.breakers.record_failure(addr)
             raise RpcError(grpc.StatusCode.UNAVAILABLE,
                            f"blockport {host}:{port}: {e!r}") from None
+        self.breakers.record_success(addr)
+        return resp
 
     async def _call_blockport(self, hostport: str, method: str,
                               req: dict, payload_into=None) -> dict:
@@ -418,6 +463,9 @@ class BlockConnPool:
         try:
             header = {k: v for k, v in req.items() if k != "data"}
             header["m"] = method
+            rem = remaining_budget()
+            if rem is not None:
+                header["_db"] = rem
             w.writelines(_pack_frame(header, req.get("data")))
             await w.drain()
             resp, payload = await _read_frame(r, into=payload_into)

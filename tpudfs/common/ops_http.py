@@ -24,7 +24,11 @@ def render_metrics(prefix: str, gauges: dict[str, float]) -> str:
     lines = []
     for name, value in gauges.items():
         full = f"{prefix}_{name}"
-        lines.append(f"# TYPE {full} gauge")
+        # Prometheus convention: monotonically increasing series end in
+        # _total and are counters (the resilience counters — shed/breaker/
+        # retry-budget — rely on this for rate() queries).
+        kind = "counter" if name.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {full} {kind}")
         lines.append(f"{full} {value}")
     return "\n".join(lines) + "\n"
 

@@ -1,0 +1,441 @@
+"""End-to-end resilience primitives: deadlines, retry budgets, breakers, shedding.
+
+The chaos tiers prove this DFS survives kills and partitions; this module
+defends against the *other* production failure mode — overload and metastable
+retry storms. Four cooperating mechanisms, each usable on its own:
+
+- **Deadline propagation.** The client's per-op budget lives in a contextvar
+  (same pattern as the request id in :mod:`tpudfs.common.telemetry`) and rides
+  outgoing RPC metadata as *remaining seconds* (relative, so clock skew between
+  hosts is irrelevant — the same choice gRPC makes with ``grpc-timeout``).
+  ``RpcClient.call`` clamps each attempt's timeout to the remaining budget and
+  refuses to send already-expired work; ``RpcServer`` adopts the budget and
+  rejects expired requests with DEADLINE_EXCEEDED *before* running the handler,
+  so a queue of doomed work drains instead of executing.
+
+- **Retry budgets.** A token bucket per target address: every first attempt
+  deposits ``ratio`` tokens, every retry/hedge withdraws one. Retry volume is
+  thereby capped at ``ratio`` × first-try volume (plus a fixed burst), which is
+  what breaks the metastable feedback loop where retries against a slow server
+  become the majority of its load.
+
+- **Circuit breakers.** Per-address closed → open → half-open state machines.
+  ``failure_threshold`` consecutive failures open the breaker; after
+  ``reset_timeout`` (doubling per consecutive open, capped) exactly one
+  half-open probe is admitted, and its outcome closes or re-opens the breaker.
+
+- **Load shedding.** An inflight-bounded admission controller for server
+  handlers. Over the limit, requests fail fast with RESOURCE_EXHAUSTED carrying
+  a machine-readable retry-after hint (``Overloaded|<seconds>|...``, same
+  message-prefix convention as ``Not Leader|``), mapped to S3 503 SlowDown at
+  the gateway.
+
+Everything here is clock-injectable so unit tests never sleep.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from collections.abc import Callable, Iterator
+from typing import Any
+
+#: Metadata key carrying the remaining deadline budget in seconds (relative).
+DEADLINE_KEY = "x-deadline-budget"
+
+#: Floor for derived per-attempt timeouts: a nearly-expired budget still gets
+#: a short real timeout rather than a degenerate zero that can never succeed.
+MIN_ATTEMPT_TIMEOUT = 0.01
+
+
+class Deadline:
+    """An absolute give-up point on the monotonic clock."""
+
+    __slots__ = ("expires_at", "_clock")
+
+    def __init__(self, expires_at: float, clock: Callable[[], float] = time.monotonic):
+        self.expires_at = expires_at
+        self._clock = clock
+
+    @classmethod
+    def after(cls, budget: float,
+              clock: Callable[[], float] = time.monotonic) -> "Deadline":
+        return cls(clock() + budget, clock)
+
+    def remaining(self) -> float:
+        return self.expires_at - self._clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+
+_deadline: contextvars.ContextVar[Deadline | None] = contextvars.ContextVar(
+    "tpudfs_deadline", default=None
+)
+
+
+def current_deadline() -> Deadline | None:
+    return _deadline.get()
+
+
+def set_deadline(d: Deadline | None) -> contextvars.Token:
+    return _deadline.set(d)
+
+
+def remaining_budget() -> float | None:
+    """Seconds left on the ambient deadline, or None when no deadline is set."""
+    d = _deadline.get()
+    return None if d is None else d.remaining()
+
+
+@contextlib.contextmanager
+def deadline_scope(budget: float | None) -> Iterator[Deadline | None]:
+    """Establish a per-op deadline unless one is already active.
+
+    An outer deadline always wins — a caller that budgeted the whole operation
+    must not have its clamp loosened by an inner hop's more generous default.
+    """
+    if budget is None or _deadline.get() is not None:
+        yield _deadline.get()
+        return
+    d = Deadline.after(budget)
+    token = _deadline.set(d)
+    try:
+        yield d
+    finally:
+        _deadline.reset(token)
+
+
+@contextlib.contextmanager
+def shielded_from_deadline() -> Iterator[None]:
+    """Clear the ambient deadline for background work.
+
+    Tasks spawned from a request context (silent re-replication, shared
+    metadata-batch drainers) inherit the spawning request's contextvars; their
+    RPCs must not die when *that* caller's budget runs out.
+    """
+    token = _deadline.set(None)
+    try:
+        yield
+    finally:
+        _deadline.reset(token)
+
+
+def attempt_timeout(timeout: float | None) -> float | None:
+    """Clamp a per-attempt timeout to the ambient deadline's remaining budget.
+
+    Raises :class:`BudgetExhausted` when the budget is already spent, so the
+    caller fails fast instead of sending doomed work.
+    """
+    rem = remaining_budget()
+    if rem is None:
+        return timeout
+    if rem <= 0:
+        raise BudgetExhausted("deadline budget exhausted")
+    rem = max(rem, MIN_ATTEMPT_TIMEOUT)
+    return rem if timeout is None else min(timeout, rem)
+
+
+class BudgetExhausted(Exception):
+    """The ambient deadline expired before the next attempt could be sent."""
+
+
+# ---------------------------------------------------------------------------
+# Retry budgets
+# ---------------------------------------------------------------------------
+
+
+class TokenBucket:
+    """Deposit-per-first-try retry throttle (The Tail at Scale / gRPC style).
+
+    First attempts deposit ``ratio`` tokens (capped at ``burst``); each retry
+    withdraws one whole token. Long-run retry volume is therefore at most
+    ``ratio`` × first-try volume + ``burst``.
+    """
+
+    __slots__ = ("ratio", "burst", "tokens")
+
+    def __init__(self, ratio: float = 0.5, burst: float = 10.0):
+        self.ratio = ratio
+        self.burst = burst
+        self.tokens = burst  # start full: isolated failures always get retries
+
+    def deposit(self) -> None:
+        self.tokens = min(self.burst, self.tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class RetryBudget:
+    """Per-target token buckets with aggregate counters.
+
+    ``first_tries``/``retries``/``denied`` feed both the overload chaos
+    assertions (retry amplification ≤ 2×) and the ops /metrics endpoint.
+    """
+
+    def __init__(self, ratio: float = 0.5, burst: float = 10.0):
+        self.ratio = ratio
+        self.burst = burst
+        self._buckets: dict[str, TokenBucket] = {}
+        self.first_tries = 0
+        self.retries = 0
+        self.denied = 0
+
+    def _bucket(self, key: str) -> TokenBucket:
+        b = self._buckets.get(key)
+        if b is None:
+            b = self._buckets[key] = TokenBucket(self.ratio, self.burst)
+        return b
+
+    def on_first_attempt(self, key: str) -> None:
+        self.first_tries += 1
+        self._bucket(key).deposit()
+
+    def acquire_retry(self, key: str) -> bool:
+        if self._bucket(key).try_spend():
+            self.retries += 1
+            return True
+        self.denied += 1
+        return False
+
+    def counters(self) -> dict[str, float]:
+        return {
+            "retry_budget_first_tries_total": float(self.first_tries),
+            "retry_budget_retries_total": float(self.retries),
+            "retry_budget_denied_total": float(self.denied),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers
+# ---------------------------------------------------------------------------
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Closed → open → half-open → closed, with exponential open windows.
+
+    ``allow()`` answers "may I send traffic here right now?": always in
+    CLOSED, never while the open window runs, and exactly once per window in
+    HALF_OPEN (the probe). ``record_success``/``record_failure`` resolve the
+    probe and drive the state machine.
+    """
+
+    __slots__ = ("failure_threshold", "reset_timeout", "max_reset", "_clock",
+                 "state", "_failures", "_open_until", "_consecutive_opens",
+                 "_probe_inflight")
+
+    def __init__(self, failure_threshold: int = 3, reset_timeout: float = 5.0,
+                 max_reset: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.max_reset = max_reset
+        self._clock = clock
+        self.state = CLOSED
+        self._failures = 0
+        self._open_until = 0.0
+        self._consecutive_opens = 0
+        self._probe_inflight = False
+
+    def allow(self) -> bool:
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            if self._clock() < self._open_until:
+                return False
+            self.state = HALF_OPEN
+            self._probe_inflight = True
+            return True
+        # HALF_OPEN: one probe at a time
+        if self._probe_inflight:
+            return False
+        self._probe_inflight = True
+        return True
+
+    def record_success(self) -> None:
+        self.state = CLOSED
+        self._failures = 0
+        self._consecutive_opens = 0
+        self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        self._probe_inflight = False
+        if self.state == HALF_OPEN:
+            self._trip()
+            return
+        self._failures += 1
+        if self._failures >= self.failure_threshold:
+            self._trip()
+
+    def _trip(self) -> None:
+        self.state = OPEN
+        self._failures = 0
+        self._consecutive_opens += 1
+        window = min(self.max_reset,
+                     self.reset_timeout * (2 ** (self._consecutive_opens - 1)))
+        self._open_until = self._clock() + window
+
+
+class BreakerBoard:
+    """Per-address circuit breakers sharing one configuration."""
+
+    def __init__(self, failure_threshold: int = 3, reset_timeout: float = 5.0,
+                 max_reset: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._cfg = (failure_threshold, reset_timeout, max_reset)
+        self._clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self.opens_total = 0
+        self.short_circuits_total = 0
+
+    def get(self, addr: str) -> CircuitBreaker:
+        br = self._breakers.get(addr)
+        if br is None:
+            ft, rt, mr = self._cfg
+            br = self._breakers[addr] = CircuitBreaker(ft, rt, mr, self._clock)
+        return br
+
+    def allow(self, addr: str) -> bool:
+        ok = self.get(addr).allow()
+        if not ok:
+            self.short_circuits_total += 1
+        return ok
+
+    def record_success(self, addr: str) -> None:
+        self.get(addr).record_success()
+
+    def record_failure(self, addr: str) -> None:
+        br = self.get(addr)
+        was_open = br.state == OPEN
+        br.record_failure()
+        if br.state == OPEN and not was_open:
+            self.opens_total += 1
+
+    def healthy_first(self, addrs: list[str]) -> list[str]:
+        """Stable partition: addresses with non-open breakers first.
+
+        Ordering only — an all-open list is returned intact, so availability
+        never depends on breaker state (the breaker biases, the retry loop
+        decides).
+        """
+        good = [a for a in addrs if self.get(a).state != OPEN]
+        bad = [a for a in addrs if self.get(a).state == OPEN]
+        return good + bad
+
+    def counters(self) -> dict[str, float]:
+        return {
+            "breaker_open_count": float(
+                sum(1 for b in self._breakers.values() if b.state == OPEN)),
+            "breaker_opens_total": float(self.opens_total),
+            "breaker_short_circuits_total": float(self.short_circuits_total),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Load shedding
+# ---------------------------------------------------------------------------
+
+#: Message prefix for RESOURCE_EXHAUSTED errors carrying a retry-after hint,
+#: mirroring the ``Not Leader|<hint>`` convention from the reference.
+OVERLOADED_PREFIX = "Overloaded|"
+
+
+def overloaded_message(retry_after: float, detail: str = "") -> str:
+    return f"{OVERLOADED_PREFIX}{retry_after:.3f}|{detail}"
+
+
+def retry_after_hint(message: str) -> float | None:
+    """Parse the retry-after seconds out of an ``Overloaded|…`` message."""
+    if not message.startswith(OVERLOADED_PREFIX):
+        return None
+    parts = message.split("|", 2)
+    try:
+        return float(parts[1])
+    except (IndexError, ValueError):
+        return None
+
+
+class LoadShedder:
+    """Inflight-bounded admission control for server handlers.
+
+    Not a queue: over the limit we fail *fast* — queueing doomed work is
+    exactly the behavior that turns a slow server into a dead one. The
+    retry-after hint scales with pressure so shed clients spread their
+    comebacks instead of thundering back in lockstep.
+    """
+
+    def __init__(self, max_inflight: int = 64, base_retry_after: float = 0.1):
+        self.max_inflight = max_inflight
+        self.base_retry_after = base_retry_after
+        self.inflight = 0
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.peak_inflight = 0
+
+    def try_acquire(self) -> bool:
+        if self.inflight >= self.max_inflight:
+            self.shed_total += 1
+            return False
+        self.inflight += 1
+        self.admitted_total += 1
+        self.peak_inflight = max(self.peak_inflight, self.inflight)
+        return True
+
+    def release(self) -> None:
+        self.inflight -= 1
+
+    def retry_after(self) -> float:
+        over = max(0, self.inflight - self.max_inflight + 1)
+        return self.base_retry_after * (1.0 + over / max(1, self.max_inflight))
+
+    def counters(self) -> dict[str, float]:
+        return {
+            "shed_inflight": float(self.inflight),
+            "shed_peak_inflight": float(self.peak_inflight),
+            "shed_admitted_total": float(self.admitted_total),
+            "shed_total": float(self.shed_total),
+        }
+
+
+def admission_controlled(fn: Any) -> Any:
+    """Decorator for service RPC methods: admit through ``self.shedder``.
+
+    Services opt in per-method (heartbeats, liveness and raft traffic stay
+    exempt — shedding those turns overload into a false partition). The
+    wrapped method keeps its ``(self, request)`` shape so the rpc-contract
+    lint still resolves handler signatures.
+    """
+
+    async def wrapped(self: Any, request: Any) -> Any:
+        shedder: LoadShedder | None = getattr(self, "shedder", None)
+        if shedder is None:
+            return await fn(self, request)
+        if not shedder.try_acquire():
+            # Local import: rpc.py imports this module for deadline clamping,
+            # so the top-level dependency must point rpc -> resilience only.
+            from tpudfs.common.rpc import RpcError
+            raise RpcError.resource_exhausted(
+                f"{type(self).__name__} at admission limit "
+                f"({shedder.max_inflight} inflight)",
+                retry_after=shedder.retry_after(),
+            )
+        try:
+            return await fn(self, request)
+        finally:
+            shedder.release()
+
+    wrapped.__name__ = fn.__name__
+    wrapped.__qualname__ = fn.__qualname__
+    wrapped.__doc__ = fn.__doc__
+    wrapped.__wrapped__ = fn
+    return wrapped

@@ -28,6 +28,16 @@ import grpc
 import grpc.aio
 import msgpack
 
+from tpudfs.common.resilience import (
+    DEADLINE_KEY,
+    BudgetExhausted,
+    Deadline,
+    attempt_timeout,
+    overloaded_message,
+    remaining_budget,
+    retry_after_hint,
+    set_deadline,
+)
 from tpudfs.common.telemetry import REQUEST_ID_KEY, current_request_id, set_request_id
 
 logger = logging.getLogger(__name__)
@@ -50,6 +60,17 @@ def _dumps(obj: Any) -> bytes:
 
 def _loads(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+def _parse_budget(raw: Any) -> float | None:
+    """Deadline metadata is advisory — a malformed value means no deadline,
+    never a rejected request."""
+    if not isinstance(raw, str):
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
 
 
 class RpcError(Exception):
@@ -114,6 +135,23 @@ class RpcError(Exception):
     @classmethod
     def data_loss(cls, message: str) -> "RpcError":
         return cls(grpc.StatusCode.DATA_LOSS, message)
+
+    @classmethod
+    def resource_exhausted(cls, message: str,
+                           retry_after: float = 0.1) -> "RpcError":
+        """Load-shed rejection carrying a machine-readable retry-after hint
+        (``Overloaded|<seconds>|<detail>``, same convention as Not Leader)."""
+        return cls(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                   overloaded_message(retry_after, message))
+
+    @classmethod
+    def deadline_exceeded(cls, message: str) -> "RpcError":
+        return cls(grpc.StatusCode.DEADLINE_EXCEEDED, message)
+
+    @property
+    def retry_after(self) -> float | None:
+        """Server-suggested backoff when this is a load-shed rejection."""
+        return retry_after_hint(self.message)
 
 
 Handler = Callable[[Any], Awaitable[Any]]
@@ -201,7 +239,20 @@ class RpcServer:
             md = {k: v for k, v in (context.invocation_metadata() or ())}
             rid = md.get(REQUEST_ID_KEY)
             token = set_request_id(rid if isinstance(rid, str) else None)
+            # Adopt the caller's remaining deadline budget so downstream RPCs
+            # made by this handler are clamped to it; reject already-expired
+            # work before executing — running it can only waste capacity.
+            budget = _parse_budget(md.get(DEADLINE_KEY))
+            dl_token = set_deadline(
+                Deadline.after(budget) if budget is not None else None
+            )
             try:
+                if budget is not None and budget <= 0:
+                    await context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        f"deadline budget exhausted before {service}/{method} "
+                        "executed",
+                    )
                 return await fn(request)
             except RpcError as e:
                 await context.abort(e.code, e.message)
@@ -214,6 +265,10 @@ class RpcServer:
                 set_request_id(None)
                 try:
                     token.var.reset(token)
+                except ValueError:
+                    pass
+                try:
+                    dl_token.var.reset(dl_token)
                 except ValueError:
                     pass
 
@@ -317,6 +372,19 @@ class RpcClient:
             )
             self._stubs[addr, service, method] = rpc
         metadata = ((REQUEST_ID_KEY, current_request_id()),)
+        # Per-attempt timeout = min(explicit timeout, remaining op budget);
+        # the budget also rides metadata (as relative seconds, skew-immune)
+        # so every downstream hop inherits the same give-up point.
+        try:
+            timeout = attempt_timeout(timeout)
+        except BudgetExhausted:
+            raise RpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"deadline budget exhausted before calling {service}/{method}",
+            ) from None
+        rem = remaining_budget()
+        if rem is not None:
+            metadata += ((DEADLINE_KEY, f"{rem:.6f}"),)
         try:
             return await rpc(request, timeout=timeout, metadata=metadata)
         except grpc.aio.AioRpcError as e:

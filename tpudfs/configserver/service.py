@@ -321,8 +321,8 @@ async def wait_for_leader(addrs: list[str], client: RpcClient,
                           timeout: float = 15.0) -> str:
     """Poll ``RaftState`` until some config server reports leadership
     (the pattern test scripts use against /raft/state in the reference)."""
-    deadline = asyncio.get_event_loop().time() + timeout
-    while asyncio.get_event_loop().time() < deadline:
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
         for addr in addrs:
             try:
                 st = await client.call(addr, SERVICE, "RaftState", {}, timeout=2.0)

@@ -26,6 +26,7 @@ import os
 import time
 import uuid
 
+from tpudfs.common.resilience import LoadShedder, admission_controlled
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
 from tpudfs.common.sharding import ShardMap
 from tpudfs.master import autoshard, placement
@@ -158,6 +159,13 @@ class Master:
             interval_secs=self._intervals["metrics_decay"],
         )
         self.tx = TransactionManager(self)
+        # Namespace-RPC admission control. Control-plane traffic (heartbeats,
+        # registration, Raft membership, safe mode, 2PC coordination) is
+        # exempt: shedding it under load would turn congestion into false
+        # liveness failures and stuck transactions.
+        self.shedder = LoadShedder(
+            max_inflight=int(os.environ.get("TPUDFS_MASTER_MAX_INFLIGHT", "256"))
+        )
         self._tasks: set[asyncio.Task] = set()
         #: Coalesced access-stats (see _note_access): path -> (at_ms, count)
         #: pending since the last batched proposal.
@@ -224,8 +232,8 @@ class Master:
                 # attempt can itself burn several RPC timeouts against
                 # blackholed config servers); _check_shard_ownership fails
                 # closed if this deadline passes without a map.
-                deadline = asyncio.get_event_loop().time() + 30.0
-                while asyncio.get_event_loop().time() < deadline:
+                deadline = asyncio.get_running_loop().time() + 30.0
+                while asyncio.get_running_loop().time() < deadline:
                     await self.run_shard_refresh()
                     if self.shard_map is not None:
                         break
@@ -420,6 +428,7 @@ class Master:
 
     # ------------------------------------------------------- namespace RPCs
 
+    @admission_controlled
     async def rpc_create_file(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
@@ -457,6 +466,7 @@ class Master:
                     "alloc_error": e.message}
         return {"success": True, "write_token": token, **alloc}
 
+    @admission_controlled
     async def rpc_allocate_block(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
@@ -509,6 +519,7 @@ class Master:
             "shard_id": self.state.shard_id,
         }
 
+    @admission_controlled
     async def rpc_complete_file(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
@@ -527,6 +538,7 @@ class Master:
         })
         return {"success": True}
 
+    @admission_controlled
     async def rpc_get_file_info(self, req: dict) -> dict:
         self._check_shard_ownership(req["path"])
         await self._linearizable_read()
@@ -550,6 +562,7 @@ class Master:
         d.pop("create_token", None)
         return d
 
+    @admission_controlled
     async def rpc_batch_get_file_info(self, req: dict) -> dict:
         """Coalesced GetFileInfo: ONE ReadIndex/lease barrier covers the
         whole batch. Linearizability per caller is preserved — every
@@ -604,6 +617,7 @@ class Master:
             except (NotLeaderError, ValueError):
                 return
 
+    @admission_controlled
     async def rpc_delete_file(self, req: dict) -> dict:
         self._check_safe_mode()
         self._check_shard_ownership(req["path"])
@@ -612,6 +626,7 @@ class Master:
         await self._propose({"op": "delete_file", "path": req["path"]})
         return {"success": True}
 
+    @admission_controlled
     async def rpc_rename(self, req: dict) -> dict:
         """Rename: same-shard fast path through one Raft command
         (master.rs:2777-2808), cross-shard via the 2PC coordinator
@@ -647,6 +662,7 @@ class Master:
                                              replace=replace)
         return {"success": True, "cross_shard": True}
 
+    @admission_controlled
     async def rpc_list_files(self, req: dict) -> dict:
         await self._linearizable_read()
         prefix = req.get("path", "")
@@ -671,6 +687,7 @@ class Master:
             ]
         return resp
 
+    @admission_controlled
     async def rpc_get_block_locations(self, req: dict) -> dict:
         # Linearizable by default; chunkserver recovery passes allow_stale
         # because it sweeps all masters and any copy of the location set
@@ -785,6 +802,7 @@ class Master:
 
     # ------------------------------------------------------- sharding RPCs
 
+    @admission_controlled
     async def rpc_ingest_metadata(self, req: dict) -> dict:
         """Bulk-import file metadata pushed by a peer shard during split
         migration (reference IngestMetadata master.rs:3558-3620). Gated like
@@ -813,6 +831,7 @@ class Master:
         result = await self._propose({"op": "ingest_metadata", "files": files})
         return {"success": True, "count": result["count"]}
 
+    @admission_controlled
     async def rpc_initiate_shuffle(self, req: dict) -> dict:
         """Operator-triggered background block re-spread for a prefix
         (reference InitiateShuffle master.rs:3620-3660)."""
@@ -1200,6 +1219,7 @@ class Master:
                 except RpcError:
                     pass
 
+    @admission_controlled
     async def rpc_stage_ingest(self, req: dict) -> dict:
         """Target side of a migration handoff: hold the moved range's
         metadata without serving it (the staged-range guard answers
@@ -1218,6 +1238,7 @@ class Master:
         })
         return {"success": True}
 
+    @admission_controlled
     async def rpc_commit_staged_ingest(self, req: dict) -> dict:
         """Publish a staged handoff once the map routes its range here.
         Idempotent: a commit for an unknown migration id is a duplicate
@@ -1230,6 +1251,7 @@ class Master:
         })
         return {"success": True, "count": result.get("count", 0)}
 
+    @admission_controlled
     async def rpc_drop_staged_ingest(self, req: dict) -> dict:
         """GC hook for a stage whose migration aborted before the map flip."""
         if not self.raft.is_leader:
@@ -1359,6 +1381,7 @@ class Master:
         raft + safe-mode; raft gauges are appended by OpsServer)."""
         st = self.state
         return {
+            **self.shedder.counters(),
             "safe_mode": 1 if st.safe_mode else 0,
             "files": len(st.files),
             "blocks": st.total_known_blocks(),

@@ -29,7 +29,7 @@ from xml.sax.saxutils import escape
 
 from tpudfs.auth.bucket_policy import BucketPolicy
 from tpudfs.auth.sse import SseEngine, SseError
-from tpudfs.client.client import Client, DfsError
+from tpudfs.client.client import Client, DfsError, OverloadedError
 from tpudfs.s3 import xml_types as xt
 
 logger = logging.getLogger(__name__)
@@ -172,6 +172,8 @@ class S3Handlers:
         for path in await self.client.list_files(f"/{bucket}/"):
             try:
                 await self.client.delete_file(path)
+            except OverloadedError:
+                raise  # a shed delete did NOT happen; don't report success
             except DfsError:
                 pass
         self._policy_cache.pop(bucket, None)
@@ -378,6 +380,8 @@ class S3Handlers:
     async def delete_object(self, bucket: str, key: str) -> S3Response:
         try:
             await self.client.delete_file(self.obj_path(bucket, key))
+        except OverloadedError:
+            raise  # shed, not deleted — 204 would be a lie
         except DfsError:
             pass  # S3 delete is idempotent: 204 either way
         return S3Response(status=204)
@@ -590,6 +594,8 @@ class S3Handlers:
             recorded_key = (await self.client.read_meta_range(
                 key_meta, 0, int(key_meta["size"])
             )).decode("utf-8")
+        except OverloadedError:
+            raise  # shed lookup proves nothing about the upload
         except DfsError:
             return _err("NoSuchUpload", "upload does not exist", 404)
         if recorded_key != key:
@@ -642,6 +648,8 @@ class S3Handlers:
         for path in entries:
             try:
                 await self.client.delete_file(path)
+            except OverloadedError:
+                raise
             except DfsError:
                 pass
 
@@ -655,6 +663,8 @@ class S3Handlers:
         try:
             raw = await self.client.get_file(f"/{bucket}/{POLICY_KEY}")
             policy = BucketPolicy.from_json(raw)
+        except OverloadedError:
+            raise  # never cache "no policy" off a shed — that fails auth open
         except (DfsError, ValueError):
             policy = None
         self._policy_cache[bucket] = policy
@@ -682,6 +692,8 @@ class S3Handlers:
     async def delete_bucket_policy(self, bucket: str) -> S3Response:
         try:
             await self.client.delete_file(f"/{bucket}/{POLICY_KEY}")
+        except OverloadedError:
+            raise
         except DfsError:
             pass
         self._policy_cache.pop(bucket, None)

@@ -37,7 +37,7 @@ from tpudfs.auth.oidc import JwksCache, OidcValidator
 from tpudfs.auth.policy import PolicyEngine
 from tpudfs.auth.sse import SseEngine
 from tpudfs.auth.sts import StsTokenService
-from tpudfs.client.client import Client, DfsError
+from tpudfs.client.client import Client, DfsError, OverloadedError
 from tpudfs.s3.audit import AuditLog
 from tpudfs.s3.handlers import S3Handlers, S3Response, _err, is_reserved_key
 from tpudfs.s3.metrics import S3Metrics
@@ -146,6 +146,13 @@ class Gateway:
             resp = S3Response(status=e.http_status,
                               body=e.to_xml(req.path, req.request_id).encode())
             outcome = "auth"
+        except OverloadedError as e:
+            # SlowDown is S3's shed signal: real clients back off and retry,
+            # while InternalError makes them give up or page an operator.
+            logger.warning("shed on %s %s: %s", req.method, req.path, e)
+            resp = _err("SlowDown", "Please reduce your request rate.",
+                        503, req.path)
+            outcome = "5xx"
         except DfsError as e:
             logger.warning("DFS error on %s %s: %s", req.method, req.path, e)
             resp = _err("InternalError", str(e), 500, req.path)

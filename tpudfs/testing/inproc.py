@@ -76,8 +76,8 @@ class InprocCluster:
             self.heartbeats.append(hb)
 
     async def leader(self, timeout: float = 15.0) -> Master:
-        deadline = asyncio.get_event_loop().time() + timeout
-        while asyncio.get_event_loop().time() < deadline:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
             for m in self.masters.values():
                 if m.raft.is_leader:
                     return m
@@ -87,8 +87,8 @@ class InprocCluster:
     async def ready(self, timeout: float = 15.0) -> Master:
         """Leader elected, safe mode exited, one heartbeat delivered."""
         leader = await self.leader(timeout)
-        deadline = asyncio.get_event_loop().time() + timeout
-        while asyncio.get_event_loop().time() < deadline:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
             if not leader.state.safe_mode:
                 break
             await asyncio.sleep(0.1)

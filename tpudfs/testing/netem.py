@@ -10,10 +10,18 @@ bytes to its upstream, with switchable toxics:
 - ``partition`` — refuse new connections AND sever established ones (the
   both-directions blackhole toxiproxy calls a timeout/reset pair);
 - ``latency`` — delay each forwarded chunk;
+- ``bandwidth`` — shape throughput to a byte rate (toxiproxy's bandwidth
+  toxic), the slow-link half of the overload fault;
 - ``reset_peer`` — kill current connections once (flaky-network blip).
 
 Services under test are simply configured with the proxy's address as their
 peer address; tests flip toxics at runtime.
+
+The overload fault also needs a slow *server*, not just a slow link — a
+proxy can't make the handler hold its admission slot longer. That is a
+failpoint on the service itself: ``slow_server(cs, delay)`` /
+``heal_server(cs)`` flip ``ChunkServerService.fault_delay``, an injected
+sleep inside the Python data-path handlers.
 """
 
 from __future__ import annotations
@@ -36,6 +44,7 @@ class FaultProxy:
         self.listen_port = listen_port
         self.partitioned = False
         self.latency = 0.0  # seconds added per forwarded chunk
+        self.bandwidth = 0.0  # bytes/sec cap; 0 = unshaped
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.Task] = set()
         self._writers: set[asyncio.StreamWriter] = set()
@@ -81,6 +90,12 @@ class FaultProxy:
     def set_latency(self, seconds: float) -> None:
         self.latency = seconds
 
+    def set_bandwidth(self, bytes_per_sec: float) -> None:
+        """Shape forwarded throughput (0 lifts the cap). Each 64 KiB chunk
+        sleeps chunk_len/rate before delivery — time-averaged rate limiting,
+        like toxiproxy's bandwidth toxic, not burst-precise policing."""
+        self.bandwidth = bytes_per_sec
+
     def sever(self) -> None:
         """Reset all established connections (one-shot blip)."""
         for w in list(self._writers):
@@ -116,6 +131,8 @@ class FaultProxy:
                         break
                     if self.latency:
                         await asyncio.sleep(self.latency)
+                    if self.bandwidth:
+                        await asyncio.sleep(len(chunk) / self.bandwidth)
                     dst.write(chunk)
                     await dst.drain()
             except (ConnectionError, asyncio.CancelledError, OSError):
@@ -130,6 +147,22 @@ class FaultProxy:
         self._conns.update({t1, t2})
         t1.add_done_callback(self._conns.discard)
         t2.add_done_callback(self._conns.discard)
+
+
+# ------------------------------------------------------ server failpoints
+
+
+def slow_server(service, delay: float) -> None:
+    """Inject a per-request stall into a service's Python data-path handlers
+    (``ChunkServerService.fault_delay``). Unlike proxy toxics this holds the
+    handler's admission slot, so inflight builds up and the shedder engages —
+    the overload fault the chaos suite drives. Python data plane only: the
+    native C++ dataplane never enters these handlers."""
+    service.fault_delay = delay
+
+
+def heal_server(service) -> None:
+    service.fault_delay = 0.0
 
 
 class ProxyFleet:

@@ -26,7 +26,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
-from typing import Any, Sequence
+import time
+from typing import Any, Callable, Sequence
 
 import numpy as np
 
@@ -46,7 +47,97 @@ except Exception as e:  # pragma: no cover - grain is installed in this image
     grain = None
     _HAVE_GRAIN = False
 
-from tpudfs.client.client import Client
+from tpudfs.client.client import Client, OverloadedError
+
+
+class _AdaptiveGate:
+    """A semaphore whose limit can shrink/grow at runtime (threading
+    semaphores can't resize). Grain prefetch workers block here, so lowering
+    the limit IS lowering the effective prefetch depth."""
+
+    def __init__(self, limit: int):
+        self._cond = threading.Condition()
+        self._limit = limit
+        self._active = 0
+
+    def set_limit(self, n: int) -> None:
+        with self._cond:
+            self._limit = max(1, n)
+            self._cond.notify_all()
+
+    def __enter__(self):
+        with self._cond:
+            while self._active >= self._limit:
+                self._cond.wait()
+            self._active += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self._cond:
+            self._active -= 1
+            self._cond.notify_all()
+
+
+class _OverloadGovernor:
+    """Degradation ladder for shed fetches (cluster said RESOURCE_EXHAUSTED
+    and the client's in-call retries ran out).
+
+    Training infeed is throughput-, not latency-critical, so when the
+    cluster sheds we cut *our own* pressure rather than hammering it:
+    level 1 drops read hedges (each hedge is a whole duplicate replica
+    read — the cheapest load to shed); each further level halves fetch
+    concurrency down to 1. ``RECOVERY_SUCCESSES`` consecutive clean
+    fetches climb one level back up, restoring hedges last-removed-first.
+    """
+
+    RECOVERY_SUCCESSES = 32
+    MAX_LEVEL = 5  # 1 hedge drop + concurrency 16 -> 8 -> 4 -> 2 -> 1
+
+    def __init__(self, max_concurrency: int = 16):
+        self._lock = threading.Lock()
+        self.max_concurrency = max_concurrency
+        self.gate = _AdaptiveGate(max_concurrency)
+        self.level = 0
+        self._streak = 0
+        self._saved_hedge: float | None = None
+
+    def _apply(self, client: Client) -> None:
+        # Called under _lock. hedge_delay is a plain attribute read once per
+        # client read call; cross-thread assignment is safe.
+        if self.level >= 1:
+            if client.hedge_delay is not None:
+                self._saved_hedge = client.hedge_delay
+                client.hedge_delay = None
+        elif self._saved_hedge is not None:
+            client.hedge_delay = self._saved_hedge
+            self._saved_hedge = None
+        self.gate.set_limit(self.max_concurrency >> max(0, self.level - 1))
+
+    def on_overload(self, client: Client) -> float:
+        """Step down one level; returns the backoff to sleep before retry."""
+        with self._lock:
+            self._streak = 0
+            if self.level < self.MAX_LEVEL:
+                self.level += 1
+                self._apply(client)
+                logger.warning(
+                    "DFS overloaded: infeed degraded to level %d "
+                    "(hedges %s, concurrency %d)", self.level,
+                    "off" if self.level >= 1 else "on",
+                    self.max_concurrency >> max(0, self.level - 1))
+            return min(2.0, 0.1 * (2 ** self.level))
+
+    def on_success(self, client: Client) -> None:
+        with self._lock:
+            if self.level == 0:
+                return
+            self._streak += 1
+            if self._streak >= self.RECOVERY_SUCCESSES:
+                self._streak = 0
+                self.level -= 1
+                self._apply(client)
+                logger.info("DFS recovered: infeed back to level %d",
+                            self.level)
 
 
 class _ClientLoop:
@@ -128,12 +219,33 @@ class DfsSourceBase:
         # Held only on sync grain-worker threads; see class docstring.
         self._lock = threading.Lock()
         self._cl: _ClientLoop | None = None
+        self._governor = _OverloadGovernor()
 
     def _client_loop(self) -> _ClientLoop:
         with self._lock:
             if self._cl is None:
                 self._cl = _ClientLoop(self.master_addrs, self.client_kwargs)
             return self._cl
+
+    _OVERLOAD_RETRIES = 8
+
+    def _governed_run(self, cl: _ClientLoop,
+                      coro_factory: Callable[[], Any]) -> Any:
+        """Run a fetch under the overload governor: gate concurrency, and on
+        a shed fetch degrade (hedges off, then narrower gate), back off and
+        retry — a training job should ride out overload, not crash on it."""
+        with self._governor.gate:
+            for _ in range(self._OVERLOAD_RETRIES):
+                try:
+                    result = cl.run(coro_factory())
+                except OverloadedError as e:
+                    backoff = self._governor.on_overload(cl.client)
+                    last = e
+                    time.sleep(backoff)
+                else:
+                    self._governor.on_success(cl.client)
+                    return result
+            raise last
 
     def _fetch_metas(self, paths: Sequence[str]) -> list[dict]:
         """File metadata for every path, failing on missing files."""
@@ -148,7 +260,7 @@ class DfsSourceBase:
                     raise FileNotFoundError(f"DFS file not found: {p}")
             return out
 
-        return cl.run(metas(cl.client))
+        return self._governed_run(cl, lambda: metas(cl.client))
 
     def close(self) -> None:
         with self._lock:
@@ -160,6 +272,7 @@ class DfsSourceBase:
         state = self.__dict__.copy()
         state["_cl"] = None
         state["_lock"] = None
+        state["_governor"] = None  # holds a Condition; rebuilt per process
         return state
 
     def __setstate__(self, state):
@@ -167,6 +280,7 @@ class DfsSourceBase:
         # Fresh lock per unpickled worker process — same sync-only
         # discipline as the one dropped in __getstate__.
         self._lock = threading.Lock()
+        self._governor = _OverloadGovernor()
 
 
 class DfsRecordSource(DfsSourceBase):
@@ -226,10 +340,11 @@ class DfsRecordSource(DfsSourceBase):
     def __getitem__(self, record_key: int) -> np.ndarray:
         path, off = self._index[record_key]
         cl = self._client_loop()
-        data = cl.run(
-            cl.client.read_meta_range(
+        data = self._governed_run(
+            cl,
+            lambda: cl.client.read_meta_range(
                 self._metas[path], off, self.record_bytes
-            )
+            ),
         )
         return np.frombuffer(data, dtype=self.dtype)
 
