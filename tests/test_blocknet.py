@@ -216,7 +216,7 @@ async def test_read_blocks_caps_budget(cluster, tmp_path):
     resp = await cs.rpc_read_blocks(
         {"block_ids": ["cap0", "cap1", "cap2"]})
     assert resp["sizes"] == [len(data), len(data), -1]
-    assert resp["data"] == data + data
+    assert b"".join(resp["data_parts"]) == data + data
     # Byte cap: second slot would cross the budget.
     cs.READ_BATCH_MAX_SLOTS = 256
     cs.READ_BATCH_MAX_BYTES = len(data) + 10

@@ -390,7 +390,8 @@ def test_every_rule_is_registered():
     ids = set(all_rules())
     assert {"TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
             "TPL007", "TPL010", "TPL011", "TPL012", "TPL013", "TPL014",
-            "TPL020", "TPL021", "TPL022", "TPL023", "TPL024"} <= ids
+            "TPL020", "TPL021", "TPL022", "TPL023", "TPL024",
+            "TPL030", "TPL031", "TPL032", "TPL033", "TPL034"} <= ids
 
 
 def test_every_rule_carries_explain_metadata():
@@ -840,6 +841,17 @@ def test_suppression_inventory_and_baseline_have_not_grown():
             f"new suppression {s['path']}:{s['line']} {s['rules']} — fix the "
             "finding instead, or make the case per docs/static-analysis.md"
         )
+    # The performance rules (TPL030-TPL034) launched with their tree at
+    # zero via real fixes; they start life unsuppressable. The overall
+    # ceiling also stays at its burned-down floor of 2.
+    assert len(ceiling) <= 2
+    perf_rules = {f"TPL03{i}" for i in range(5)}
+    for s in current:
+        assert not perf_rules & set(s["rules"]), (
+            f"suppression of a TPL03x performance rule at "
+            f"{s['path']}:{s['line']} — these findings are fixed, never "
+            "suppressed (see docs/static-analysis.md)"
+        )
     baseline = load_baseline(BASELINE)
     assert len(baseline) <= committed["baseline_size"]
 
@@ -909,6 +921,78 @@ def test_changed_falls_back_to_full_lint_without_merge_base(
     captured = capsys.readouterr()
     assert rc == 0
     assert "falling back to a full-tree lint" in captured.err
+
+
+def test_hot_caller_files_widens_subset_to_hot_callers_only(tmp_path):
+    """--changed widening: an unchanged file whose *hot-path* function
+    calls into the changed file must be pulled in; an unchanged file
+    whose only caller is cold must not (widening to cold callers would
+    turn every edit into a full-tree lint)."""
+    files = {
+        # Hot root (_ROOT_PATTERNS matches BlockPortServer._handle)
+        # calling into the changed module.
+        "tpudfs/common/blocknet.py": """
+            from tpudfs.chunkserver.service import read_block
+
+            class BlockPortServer:
+                async def _handle(self, r, w):
+                    while True:
+                        data = read_block()
+        """,
+        # The "changed" file.
+        "tpudfs/chunkserver/service.py": """
+            def read_block():
+                return b"x"
+        """,
+        # Cold caller of the same changed function: must stay out.
+        "tpudfs/tools_offline.py": """
+            from tpudfs.chunkserver.service import read_block
+
+            def report():
+                return len(read_block())
+        """,
+    }
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+    from tpudfs.analysis.cli import hot_caller_files
+
+    extra = hot_caller_files(
+        tmp_path, [tmp_path / "tpudfs/chunkserver/service.py"])
+    rels = [p.relative_to(tmp_path).as_posix() for p in extra]
+    assert rels == ["tpudfs/common/blocknet.py"]
+
+
+def test_profile_prints_per_function_timing_for_hot_rules(tmp_path, capsys):
+    """--profile TPL03x bills each hot function's analysis time to its
+    qualname, and the instrumentation flag is restored afterwards so
+    plain runs pay nothing for it."""
+    target = tmp_path / "tpudfs" / "common"
+    target.mkdir(parents=True)
+    (target / "blocknet.py").write_text(textwrap.dedent("""
+        class BlockPortServer:
+            async def _handle(self, r, w):
+                while True:
+                    data = await r.readexactly(4)
+    """))
+    rc = lint_main(["--profile", "TPL032", "--root", str(tmp_path),
+                    "--baseline", str(tmp_path / "nonexistent.json"), "-q"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "tpulint --profile TPL032" in captured.err
+    assert "BlockPortServer._handle" in captured.err
+
+    from tpudfs.analysis import linter as linter_mod
+    assert linter_mod.PROFILE_UNITS is False
+
+
+def test_profile_rejects_combination_with_rule_selection(capsys):
+    rc = lint_main(["--profile", "TPL030", "--rule", "TPL001"])
+    captured = capsys.readouterr()
+    assert rc == 2
+    assert "mutually exclusive" in captured.err
 
 
 # ===================================================== CFG + dataflow (v3)
@@ -1488,3 +1572,243 @@ def test_cli_stats_reports_per_rule_timing(tmp_path, capsys):
     assert "tpulint --stats:" in captured.err
     assert "TPL001" in captured.err  # per-rule line for the executed rule
     assert "tpulint --stats:" not in captured.out  # stdout stays clean
+
+
+# ===================================================== tpuperf (v4)
+#
+# TPL030-TPL034 key off hot-path reachability (hotpath.py) and buffer
+# provenance (bufferflow.py), so every fixture routes through a
+# data-plane root qualname (BlockPortServer._handle, ChunkServer.rpc_*,
+# BlockConnPool.call) — the same code outside those roots must stay
+# silent, which the cold-caller negatives in each pair pin down.
+
+from tpudfs.analysis.hotpath import loop_depth_at  # noqa: E402
+
+
+def test_loop_depth_nested_loops_with_try_finally_and_continue():
+    """CFG loop-nesting depth drives the TPL03x effective-depth math:
+    statements inside for-in-while are depth 2 even under try/finally
+    and behind a continue; comprehensions count as one loop level."""
+    import ast as _ast
+
+    mod = _module("""
+        async def f(items, q, n):
+            total = 0
+            while n > 0:
+                for it in items:
+                    try:
+                        if it is None:
+                            continue
+                        total += 1
+                    finally:
+                        q.note(it)
+            sizes = [len(x) for x in items]
+            return total
+    """)
+    fn = mod.tree.body[0]
+
+    def depth_of(node_type, predicate=lambda n: True):
+        for node in _ast.walk(fn):
+            if isinstance(node, node_type) and predicate(node):
+                return loop_depth_at(mod, fn, node)
+        raise AssertionError(f"no {node_type} in fixture")
+
+    assert depth_of(_ast.AugAssign) == 2            # total += 1
+    assert depth_of(_ast.Continue) == 2             # behind the if
+    # the finally body runs per inner iteration too
+    assert depth_of(
+        _ast.Call, lambda n: getattr(n.func, "attr", "") == "note") == 2
+    assert depth_of(                                 # pre-loop statement
+        _ast.Assign, lambda n: n.targets[0].id == "total") == 0
+    # comprehension = one implicit loop level
+    assert depth_of(
+        _ast.Call, lambda n: getattr(n.func, "id", "") == "len") == 1
+    assert depth_of(_ast.Return) == 0
+
+
+# ------------------------------------------------------------------ TPL032
+
+
+def test_tpl032_flags_sequential_await_chain_in_hot_loop(tmp_path):
+    """One awaited round-trip per iteration, nothing in flight between
+    them: the latency is N * RTT when it could be ~1 * RTT."""
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            import asyncio
+
+            class BlockConnPool:
+                async def call(self, reqs, pool):
+                    out = []
+                    for req in reqs:
+                        resp = await pool.request(req)
+                        out.append(resp)
+                    return out
+        """,
+    }, rules=["TPL032"])
+    assert [f.rule for f in findings] == ["TPL032"]
+    assert "every iteration" in findings[0].message
+
+
+def test_tpl032_silent_for_gathered_requests(tmp_path):
+    """The fixed shape: create tasks, await one gather — no per-frame
+    serialization left to flag."""
+    assert lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            import asyncio
+
+            class BlockConnPool:
+                async def call(self, reqs, pool):
+                    tasks = [asyncio.create_task(pool.request(r))
+                             for r in reqs]
+                    return await asyncio.gather(*tasks)
+        """,
+    }, rules=["TPL032"]) == []
+
+
+# ------------------------------------------------------------------ TPL030
+
+
+def test_tpl030_flags_slice_copy_reached_from_hot_loop(tmp_path):
+    """Cross-file entry-depth propagation: the helper has no loop of its
+    own, but its only caller invokes it per frame, so the O(n) slice is
+    per-frame work — and every consumer accepts a memoryview."""
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            from tpudfs.common.framing import send_piece
+
+            class BlockPortServer:
+                async def _handle(self, r, w):
+                    while True:
+                        data = await r.readexactly(65536)
+                        await send_piece(w, data)
+        """,
+        "tpudfs/common/framing.py": """
+            async def send_piece(w, data):
+                piece = data[4:]
+                w.write(piece)
+                await w.drain()
+        """,
+    }, rules=["TPL030"])
+    assert [(f.rule, f.path) for f in findings] == \
+        [("TPL030", "tpudfs/common/framing.py")]
+
+
+def test_tpl030_silent_for_constant_header_peek(tmp_path):
+    """data[:4] is a fixed-size header peek, not a per-frame memcpy."""
+    assert lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            class BlockPortServer:
+                async def _handle(self, r, w):
+                    while True:
+                        data = await r.readexactly(65536)
+                        header = data[:4]
+                        w.write(header)
+        """,
+    }, rules=["TPL030"]) == []
+
+
+# ------------------------------------------------------------------ TPL031
+
+
+def test_tpl031_flags_quadratic_bytes_accumulation(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            class BlockPortServer:
+                async def _handle(self, r, w):
+                    buf = b""
+                    while True:
+                        chunk = await r.read(4096)
+                        if not chunk:
+                            break
+                        buf += chunk
+                    return buf
+        """,
+    }, rules=["TPL031"])
+    assert [f.rule for f in findings] == ["TPL031"]
+
+
+def test_tpl031_silent_for_bytearray_accumulator(tmp_path):
+    assert lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            class BlockPortServer:
+                async def _handle(self, r, w):
+                    buf = bytearray()
+                    while True:
+                        chunk = await r.read(4096)
+                        if not chunk:
+                            break
+                        buf += chunk
+                    return bytes(buf)
+        """,
+    }, rules=["TPL031"]) == []
+
+
+# ------------------------------------------------------------------ TPL033
+
+
+def test_tpl033_flags_callee_recrc_of_same_buffer(tmp_path):
+    """Cross-file redundancy: the handler CRCs `data`, then passes it to
+    a helper that CRCs it again — two O(n) passes over the same bytes,
+    visible only through the resolved call edge."""
+    findings = lint_tree(tmp_path, {
+        "tpudfs/chunkserver/service.py": """
+            from tpudfs.common.checks import stamp
+
+            class ChunkServer:
+                async def rpc_write(self, req):
+                    data = req["data"]
+                    crc = crc32c(data)
+                    tag = stamp(data)
+                    return {"crc": crc, "tag": tag}
+        """,
+        "tpudfs/common/checks.py": """
+            def stamp(data):
+                return crc32c(data)
+        """,
+    }, rules=["TPL033"])
+    assert [(f.rule, f.path) for f in findings] == \
+        [("TPL033", "tpudfs/chunkserver/service.py")]
+
+
+def test_tpl033_silent_for_crcs_over_different_buffers(tmp_path):
+    assert lint_tree(tmp_path, {
+        "tpudfs/chunkserver/service.py": """
+            class ChunkServer:
+                async def rpc_write(self, req):
+                    data = req["data"]
+                    head = req["head"]
+                    return {"c1": crc32c(data), "c2": crc32c(head)}
+        """,
+    }, rules=["TPL033"]) == []
+
+
+# ------------------------------------------------------------------ TPL034
+
+
+def test_tpl034_flags_sync_packb_of_payload_on_event_loop(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            class BlockPortServer:
+                async def _handle(self, r, w):
+                    while True:
+                        payload = await r.readexactly(1 << 20)
+                        body = msgpack.packb({"data": payload})
+                        w.write(body)
+        """,
+    }, rules=["TPL034"])
+    assert [f.rule for f in findings] == ["TPL034"]
+
+
+def test_tpl034_silent_for_small_control_dict(tmp_path):
+    """Size-awareness: packing a control dict with no byte-buffer
+    provenance is microseconds, not an event-loop stall."""
+    assert lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            class BlockPortServer:
+                async def _handle(self, r, w):
+                    while True:
+                        size = await r.readexactly(4)
+                        body = msgpack.packb({"ok": True, "n": len(size)})
+                        w.write(body)
+        """,
+    }, rules=["TPL034"]) == []

@@ -1,0 +1,282 @@
+"""Byte-buffer provenance dataflow for tpulint's performance rules.
+
+The write-pipeline and cache gaps in BENCH r01-r05 are not algorithmic —
+they are Python buffer handling: a ``data[off:off+n]`` that memcpys a
+megabyte per block, a ``b"".join`` that re-copies a batch the socket
+could have scattered, a CRC pass over bytes another layer already
+checksummed. Spotting those requires knowing, per CFG node, *which local
+names hold byte buffers, of what flavor, and whether a checksum has
+already been taken over them on this path* — a forward may-analysis on
+the existing fixed-point solver.
+
+Facts are tuples in a frozenset (the solver's value domain):
+
+- ``("buf", name, kind)`` — ``name`` may hold a buffer of ``kind`` at
+  this point; ``kind`` is ``"bytes"`` | ``"bytearray"`` |
+  ``"memoryview"``. Buffers enter via literals, constructor calls,
+  slices, concatenation, ``join``/``pack``/``read``-shaped producers,
+  and parameters whose annotation or name marks them as payloads.
+- ``("crc", name)`` — a CRC (crc32c / crc32c_chunks / crc64nvme) has
+  been computed over ``name``'s current value on some path into this
+  node. Reassigning or mutating ``name`` kills the fact; that is what
+  makes "CRC computed twice over the same provenance" a path property
+  instead of a grep.
+
+:func:`kind_of` is the shared expression classifier; :func:`is_copy_expr`
+labels an expression O(n)-copy vs zero-copy given the environment
+(slicing a ``memoryview`` is free; slicing ``bytes`` is a memcpy).
+Everything over-approximates in the *fewer-findings* direction: an
+expression of unknown provenance is not a buffer, and produces nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpudfs.analysis.cfg import Node, cfg_for
+from tpudfs.analysis.dataflow import MayAnalysis, solve
+
+__all__ = [
+    "BUFFER_KINDS",
+    "buffer_flow",
+    "env_at",
+    "crc_names",
+    "kind_of",
+    "is_copy_expr",
+    "CRC_CALLS",
+    "PAYLOAD_NAME_RE",
+]
+
+BUFFER_KINDS = ("bytes", "bytearray", "memoryview")
+
+#: Callables that compute a checksum over their first argument.
+CRC_CALLS = {"crc32c", "crc32c_chunks", "crc64nvme"}
+
+#: Producer call names whose result is a fresh ``bytes``.
+_BYTES_PRODUCERS = {
+    "bytes", "pack", "packb", "dumps", "tobytes", "read", "recv",
+    "read_exactly", "readexactly", "getvalue", "digest", "encode",
+    "compress", "decompress", "serialize",
+}
+
+#: Parameter names that, absent an annotation, we take to be payload
+#: buffers on the data plane. Deliberately narrow: a wrong guess here
+#: manufactures findings.
+_BUF_PARAM_RE = re.compile(
+    r"^(data|payload|buf|buffer|chunk|piece|frame|blob|body)s?$")
+
+#: Public alias: names that read as data-plane payloads. TPL034 uses it
+#: to separate "packing the payload" from "packing a header variable
+#: that happens to be bytes".
+PAYLOAD_NAME_RE = _BUF_PARAM_RE
+
+_ANNOT_KINDS = {"bytes": "bytes", "bytearray": "bytearray",
+                "memoryview": "memoryview"}
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _annotation_kind(annotation: ast.AST | None) -> str | None:
+    if annotation is None:
+        return None
+    for n in ast.walk(annotation):
+        if isinstance(n, ast.Name) and n.id in _ANNOT_KINDS:
+            return _ANNOT_KINDS[n.id]
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            for key, kind in _ANNOT_KINDS.items():
+                if key in n.value:
+                    return kind
+    return None
+
+
+def kind_of(expr: ast.AST, env: dict[str, set[str]]) -> str | None:
+    """Buffer kind an expression evaluates to, or None if unknown /
+    not a buffer. ``env`` maps name -> possible kinds at this point."""
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, bytes):
+            return "bytes"
+        return None
+    if isinstance(expr, ast.Name):
+        kinds = env.get(expr.id)
+        if not kinds:
+            return None
+        # May-analysis can report several kinds; prefer the copying one
+        # so rules stay conservative about "this slice was free".
+        for kind in BUFFER_KINDS:
+            if kind in kinds:
+                return kind
+        return None
+    if isinstance(expr, ast.Await):
+        return kind_of(expr.value, env)
+    if isinstance(expr, ast.Subscript):
+        if not isinstance(expr.slice, ast.Slice):
+            return None  # single-index subscript yields an int, not bytes
+        return kind_of(expr.value, env)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = kind_of(expr.left, env)
+        right = kind_of(expr.right, env)
+        if left and right:
+            return "bytes"  # buffer + buffer concatenates into fresh bytes
+        return None
+    if isinstance(expr, ast.Call):
+        name = _call_name(expr)
+        if name == "memoryview":
+            return "memoryview"
+        if name == "bytearray":
+            return "bytearray"
+        if name == "join":
+            f = expr.func
+            if isinstance(f, ast.Attribute) \
+                    and kind_of(f.value, env) == "bytes":
+                return "bytes"
+            return None
+        if name in _BYTES_PRODUCERS:
+            return "bytes"
+        return None
+    return None
+
+
+def is_copy_expr(expr: ast.AST, env: dict[str, set[str]]) -> str | None:
+    """Classify ``expr`` as an O(n) buffer copy: returns a short label
+    ("slice", "concat", "bytes()", "join") or None when the expression
+    is zero-copy or not a buffer operation at all."""
+    if isinstance(expr, ast.Subscript) and isinstance(expr.slice, ast.Slice):
+        base = kind_of(expr.value, env)
+        if base in ("bytes", "bytearray"):
+            return "slice"
+        return None  # memoryview slice: zero-copy
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        if kind_of(expr.left, env) and kind_of(expr.right, env):
+            return "concat"
+        return None
+    if isinstance(expr, ast.Call):
+        name = _call_name(expr)
+        if name == "bytes" and expr.args:
+            if kind_of(expr.args[0], env):
+                return "bytes()"
+            return None
+        if name == "join":
+            f = expr.func
+            if isinstance(f, ast.Attribute) \
+                    and kind_of(f.value, env) == "bytes":
+                return "join"
+    return None
+
+
+def _assigned_names(target: ast.AST) -> list[str]:
+    out = []
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+    return out
+
+
+class _BufferFacts(MayAnalysis):
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self.fn = fn
+
+    def initial(self):
+        facts = set()
+        args = self.fn.args
+        params = list(args.posonlyargs) + list(args.args) \
+            + list(args.kwonlyargs)
+        for a in params:
+            kind = _annotation_kind(a.annotation)
+            if kind is None and _BUF_PARAM_RE.match(a.arg):
+                kind = "bytes"
+            if kind is not None:
+                facts.add(("buf", a.arg, kind))
+        return frozenset(facts)
+
+    def transfer(self, node: Node, value):
+        facts = set(value)
+        env = env_from(value)
+        if node.kind == "for_iter":
+            # Loop target rebinds each iteration; iterating a buffer
+            # yields ints, iterating an unknown yields unknowns.
+            for name in _assigned_names(node.stmt.target):
+                self._kill(facts, name)
+        for stmt in node.exprs():
+            self._transfer_stmt(stmt, facts, env)
+        return frozenset(facts)
+
+    def _kill(self, facts: set, name: str) -> None:
+        facts.difference_update(
+            {f for f in facts if f[1] == name})
+
+    def _transfer_stmt(self, stmt: ast.AST, facts: set,
+                       env: dict[str, set[str]]) -> None:
+        # CRC facts: any checksum call over a plain name marks it, even
+        # mid-expression (`actual = crc32c(data)` or a call argument).
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call) and _call_name(n) in CRC_CALLS \
+                    and n.args and isinstance(n.args[0], ast.Name):
+                facts.add(("crc", n.args[0].id))
+
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            if isinstance(stmt, ast.AugAssign):
+                # `buf += chunk` mutates/rebinds: kind survives for
+                # buffers, but any CRC over the old value is stale.
+                for name in _assigned_names(stmt.target):
+                    facts.discard(("crc", name))
+                return
+            if value is None:
+                return
+            kind = kind_of(value, env)
+            simple = [t.id for t in targets if isinstance(t, ast.Name)]
+            for name in simple:
+                self._kill(facts, name)
+                if kind is not None:
+                    facts.add(("buf", name, kind))
+            # Tuple targets and attribute stores: kill what we track,
+            # claim nothing.
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    for name in _assigned_names(t):
+                        self._kill(facts, name)
+
+
+def env_from(facts) -> dict[str, set[str]]:
+    """name -> possible buffer kinds, from a solver value."""
+    env: dict[str, set[str]] = {}
+    if facts:
+        for f in facts:
+            if f[0] == "buf":
+                env.setdefault(f[1], set()).add(f[2])
+    return env
+
+
+def crc_names(facts) -> set[str]:
+    """Names whose current value has a CRC computed on some path in."""
+    if not facts:
+        return set()
+    return {f[1] for f in facts if f[0] == "crc"}
+
+
+def buffer_flow(module, fn: ast.FunctionDef | ast.AsyncFunctionDef):
+    """Memoized solve of the buffer-provenance analysis over ``fn``'s
+    CFG; returns ``{node.index: (in_facts, out_facts)}``."""
+    cfg = cfg_for(module, fn)
+    result = getattr(cfg, "_bufferflow", None)
+    if result is None:
+        result = solve(cfg, _BufferFacts(fn))
+        cfg._bufferflow = result
+    return result
+
+
+def env_at(module, fn, node: Node) -> dict[str, set[str]]:
+    """Buffer environment on entry to one CFG node."""
+    result = buffer_flow(module, fn)
+    in_facts, _out = result.get(node.index, (None, None))
+    return env_from(in_facts)

@@ -61,7 +61,7 @@ class Node:
     """One CFG node: a simple statement or a compound-statement header."""
 
     __slots__ = ("index", "kind", "stmt", "succs", "preds", "has_await",
-                 "lineno")
+                 "lineno", "loop_depth")
 
     def __init__(self, index: int, kind: str, stmt: ast.AST | None) -> None:
         self.index = index
@@ -74,6 +74,10 @@ class Node:
         self.preds: list[tuple["Node", str]] = []
         self.has_await = False
         self.lineno = getattr(stmt, "lineno", 0)
+        #: Number of enclosing loops whose body re-executes this node —
+        #: loop headers count their own loop (the test/iter runs once per
+        #: iteration). Stamped by _Builder; 0 on entry/exit sentinels.
+        self.loop_depth = 0
 
     def exprs(self) -> list[ast.AST]:
         """The ASTs evaluated at this node (never a compound body)."""
@@ -224,6 +228,7 @@ class _Builder:
     def _node(self, kind: str, stmt: ast.AST | None,
               frontier: list[Node]) -> Node:
         node = self.cfg._new(kind, stmt)
+        node.loop_depth = len(self._loops)
         for src in frontier:
             CFG._edge(src, node, "flow")
         return node
@@ -339,6 +344,9 @@ class _Builder:
 
     def _while(self, stmt: ast.While, frontier: list[Node]) -> list[Node]:
         test = self._node("while_test", stmt, frontier)
+        # The test re-runs every iteration: it belongs to its own loop,
+        # which is pushed only after the header node is created.
+        test.loop_depth += 1
         self._mark(test)
         loop = _Loop(test, len(self._finals))
         self._loops.append(loop)
@@ -354,6 +362,7 @@ class _Builder:
     def _for(self, stmt: ast.For | ast.AsyncFor,
              frontier: list[Node]) -> list[Node]:
         it = self._node("for_iter", stmt, frontier)
+        it.loop_depth += 1  # the iter-next runs once per iteration
         self._mark(it)
         if isinstance(stmt, ast.AsyncFor):
             it.has_await = True
@@ -394,10 +403,13 @@ class _Builder:
         fin_frame: _FinallyFrame | None = None
         if stmt.finalbody:
             fin_entry = self.cfg._new("finally_enter", stmt)
+            fin_entry.loop_depth = len(self._loops)
             fin_frame = _FinallyFrame(fin_entry)
         uncaught = [fin_frame.entry] if fin_frame else list(outer)
 
         handler_nodes = [self.cfg._new("except", h) for h in stmt.handlers]
+        for hnode in handler_nodes:
+            hnode.loop_depth = len(self._loops)
 
         if fin_frame:
             self._finals.append(fin_frame)

@@ -9,15 +9,20 @@
     python -m tpudfs.analysis --list-rules
     python -m tpudfs.analysis --explain TPL020  # why + example + fix
     python -m tpudfs.analysis --stats         # per-rule wall-time report
+    python -m tpudfs.analysis --profile TPL030  # one rule, per-unit timing
     python -m tpudfs.analysis --no-baseline   # show grandfathered too
     python -m tpudfs.analysis --write-rule-table  # sync docs table
 
 Full-tree runs reuse a content-hash cache (``.tpulint_cache.json`` at the
 repo root, git-ignored) so the common nothing-changed case costs file
 hashing only; ``--no-cache`` forces a cold analysis. ``--changed`` is the
-fast pre-commit mode — note the interprocedural rules (TPL010-TPL014) then
-see only the changed files' call graph, so cross-file findings involving
-unchanged files surface in the next full run, not here.
+fast pre-commit mode — the interprocedural rules (TPL010-TPL014) then see
+only the changed files' call graph, so most cross-file findings involving
+unchanged files surface in the next full run, not here. The exception is
+the hot data plane: ``--changed`` also pulls in unchanged files whose
+*hot-path* functions call into the changed files, so the performance
+rules (TPL030-TPL034) re-judge callers whose effective loop depth or
+buffer provenance a changed callee may have shifted.
 
 Exit codes: 0 clean (or fully baselined), 1 non-baselined findings,
 2 bad invocation.
@@ -60,6 +65,11 @@ def _parser() -> argparse.ArgumentParser:
                         "catches, a flagged example, how to fix) and exit")
     p.add_argument("--stats", action="store_true",
                    help="after linting, print wall time spent per rule")
+    p.add_argument("--profile", metavar="TPLxxx",
+                   help="run only this rule with per-unit timing and "
+                        "print its top-10 most expensive analysis units "
+                        "(functions for the hot-path rules, files for "
+                        "per-module rules)")
     p.add_argument("--write-rule-table", action="store_true",
                    help="regenerate the rule table in "
                         "docs/static-analysis.md from rule metadata")
@@ -71,8 +81,9 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--output", type=pathlib.Path, metavar="FILE",
                    help="write the report to FILE instead of stdout")
     p.add_argument("--changed", action="store_true",
-                   help="lint only files differing from "
-                        "`git merge-base HEAD main` (fast pre-commit mode)")
+                   help="lint files differing from `git merge-base HEAD "
+                        "main`, widened with unchanged hot-path callers "
+                        "of the changed functions (fast pre-commit mode)")
     p.add_argument("--no-cache", action="store_true",
                    help="bypass the content-hash analysis cache")
     p.add_argument("-q", "--quiet", action="store_true",
@@ -104,6 +115,48 @@ def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
         if name.endswith(".py") and p.exists():
             out.append(p)
     return out
+
+
+def hot_caller_files(
+    root: pathlib.Path, changed: list[pathlib.Path]
+) -> list[pathlib.Path]:
+    """Unchanged files that contain *hot-path* callers of functions
+    defined in ``changed``.
+
+    The TPL03x performance rules judge a statement by its effective loop
+    depth and buffer provenance, both of which flow through call edges: a
+    changed callee can move an unchanged caller's finding set without the
+    caller's text changing (e.g. a callee that starts returning a list of
+    buffers, or a root whose loop now encloses the call site). A plain
+    ``--changed`` subset would miss those, so the CLI widens the subset
+    with the files this returns. Cold callers are deliberately excluded —
+    off the data plane the TPL03x rules never fire, and widening to every
+    caller would turn most edits into full-tree lints.
+    """
+    from tpudfs.analysis.callgraph import Project
+    from tpudfs.analysis.hotpath import hot_paths
+
+    pkg = root / "tpudfs"
+    base = pkg if pkg.is_dir() else root
+    modules = {}
+    for path in linter.iter_python_files(base):
+        module, _errors = linter._load_module(path, root)
+        if module is not None:
+            modules[module.rel_path] = module
+    if not modules:
+        return []
+    project = Project(modules)
+    hp = hot_paths(project)
+    changed_set = {p.resolve() for p in changed}
+    extra: set[pathlib.Path] = set()
+    for caller in project.functions.values():
+        cpath = caller.module.path.resolve()
+        if cpath in changed_set or not hp.is_hot(caller):
+            continue
+        if any(edge.callee.module.path.resolve() in changed_set
+               for edge in caller.calls):
+            extra.add(cpath)
+    return sorted(extra)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -144,6 +197,20 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         selected = [rules[r] for r in sorted(wanted)]
 
+    profile_rule = None
+    if args.profile:
+        if args.rules:
+            print("--profile and --rule are mutually exclusive "
+                  "(--profile already restricts the run to one rule)",
+                  file=sys.stderr)
+            return 2
+        profile_rule = rules.get(args.profile.upper())
+        if profile_rule is None:
+            print(f"unknown rule id: {args.profile} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+        selected = [profile_rule]
+
     if args.paths:
         paths = args.paths
     elif args.root.resolve() == REPO_ROOT:
@@ -175,7 +242,12 @@ def main(argv: list[str] | None = None) -> int:
                       "merge-base with main")
             return 0
         else:
-            paths = subset
+            extra = hot_caller_files(args.root, subset)
+            if extra and not args.quiet:
+                print(f"tpulint: --changed: widening to {len(extra)} "
+                      "unchanged file(s) whose hot-path functions call "
+                      "into the changed set", file=sys.stderr)
+            paths = sorted({*subset, *extra})
             changed_subset = True
     for p in paths:
         if not p.exists():
@@ -198,9 +270,24 @@ def main(argv: list[str] | None = None) -> int:
     linter.reset_rule_timings()
     import time as _time
     t0 = _time.perf_counter()
-    result = linter.run(paths, args.root, baseline, selected,
-                        cache_path=cache_path)
+    if profile_rule is not None:
+        linter.PROFILE_UNITS = True
+    try:
+        result = linter.run(paths, args.root, baseline, selected,
+                            cache_path=cache_path)
+    finally:
+        linter.PROFILE_UNITS = False
     wall = _time.perf_counter() - t0
+
+    if profile_rule is not None:
+        per = linter.UNIT_TIMINGS.get(profile_rule.id, {})
+        top = sorted(per.items(), key=lambda kv: kv[1], reverse=True)[:10]
+        total = sum(per.values())
+        print(f"tpulint --profile {profile_rule.id}: {total * 1000:.1f} ms "
+              f"attributed across {len(per)} unit(s); top {len(top)}:",
+              file=sys.stderr)
+        for unit, secs in top:
+            print(f"  {secs * 1000:8.2f} ms  {unit}", file=sys.stderr)
 
     if args.stats:
         # Stderr: --format sarif/json write a document to stdout.

@@ -53,7 +53,9 @@ __all__ = [
     "run",
     "scan_suppressions",
     "RULE_TIMINGS",
+    "UNIT_TIMINGS",
     "reset_rule_timings",
+    "profile_units",
 ]
 
 #: Cumulative wall-clock seconds per rule id, accumulated across every
@@ -62,9 +64,39 @@ __all__ = [
 #: ``tpulint --stats`` should show). Reset with :func:`reset_rule_timings`.
 RULE_TIMINGS: dict[str, float] = {}
 
+#: ``rule id -> {unit label -> seconds}`` — the fine-grained layer under
+#: :data:`RULE_TIMINGS`, populated only while :data:`PROFILE_UNITS` is
+#: true (``tpulint --profile``). Module rules attribute whole-file check
+#: time to the file; the hot-path project rules (TPL030-TPL034) attribute
+#: per analyzed function via :func:`profile_units`; project rules without
+#: per-unit hooks fall back to a single ``<whole tree>`` entry.
+UNIT_TIMINGS: dict[str, dict[str, float]] = {}
+
+#: Toggled by ``tpulint --profile``. Off by default so the warm-cache
+#: full-tree lint pays nothing for the instrumentation.
+PROFILE_UNITS = False
+
 
 def reset_rule_timings() -> None:
     RULE_TIMINGS.clear()
+    UNIT_TIMINGS.clear()
+
+
+def profile_units(rule_id, units, label):
+    """Pass-through generator attributing inter-``next`` wall time — i.e.
+    the consumer's per-item processing — to each yielded unit. A rule
+    writes ``for fn in profile_units(self.id, fns, key):`` and its loop
+    body is billed to ``key(fn)``; with profiling off (or no rule id)
+    this degrades to a plain ``yield from``."""
+    if not PROFILE_UNITS or rule_id is None:
+        yield from units
+        return
+    per = UNIT_TIMINGS.setdefault(rule_id, {})
+    for unit in units:
+        t0 = time.perf_counter()
+        yield unit
+        key = label(unit)
+        per[key] = per.get(key, 0.0) + time.perf_counter() - t0
 
 
 # ---------------------------------------------------------------------------
@@ -359,8 +391,11 @@ def _module_findings(module: ModuleInfo,
         for f in rule.check(module):
             if not module.suppressed(f.rule, f.line):
                 findings.append(f)
-        RULE_TIMINGS[rule.id] = RULE_TIMINGS.get(rule.id, 0.0) \
-            + time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        RULE_TIMINGS[rule.id] = RULE_TIMINGS.get(rule.id, 0.0) + elapsed
+        if PROFILE_UNITS:
+            per = UNIT_TIMINGS.setdefault(rule.id, {})
+            per[module.rel_path] = per.get(module.rel_path, 0.0) + elapsed
     return findings
 
 
@@ -377,8 +412,11 @@ def _project_findings(modules: dict[str, ModuleInfo],
             if mod is not None and mod.suppressed(f.rule, f.line):
                 continue
             findings.append(f)
-        RULE_TIMINGS[rule.id] = RULE_TIMINGS.get(rule.id, 0.0) \
-            + time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        RULE_TIMINGS[rule.id] = RULE_TIMINGS.get(rule.id, 0.0) + elapsed
+        if PROFILE_UNITS and rule.id not in UNIT_TIMINGS:
+            # Rule without per-unit hooks: one coarse bucket beats none.
+            UNIT_TIMINGS[rule.id] = {"<whole tree>": elapsed}
     return findings
 
 

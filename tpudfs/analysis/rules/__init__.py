@@ -25,4 +25,6 @@ from tpudfs.analysis.rules import (  # noqa: F401
     lock_hygiene,
     resources,
     raft_durability,
+    # tpuperf performance rules (hotpath.py + bufferflow.py backed)
+    perf,
 )

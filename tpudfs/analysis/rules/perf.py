@@ -1,0 +1,842 @@
+"""TPL030-TPL034 — tpuperf: hot-path copy and chattiness rules.
+
+BENCH r01-r05 ended with the read path at ~1 GB/s and the write pipeline
+at 0.025 GB/s. The difference is not architecture — both paths move the
+same frames through the same transports — it is a layer of Python-level
+de-optimisations no correctness rule sees: a slice that memcpys every
+block, a ``b"".join`` over a batch the socket could scatter, one awaited
+round-trip per frame, the same buffer CRC'd twice by adjacent layers.
+These five rules put the analyzer on that money path:
+
+- **TPL030** — O(n) buffer copy (slice / concat / ``bytes()`` /
+  ``join``) inside a hot-path loop where a ``memoryview`` (or a scatter
+  list handed to ``writelines``) provably suffices for every consumer.
+- **TPL031** — quadratic ``buf += chunk`` accumulation of immutable
+  ``bytes`` in a loop (each += re-copies the prefix; ``bytearray`` or a
+  list + single ``join`` is linear).
+- **TPL032** — an awaited RPC/IO call per iteration of a hot loop with
+  no batching, gather, or pipelining between iterations — the
+  sequential-await chain that serializes N round-trips.
+- **TPL033** — redundant checksum: a CRC computed over a buffer whose
+  current value already has a CRC on some path in (directly, or because
+  a callee checksums the same argument). Reuses the TPL013 idea of
+  walking resolved call edges instead of trusting names.
+- **TPL034** — synchronous serialization / compression / slow digest on
+  the event loop in a hot path, size-aware: only flagged when an
+  argument has byte-buffer provenance (headers and tiny control dicts
+  pack in microseconds; payloads do not).
+
+All five key off :mod:`tpudfs.analysis.hotpath` (reachability from the
+bench/data-plane roots + effective loop depth) and
+:mod:`tpudfs.analysis.bufferflow` (per-node buffer kinds and CRC facts
+on the fixed-point solver), so a copy in a config loader stays silent
+while the same copy per frame of a chain write is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.bufferflow import (
+    CRC_CALLS,
+    PAYLOAD_NAME_RE,
+    buffer_flow,
+    env_from,
+    crc_names,
+    is_copy_expr,
+    kind_of,
+)
+from tpudfs.analysis.callgraph import FunctionInfo, Project
+from tpudfs.analysis.cfg import cfg_for
+from tpudfs.analysis.hotpath import hot_paths, loop_depth_at
+from tpudfs.analysis.linter import (Finding, ProjectRule, profile_units,
+                                    register)
+
+#: Callees for which passing a memoryview instead of a fresh bytes copy
+#: is known-safe: checksums, length, socket/file writes, struct/msgpack
+#: packing (msgpack bin-packs any buffer), list collection for
+#: writelines/join, numpy ingestion.
+_MV_SAFE_CALLEES = {
+    "crc32c", "crc32c_chunks", "crc64nvme", "len", "min", "max",
+    "write", "writelines", "sendall", "send", "update", "pack", "packb",
+    "memoryview", "bytearray", "bytes", "frombuffer", "append", "extend",
+    "isinstance", "enumerate", "range",
+}
+
+#: Slices with constant bounds at or under this are header peeks /
+#: fixed-size prefixes — O(1)-ish, not the per-frame memcpy this rule
+#: hunts.
+_SMALL_SLICE = 4096
+
+#: Await-call names that initiate a round-trip / offload per iteration.
+_RPC_IO_NAMES = {"call", "to_thread", "run_in_executor", "request",
+                 "fetch", "execute", "submit"}
+_RPC_IO_PREFIXES = ("rpc_", "read_", "write_", "_read_", "_write_",
+                    "send_", "recv_", "_execute", "replicate",
+                    "publish", "_call", "_data_call")
+
+#: Names whose presence in a loop body is batching/pipelining evidence.
+_BATCH_NAMES = {"gather", "wait", "as_completed", "create_task",
+                "ensure_future", "TaskGroup", "start_soon"}
+
+#: Receivers that are ordered streams: per-iteration awaits on them are
+#: sequential by nature (a TCP stream cannot be gathered).
+_STREAM_RECEIVERS = {"r", "w", "reader", "writer", "stream", "sock",
+                     "conn", "resp", "response"}
+
+#: Serialization / compression / slow-digest callees for TPL034. crc32c
+#: is deliberately absent (native-accelerated, sub-ms per MiB);
+#: crc64nvme's Python fallback is the documented slow path.
+_SERIALIZE_CALLEES = {"packb", "unpackb", "dumps", "loads", "compress",
+                      "decompress", "crc64nvme", "md5", "sha1", "sha256",
+                      "blake2b", "b64encode", "b64decode"}
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _hot_functions(
+    project: Project, rule_id: str | None = None
+) -> Iterator[tuple[FunctionInfo, int]]:
+    """Hot functions with their entry loop depth. With ``rule_id`` set
+    and ``tpulint --profile`` active, each function's analysis time (the
+    caller's loop body) is billed to it in ``linter.UNIT_TIMINGS``."""
+    hp = hot_paths(project)
+    fns = ((fn, hp.entry_depth(fn))
+           for fn in project.functions.values() if hp.is_hot(fn))
+    yield from profile_units(rule_id, fns, lambda pair: pair[0].qualname)
+
+
+def _own_nodes(fn: FunctionInfo):
+    """CFG nodes of ``fn`` (its own statements; nested defs are their
+    own functions and analyze separately)."""
+    return cfg_for(fn.module, fn.node).nodes
+
+
+def _in_env(fn: FunctionInfo, node):
+    flow = buffer_flow(fn.module, fn.node)
+    in_facts, _ = flow.get(node.index, (None, None))
+    return env_from(in_facts), in_facts
+
+
+def _const_small_slice(sl: ast.Slice) -> bool:
+    lower = 0
+    if sl.lower is not None:
+        if not (isinstance(sl.lower, ast.Constant)
+                and isinstance(sl.lower.value, int)):
+            return False
+        lower = sl.lower.value
+    if sl.upper is None:
+        return False
+    if not (isinstance(sl.upper, ast.Constant)
+            and isinstance(sl.upper.value, int)):
+        return False
+    return 0 <= sl.upper.value - lower <= _SMALL_SLICE
+
+
+class _MvSafety:
+    """Answers "would a memoryview work everywhere this value flows?" —
+    AST-level consumer check, with one-hop-per-call recursion into
+    resolved project-internal callees' parameter uses."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self._param_memo: dict[tuple[int, str], bool] = {}
+
+    def expr_safe(self, module, expr: ast.AST, depth: int = 0) -> bool:
+        """True when the immediate consumer of ``expr`` accepts any
+        buffer-protocol object."""
+        parent = module.parent(expr)
+        if isinstance(parent, ast.Call) and expr in parent.args:
+            return self._call_arg_safe(module, parent, expr, depth)
+        if isinstance(parent, ast.Subscript):
+            return True  # further slicing/indexing works on memoryview
+        if isinstance(parent, (ast.Compare, ast.UnaryOp)):
+            return True  # truthiness / equality work via the buffer len
+        if isinstance(parent, (ast.IfExp, ast.If, ast.While)) \
+                and expr is parent.test:
+            return True  # bare truthiness test: memoryview has __len__
+        if isinstance(parent, ast.Assign):
+            targets = [t for t in parent.targets if isinstance(t, ast.Name)]
+            if len(targets) == len(parent.targets) and targets:
+                fn = module.enclosing_function(expr)
+                return fn is not None and all(
+                    self._name_uses_safe(module, fn, t.id, parent, depth)
+                    for t in targets)
+            return False
+        if isinstance(parent, ast.Dict):
+            # Stored as a dict value: our serializers (msgpack bin),
+            # transports (writelines), and caches all take buffer-
+            # protocol objects; the store itself copies nothing.
+            return expr in parent.values
+        return False
+
+    def _name_uses_safe(self, module, fn: ast.AST, name: str,
+                        defining: ast.AST, depth: int) -> bool:
+        """Every Load of ``name`` inside ``fn`` (outside the defining
+        assignment) must itself be a memoryview-safe consumer."""
+        if depth >= 3:
+            return False
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Name) and n.id == name
+                    and isinstance(n.ctx, ast.Load)):
+                continue
+            if module.enclosing_function(n) is not fn:
+                continue
+            if any(anc is defining for anc in module.ancestors(n)):
+                continue
+            if not self.expr_safe(module, n, depth + 1):
+                return False
+        return True
+
+    def _call_arg_safe(self, module, call: ast.Call, arg: ast.AST,
+                       depth: int) -> bool:
+        name = _call_name(call)
+        if name in _MV_SAFE_CALLEES or name in CRC_CALLS:
+            return True
+        if depth >= 3:
+            return False
+        # Resolved internal callee: safe iff the receiving parameter is
+        # itself only used in memoryview-safe ways.
+        fn = self._enclosing_info(module, call)
+        if fn is None:
+            return False
+        for edge in fn.calls:
+            if edge.site is not call:
+                continue
+            callee = edge.callee
+            param = self._param_for_arg(callee, call, arg, edge.kind)
+            if param is None:
+                return False
+            return self._param_safe(callee, param, depth + 1)
+        return False
+
+    def _enclosing_info(self, module, node) -> FunctionInfo | None:
+        fn_node = module.enclosing_function(node)
+        if fn_node is None:
+            return None
+        return self.project.enclosing_function_info(module, node)
+
+    @staticmethod
+    def _param_for_arg(callee: FunctionInfo, call: ast.Call, arg: ast.AST,
+                       kind: str) -> str | None:
+        args = list(call.args)
+        if kind == "thread" and args:
+            args = args[1:]  # to_thread(fn, *args)
+        try:
+            pos = args.index(arg)
+        except ValueError:
+            return None
+        params = [a.arg for a in callee.node.args.args]
+        if params and params[0] in ("self", "cls"):
+            pos += 1
+        if pos < len(params):
+            return params[pos]
+        return None
+
+    def _param_safe(self, callee: FunctionInfo, param: str,
+                    depth: int) -> bool:
+        key = (id(callee.node), param)
+        memo = self._param_memo.get(key)
+        if memo is not None:
+            return memo
+        self._param_memo[key] = True  # cycle guard: optimistic
+        module = callee.module
+        safe = True
+        for node in ast.walk(callee.node):
+            if isinstance(node, ast.Name) and node.id == param \
+                    and isinstance(node.ctx, ast.Load) \
+                    and module.enclosing_function(node) is callee.node:
+                if not self.expr_safe(module, node, depth):
+                    safe = False
+                    break
+        self._param_memo[key] = safe
+        return safe
+
+
+@register
+class HotLoopCopy(ProjectRule):
+    id = "TPL030"
+    name = "hot-loop-buffer-copy"
+    summary = ("O(n) buffer copy (slice/concat/`bytes()`/`join`) inside "
+               "a hot-path loop where a `memoryview` or scatter list "
+               "suffices — memcpy per frame is the write-pipeline gap")
+    doc = (
+        "`data[off:off+n]` on `bytes` memcpys n bytes; per block of a "
+        "chain write that is the whole payload copied again before it "
+        "even reaches the socket. On the hot paths (bench/data-plane "
+        "reachability with loop depth from the CFG) this rule flags "
+        "slice, concat, `bytes()` and `b''.join` copies whose consumers "
+        "all accept buffer-protocol objects — checksums, socket "
+        "writes/writelines, msgpack bin packing, further slicing — so "
+        "`memoryview(data)[off:off+n]` (or handing the parts list to "
+        "`writelines`) is a drop-in. Small constant-bound slices "
+        "(header peeks) and copies whose value escapes to unknown "
+        "consumers stay silent."
+    )
+    example = """\
+while offset < len(data):                  # hot write loop
+    piece = data[offset:offset + block]    # memcpys every block
+    await write_block(piece, crc32c(piece))
+    offset += block
+"""
+    fix = ("Slice a `memoryview(data)` once outside the loop: "
+           "`view = memoryview(data); piece = view[off:off+n]` — "
+           "checksums, msgpack and socket writes all take it unchanged.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hp = hot_paths(project)
+        safety = _MvSafety(project)
+        for fn, _entry in _hot_functions(project, self.id):
+            module = fn.module
+            seen: set[tuple[int, int]] = set()
+            for node in _own_nodes(fn):
+                eff = hp.effective_depth(fn, node.loop_depth)
+                env, _ = _in_env(fn, node)
+                for top in node.exprs():
+                    for expr in ast.walk(top):
+                        label = self._copy_label(module, expr, env)
+                        if label is None:
+                            continue
+                        batch_join = (
+                            label == "join"
+                            and self._loop_accumulated(module, fn, expr))
+                        if eff < 1 and not batch_join:
+                            continue
+                        key = (getattr(expr, "lineno", 0),
+                               getattr(expr, "col_offset", 0))
+                        if key in seen:
+                            continue
+                        if not safety.expr_safe(module, expr):
+                            continue
+                        seen.add(key)
+                        if eff >= 1:
+                            msg = (
+                                f"O(n) {label} copy in a hot loop "
+                                f"(effective depth {eff}) in "
+                                f"`{fn.short()}`; every consumer accepts "
+                                "a buffer view — use `memoryview` "
+                                "slicing (or pass the parts list to "
+                                "`writelines`) instead of copying per "
+                                "iteration")
+                        else:
+                            msg = (
+                                "`join` flattens a batch accumulated in "
+                                f"a loop in `{fn.short()}` — the whole "
+                                "batch is re-copied once more; hand the "
+                                "parts list to the transport "
+                                "(`writelines`/scatter framing) instead")
+                        yield self.finding(module, expr, msg)
+
+    @staticmethod
+    def _loop_accumulated(module, fn: FunctionInfo, expr: ast.AST) -> bool:
+        """``b"".join(parts)`` where ``parts`` is ``.append``ed inside a
+        loop of the same function: the join re-copies the entire batch
+        the loop just assembled, even when the join itself sits after
+        the loop at depth 0."""
+        if not (isinstance(expr, ast.Call) and expr.args
+                and isinstance(expr.args[0], ast.Name)):
+            return False
+        name = expr.args[0].id
+        for n in ast.walk(fn.node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("append", "extend") \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id == name:
+                cur = module.parent(n)
+                while cur is not None and cur is not fn.node:
+                    if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                        return True
+                    cur = module.parent(cur)
+        return False
+
+    @staticmethod
+    def _copy_label(module, expr: ast.AST, env) -> str | None:
+        label = is_copy_expr(expr, env)
+        if label is None:
+            return None
+        if label == "slice":
+            if not isinstance(expr.ctx, ast.Load):
+                return None
+            if _const_small_slice(expr.slice):
+                return None
+        if label == "concat":
+            # `buf = buf + chunk` is TPL031's quadratic accumulation;
+            # don't double-report the same expression.
+            parent = module.parent(expr)
+            if isinstance(parent, ast.Assign) \
+                    and isinstance(expr.left, ast.Name) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == expr.left.id
+                            for t in parent.targets):
+                return None
+        return label
+
+
+@register
+class QuadraticAccumulation(ProjectRule):
+    id = "TPL031"
+    name = "quadratic-bytes-accumulation"
+    summary = ("`buf += chunk` on immutable `bytes` in a loop re-copies "
+               "the whole prefix every iteration — O(n^2) accumulation; "
+               "use `bytearray` or collect parts and `join` once")
+    doc = (
+        "`bytes` is immutable: `buf += chunk` allocates a fresh object "
+        "and memcpys len(buf) + len(chunk) bytes, so accumulating n "
+        "chunks costs O(n^2) — 256 frames of 64 KiB copy two gigabytes. "
+        "The rule uses buffer provenance to fire only when the target "
+        "may hold `bytes` (bytearray += is amortized O(1) and stays "
+        "silent) and only inside a loop in a hot function, where the "
+        "accumulation actually multiplies."
+    )
+    example = """\
+frame = b""
+while len(frame) < total:       # hot reassembly loop
+    frame += await read_chunk() # re-copies the prefix every time
+"""
+    fix = ("Accumulate into a `bytearray` (then `bytes(buf)` once if an "
+           "immutable result is needed), or append chunks to a list and "
+           "`b''.join(parts)` after the loop.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hp = hot_paths(project)
+        for fn, _entry in _hot_functions(project, self.id):
+            module = fn.module
+            for node in _own_nodes(fn):
+                if node.loop_depth < 1:
+                    # The accumulator must live across iterations of a
+                    # loop in THIS function to go quadratic.
+                    continue
+                env, _ = _in_env(fn, node)
+                for top in node.exprs():
+                    hit = self._accumulation(top, env)
+                    if hit is None:
+                        continue
+                    target, form = hit
+                    yield self.finding(
+                        module, top,
+                        f"quadratic accumulation `{target} {form}` on "
+                        f"immutable bytes in a loop in `{fn.short()}` — "
+                        "each iteration re-copies the whole prefix; use "
+                        "a `bytearray` or collect parts and `join` once",
+                    )
+
+    @staticmethod
+    def _accumulation(stmt: ast.AST, env) -> tuple[str, str] | None:
+        if isinstance(stmt, ast.AugAssign) and isinstance(stmt.op, ast.Add) \
+                and isinstance(stmt.target, ast.Name):
+            name = stmt.target.id
+            kinds = env.get(name, set())
+            if "bytes" in kinds and "bytearray" not in kinds:
+                return name, "+= ..."
+            return None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.BinOp) \
+                and isinstance(stmt.value.op, ast.Add) \
+                and isinstance(stmt.value.left, ast.Name) \
+                and stmt.value.left.id == stmt.targets[0].id:
+            name = stmt.targets[0].id
+            kinds = env.get(name, set())
+            if "bytes" in kinds and "bytearray" not in kinds \
+                    and kind_of(stmt.value.right, env):
+                return name, "= " + name + " + ..."
+        return None
+
+
+@register
+class SequentialAwaitPerFrame(ProjectRule):
+    id = "TPL032"
+    name = "sequential-await-in-hot-loop"
+    summary = ("awaited RPC/IO per iteration of a hot loop with no "
+               "batching/gather/pipelining — N serial round-trips where "
+               "one gathered batch would do")
+    doc = (
+        "A loop that awaits a round-trip per item serializes N network "
+        "(or thread-pool) latencies; the reads of a 256-block batch "
+        "take 256x the latency of one. Detection is on the CFG: a loop "
+        "in a hot async function whose body awaits an initiating RPC/IO "
+        "call, with no batching evidence — no gather/create_task/"
+        "TaskGroup in the body, no inner batch-building loop (the "
+        "group-commit drain shape), no normal-path break/return (the "
+        "retry/failover shape tries alternatives, it does not iterate "
+        "work), and not a pure stream-consumer await (an ordered TCP "
+        "stream cannot be gathered). An unconditional `await w.drain()` "
+        "per frame counts — flushing every frame is the ack-chattiness "
+        "this rule exists for; a watermark-guarded drain does not."
+    )
+    example = """\
+for block_id in req["block_ids"]:          # hot batch-read handler
+    data = await asyncio.to_thread(store.read, block_id)
+    out.append(data)                       # N serial disk round-trips
+"""
+    fix = ("Issue the calls concurrently and gather: `await asyncio."
+           "gather(*(asyncio.to_thread(store.read, b) for b in ids))` — "
+           "or pipeline iterations with create_task/TaskGroup.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hp = hot_paths(project)
+        for fn, _entry in _hot_functions(project, self.id):
+            if not fn.is_async:
+                continue
+            module = fn.module
+            for loop in ast.walk(fn.node):
+                if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                    continue
+                if module.enclosing_function(loop) is not fn.node:
+                    continue
+                hit = self._chatty_await(module, fn, loop)
+                if hit is not None:
+                    await_node, what = hit
+                    yield self.finding(
+                        module, await_node,
+                        f"`{fn.short()}` awaits `{what}` on every "
+                        "iteration of a hot loop with no batching or "
+                        "pipelining between iterations — gather the "
+                        "calls, pipeline with create_task, or batch "
+                        "the flush behind a watermark",
+                    )
+
+    def _chatty_await(self, module, fn: FunctionInfo,
+                      loop: ast.AST) -> tuple[ast.AST, str] | None:
+        body_nodes = [n for stmt in loop.body for n in ast.walk(stmt)]
+        # Batching / pipelining evidence exempts the whole loop.
+        for n in body_nodes:
+            if isinstance(n, ast.Call) and _call_name(n) in _BATCH_NAMES:
+                return None
+            if isinstance(n, ast.Attribute) and n.attr in _BATCH_NAMES:
+                return None
+        # An inner loop is the drain-batch shape: each awaited call
+        # covers many gathered items.
+        for stmt in loop.body:
+            for n in ast.walk(stmt):
+                if n is not loop and isinstance(
+                        n, (ast.While, ast.For, ast.AsyncFor)):
+                    return None
+        # Normal-path break/return = retry/failover over alternatives.
+        for n in body_nodes:
+            if isinstance(n, (ast.Break, ast.Return)) \
+                    and not self._under_except(module, n, loop) \
+                    and module.enclosing_function(n) is fn.node:
+                return None
+
+        candidate: tuple[ast.AST, str] | None = None
+        for n in body_nodes:
+            if not isinstance(n, ast.Await):
+                continue
+            if module.enclosing_function(n) is not fn.node:
+                continue
+            call = n.value
+            if isinstance(call, ast.Call) \
+                    and _call_name(call) == "wait_for" and call.args:
+                inner = call.args[0]
+                call = inner if isinstance(inner, ast.Call) else call
+            if not isinstance(call, ast.Call):
+                continue
+            name = _call_name(call)
+            if name in ("sleep",):
+                continue
+            if name in ("drain", "flush"):
+                if self._guarded(module, n, loop):
+                    continue
+                return n, name + "()"
+            if self._stream_consumer(call, loop):
+                continue
+            if name in _RPC_IO_NAMES \
+                    or name.startswith(_RPC_IO_PREFIXES):
+                candidate = (n, name + "()")
+        return candidate
+
+    @staticmethod
+    def _under_except(module, node: ast.AST, stop: ast.AST) -> bool:
+        cur = module.parent(node)
+        while cur is not None and cur is not stop:
+            if isinstance(cur, ast.ExceptHandler):
+                return True
+            cur = module.parent(cur)
+        return False
+
+    @staticmethod
+    def _guarded(module, node: ast.AST, loop: ast.AST) -> bool:
+        """True when an `if` between the await and the loop gates it —
+        the flush-on-watermark idiom."""
+        cur = module.parent(node)
+        while cur is not None and cur is not loop:
+            if isinstance(cur, ast.If):
+                return True
+            cur = module.parent(cur)
+        return False
+
+    @staticmethod
+    def _stream_consumer(call: ast.Call, loop: ast.AST) -> bool:
+        """Reads from an ordered stream object: sequential by nature."""
+        name = _call_name(call)
+        reads_input = name.startswith(("read", "_read", "recv", "_recv"))
+        if not reads_input:
+            return False
+        if isinstance(loop, ast.While):
+            return True  # serve/consumer loop: input arrival is the clock
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in _STREAM_RECEIVERS:
+            return True
+        return False
+
+
+@register
+class RedundantChecksum(ProjectRule):
+    id = "TPL033"
+    name = "redundant-checksum"
+    summary = ("CRC computed over a buffer whose current value already "
+               "has a CRC on this path (directly or via a callee) — two "
+               "O(n) passes where a combine/fold gives one")
+    doc = (
+        "crc32c over a buffer is an O(n) pass; two layers each taking "
+        "their own pass over the same unmodified bytes doubles the "
+        "checksum cost of every write. The buffer-provenance dataflow "
+        "tracks a `crc`-computed fact per name, killed on reassignment "
+        "or mutation; a second CRC call over the same name — or passing "
+        "it to a resolved callee that (transitively) checksums that "
+        "parameter, the TPL013-style walk — fires on the path where "
+        "both passes happen. `crc32c_combine_chunks` folds per-chunk "
+        "CRCs into the whole-buffer CRC, so one pass can serve both "
+        "verification and sidecar generation."
+    )
+    example = """\
+actual = crc32c(data)              # pass 1: whole-buffer verify
+if actual != expected:
+    return reject()
+await store.write(block_id, data)  # pass 2: write_staged re-CRCs data
+"""
+    fix = ("Compute per-chunk CRCs once and fold them: `crcs = "
+           "crc32c_chunks(data); crc32c_combine_chunks(crcs, CHUNK) == "
+           "expected` — then hand the chunk CRCs to the layer that "
+           "needed them.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hp = hot_paths(project)
+        memo: dict[FunctionInfo, frozenset[str]] = {}
+
+        def checksummed_params(fn: FunctionInfo,
+                               stack: set[FunctionInfo]) -> frozenset[str]:
+            """Parameter names ``fn`` (transitively) computes a CRC over."""
+            if fn in memo:
+                return memo[fn]
+            if fn in stack:
+                return frozenset()
+            stack.add(fn)
+            params = {a.arg for a in fn.node.args.args}
+            out: set[str] = set()
+            for n in ast.walk(fn.node):
+                if isinstance(n, ast.Call) and _call_name(n) in CRC_CALLS \
+                        and n.args and isinstance(n.args[0], ast.Name) \
+                        and n.args[0].id in params \
+                        and not _compute_if_absent(fn.module, n):
+                    out.add(n.args[0].id)
+            for edge in fn.calls:
+                if not isinstance(edge.site, ast.Call):
+                    continue
+                callee_sums = checksummed_params(edge.callee, stack)
+                if not callee_sums:
+                    continue
+                for arg_name, param in _positional_map(edge):
+                    if param in callee_sums and arg_name in params:
+                        out.add(arg_name)
+            stack.discard(fn)
+            memo[fn] = frozenset(out)
+            return memo[fn]
+
+        for fn, _entry in _hot_functions(project, self.id):
+            module = fn.module
+            flow = buffer_flow(module, fn.node)
+            edges_by_site = {id(e.site): e for e in fn.calls}
+            reported: set[tuple[str, int]] = set()
+            for node in _own_nodes(fn):
+                in_facts, _ = flow.get(node.index, (None, None))
+                already = crc_names(in_facts)
+                if not already:
+                    continue
+                for top in node.exprs():
+                    for n in ast.walk(top):
+                        if not isinstance(n, ast.Call):
+                            continue
+                        hit = self._second_pass(
+                            module, n, already, edges_by_site,
+                            checksummed_params)
+                        if hit is None:
+                            continue
+                        var, how = hit
+                        key = (var, getattr(n, "lineno", 0))
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        yield self.finding(
+                            module, n,
+                            f"`{var}` already has a CRC computed on this "
+                            f"path in `{fn.short()}`, and {how} takes "
+                            "another O(n) pass over the same bytes — "
+                            "compute chunk CRCs once and fold with "
+                            "`crc32c_combine_chunks`",
+                        )
+
+    @staticmethod
+    def _second_pass(module, call: ast.Call, already: set[str],
+                     edges_by_site,
+                     checksummed_params) -> tuple[str, str] | None:
+        name = _call_name(call)
+        if name in CRC_CALLS and call.args \
+                and isinstance(call.args[0], ast.Name) \
+                and call.args[0].id in already \
+                and not _compute_if_absent(module, call):
+            return call.args[0].id, f"`{name}(...)`"
+        edge = edges_by_site.get(id(call))
+        if edge is None:
+            return None
+        callee_sums = checksummed_params(edge.callee, set())
+        if not callee_sums:
+            return None
+        for arg_name, param in _positional_map(edge):
+            if param in callee_sums and arg_name in already:
+                return arg_name, f"`{edge.callee.short()}(...)`"
+        return None
+
+
+def _compute_if_absent(module, call: ast.Call) -> bool:
+    """`crc if crc is not None else crc32c(data)` — or the statement
+    form, `if crcs is None: crcs = crc32c_chunks(data)` — computes the
+    CRC only when the caller did not supply one; on the supplied path
+    there is exactly one pass, so this is not redundancy."""
+    cur = module.parent(call)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        test = cur.test if isinstance(cur, (ast.IfExp, ast.If)) else None
+        if isinstance(test, ast.Compare) \
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in [test.left, *test.comparators]):
+            return True
+        cur = module.parent(cur)
+    return False
+
+
+def _positional_map(edge) -> list[tuple[str, str]]:
+    """(caller arg name, callee param name) pairs for plain positional
+    Name arguments of a resolved call edge, self-offset and
+    to_thread-shift aware."""
+    call = edge.site
+    if not isinstance(call, ast.Call):
+        return []
+    args = list(call.args)
+    if edge.kind == "thread" and args:
+        fname = _call_name(call)
+        args = args[2:] if fname == "run_in_executor" else args[1:]
+    params = [a.arg for a in edge.callee.node.args.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    out = []
+    for i, a in enumerate(args):
+        if isinstance(a, ast.Name) and i < len(params):
+            out.append((a.id, params[i]))
+    return out
+
+
+@register
+class SyncSerializationOnLoop(ProjectRule):
+    id = "TPL034"
+    name = "sync-serialization-on-loop"
+    summary = ("synchronous serialization/compression/slow digest of a "
+               "byte buffer on the event loop in a hot path — O(n) CPU "
+               "that stalls every other connection")
+    doc = (
+        "TPL010 catches blocking *calls* (sleep, sync I/O); this is its "
+        "size-aware sibling for blocking *CPU*: msgpack/pickle/json "
+        "serialization, zlib-family compression, md5/sha digests and "
+        "the pure-Python crc64nvme fallback are all O(n) passes that "
+        "hold the event loop for milliseconds per megabyte. The rule "
+        "fires only in hot async functions and only when an argument "
+        "has byte-buffer provenance from the dataflow — packing a "
+        "20-byte header dict is free and stays silent; packing the "
+        "payload is not."
+    )
+    example = """\
+async def send_block(w, data: bytes):       # hot data-plane send
+    w.write(zlib.compress(data))            # O(n) CPU on the loop
+    await w.drain()
+"""
+    fix = ("Offload the O(n) pass: `await asyncio.to_thread(zlib."
+           "compress, data)` — or move payload bytes outside the "
+           "serialized envelope entirely (scatter framing).")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        hp = hot_paths(project)
+        for fn, _entry in _hot_functions(project, self.id):
+            if not fn.is_async:
+                continue
+            module = fn.module
+            for node in _own_nodes(fn):
+                env, _ = _in_env(fn, node)
+                for top in node.exprs():
+                    for n in ast.walk(top):
+                        if not isinstance(n, ast.Call):
+                            continue
+                        name = _call_name(n)
+                        if name not in _SERIALIZE_CALLEES:
+                            continue
+                        if module.enclosing_function(n) is not fn.node:
+                            continue
+                        if not self._buffer_arg(n, env):
+                            continue
+                        if self._offloaded(module, n):
+                            continue
+                        yield self.finding(
+                            module, n,
+                            f"`{name}(...)` serializes a byte buffer "
+                            f"synchronously on the event loop in hot "
+                            f"`{fn.short()}` — offload with "
+                            "`asyncio.to_thread`, or keep payload bytes "
+                            "out of the serialized envelope",
+                        )
+
+    @classmethod
+    def _buffer_arg(cls, call: ast.Call, env) -> bool:
+        return any(cls._payloadish(a, env) for a in call.args)
+
+    @classmethod
+    def _payloadish(cls, expr: ast.AST, env) -> bool:
+        """Buffer provenance AND a payload-reading name somewhere in the
+        expression. `unpackb(await r.readexactly(hlen))` has provenance
+        but is a length-prefixed *header* read — without a payload name
+        there is no evidence the buffer is O(payload)-sized."""
+        if isinstance(expr, ast.Name):
+            return bool(kind_of(expr, env)) \
+                and PAYLOAD_NAME_RE.match(expr.id) is not None
+        if isinstance(expr, ast.Await):
+            return cls._payloadish(expr.value, env)
+        if isinstance(expr, ast.Subscript):
+            return cls._payloadish(expr.value, env)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return cls._payloadish(expr.left, env) \
+                or cls._payloadish(expr.right, env)
+        if isinstance(expr, ast.Dict):
+            return any(v is not None and cls._payloadish(v, env)
+                       for v in expr.values)
+        return False
+
+    @staticmethod
+    def _offloaded(module, call: ast.Call) -> bool:
+        """Already behind to_thread/run_in_executor at this site."""
+        cur = module.parent(call)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(cur, ast.Call) \
+                    and _call_name(cur) in ("to_thread", "run_in_executor"):
+                return True
+            cur = module.parent(cur)
+        return False

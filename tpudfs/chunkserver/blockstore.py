@@ -146,29 +146,37 @@ class BlockStore:
         return (Path(f"{path}.tmp-{token}"),
                 Path(f"{self._meta_path(path)}.tmp-{token}"))
 
-    def write_staged(self, block_id: str, data: bytes,
-                     token: str) -> np.ndarray:
+    def write_staged(self, block_id: str, data: bytes, token: str,
+                     checksums: np.ndarray | None = None) -> np.ndarray:
         """Stage block + sidecar as PER-WRITER ``.tmp-<token>`` files
         WITHOUT fsync or rename — step 1 of group commit. Unique names mean
         concurrent stagers of the same block (retries, recovery racing a
         client write) can never truncate each other's files; whichever
         publish renames last wins with a complete data+sidecar pair.
         Returns the per-chunk CRCs; durability and visibility come from
-        ``publish_staged_batch``."""
+        ``publish_staged_batch``.
+
+        ``checksums``: per-chunk CRCs the caller already computed over
+        ``data`` at ``self.chunk_size`` (the handler's verify pass) —
+        the sidecar is then encoded from them directly and staging never
+        re-reads the payload; the fused native write exists to fold the
+        CRC pass into the file write, so with CRCs in hand the plain
+        write path is the single-pass one."""
         dtmp, mtmp = self._staged_paths(block_id, token)
-        lib = native.get_lib()
-        if lib is not None and hasattr(lib, "tpudfs_block_write_staged"):
-            n = (len(data) + self.chunk_size - 1) // self.chunk_size
-            out = np.empty(n, dtype="<u4")
-            rc = lib.tpudfs_block_write_staged(
-                str(dtmp).encode(), str(mtmp).encode(),
-                data, len(data), self.chunk_size,
-                out.ctypes.data if n else None,
-            )
-            if rc < 0:
-                raise OSError(-rc, os.strerror(int(-rc)), str(dtmp))
-            return out.astype(np.uint32)
-        checksums = crc32c_chunks(data, self.chunk_size)
+        if checksums is None:
+            lib = native.get_lib()
+            if lib is not None and hasattr(lib, "tpudfs_block_write_staged"):
+                n = (len(data) + self.chunk_size - 1) // self.chunk_size
+                out = np.empty(n, dtype="<u4")
+                rc = lib.tpudfs_block_write_staged(
+                    str(dtmp).encode(), str(mtmp).encode(),
+                    data, len(data), self.chunk_size,
+                    out.ctypes.data if n else None,
+                )
+                if rc < 0:
+                    raise OSError(-rc, os.strerror(int(-rc)), str(dtmp))
+                return out.astype(np.uint32)
+            checksums = crc32c_chunks(data, self.chunk_size)
         with open(dtmp, "wb") as f:
             f.write(data)
         with open(mtmp, "wb") as f:

@@ -33,7 +33,7 @@ import grpc
 
 from tpudfs.common import blocknet, native
 from tpudfs.common.blocknet import BlockConnPool
-from tpudfs.common.checksum import crc32c
+from tpudfs.common.checksum import crc32c, crc32c_chunks, crc32c_fold
 from tpudfs.common.erasure import encode as ec_encode, reconstruct
 from tpudfs.common.resilience import (
     LoadShedder,
@@ -99,19 +99,22 @@ class GroupCommitter:
         self._task: asyncio.Task | None = None
         self._closed = False
 
-    async def write(self, block_id: str, data: bytes) -> None:
+    async def write(self, block_id: str, data: bytes,
+                    checksums=None) -> None:
         """Stage under a PRIVATE ``.tmp-<token>`` name (a cancelled or
         concurrent same-block writer can never truncate another's staged
         file — the uncancellable staging thread only ever touches its own
         token's paths), then wait for the drain loop to publish the batch.
         Cancellation mid-staging leaves an orphan tmp (boot cleanup);
-        cancellation mid-publish lets the publish finish (shielded)."""
+        cancellation mid-publish lets the publish finish (shielded).
+        ``checksums``: per-chunk CRCs the caller already computed over
+        ``data`` (store chunking) — staging then skips its own pass."""
         if self._closed:
             raise OSError("chunkserver stopping")
         token = uuid.uuid4().hex
         try:
             await asyncio.to_thread(
-                self.store.write_staged, block_id, data, token
+                self.store.write_staged, block_id, data, token, checksums
             )
         except asyncio.CancelledError:
             # The thread may still be writing its private tmp; it cannot
@@ -292,7 +295,12 @@ class ChunkServer:
         """Batched full reads for a remote reader's fused round: one
         frame/RPC instead of one per block. Per-slot ``sizes`` (-1 =
         missing/over-budget; caller falls back per block), payload = the
-        successful blocks concatenated in request order. Reads bypass
+        successful blocks in request order as a ``data_parts`` scatter
+        list — the blockport writes the parts straight to the socket and
+        the msgpack plane flattens once at the frame boundary. The slot
+        reads for one frame run concurrently on the thread pool (the
+        disk round-trips were the batch's serial latency), with the byte
+        budget applied in request order afterwards. Reads bypass
         the LRU block cache (the streaming fused sweep must not wash it)
         AND skip the sidecar verify: every ReadBlocks consumer — the
         combiner's remote rounds — re-verifies END-TO-END against the
@@ -300,25 +308,29 @@ class ChunkServer:
         a mismatch falls back to the per-block VERIFIED path, which
         detects the rot, reports it, and triggers recovery. The native
         engine serves the same method, same contract, on the blockport."""
-        sizes: list[int] = []
-        chunks: list[bytes] = []
-        total = 0
-        for block_id in req.get("block_ids") or []:
-            if len(sizes) >= self.READ_BATCH_MAX_SLOTS or                     total >= self.READ_BATCH_MAX_BYTES:
-                sizes.append(-1)
-                continue
+        ids = list(req.get("block_ids") or [])
+        attempt = ids[: self.READ_BATCH_MAX_SLOTS]
+
+        async def _read_one(block_id: str) -> bytes | None:
             try:
-                data = await asyncio.to_thread(self.store.read, block_id)
+                return await asyncio.to_thread(self.store.read, block_id)
             except (BlockNotFoundError, BlockCorruptionError, OSError):
+                return None
+
+        results = await asyncio.gather(*(_read_one(b) for b in attempt))
+        sizes: list[int] = []
+        parts: list[bytes] = []
+        total = 0
+        for data in results:
+            if data is None or total >= self.READ_BATCH_MAX_BYTES \
+                    or total + len(data) > self.READ_BATCH_MAX_BYTES:
                 sizes.append(-1)
                 continue
-            if total + len(data) > self.READ_BATCH_MAX_BYTES:
-                sizes.append(-1)
-                continue
-            chunks.append(data)
+            parts.append(data)
             sizes.append(len(data))
             total += len(data)
-        return {"sizes": sizes, "data": b"".join(chunks)}
+        sizes.extend(-1 for _ in ids[self.READ_BATCH_MAX_SLOTS:])
+        return {"sizes": sizes, "data_parts": parts}
 
     async def rpc_data_port(self, req: dict) -> dict:
         """Blockport discovery (tpudfs.common.blocknet): port 0 = none.
@@ -594,8 +606,15 @@ class ChunkServer:
         block_id = req["block_id"]
         data = req["data"]
         expected = int(req.get("expected_crc32c", 0))
+        chunk_crcs = None
         if expected != 0:
-            actual = crc32c(data)
+            # Single-pass CRC: ONE chunked pass both verifies the
+            # client's whole-buffer CRC (GF(2) fold, no second data
+            # pass) and yields the sidecar array write_staged needs —
+            # previously this hop CRC'd every payload byte twice.
+            chunk_crcs = crc32c_chunks(data, self.store.chunk_size)
+            actual = crc32c_fold(chunk_crcs, len(data),
+                                 self.store.chunk_size)
             if actual != expected:
                 logger.error(
                     "checksum mismatch for block %s: expected %d actual %d",
@@ -659,7 +678,8 @@ class ChunkServer:
 
         local_err: str | None = None
         try:
-            await self.committer.write(block_id, data)
+            await self.committer.write(block_id, data,
+                                       checksums=chunk_crcs)
         except (OSError, ValueError) as e:
             local_err = str(e)
         except BaseException:

@@ -637,9 +637,15 @@ class Client:
         # Stick to the creating master for read-your-writes (mod.rs:256-266).
         sticky = [master] + [a for a in self._masters_for(path) if a != master]
         block_checksums = []
+        # Zero-copy block framing: slicing the memoryview costs O(1)
+        # where `data[off:off+block]` memcpys every block once more
+        # before it even reaches a socket. Every consumer — crc32c,
+        # ec_encode's frombuffer, msgpack bin packing, the blockport's
+        # writelines — takes the view unchanged.
+        view = memoryview(data)
         offset = 0
         while offset < len(data) or offset == 0:
-            piece = data[offset : offset + self.block_size]
+            piece = view[offset : offset + self.block_size]
             if not piece and offset > 0:
                 break
             if first_alloc is not None:
@@ -689,6 +695,9 @@ class Client:
                                       crc: int | None = None,
                                       shard: str = "") -> None:
         timeout = max(self.rpc_timeout, 60.0)
+        # One CRC pass regardless of how many chain rotations the
+        # failover loop below tries — the payload does not change.
+        expected = crc if crc is not None else crc32c(data)
         resp = None
         last_err: RpcError | None = None
         # Chain-ENTRY failover: a dead/unreachable first hop rotates the
@@ -704,7 +713,7 @@ class Client:
                 "block_id": block_id,
                 "data": data,
                 "next_servers": chain[1:],
-                "expected_crc32c": crc if crc is not None else crc32c(data),
+                "expected_crc32c": expected,
                 "master_term": term,
                 "master_shard": shard,
             }

@@ -104,10 +104,20 @@ def enabled() -> bool:
 def _pack_frame(header: dict, payload) -> list[bytes]:
     """``payload=None`` means "no data field"; ``b""`` is a real, empty
     data field (an empty block is valid DFS content) — the ``_d`` header
-    flag keeps the two distinguishable across the wire."""
+    flag keeps the two distinguishable across the wire.
+
+    ``payload`` may also be a list/tuple of buffers (the handler
+    scatter-framing contract, ``data_parts``): the parts ride straight
+    into ``writelines`` without ever being concatenated — the kernel
+    gathers them off the list."""
     if payload is not None:
         header["_d"] = 1
     h = msgpack.packb(header, use_bin_type=True)
+    if isinstance(payload, (list, tuple)):
+        plen = sum(len(p) for p in payload)
+        out = [_U32.pack(len(h)), h, _U64.pack(plen)]
+        out.extend(p for p in payload if len(p))
+        return out
     out = [_U32.pack(len(h)), h, _U64.pack(len(payload) if payload else 0)]
     if payload:
         out.append(payload)
@@ -165,6 +175,19 @@ async def _read_into(r: asyncio.StreamReader, segments, plen: int) -> None:
 #: enough to stay within the stream buffer's high-water mark.
 _READ_INTO_CHUNK = 1 << 20
 
+#: Serve-loop backpressure watermark: an unconditional ``await
+#: w.drain()`` per response frame costs an event-loop round-trip per
+#: frame even when the kernel buffer is empty; only pay it once the
+#: transport's write buffer actually backs up past this.
+_DRAIN_WATERMARK = 1 << 18
+
+
+async def _drain_backpressure(w: asyncio.StreamWriter) -> None:
+    transport = w.transport
+    if transport is None or \
+            transport.get_write_buffer_size() > _DRAIN_WATERMARK:
+        await w.drain()
+
 
 class BlockPortServer:
     """Framed-TCP front over the same async handlers the gRPC service
@@ -221,7 +244,7 @@ class BlockPortServer:
                     w.writelines(_pack_frame(
                         {"ok": False, "code": "UNIMPLEMENTED",
                          "message": f"no blockport method {method!r}"}, None))
-                    await w.drain()
+                    await _drain_backpressure(w)
                     continue
                 req = header
                 # Deadline parity with the gRPC plane: adopt the caller's
@@ -235,7 +258,7 @@ class BlockPortServer:
                         {"ok": False, "code": "DEADLINE_EXCEEDED",
                          "message": "deadline budget exhausted before "
                                     f"blockport {method} executed"}, None))
-                    await w.drain()
+                    await _drain_backpressure(w)
                     continue
                 if req.pop("_d", 0):
                     req["data"] = payload
@@ -248,7 +271,7 @@ class BlockPortServer:
                     w.writelines(_pack_frame(
                         {"ok": False, "code": e.code.name,
                          "message": e.message}, None))
-                    await w.drain()
+                    await _drain_backpressure(w)
                     continue
                 except asyncio.CancelledError:
                     raise
@@ -257,7 +280,7 @@ class BlockPortServer:
                     w.writelines(_pack_frame(
                         {"ok": False, "code": "INTERNAL",
                          "message": "internal error"}, None))
-                    await w.drain()
+                    await _drain_backpressure(w)
                     continue
                 finally:
                     try:
@@ -266,9 +289,11 @@ class BlockPortServer:
                         pass
                 out = dict(resp)
                 data = out.pop("data", None) if "data" in out else None
+                if "data_parts" in out:
+                    data = out.pop("data_parts")
                 out["ok"] = True
                 w.writelines(_pack_frame(out, data))
-                await w.drain()
+                await _drain_backpressure(w)
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:

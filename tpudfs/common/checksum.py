@@ -238,6 +238,22 @@ def combine_fold_table(chunk_len: int, n: int) -> np.ndarray:
     return out
 
 
+def crc32c_fold(crcs, total_len: int, chunk_len: int) -> int:
+    """Whole-buffer CRC32C from the per-chunk CRCs of a ``total_len``-byte
+    buffer chunked at ``chunk_len`` (last chunk may be short — the
+    ``crc32c_chunks`` sidecar layout). One GF(2) fold instead of a second
+    O(n) pass over the data: a handler that chunk-CRCs a payload once can
+    both verify the sender's whole-buffer CRC and hand the same array to
+    the sidecar writer."""
+    arr = np.asarray(crcs, dtype=np.uint32)
+    full = total_len // chunk_len
+    crc = crc32c_combine_chunks(arr[:full], chunk_len)
+    tail = total_len - full * chunk_len
+    if tail:
+        crc = crc32c_combine(crc, int(arr[full]), tail)
+    return crc
+
+
 def crc32c_combine_chunks(crcs, chunk_len: int, crc: int = 0) -> int:
     """CRC of the concatenation of n equal-length chunks from their per-chunk
     CRCs — the vectorized equivalent of folding with ``crc32c_combine`` once

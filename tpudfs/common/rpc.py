@@ -253,7 +253,16 @@ class RpcServer:
                         f"deadline budget exhausted before {service}/{method} "
                         "executed",
                     )
-                return await fn(request)
+                resp = await fn(request)
+                if isinstance(resp, dict) and "data_parts" in resp:
+                    # Scatter-framing contract: a handler may return its
+                    # payload as a list of buffers. Transports that can
+                    # scatter (blockport writelines) send the parts
+                    # as-is; this msgpack plane flattens exactly once,
+                    # at the frame boundary.
+                    resp = dict(resp)
+                    resp["data"] = b"".join(resp.pop("data_parts"))
+                return resp
             except RpcError as e:
                 await context.abort(e.code, e.message)
             except asyncio.CancelledError:

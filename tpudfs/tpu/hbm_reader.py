@@ -216,10 +216,27 @@ class HbmReader:
             block, local_verify=safe_local or not device_verify
         )
         if all(s is not None for s in shards[:k]):
-            data = b"".join(shards[:k])[:size]  # type: ignore[arg-type]
-            words = await asyncio.to_thread(
-                lambda: jax.device_put(bytes_to_words(data), device)
-            )
+            def _assemble():
+                # Scatter the shards straight into the padded chunk grid
+                # in ONE copy: `b"".join(shards)[:size]` copies the block
+                # once to concatenate and bytes_to_words copies it AGAIN
+                # to pad non-chunk-aligned sizes; the grid is where the
+                # bytes end up either way.
+                need = -(-max(size, 1) // CHECKSUM_CHUNK_SIZE) \
+                    * CHECKSUM_CHUNK_SIZE
+                buf = np.zeros(need, dtype=np.uint8)
+                off = 0
+                for s in shards[:k]:
+                    take = min(len(s), size - off)
+                    if take <= 0:
+                        break
+                    buf[off : off + take] = \
+                        np.frombuffer(s, dtype=np.uint8, count=take)
+                    off += take
+                words = buf.view("<u4").reshape(-1, WORDS_PER_CHUNK)
+                return jax.device_put(words, device)
+
+            words = await asyncio.to_thread(_assemble)
             return words, size
         present = tuple(i for i, s in enumerate(shards) if s is not None)
         if len(present) < k:
@@ -364,16 +381,27 @@ class HbmReader:
             )
             if not b.verified:
                 bad.append(b)
+        # Mismatch re-reads run CONCURRENTLY: each one is a full network
+        # fetch + upload, and a corrupted fused round can flag many
+        # blocks at once — serial retries would stack those round-trips.
+        async def _reread(b):
+            try:
+                return await self.read_block_to_device(
+                    b.source, b.device, verify=True, safe_local=True
+                )
+            except DfsError:
+                return None
+
+        retryable = [
+            b for b in bad
+            if retry and b.source is not None and b.device is not None
+        ]
+        rereads = await asyncio.gather(*(_reread(b) for b in retryable))
+        fixed = {id(b): nb for b, nb in zip(retryable, rereads)}
         unrecovered = []
         for b in bad:
-            if retry and b.source is not None and b.device is not None:
-                try:
-                    nb = await self.read_block_to_device(
-                        b.source, b.device, verify=True, safe_local=True
-                    )
-                except DfsError:
-                    unrecovered.append(b.block_id)
-                    continue
+            nb = fixed.get(id(b))
+            if nb is not None:
                 b.array, b.size, b.verified = nb.array, nb.size, nb.verified
             else:
                 unrecovered.append(b.block_id)
@@ -416,7 +444,12 @@ class HbmReader:
             from tpudfs.common.checksum import crc32c
 
             tail_words = np.asarray(words[full_chunks:])
-            tail = tail_words.astype("<u4").tobytes()[:tail_len]
+            # uint8 view instead of tobytes()[:tail_len]: tobytes copies
+            # the whole padded tail chunk and the slice copies it again,
+            # per confirmed block; the view costs nothing and crc32c
+            # takes any buffer.
+            tail = tail_words.astype("<u4").reshape(-1) \
+                .view(np.uint8)[:tail_len]
             crc = crc32c_combine(crc, crc32c(tail), tail_len)
         return crc == expected_crc
 
