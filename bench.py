@@ -260,10 +260,14 @@ def _bench_ici_write_step(device) -> tuple:
     return samples, jnp.concatenate(ok_stacks)
 
 
-def _spawn_cluster(root: str, cache_blocks: int = CS_CACHE_BLOCKS):
-    """1 master + 3 chunkservers as separate OS processes (real sockets,
-    real GIL isolation — the client must not time-share with the servers).
-    On failure every already-started process is torn down before raising."""
+def _spawn_cluster(root: str, cache_blocks: int = CS_CACHE_BLOCKS,
+                   n_cs: int = 3):
+    """1 master + ``n_cs`` chunkservers as separate OS processes (real
+    sockets, real GIL isolation — the client must not time-share with the
+    servers). The flagship read/write phases use 3 (a replication set);
+    the checkpoint phase asks for 5 so RS(3,2) shards land on distinct
+    servers and 2 can die. On failure every already-started process is
+    torn down before raising."""
     import atexit
     import pathlib
 
@@ -281,7 +285,7 @@ def _spawn_cluster(root: str, cache_blocks: int = CS_CACHE_BLOCKS):
               "--data-dir", f"{root}/m0", "--http-port", "0", env=env)
         wait_ready(logdir, "master")
         cs_addrs = []
-        for i in range(3):
+        for i in range(n_cs):
             port = free_port()
             # --scrub-interval 3600: this host has ONE core; the default
             # 60 s scrubber would re-CRC the whole 384 MiB dataset mid-sweep
@@ -343,6 +347,167 @@ async def _run() -> dict:
 
         terminate_all(procs)
         tmp.cleanup()
+
+
+# ----------------------------------------------------- checkpoint bench
+#
+# ``bench.py --ckpt``: the fault-tolerant sharded-checkpoint data path
+# (tpudfs/tpu/checkpoint.py) as its own fast mode — 4-shard saves
+# (hot 3x + RS(3,2) cold copy, two-phase atomic-manifest commit), host
+# restores, and the DEGRADED restore: an EC-only checkpoint read back
+# with 2 of 5 chunkservers SIGKILLed, so every shard comes out of
+# RS(3,2) reconstruction, CRC-verified end-to-end. CPU-safe (host
+# restore path; no device windows), so the numbers hold on the
+# cpu-fallback host too. vs_baseline = save GB/s over plain 3x
+# create_file GB/s of the same logical bytes measured in-run — the cost
+# of checkpoint semantics (staging + EC cold copy + spec + verify +
+# publish) relative to raw replicated writes.
+
+CKPT_SHARDS = 4
+CKPT_TREE_KIB = 4 * 1024  # ~3.25 MiB payload/shard (see ckpt_tree's mix)
+CKPT_STEPS = 3            # one timed save window per step
+
+
+async def _run_ckpt() -> dict:
+    import signal as _signal
+    import tempfile
+
+    from tpudfs.client.client import Client
+    from tpudfs.common.rpc import RpcClient
+    from tpudfs.testing.ckptchaos import ckpt_tree, trees_equal
+    from tpudfs.tpu.checkpoint import CheckpointManager
+
+    tmp = tempfile.TemporaryDirectory(prefix="tpudfs-ckptbench-")
+    maddr, cs_addrs, procs = _spawn_cluster(tmp.name, n_cs=5)
+    try:
+        rpc = RpcClient()
+        client = Client([maddr], rpc_client=rpc, block_size=BLOCK_MB << 20,
+                        etag_mode="crc64")
+        deadline = asyncio.get_event_loop().time() + 60
+        while True:
+            try:
+                await client.create_file("/ckpt/probe", b"x")
+                await client.delete_file("/ckpt/probe")
+                break
+            except Exception:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.3)
+
+        trees = {step: {s: ckpt_tree(step, s, kib=CKPT_TREE_KIB)
+                        for s in range(CKPT_SHARDS)}
+                 for step in range(1, CKPT_STEPS + 1)}
+
+        # Denominator: the same logical bytes as plain 3x-replicated
+        # create_file puts (per-shard files, same concurrency as the
+        # sharded save's gather) — what the payload writes would cost
+        # without checkpoint semantics.
+        plain_samples = []
+        payloads = None
+        for rep in range(REPS):
+            from tpudfs.tpu.checkpoint import pack_shard
+
+            if payloads is None:
+                payloads = [pack_shard(trees[1][s])[0]
+                            for s in range(CKPT_SHARDS)]
+            t0 = time.perf_counter()
+            await asyncio.gather(*(
+                client.create_file(f"/ckpt/plain/r{rep}/s{i}", p)
+                for i, p in enumerate(payloads)))
+            plain_samples.append(
+                sum(len(p) for p in payloads)
+                / (time.perf_counter() - t0) / 1e9)
+            _tick(f"ckpt-plain{rep}")
+
+        mgr = CheckpointManager(client, "/ckpt/bench",
+                                num_shards=CKPT_SHARDS, ec=(3, 2))
+        save_samples, logical = [], 0
+        for step in range(1, CKPT_STEPS + 1):
+            t0 = time.perf_counter()
+            manifest = await mgr.save(step, trees[step])
+            dt = time.perf_counter() - t0
+            logical = sum(s["size"] for s in manifest["shards"])
+            save_samples.append(logical / dt / 1e9)
+            _tick(f"ckpt-save{step}")
+
+        restore_samples = []
+        out = None
+        for rep in range(REPS):
+            step = (rep % CKPT_STEPS) + 1
+            t0 = time.perf_counter()
+            out = await mgr.restore(step)
+            restore_samples.append(
+                logical / (time.perf_counter() - t0) / 1e9)
+            _tick(f"ckpt-restore{rep}")
+        assert all(trees_equal(out[s], trees[step][s])
+                   for s in range(CKPT_SHARDS)), "restore not bit-exact"
+
+        # Degraded restore: EC-ONLY checkpoint (no hot copies to fail
+        # over to), then 2 of 5 chunkservers SIGKILLed — every shard read
+        # is forced through RS(3,2) reconstruction. One untimed warm
+        # restore absorbs the dead-peer discovery (connection refusals,
+        # stale location metadata) so the windows time the decode path.
+        ec_mgr = CheckpointManager(client, "/ckpt/bench-ec",
+                                   num_shards=CKPT_SHARDS, ec=(3, 2),
+                                   hot_copies=False)
+        await ec_mgr.save(1, trees[1])
+        for p in procs[-2:]:  # procs[0] is the master; kill cs3, cs4
+            p.send_signal(_signal.SIGKILL)
+        _tick("ckpt-kill")
+        await ec_mgr.restore(1)  # untimed warm (failover discovery)
+        degraded_samples = []
+        for rep in range(REPS):
+            t0 = time.perf_counter()
+            out = await ec_mgr.restore(1)
+            degraded_samples.append(
+                logical / (time.perf_counter() - t0) / 1e9)
+            _tick(f"ckpt-degraded{rep}")
+        assert all(trees_equal(out[s], trees[1][s])
+                   for s in range(CKPT_SHARDS)), \
+            "degraded restore not bit-exact"
+
+        await rpc.close()
+        med = statistics.median
+        save, plain = med(save_samples), med(plain_samples)
+        return {
+            "metric": (
+                "sharded-checkpoint save/restore GB/s (4 shards, hot 3x "
+                "+ RS(3,2) cold copy, atomic manifest commit; degraded = "
+                "EC-only restore with 2/5 chunkservers SIGKILLed)"
+            ),
+            "value": round(save, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(save / plain, 3) if plain else 0.0,
+            "windows": REPS,
+            "ckpt_save_GBps": round(save, 3),
+            "ckpt_save_win": _winmm(save_samples),
+            "ckpt_restore_GBps": round(med(restore_samples), 3),
+            "ckpt_restore_win": _winmm(restore_samples),
+            "ckpt_restore_degraded_GBps": round(med(degraded_samples), 3),
+            "ckpt_restore_degraded_win": _winmm(degraded_samples),
+            "plain_write_GBps": round(plain, 3),
+            "ckpt_shards": CKPT_SHARDS,
+            "ckpt_steps": CKPT_STEPS,
+            "ckpt_logical_bytes_per_step": logical,
+            "etag_mode": client.etag_mode,
+            "platform": "cpu",  # host restore path; no device windows
+        }
+    finally:
+        from tpudfs.testing.procs import terminate_all
+
+        terminate_all(procs)
+        tmp.cleanup()
+
+
+def main_ckpt() -> None:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _tick("ckpt-start")
+    _start_watchdog()
+    result = asyncio.run(_run_ckpt())
+    _progress["t"] = None
+    _emit_once(result)
 
 
 #: Set by main(): the startup probe saw a live TPU, so the device phase
@@ -1359,5 +1524,7 @@ if __name__ == "__main__":
         main_standby()
     elif "--sprint" in sys.argv:
         main_sprint()
+    elif "--ckpt" in sys.argv:
+        main_ckpt()
     else:
         main()

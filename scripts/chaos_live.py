@@ -8,7 +8,7 @@ here via testing/netem.FaultProxy instead of Toxiproxy containers), and
 linearizability_test.sh (the under-fault workload history goes through the
 WGL checker).
 
-Timeline against a two-shard-HA cluster (6 masters, 6 chunkservers):
+Timeline against a two-shard-HA cluster (6 masters, 5 chunkservers):
 
   t0   write a multi-block payload, record its md5
   t1   start a 4-client workload (>= 200 ops, keys span both shards)
@@ -21,6 +21,17 @@ Timeline against a two-shard-HA cluster (6 masters, 6 chunkservers):
   t8   post-chaos write/read sanity on a fresh key
   t9   bandwidth-shape one chunkserver (overload); budgeted hedged reads
        must stay inside their deadline budget and recover after the heal
+  t10  kill-mid-checkpoint: publish a 2-shard hot-3x checkpoint, then
+       SIGKILL two MORE chunkservers while the next step's sharded save
+       is in flight (3 of 5 CS now dead). The latest published step must
+       restore BIT-EXACT, the interrupted save must RESUME to completion
+       (idempotent content-ETag re-puts; replication degrades to the 2
+       survivors with healer repair), and the namespace must never list
+       a torn checkpoint. Hot-only on purpose: EC allocation hard-fails
+       below k+m live chunkservers, so the RS cold-copy path is chaos'd
+       where the survivor count supports it (the roulette ckpt axis) and
+       the EC-reconstruction restore is proven by the unit tier and the
+       degraded bench.
 
 Run directly or via scripts/run_all_tests.py (the CI live tier).
 """
@@ -231,6 +242,66 @@ async def chaos(eps: dict) -> None:
           f"{time.monotonic() - t0:.2f}s")
     await ov_proxy.stop()
     await ov_client.close()
+
+    # t10: kill-mid-checkpoint. Hot-only (no EC cold copy): with t2's kill
+    # plus two more here only 2 of 5 chunkservers survive, and EC
+    # allocation hard-fails below k+m live servers while 3x replication
+    # degrades (healer repairs when capacity returns) — the resume must be
+    # able to finish on the survivors.
+    from tpudfs.testing.ckptchaos import assert_restores_bit_exact, ckpt_tree
+    from tpudfs.tpu.checkpoint import CheckpointManager
+
+    ck_client = Client(masters, config_addrs=[eps["config_server"]],
+                       block_size=256 * 1024, rpc_timeout=3.0,
+                       max_retries=8, tls=tls)
+    ck = CheckpointManager(ck_client, "/a/chaos-ckpt",
+                           num_shards=2, ec=None)
+    trees_by_step = {s: {sh: ckpt_tree(s, sh, kib=768) for sh in range(2)}
+                     for s in (1, 2)}
+    await ck.save(1, trees_by_step[1])
+    print("t10: checkpoint step 1 published (pre-kill baseline)")
+
+    live_cs = [n for n in procs
+               if n.startswith("cs") and n != dead_cs][:2]
+    save_task = asyncio.create_task(ck.save(2, trees_by_step[2]))
+    await asyncio.sleep(0.05)
+    mid_save = not save_task.done()
+    for victim in live_cs:
+        os.kill(procs[victim]["pid"], signal.SIGKILL)
+    when = "mid-save of step 2" if mid_save else \
+        "after step 2 completed (DEGENERATE: kills missed the save window)"
+    print(f"t10: SIGKILLed {live_cs} {when}")
+    try:
+        await save_task
+        print("t10: in-flight save of step 2 rode out the kills")
+    except Exception as e:
+        print(f"t10: in-flight save interrupted ({type(e).__name__}: {e})")
+
+    # Resume the (possibly torn) step-2 save to completion. Allocations
+    # may still target the freshly-killed chunkservers until the 15 s
+    # liveness cutoff prunes them — retry through that window; every
+    # shard that already landed durably is skipped by its content ETag.
+    deadline = time.time() + 60
+    while True:
+        try:
+            await ck.save(2, trees_by_step[2])
+            break
+        except Exception as e:
+            if time.time() > deadline:
+                raise SystemExit(
+                    f"t10: step-2 save never resumed to completion: {e}")
+            await asyncio.sleep(1.0)
+    steps = await ck.list_steps()
+    assert steps == [1, 2], (
+        f"t10: namespace lists {steps}, want [1, 2] — a torn or missing "
+        "checkpoint is visible")
+    for s in steps:
+        assert_restores_bit_exact(await ck.restore(s), s, kib=768)
+    print(f"t10: steps {steps} restore bit-exact with 3/5 chunkservers "
+          f"dead (resume skipped {ck.stats['shards_skipped']} durable "
+          f"shard copies, {ck.stats['degraded_shard_reads']} degraded "
+          f"shard reads)")
+    await ck_client.close()
 
     await proxy.stop()
     await client.close()

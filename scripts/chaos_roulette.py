@@ -30,6 +30,16 @@ AXES (round-5 expansion — the round-4 plans centered on kills):
   budget + grace — bounded failure is acceptable under combined faults,
   hanging is not — retry volume must stay within the 2x retry budget,
   and the read must succeed after the shaping lifts.
+- ``ckpt``: a 2-shard CheckpointManager (tpudfs/tpu/checkpoint.py,
+  hot 3x + RS(2,1)) saves sequential steps THROUGH the fault window —
+  interrupted saves are expected and logged, never fatal. Post-faults:
+  the last interrupted step is RESUMED to completion (idempotent
+  content-ETag skips), every step the namespace lists as published
+  restores BIT-EXACT against its regenerated canonical tree
+  (tpudfs/testing/ckptchaos.py), and no torn step is ever listed.
+  RS(2,1) rather than (3,2) on purpose: killed chunkservers stay dead
+  for the round, and the post-fault resume must still be able to place
+  k+m EC shards on the 3 guaranteed survivors.
 
 Safety caps keep every plan survivable by design, so any failure is a
 REAL bug, not an over-killed cluster: at most 2 of the 5 chunkservers
@@ -119,6 +129,7 @@ def make_axes(rng: random.Random) -> dict:
         "torn": "torn" in forced or rng.random() < 0.5,
         "tiering": "tiering" in forced or rng.random() < 0.4,
         "overload": "overload" in forced or rng.random() < 0.4,
+        "ckpt": "ckpt" in forced or rng.random() < 0.35,
     }
 
 
@@ -204,6 +215,20 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
         print(f"  overload axis: shaping {slow} ({slow_addr}) to "
               f"256 KiB/s (+0.3 s/chunk)")
 
+    # Ckpt axis: sequential sharded saves THROUGH the fault window on a
+    # dedicated client; which steps publish (and which get torn) depends
+    # on where the kills land.
+    ck_client = ck_mgr = None
+    ck_published: set[int] = set()
+    ck_attempted = 0
+    if axes.get("ckpt"):
+        from tpudfs.tpu.checkpoint import CheckpointManager
+        ck_client = Client(masters, config_addrs=[eps["config_server"]],
+                           block_size=256 * 1024, rpc_timeout=3.0,
+                           max_retries=8, tls=tls)
+        ck_mgr = CheckpointManager(ck_client, "/a/roulette-ckpt",
+                                   num_shards=2, ec=(2, 1))
+
     wl_client = Client(masters, config_addrs=[eps["config_server"]],
                        rpc_timeout=3.0, max_retries=8,
                        host_aliases=aliases, tls=tls)
@@ -278,6 +303,28 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
             print(f"  +{torn_cancel_at:.1f}s cancelled torn write "
                   f"mid-session")
 
+    async def checkpointer() -> None:
+        nonlocal ck_attempted
+        if ck_mgr is None:
+            return
+        from tpudfs.common.resilience import BudgetExhausted
+        from tpudfs.testing.ckptchaos import ckpt_tree
+        for step in range(1, 5):
+            ck_attempted = step
+            trees = {s: ckpt_tree(step, s) for s in range(2)}
+            try:
+                await ck_mgr.save(step, trees)
+                ck_published.add(step)
+                print(f"  ckpt axis: step {step} published under faults")
+            except (DfsError, BudgetExhausted, asyncio.TimeoutError,
+                    OSError) as e:
+                # An interrupted save is the point of the axis; whether
+                # the commit actually landed is decided post-faults from
+                # what the namespace LISTS, not from this exception.
+                print(f"  ckpt axis: step {step} save interrupted "
+                      f"({type(e).__name__})")
+            await asyncio.sleep(rng.uniform(0.2, 0.8))
+
     async def overloaded_reader() -> None:
         if ov_client is None:
             return
@@ -296,7 +343,7 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
             await asyncio.sleep(0.5)
 
     await asyncio.gather(workload, injector(), torn_killer(),
-                         overloaded_reader())
+                         overloaded_reader(), checkpointer())
     entries = workload.result()
     ok_ops = sum(1 for e in entries if e.get("return_ts") is not None)
     print(f"  workload: {len(entries)} ops ({ok_ops} returned)")
@@ -420,6 +467,40 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
         print(f"  overload axis: walls "
               f"{['%.2f' % w for w in ov_walls]} <= {ov_budget_grace}s, "
               f"retries {orc}, healed read ok")
+    if ck_mgr is not None:
+        from tpudfs.testing.ckptchaos import (
+            assert_restores_bit_exact, ckpt_tree,
+        )
+        listed = await settle("ckpt list", ck_mgr.list_steps)
+        # The save-loop's view is a lower bound: a commit whose ack was
+        # lost to a kill still published. The namespace is authoritative.
+        assert ck_published <= set(listed), (
+            f"ckpt axis: acked steps {sorted(ck_published)} missing from "
+            f"listed {listed} (round {rnd}); plan: {plan}")
+        resume = ck_attempted if ck_attempted > max(listed, default=0) else 0
+        if resume:
+            # Finish the interrupted save: idempotent re-puts skip every
+            # shard that already landed (content ETag), then publish.
+            trees = {s: ckpt_tree(resume, s) for s in range(2)}
+            await settle(f"ckpt resume step {resume}",
+                         lambda: ck_mgr.save(resume, trees))
+            listed = await settle("ckpt relist", ck_mgr.list_steps)
+            assert resume in listed, (
+                f"ckpt axis: resumed step {resume} not listed "
+                f"(round {rnd}); plan: {plan}")
+        assert listed, (
+            f"ckpt axis: no step published or resumable (round {rnd}); "
+            f"plan: {plan}")
+        # EVERY step the namespace lists must restore bit-exact — a torn
+        # checkpoint that is visible at all is the bug this axis hunts.
+        for s in listed:
+            trees = await settle(f"ckpt restore step {s}",
+                                 lambda s=s: ck_mgr.restore(s))
+            assert_restores_bit_exact(trees, s)
+        print(f"  ckpt axis: steps {listed} all restore bit-exact "
+              f"(resumed {resume or 'none'}; "
+              f"degraded reads {ck_mgr.stats['degraded_shard_reads']}, "
+              f"shards skipped on resume {ck_mgr.stats['shards_skipped']})")
     for prefix in ("/a/", "/z/"):
         deadline = time.time() + 45
         while True:
@@ -441,6 +522,8 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
         await ov_proxy.stop()
     if ov_client is not None:
         await ov_client.close()
+    if ck_client is not None:
+        await ck_client.close()
     await client.close()
     await wl_client.close()
     await v_client.close()

@@ -246,6 +246,14 @@ def main() -> None:
             [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
              "--seed=2468", "--force-axes=overload",
              "--topology", args.topology])
+        # Ckpt-pinned round: a 2-shard sharded checkpoint saves steps
+        # through the seeded fault window — interrupted saves resume to
+        # completion, every listed step restores bit-exact, and no torn
+        # checkpoint is ever visible (the atomic-manifest-commit tier).
+        run("live chaos roulette (ckpt axis)",
+            [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
+             "--seed=3579", "--force-axes=ckpt",
+             "--topology", args.topology])
         # Add a 4th master to a RUNNING group under workload, remove the
         # old leader, verify discovery + no write loss (reference
         # dynamic_membership_test.sh / cluster_membership_test.sh).

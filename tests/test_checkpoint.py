@@ -1,0 +1,333 @@
+"""Fault-tolerant sharded checkpoints: format, two-phase commit atomicity,
+resumable saves, degraded restore, GC (client + master control-plane
+exemption) and the stage→SIGKILL→restart blockstore regression."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tests.test_master_service import MiniCluster
+from tpudfs.chunkserver.blockstore import (
+    BlockCorruptionError,
+    BlockNotFoundError,
+    BlockStore,
+)
+from tpudfs.client.client import ChecksumMismatchError, Client, DfsError
+from tpudfs.common import ckptpaths
+from tpudfs.common.checksum import crc32c
+from tpudfs.common.resilience import deadline_scope
+from tpudfs.common.rpc import RpcError
+from tpudfs.testing.ckptchaos import assert_restores_bit_exact, ckpt_tree, trees_equal
+from tpudfs.tpu.checkpoint import (
+    CheckpointManager,
+    CheckpointNotFoundError,
+    IncompleteCheckpointError,
+    pack_shard,
+    unpack_shard,
+)
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
+
+# ------------------------------------------------------------- pure format
+
+
+def test_pack_unpack_roundtrip_and_alignment():
+    tree = ckpt_tree(3, 1)
+    payload, specs = pack_shard(tree)
+    # Deterministic: same tree -> byte-identical payload (the resume
+    # probe's soundness rests on this).
+    payload2, _ = pack_shard(dict(reversed(list(tree.items()))))
+    assert payload == payload2
+    for spec in specs:
+        assert spec.offset % 512 == 0
+    out = unpack_shard(payload, [s.to_dict() for s in specs])
+    assert trees_equal(out, tree)
+
+
+def test_unpack_detects_torn_payload():
+    payload, specs = pack_shard({"w": np.arange(1024, dtype=np.int32)})
+    torn = bytearray(payload)
+    torn[100] ^= 0xFF
+    with pytest.raises(ChecksumMismatchError):
+        unpack_shard(bytes(torn), [s.to_dict() for s in specs])
+
+
+def test_ckptpaths_parse():
+    base = "/ckpt/run1"
+    m = ckptpaths.manifest_path(base, 7)
+    assert ckptpaths.parse_manifest_path(m) == (base, 7)
+    assert ckptpaths.parse_manifest_path("/ckpt/run1/MANIFEST-xyz") is None
+    p = ckptpaths.shard_data_path(base, 7, 2)
+    assert ckptpaths.parse_step_path(p) == (base, 7)
+    assert ckptpaths.parse_step_path("/user/data/file.bin") is None
+    # A path that merely *mentions* the staging dir with no step component
+    # is not staging.
+    assert ckptpaths.parse_step_path("/a/.ckpt/notdigits/x") is None
+
+
+# --------------------------------------------------------------- clusters
+
+
+async def _ready(tmp_path, n_cs=3, block_size=64 * 1024, **kw):
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=n_cs, **kw)
+    await c.start()
+    leader = await c.leader()
+    await c.wait_out_of_safe_mode(leader)
+    client = Client(list(c.masters), rpc_client=c.client,
+                    block_size=block_size)
+    return c, client, leader
+
+
+async def test_save_restore_roundtrip_host_and_device(tmp_path):
+    import jax
+    from tpudfs.tpu.hbm_reader import HbmReader
+
+    c, client, _ = await _ready(tmp_path)
+    try:
+        device = jax.devices()[0]
+        mgr = CheckpointManager(client, "/ckpt/run1", num_shards=2,
+                                ec=(2, 1), reader=HbmReader(client, [device]))
+        trees = {s: ckpt_tree(1, s) for s in range(2)}
+        manifest = await mgr.save(1, trees)
+        assert manifest["step"] == 1
+        assert await mgr.list_steps() == [1]
+        # Host restore: bit-exact through the replicated hot copy.
+        assert_restores_bit_exact(await mgr.restore(), 1)
+        # Device restore: blocks verified on-device, tensors assembled
+        # from the word stream (bitcast f4/i4, host bounce for int8).
+        dev_trees = await mgr.restore(1, device=device)
+        assert_restores_bit_exact(
+            {s: {k: np.asarray(v) for k, v in t.items()}
+             for s, t in dev_trees.items()}, 1)
+        for t in dev_trees.values():
+            for arr in t.values():
+                assert isinstance(arr, jax.Array)
+    finally:
+        await c.stop()
+
+
+async def test_resumed_save_skips_durable_shards(tmp_path):
+    c, client, _ = await _ready(tmp_path)
+    try:
+        base = "/ckpt/resume"
+        mgr = CheckpointManager(client, base, num_shards=2, ec=(2, 1))
+        # First attempt dies after shard 0 (simulated preemption: only
+        # shard 0 was written, no commit).
+        await mgr.save_shard(5, 0, ckpt_tree(5, 0))
+        assert await mgr.list_steps() == []  # nothing visible
+        # The restarted replica re-runs the whole save. Shard 0's payload
+        # files are already durable -> probed and skipped, shard 1 written.
+        mgr2 = CheckpointManager(client, base, num_shards=2, ec=(2, 1))
+        await mgr2.save(5, {s: ckpt_tree(5, s) for s in range(2)})
+        assert mgr2.stats["shards_skipped"] == 2  # shard 0: .bin + .ec
+        assert await mgr2.latest_step() == 5
+        assert_restores_bit_exact(await mgr2.restore(), 5)
+    finally:
+        await c.stop()
+
+
+async def test_torn_checkpoint_never_listed_or_restorable(tmp_path):
+    c, client, _ = await _ready(tmp_path)
+    try:
+        base = "/ckpt/torn"
+        mgr = CheckpointManager(client, base, num_shards=2, ec=None)
+        await mgr.save(1, {s: ckpt_tree(1, s) for s in range(2)})
+        # Step 2 is interrupted mid-save: one shard landed, no manifest.
+        await mgr.save_shard(2, 0, ckpt_tree(2, 0))
+        assert await mgr.list_steps() == [1]
+        with pytest.raises(CheckpointNotFoundError):
+            await mgr.read_manifest(2)
+        with pytest.raises(IncompleteCheckpointError):
+            await mgr.commit(2)
+        # Even a fully staged manifest that never published stays invisible.
+        await client.create_file(
+            ckptpaths.staged_manifest_path(base, 3), b"{}", overwrite=True)
+        assert await mgr.list_steps() == [1]
+        assert_restores_bit_exact(await mgr.restore(), 1)
+    finally:
+        await c.stop()
+
+
+async def test_publish_is_idempotent_and_monotonic(tmp_path):
+    c, client, _ = await _ready(tmp_path)
+    try:
+        base = "/ckpt/mono"
+        mgr = CheckpointManager(client, base, num_shards=1, ec=None)
+        await mgr.save(2, {0: ckpt_tree(2, 0)})
+        # Replayed commit of the same step converges as a no-op.
+        await mgr.commit(2)
+        assert mgr.stats["already_published"] == 1
+        assert await mgr.list_steps() == [2]
+        # A zombie writer replaying an OLDER step is fenced at apply time.
+        zombie = CheckpointManager(client, base, num_shards=1, ec=None)
+        await zombie.save_shard(1, 0, ckpt_tree(1, 0))
+        with pytest.raises(DfsError, match="stale"):
+            await zombie.commit(1)
+        assert await mgr.list_steps() == [2]
+    finally:
+        await c.stop()
+
+
+async def test_restore_with_two_chunkservers_dead_via_ec(tmp_path):
+    """Acceptance: 2 of 5 chunkservers permanently dead -> the EC cold
+    copy reconstructs every shard, CRC-verified end-to-end."""
+    c, client, _ = await _ready(tmp_path, n_cs=5)
+    try:
+        base = "/ckpt/degraded"
+        mgr = CheckpointManager(client, base, num_shards=2, ec=(3, 2),
+                                hot_copies=False)
+        await mgr.save(1, {s: ckpt_tree(1, s) for s in range(2)})
+        for i in (0, 1):  # permanent: processes stopped, never restarted
+            c.heartbeats[i].stop()
+            await c.chunkservers[i].stop()
+        assert_restores_bit_exact(await mgr.restore(), 1)
+    finally:
+        await c.stop()
+
+
+async def test_restore_falls_back_from_hot_to_ec(tmp_path):
+    c, client, _ = await _ready(tmp_path, n_cs=5)
+    try:
+        base = "/ckpt/fallback"
+        mgr = CheckpointManager(client, base, num_shards=1, ec=(3, 2))
+        await mgr.save(1, {0: ckpt_tree(1, 0)})
+        # Kill the hot copy outright; restore must degrade to EC
+        # reconstruction per shard instead of failing.
+        await client.delete_file(ckptpaths.shard_data_path(base, 1, 0))
+        assert_restores_bit_exact(await mgr.restore(), 1)
+        assert mgr.stats["degraded_shard_reads"] == 1
+    finally:
+        await c.stop()
+
+
+async def test_prune_deletes_manifest_first_and_gc_incomplete(tmp_path):
+    c, client, _ = await _ready(tmp_path)
+    try:
+        base = "/ckpt/gc"
+        mgr = CheckpointManager(client, base, num_shards=1, ec=None)
+        for step in (1, 2, 3):
+            await mgr.save(step, {0: ckpt_tree(step, 0)})
+        assert await mgr.prune(keep=2) == [1]
+        assert await mgr.list_steps() == [2, 3]
+        files = await client.list_files(ckptpaths.step_prefix(base, 1))
+        assert files == []
+        # Client-side incomplete GC: an abandoned (superseded) staging
+        # prefix is removed; published data and fresh in-flight work stay.
+        abandoned = ckptpaths.shard_data_path(base, 0, 0)
+        await client.create_file(abandoned, b"abandoned save")
+        await mgr.save_shard(4, 0, ckpt_tree(4, 0))  # in-flight, not stale
+        deleted = await mgr.gc_incomplete(max_age_ms=10**9)
+        assert deleted == [abandoned]
+        assert await client.list_files(ckptpaths.step_prefix(base, 4)) != []
+        assert_restores_bit_exact(await mgr.restore(), 3)
+    finally:
+        await c.stop()
+
+
+async def test_master_ckpt_gc_shielded_and_shed_exempt(tmp_path, monkeypatch):
+    """Satellite: incomplete-checkpoint GC is control-plane — it must run
+    to completion under an expired ambient deadline AND while the
+    admission shedder is saturated (the exact conditions that starve
+    client-side cleanup)."""
+    c, client, leader = await _ready(tmp_path)
+    try:
+        base = "/ckpt/mgc"
+        mgr = CheckpointManager(client, base, num_shards=1, ec=None)
+        await mgr.save(2, {0: ckpt_tree(2, 0)})
+        # Unpublished, superseded staging file -> collectable.
+        stale = ckptpaths.shard_data_path(base, 1, 0)
+        await client.create_file(stale, b"superseded")
+        # Fresh unpublished staging for a FUTURE step -> must be kept.
+        live = ckptpaths.shard_data_path(base, 3, 0)
+        await client.create_file(live, b"in-flight")
+
+        # Saturate admission control: namespace RPCs shed...
+        while leader.shedder.try_acquire():
+            pass
+        with pytest.raises(RpcError) as ei:
+            await c.call(leader.address, "ListFiles", {"path": base})
+        assert ei.value.code.name == "RESOURCE_EXHAUSTED"
+        # ...but the GC proposes directly, shielded from the (expired)
+        # ambient deadline, and still makes progress.
+        with deadline_scope(0.001):
+            await asyncio.sleep(0.01)
+            await leader.run_ckpt_gc()
+        assert leader.ckpt_gc_deleted >= 1
+        for _ in range(leader.shedder.max_inflight):
+            leader.shedder.release()
+        assert await client.get_file_info(stale) is None
+        assert await client.get_file_info(live) is not None
+        # TTL rule: with the age floor at zero the fresh file goes too.
+        monkeypatch.setenv("TPUDFS_CKPT_GC_AGE_SECS", "0")
+        await leader.run_ckpt_gc()
+        assert await client.get_file_info(live) is None
+        # Published checkpoint data is never GC'd.
+        assert_restores_bit_exact(await mgr.restore(), 2)
+    finally:
+        await c.stop()
+
+
+# ------------------------------------------- stage -> SIGKILL -> restart
+
+_CHILD = """
+import os, signal, sys
+from tpudfs.chunkserver.blockstore import BlockStore
+store = BlockStore(sys.argv[1], sys.argv[2])
+store.write_staged("blk1", b"x" * 4096, "tok1")
+store.write_staged("blk2", b"y" * 8192, "tok2")
+print("STAGED", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+def test_sigkill_between_stage_and_publish_boot_cleanup(tmp_path):
+    """Stage blocks, SIGKILL before publish, restart: the owning store's
+    boot cleanup removes the orphan tmps and no torn block is ever
+    served."""
+    hot, cold = tmp_path / "hot", tmp_path / "cold"
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(hot), str(cold)],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "STAGED" in proc.stdout
+    orphans = list(hot.glob("*.tmp-*"))
+    assert orphans, "child should have left staged tmp files behind"
+    store = BlockStore(hot, cold, owner=True)  # restart: boot cleanup
+    assert not list(hot.glob("*.tmp-*"))
+    assert not store.exists("blk1") and not store.exists("blk2")
+    with pytest.raises(BlockNotFoundError):
+        store.read_verified("blk1")
+
+
+def test_corrupt_sidecar_quarantined_not_returned(tmp_path):
+    """A published block whose bytes no longer match the CRC sidecar (or
+    whose sidecar is mangled) must surface as BlockCorruptionError from
+    every verified read — torn bytes are never handed back."""
+    store = BlockStore(tmp_path / "hot", tmp_path / "cold", owner=True)
+    data = np.random.default_rng(7).integers(
+        0, 256, 100_000, dtype=np.uint8).tobytes()
+    store.write("blk", data)
+    assert store.read_verified("blk") == data
+    # Flip one byte of the payload on disk.
+    path = store.hot_dir / "blk"
+    raw = bytearray(path.read_bytes())
+    raw[12_345] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(BlockCorruptionError):
+        store.read_verified("blk")
+    with pytest.raises(BlockCorruptionError):
+        store.verify_full("blk")
+    # Mangled sidecar header: also corruption, not data.
+    (store.hot_dir / "blk.meta").write_bytes(b"JUNKJUNKJUNK")
+    with pytest.raises(BlockCorruptionError):
+        store.read_verified("blk")

@@ -390,7 +390,7 @@ def test_every_rule_is_registered():
     ids = set(all_rules())
     assert {"TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
             "TPL007", "TPL010", "TPL011", "TPL012", "TPL013", "TPL014",
-            "TPL020", "TPL021", "TPL022", "TPL023", "TPL024",
+            "TPL020", "TPL021", "TPL022", "TPL023", "TPL024", "TPL025",
             "TPL030", "TPL031", "TPL032", "TPL033", "TPL034"} <= ids
 
 
@@ -1470,6 +1470,68 @@ def test_tpl024_skips_dynamic_methods_and_unknown_services(tmp_path):
                     return await rpc.call(addr, "s3", "PutObject", {})
         """,
     }, rules=["TPL024"]) == []
+
+
+# ------------------------------------------------------------------ TPL025
+
+
+def test_tpl025_flags_publish_before_any_durable_write(tmp_path):
+    findings = lint(tmp_path, """
+        class Mgr:
+            async def commit(self, step):
+                await self.client.publish_checkpoint(
+                    self.base, step, "src", "dst")
+                await self.client.create_file("src", b"manifest")
+    """, rel="tpudfs/tpu/checkpoint.py", rule="TPL025")
+    assert rule_ids(findings) == ["TPL025"]
+    assert "publish" in findings[0].message
+
+
+def test_tpl025_flags_publish_dominated_on_only_one_branch(tmp_path):
+    # Must-analysis: durable on SOME path is not durable on EVERY path.
+    findings = lint(tmp_path, """
+        class Mgr:
+            async def commit(self, step, fast):
+                if not fast:
+                    await self.client.create_file("m", b"x")
+                await self.client.publish_checkpoint("b", step, "s", "d")
+    """, rel="tpudfs/tpu/checkpoint.py", rule="TPL025")
+    assert rule_ids(findings) == ["TPL025"]
+
+
+def test_tpl025_scheduled_but_unawaited_write_does_not_count(tmp_path):
+    findings = lint(tmp_path, """
+        import asyncio
+        class Mgr:
+            async def commit(self, step):
+                asyncio.create_task(self.client.create_file("m", b"x"))
+                await self.client.publish_checkpoint("b", step, "s", "d")
+    """, rel="tpudfs/tpu/checkpoint.py", rule="TPL025")
+    assert rule_ids(findings) == ["TPL025"]
+
+
+def test_tpl025_accepts_verify_then_publish_and_gathered_writes(tmp_path):
+    assert lint(tmp_path, """
+        import asyncio
+        class Mgr:
+            async def commit(self, step):
+                await self._verify_staged(step)
+                await self.client.create_file("m", b"manifest")
+                await self.client.publish_checkpoint("b", step, "s", "d")
+
+            async def commit_gathered(self, step, shards):
+                await asyncio.gather(
+                    *(self.client.create_file(p, b"x") for p in shards))
+                await self.client.rename_file("s", "d", replace=True)
+    """, rel="tpudfs/tpu/checkpoint.py", rule="TPL025") == []
+
+
+def test_tpl025_is_scoped_to_checkpoint_modules(tmp_path):
+    assert lint(tmp_path, """
+        class Mgr:
+            async def commit(self, step):
+                await self.client.publish_checkpoint("b", step, "s", "d")
+    """, rel="tpudfs/client/client.py", rule="TPL025") == []
 
 
 # --------------------------------------------------- explain + rule table

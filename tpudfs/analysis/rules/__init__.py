@@ -25,6 +25,7 @@ from tpudfs.analysis.rules import (  # noqa: F401
     lock_hygiene,
     resources,
     raft_durability,
+    ckpt_publish,
     # tpuperf performance rules (hotpath.py + bufferflow.py backed)
     perf,
 )

@@ -1,0 +1,188 @@
+"""TPL025 — checkpoint publish-before-durable ordering (sibling of TPL023).
+
+The two-phase checkpoint commit's all-or-nothing guarantee rests on one
+ordering invariant, one level above TPL023's Raft version: nothing may make
+a checkpoint *visible* — the atomic manifest publish, a rename-publish, an
+ack to the coordinator — until the shard data it references is durably
+written and verified. Publish first and crash (or lose the chunkserver)
+before the shards land, and readers restore a manifest whose payloads
+don't exist: a torn checkpoint that the staging discipline exists to make
+impossible.
+
+Proven on the CFG with a forward **must**-analysis: the lattice value is
+the set of durable-write sites executed on *every* path into a node; any
+publish-classified call whose in-state is empty has some path on which the
+checkpoint becomes visible before anything was durably staged. (TPL023 is
+the may-analysis dual — "did a send already happen on some path before
+this persist"; here dominance is the property, so the join is
+intersection.) A durable call only counts when it is actually awaited
+(directly or inside an awaited expression such as ``asyncio.gather`` —
+a ``create_task`` that is never awaited has merely *scheduled* the write).
+
+Publish calls: ``publish_*`` method tails (``publish_checkpoint``,
+``publish_manifest``, …), ``rename_file`` (the generic atomic-publish
+namespace primitive), and commit-acks (``ack``/``send_ack``). Durable
+calls: ``create_file``/``complete_file``/``publish_staged_batch``/
+``save_shard`` tails, ``write_staged*``/``verify_*``/``persist*``
+prefixes, and ``_verify_staged``. Scoped to checkpoint modules
+(``tpudfs/**/*checkpoint*``): these names are only a commit-protocol
+contract there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.cfg import Node, cfg_for
+from tpudfs.analysis.dataflow import MustAnalysis, solve
+from tpudfs.analysis.linter import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_PUBLISH_TAILS = {"rename_file", "ack", "send_ack"}
+_PUBLISH_PREFIXES = ("publish_",)
+_DURABLE_TAILS = {"create_file", "complete_file", "publish_staged_batch",
+                  "save_shard", "fsync"}
+_DURABLE_PREFIXES = ("write_staged", "verify_", "_verify_", "persist")
+
+
+def _classify_call(call: ast.Call) -> str | None:
+    """"publish" | "durable" | None for one call site."""
+    name = dotted_name(call.func) or ""
+    tail = name.split(".")[-1]
+    if tail in _PUBLISH_TAILS or tail.startswith(_PUBLISH_PREFIXES):
+        return "publish"
+    if tail in _DURABLE_TAILS or tail.startswith(_DURABLE_PREFIXES):
+        return "durable"
+    return None
+
+
+class _DurablesSeen(MustAnalysis):
+    """Must-set of durable-write sites executed on every path in."""
+
+    def __init__(self, durables: dict[int, ast.Call]):
+        self._durables = durables
+
+    def transfer(self, node: Node, value):
+        for sub in node.walk():
+            if id(sub) in self._durables:
+                value = value | {id(sub)}
+        return value
+
+
+@register
+class CheckpointPublishOrdering(Rule):
+    id = "TPL025"
+    name = "ckpt-publish-before-durable"
+    summary = ("a checkpoint publish/ack is not dominated by a durable "
+               "shard write or verification — on some path the manifest "
+               "becomes visible before the data it references is durable")
+    doc = (
+        "The two-phase checkpoint commit is all-or-nothing only if "
+        "nothing makes the checkpoint visible (manifest publish, rename-"
+        "publish, coordinator ack) before its shard data is durably "
+        "written and verified. This rule proves the ordering on the CFG "
+        "with a must-analysis: the set of awaited durable-write sites "
+        "executed on EVERY path is tracked forward, and any publish call "
+        "whose must-set is empty is flagged — some path reaches it with "
+        "nothing staged, so a crash right after leaves readers a manifest "
+        "over missing payloads. A durable write merely scheduled via "
+        "create_task does not count; only awaited writes do. Scoped to "
+        "checkpoint modules (tpudfs/**/*checkpoint*)."
+    )
+    example = """\
+async def commit(self, step):
+    await self.client.publish_checkpoint(       # visible first...
+        self.base, step, src, dst)
+    await self.client.create_file(src, body)    # ...durable after
+"""
+    fix = ("Stage and verify every shard (awaited create_file / "
+           "_verify_staged / publish_staged_batch) BEFORE the publish or "
+           "ack; never fire-and-forget the durable writes.")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not module.rel_path.startswith("tpudfs/"):
+            return
+        stem = module.rel_path.rsplit("/", 1)[-1]
+        if "checkpoint" not in stem:
+            return
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, ast.AsyncFunctionDef):
+                yield from self._check_fn(module, fn)
+
+    def _check_fn(self, module: ModuleInfo,
+                  fn: ast.AsyncFunctionDef) -> Iterator[Finding]:
+        parents: dict[int, ast.AST] = {}
+        publishes: dict[int, ast.Call] = {}
+        durables: dict[int, ast.Call] = {}
+        for sub in ast.walk(fn):
+            if module.enclosing_function(sub) is not fn:
+                continue
+            for child in ast.iter_child_nodes(sub):
+                parents[id(child)] = sub
+            if not isinstance(sub, ast.Call):
+                continue
+            kind = _classify_call(sub)
+            if kind == "publish":
+                publishes[id(sub)] = sub
+            elif kind == "durable" and self._is_awaited(sub, parents):
+                durables[id(sub)] = sub
+        if not publishes:
+            return
+
+        cfg = cfg_for(module, fn)
+        res = solve(cfg, _DurablesSeen(durables))
+        locator: dict[int, Node] = {}
+        for node in cfg.nodes:
+            for sub in node.walk():
+                locator.setdefault(id(sub), node)
+
+        for call in sorted(publishes.values(), key=lambda c: c.lineno):
+            node = locator.get(id(call))
+            if node is None:
+                continue
+            pair = res.get(node.index)
+            seen = pair[0] if pair and pair[0] is not None else frozenset()
+            # Durable calls in the SAME node that precede the publish
+            # lexically also dominate it (statement-granular CFG).
+            same = {
+                did for did in durables
+                if locator.get(did) is node
+                and self._precedes(durables[did], call)
+            }
+            if seen or same:
+                continue
+            name = dotted_name(call.func) or "publish"
+            yield self.finding(
+                module, call,
+                f"checkpoint publish ordering: `{name.split('.')[-1]}` "
+                "makes the checkpoint visible here, but no awaited "
+                "durable shard write/verification dominates this call — "
+                "on some path the manifest publishes before the data it "
+                "references is durable, and a crash right after leaves "
+                "readers a manifest over missing payloads; stage and "
+                "verify the shards first, then publish",
+            )
+
+    @staticmethod
+    def _is_awaited(call: ast.Call, parents: dict[int, ast.AST]) -> bool:
+        """True when ``call`` sits inside an awaited expression (directly,
+        or e.g. as an ``asyncio.gather`` argument) — walking the parent
+        chain up to the enclosing statement."""
+        node: ast.AST = call
+        while True:
+            parent = parents.get(id(node))
+            if parent is None or isinstance(parent, ast.stmt):
+                return isinstance(node, ast.Await) or isinstance(parent, ast.Await)
+            if isinstance(parent, ast.Await):
+                return True
+            node = parent
+
+    @staticmethod
+    def _precedes(a: ast.AST, b: ast.AST) -> bool:
+        return (a.lineno, a.col_offset) < (b.lineno, b.col_offset)

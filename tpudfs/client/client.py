@@ -1180,6 +1180,21 @@ class Client:
                             retry_benign=("NOT_FOUND",))
 
     @_budgeted
+    async def publish_checkpoint(self, base: str, step: int,
+                                 src: str, dst: str) -> bool:
+        """Atomically publish a staged checkpoint manifest (phase two of
+        the two-phase checkpoint commit, tpudfs/tpu/checkpoint.py). The
+        master renames ``src`` to ``dst`` in one replicated command,
+        enforcing monotonic steps per ``base`` and succeeding idempotently
+        when the step is already published — so a retried/resumed commit
+        converges instead of erroring. Returns True when THIS call
+        published the step, False when it was already published."""
+        resp, _ = await self._execute("PublishCheckpoint", {
+            "base": base, "step": int(step), "src": src, "dst": dst,
+        }, path=src)
+        return not resp.get("already_published")
+
+    @_budgeted
     async def list_files(self, prefix: str = "") -> list[str]:
         """Per-shard fan-out union (reference mod.rs:125-200)."""
         return [p for p, _ in await self.list_files_with_meta(prefix, meta=False)]

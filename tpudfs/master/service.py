@@ -26,7 +26,12 @@ import os
 import time
 import uuid
 
-from tpudfs.common.resilience import LoadShedder, admission_controlled
+from tpudfs.common import ckptpaths
+from tpudfs.common.resilience import (
+    LoadShedder,
+    admission_controlled,
+    shielded_from_deadline,
+)
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
 from tpudfs.common.sharding import ShardMap
 from tpudfs.master import autoshard, placement
@@ -57,6 +62,13 @@ METRICS_DECAY_INTERVAL = 5.0  # reference master.rs:1421-1427
 SPLIT_DETECTOR_INTERVAL = 5.0  # reference master.rs:1495
 DATA_SHUFFLER_INTERVAL = 10.0  # reference master.rs:1325
 STAGED_INGEST_TTL_MS = 600_000  # abandoned-stage GC horizon
+CKPT_GC_INTERVAL = 60.0  # incomplete-checkpoint staging GC cadence
+#: Unpublished staging files older than this are collectable even when no
+#: newer checkpoint superseded them (env-overridable for chaos/tests).
+CKPT_GC_AGE_SECS = 3600.0
+#: Per-cycle delete cap: GC is a janitor, not a bulk deleter — it must not
+#: monopolize the Raft pipeline right after a big checkpoint is abandoned.
+CKPT_GC_MAX_DELETES = 64
 DEFAULT_COLD_THRESHOLD_SECS = 7 * 24 * 3600  # reference: COLD_THRESHOLD_SECS
 DEFAULT_EC_THRESHOLD_SECS = 30 * 24 * 3600  # reference: EC_THRESHOLD_SECS
 EC_CONVERSION_SHAPE = (6, 3)  # reference RS(6,3), master.rs:2016-2138
@@ -151,7 +163,11 @@ class Master:
             "metrics_decay": iv.get("metrics_decay", METRICS_DECAY_INTERVAL),
             "split_detector": iv.get("split_detector", SPLIT_DETECTOR_INTERVAL),
             "data_shuffler": iv.get("data_shuffler", DATA_SHUFFLER_INTERVAL),
+            "ckpt_gc": iv.get("ckpt_gc", CKPT_GC_INTERVAL),
         }
+        #: Staging files removed by the incomplete-checkpoint GC
+        #: (observability/tests).
+        self.ckpt_gc_deleted = 0
         self.monitor = autoshard.ThroughputMonitor(
             split_threshold_rps=split_threshold_rps,
             merge_threshold_rps=merge_threshold_rps,
@@ -188,6 +204,7 @@ class Master:
             "Heartbeat": self.rpc_heartbeat,
             "RegisterChunkServer": self.rpc_register_chunk_server,
             "Rename": self.rpc_rename,
+            "PublishCheckpoint": self.rpc_publish_checkpoint,
             "SafeModeStatus": self.rpc_safe_mode_status,
             "EnterSafeMode": self.rpc_enter_safe_mode,
             "ExitSafeMode": self.rpc_exit_safe_mode,
@@ -224,6 +241,8 @@ class Master:
                                    self.run_metrics_decay))
             self._spawn(self._loop(self._intervals["data_shuffler"],
                                    self.run_data_shuffler))
+            self._spawn(self._loop(self._intervals["ckpt_gc"],
+                                   self.run_ckpt_gc))
             if self.config_servers:
                 # Prime the map BEFORE serving: without it a sharded master
                 # can't tell its keys from a peer's and could e.g. apply a
@@ -661,6 +680,81 @@ class Master:
         await self.tx.run_cross_shard_rename(src, dst, dest_shard,
                                              replace=replace)
         return {"success": True, "cross_shard": True}
+
+    @admission_controlled
+    async def rpc_publish_checkpoint(self, req: dict) -> dict:
+        """Phase two of the two-phase checkpoint commit (see
+        tpudfs/tpu/checkpoint.py + docs/checkpoint.md): atomically rename
+        the staged manifest to its published ``MANIFEST-{step}`` name. The
+        checkpoint-specific invariants — idempotent re-publish, monotonic
+        steps per base, staged manifest must be complete — live in
+        ``_apply_publish_checkpoint``, the authoritative ordering point."""
+        self._check_safe_mode()
+        src, dst = req["src"], req["dst"]
+        self._check_shard_ownership(src)
+        self._check_shard_ownership(dst)
+        self._check_migration_freeze(src, dst)
+        self._check_tx_lock(src, dst)
+        result = await self._propose({
+            "op": "publish_checkpoint", "src": src, "dst": dst,
+            "base": req["base"], "step": int(req["step"]),
+        })
+        return {"success": True,
+                "already_published": bool(result.get("already_published"))}
+
+    async def run_ckpt_gc(self) -> None:
+        """Collect unpublished checkpoint staging prefixes.
+
+        A staging file (any path under ``{base}/.ckpt/{step}/``) is garbage
+        once its step has no published manifest AND either a newer step was
+        published for the same base (the save was superseded — a preempted
+        writer's publish would be rejected as stale anyway) or the file is
+        older than TPUDFS_CKPT_GC_AGE_SECS. Files of *published* steps are
+        the checkpoint's data and are never touched here — only an explicit
+        prune removes them, manifest first.
+
+        Control-plane exemption (the PR-4 scrubber treatment): this loop
+        proposes directly — NOT through the admission-controlled RPC
+        surface — and runs shielded from any ambient deadline, because GC
+        must make progress exactly when the cluster is overloaded or
+        budget-starved; shedding or deadline-aborting it would turn
+        congestion into a permanent storage leak."""
+        if not self.raft.is_leader or self.state.safe_mode:
+            return
+        with shielded_from_deadline():
+            ttl_ms = int(1000 * float(
+                os.environ.get("TPUDFS_CKPT_GC_AGE_SECS", CKPT_GC_AGE_SECS)))
+            at = now_ms()
+            published: dict[str, set[int]] = {}
+            latest: dict[str, int] = {}
+            for p, f in self.state.files.items():
+                parsed = ckptpaths.parse_manifest_path(p)
+                if parsed is None or not f.complete:
+                    continue
+                base, step = parsed
+                published.setdefault(base, set()).add(step)
+                latest[base] = max(latest.get(base, -1), step)
+            doomed: list[str] = []
+            # Incomplete files (a writer SIGKILLed mid-put) are collectable
+            # too — they hold chunkserver blocks but are invisible to
+            # clients, so only this scan can ever free them.
+            for p, f in self.state.files.items():
+                parsed = ckptpaths.parse_step_path(p)
+                if parsed is None:
+                    continue
+                base, step = parsed
+                if step in published.get(base, ()):
+                    continue
+                superseded = latest.get(base, -1) > step
+                expired = f.created_at_ms and at - f.created_at_ms >= ttl_ms
+                if superseded or expired:
+                    doomed.append(p)
+            for p in sorted(doomed)[:CKPT_GC_MAX_DELETES]:
+                try:
+                    await self._propose({"op": "delete_file", "path": p})
+                    self.ckpt_gc_deleted += 1
+                except RpcError:
+                    return
 
     @admission_controlled
     async def rpc_list_files(self, req: dict) -> dict:

@@ -400,6 +400,53 @@ class MasterState:
         self.files[dst] = f
         return {"success": True}
 
+    def _apply_publish_checkpoint(self, cmd: dict):
+        """Atomic checkpoint publish (tpudfs/tpu/checkpoint.py phase two):
+        rename the staged manifest to its published ``MANIFEST-{step}``
+        name, with the checkpoint invariants enforced AT APPLY TIME — the
+        authoritative ordering point, exactly like the write-session fence:
+
+        - **Idempotent / level-triggered**: if the destination manifest is
+          already complete the step IS published and this command succeeds
+          as a no-op. A committer that crashed after its publish applied
+          but before the ack arrived (or a resumed replica replaying the
+          commit) converges instead of erroring.
+        - **Monotonic**: publishing a step <= the latest published step
+          for the same base is rejected — a preempted zombie coordinator
+          replaying an old commit must never clobber or interleave with a
+          newer checkpoint, so readers observe a strictly advancing chain.
+        - The staged manifest must exist and be complete (its payload is
+          durable on chunkservers) — publish never fabricates metadata.
+        """
+        from tpudfs.common import ckptpaths
+
+        src, dst = cmd["src"], cmd["dst"]
+        base, step = cmd["base"], int(cmd["step"])
+        self.check_not_migrating(src, dst)
+        existing = self.files.get(dst)
+        if existing is not None and existing.complete:
+            return {"success": True, "already_published": True}
+        latest = -1
+        mprefix = ckptpaths.manifest_list_prefix(base)
+        for p, f in self.files.items():
+            if not (f.complete and p.startswith(mprefix)):
+                continue
+            parsed = ckptpaths.parse_manifest_path(p)
+            if parsed is not None:
+                latest = max(latest, parsed[1])
+        if step <= latest:
+            raise ValueError(
+                f"stale checkpoint publish for {base}: step {step} <= "
+                f"latest published step {latest}"
+            )
+        f = self.files.get(src)
+        if f is None or not f.complete:
+            raise ValueError(f"file not found: {src}")
+        self.files.pop(src)
+        f.path = dst
+        self.files[dst] = f
+        return {"success": True}
+
     def _apply_update_access_stats(self, cmd: dict):
         f = self.files.get(cmd["path"])
         if f is not None:

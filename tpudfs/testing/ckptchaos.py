@@ -1,0 +1,56 @@
+"""Deterministic checkpoint workloads for the chaos tiers and tests.
+
+The chaos assertions are all of the form "whatever step the cluster claims
+is published must restore BIT-EXACT" — which only works when the harness
+can regenerate the exact tensor tree of any (step, shard) pair after the
+fact, without having kept a copy. ``ckpt_tree`` is that pure function:
+seeded per (step, shard), mixed dtypes (a 4-byte dtype for the device
+restore path, int8 so the host-bounce path stays covered too), and used by
+chaos_roulette's ckpt axis, chaos_live's kill-mid-checkpoint stage and the
+integration tests alike.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SEED = 0xC4F07
+
+
+def ckpt_tree(step: int, shard: int, *, kib: int = 96) -> dict:
+    """The canonical tensor tree for (step, shard): ~``kib`` KiB split
+    across float32 "weights", int32 "opt state" and an int8 tail."""
+    rng = np.random.default_rng(_SEED + 100_003 * step + shard)
+    words = (kib * 1024) // 4
+    w = words // 2
+    o = words // 4
+    return {
+        "layer0/w": rng.standard_normal(w, dtype=np.float32),
+        "opt/step_counts": rng.integers(0, 2**31 - 1, size=o, dtype=np.int32),
+        "opt/flags": rng.integers(-128, 127, size=o, dtype=np.int8),
+    }
+
+
+def trees_equal(a: dict, b: dict) -> bool:
+    """Bit-exact tree comparison (dtype + shape + every element)."""
+    if sorted(a) != sorted(b):
+        return False
+    for name in a:
+        x, y = np.asarray(a[name]), np.asarray(b[name])
+        if x.dtype != y.dtype or x.shape != y.shape:
+            return False
+        if not np.array_equal(x.view(np.uint8), y.view(np.uint8)):
+            return False
+    return True
+
+
+def assert_restores_bit_exact(trees: dict, step: int, *,
+                              kib: int = 96) -> None:
+    """``trees`` is CheckpointManager.restore()'s {shard: tree} for
+    ``step``; every shard must match its regenerated canonical tree
+    (``kib`` must match what the saver passed to :func:`ckpt_tree`)."""
+    for shard, tree in trees.items():
+        if not trees_equal(tree, ckpt_tree(step, shard, kib=kib)):
+            raise AssertionError(
+                f"checkpoint step {step} shard {shard} did not restore "
+                "bit-exact")
