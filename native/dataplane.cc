@@ -108,9 +108,10 @@ constexpr uint64_t kMaxPayload = 100ull * 1024 * 1024;
 // ----------------------------------------------------------- msgpack mini
 
 struct Value {
-  enum Kind { NIL, BOOL, INT, STR, ASTR, AINT } kind = NIL;
+  enum Kind { NIL, BOOL, INT, FLT, STR, ASTR, AINT } kind = NIL;
   bool b = false;
   int64_t i = 0;
+  double f = 0.0;
   std::string s;
   std::vector<std::string> astr;
   std::vector<int64_t> aint;
@@ -179,6 +180,22 @@ bool parse_value(Reader& r, Value* v) {
     v->kind = Value::BOOL;
     v->b = (t == 0xc3);
     return true;
+  }
+  if (t == 0xca || t == 0xcb) {
+    // float32/float64 — advisory headers like the deadline budget `_db`
+    // ride every hop; rejecting them would tear the whole connection.
+    r.u8();
+    v->kind = Value::FLT;
+    if (t == 0xca) {
+      uint32_t bits = static_cast<uint32_t>(r.be(4));
+      float f32;
+      std::memcpy(&f32, &bits, sizeof(f32));
+      v->f = f32;
+    } else {
+      uint64_t bits = r.be(8);
+      std::memcpy(&v->f, &bits, sizeof(v->f));
+    }
+    return r.ok;
   }
   if (t <= 0x7f || t >= 0xcc) {
     if (t <= 0x7f || (t >= 0xcc && t <= 0xd3) || t >= 0xe0) {

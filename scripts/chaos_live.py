@@ -32,6 +32,13 @@ Timeline against a two-shard-HA cluster (6 masters, 5 chunkservers):
        where the survivor count supports it (the roulette ckpt axis) and
        the EC-reconstruction restore is proven by the unit tier and the
        degraded bench.
+  t11  noisy neighbor: with per-tenant QoS live on the surviving
+       chunkservers (the launcher exports TPUDFS_QOS=1), an "abuser"
+       tenant floods the data path at ~10x a "fair" tenant's concurrency.
+       The fair tenant's p99 must stay within 3x its uncontended baseline
+       and its error rate under 1%, the abuser must show up throttled in
+       the per-tenant shed counters on the chunkserver ops endpoints, and
+       once the flood stops the abuser must be admitted again.
 
 Run directly or via scripts/run_all_tests.py (the CI live tier).
 """
@@ -303,6 +310,119 @@ async def chaos(eps: dict) -> None:
           f"shard reads)")
     await ck_client.close()
 
+    # t11: noisy neighbor. The launcher started every server with
+    # TPUDFS_QOS=1, so the surviving chunkservers run the tenant-aware
+    # admission plane (weighted-fair queueing + 40 ops/s per named
+    # tenant). An "abuser" tenant floods them at ~10x the "fair" tenant's
+    # concurrency; QoS must keep the fair tenant's latency and error rate
+    # bounded, visibly throttle the abuser, and re-admit the abuser once
+    # the flood stops.
+    t11_payload = os.urandom(4 * 256 * 1024)
+    t11_md5 = hashlib.md5(t11_payload).hexdigest()
+    # local_reads=False: the whole cluster is on 127.0.0.1, and the
+    # local-read short circuit would bypass server admission — QoS must
+    # be in the measured path.
+    fair = Client(masters, config_addrs=[eps["config_server"]],
+                  block_size=256 * 1024, op_budget=6.0, rpc_timeout=1.0,
+                  initial_backoff=0.05, tls=tls, tenant="fair",
+                  local_reads=False)
+    abuser = Client(masters, config_addrs=[eps["config_server"]],
+                    block_size=256 * 1024, op_budget=6.0, rpc_timeout=1.0,
+                    initial_backoff=0.05, tls=tls, tenant="abuser",
+                    local_reads=False)
+    deadline = time.time() + 45  # ride out the liveness cutoff on t10 kills
+    while True:
+        try:
+            await fair.create_file("/a/t11-payload", t11_payload,
+                                   overwrite=True)
+            break
+        except Exception as e:
+            if time.time() > deadline:
+                raise SystemExit(f"t11: payload write never succeeded: {e}")
+            await asyncio.sleep(1.0)
+
+    async def timed_fair_read(errors: list) -> float:
+        t0 = time.monotonic()
+        try:
+            got = await fair.get_file("/a/t11-payload")
+            assert hashlib.md5(got).hexdigest() == t11_md5
+        except Exception as e:
+            errors.append(e)
+        return time.monotonic() - t0
+
+    baseline = sorted([await timed_fair_read([]) for _ in range(8)])
+    base_p99 = baseline[-1]
+    print(f"t11: fair baseline p99 {base_p99:.3f}s; starting flood")
+
+    stop = asyncio.Event()
+    abuser_errors: list = []
+
+    async def flood() -> int:
+        done = 0
+
+        async def one() -> None:
+            nonlocal done
+            try:
+                await abuser.get_file("/a/t11-payload")
+                done += 1
+            except Exception as e:
+                abuser_errors.append(e)
+
+        while not stop.is_set():
+            await asyncio.gather(*(one() for _ in range(20)))
+        return done
+
+    flood_task = asyncio.create_task(flood())
+    await asyncio.sleep(1.0)  # let the flood build a backlog
+    fair_errors: list = []
+    walls = sorted([await timed_fair_read(fair_errors) for _ in range(12)])
+    stop.set()
+    abuser_ok = await flood_task
+    err_rate = len(fair_errors) / len(walls)
+    assert err_rate < 0.01, (
+        f"t11: fair tenant error rate {err_rate:.0%} under flood: "
+        f"{fair_errors}")
+    bound = max(3 * base_p99, 2.0)  # absolute floor: baseline can be ~ms
+    assert walls[-1] <= bound, (
+        f"t11: fair p99 {walls[-1]:.2f}s blew the {bound:.2f}s bound "
+        f"under a noisy neighbor")
+
+    # The abuser was actually throttled: per-tenant shed/rate-limit
+    # counters on the surviving chunkservers' ops endpoints (data port
+    # + 1000, start_cluster's convention).
+    import urllib.request
+    throttled = 0.0
+    for name, v in procs.items():
+        if not name.startswith("cs") or not v["addr"]:
+            continue
+        ops_port = int(v["addr"].rsplit(":", 1)[1]) + 1000
+        try:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{ops_port}/metrics", timeout=3
+            ).read().decode()
+        except Exception:
+            continue  # one of the t2/t10 corpses
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            if ("qos_tenant_abuser_shed_total" in line
+                    or "qos_tenant_abuser_rate_limited_total" in line):
+                throttled += float(line.split()[-1])
+    assert throttled > 0, (
+        "t11: abuser flooded but no chunkserver reported per-tenant "
+        "qos shed/rate-limit counters for it")
+
+    # Recovery: tokens refill, the former abuser reads clean again.
+    await asyncio.sleep(1.0)
+    got = await abuser.get_file("/a/t11-payload")
+    assert hashlib.md5(got).hexdigest() == t11_md5
+    print(f"t11: fair p99 {walls[-1]:.2f}s <= {bound:.2f}s under flood "
+          f"({len(fair_errors)} fair errors, abuser {abuser_ok} ok / "
+          f"{len(abuser_errors)} shed, {throttled:.0f} throttle counts); "
+          f"abuser re-admitted after flood")
+    await fair.close()
+    await abuser.close()
+
     await proxy.stop()
     await client.close()
     await wl_client.close()
@@ -327,7 +447,18 @@ def _run_once() -> None:
     use_tls = "--tls" in sys.argv
     topology = args[0] if args else \
         str(REPO / "deploy/topologies/two-shard-ha.json")
-    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"}
+    env = {**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu",
+           # t11 drives tenant-aware admission on the live cluster. The
+           # rate only bites named tenants (system traffic — everything
+           # t0-t10 sends — is never rate-limited), so earlier stages see
+           # the same admission behavior as the flat shedder.
+           # Rate 40/s + burst 12: the 20-way abuser flood (hundreds of
+           # ops/s) reliably trips per-tenant throttling, while the fair
+           # tenant's paced single stream stays far under the rate.
+           "TPUDFS_QOS": "1", "TPUDFS_QOS_RATE": "40",
+           "TPUDFS_QOS_BURST": "12",
+           "TPUDFS_QOS_QUEUE_DEPTH": "16", "TPUDFS_QOS_QUEUE_WAIT": "0.3",
+           "TPUDFS_QOS_WEIGHTS": "fair=2"}
     with tempfile.TemporaryDirectory(prefix="tpudfs-chaos-") as tmp:
         ready = pathlib.Path(tmp) / "endpoints.json"
         launcher = subprocess.Popen(

@@ -40,6 +40,14 @@ AXES (round-5 expansion — the round-4 plans centered on kills):
   RS(2,1) rather than (3,2) on purpose: killed chunkservers stay dead
   for the round, and the post-fault resume must still be able to place
   k+m EC shards on the 3 guaranteed survivors.
+- ``tenant``: the cluster boots with per-tenant QoS on (TPUDFS_QOS=1:
+  weighted-fair queueing + a per-tenant rate), and a 16-way "abuser"
+  flood runs through the whole fault window while a budgeted "fair"
+  tenant keeps reading the payload. Every fair read must stay inside
+  budget + grace (bounded failure under combined faults is acceptable,
+  hanging or starving is not), and post-faults BOTH tenants must read
+  the payload back byte-exact — the abuser's throttling must never
+  become a permanent penalty.
 
 Safety caps keep every plan survivable by design, so any failure is a
 REAL bug, not an over-killed cluster: at most 2 of the 5 chunkservers
@@ -130,6 +138,7 @@ def make_axes(rng: random.Random) -> dict:
         "tiering": "tiering" in forced or rng.random() < 0.4,
         "overload": "overload" in forced or rng.random() < 0.4,
         "ckpt": "ckpt" in forced or rng.random() < 0.35,
+        "tenant": "tenant" in forced or rng.random() < 0.35,
     }
 
 
@@ -228,6 +237,28 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
                            max_retries=8, tls=tls)
         ck_mgr = CheckpointManager(ck_client, "/a/roulette-ckpt",
                                    num_shards=2, ec=(2, 1))
+
+    # Tenant axis: QoS is live on the cluster (one_cluster_round exported
+    # TPUDFS_QOS=1), so a named-tenant flood and a budgeted fair tenant
+    # contend for admission through the whole fault window.
+    tn_fair = tn_abuser = None
+    tn_fair_walls: list[float] = []
+    tn_fair_errors: list = []
+    tn_abuser_shed = 0
+    tn_budget_grace = 6.0 + 1.0
+    tn_stop = asyncio.Event()
+    if axes.get("tenant"):
+        # local_reads=False: everything is on 127.0.0.1 and the local-read
+        # short circuit would bypass server admission entirely.
+        tn_fair = Client(masters, config_addrs=[eps["config_server"]],
+                         block_size=256 * 1024, op_budget=6.0,
+                         rpc_timeout=1.0, initial_backoff=0.05, tls=tls,
+                         tenant="fair", local_reads=False)
+        tn_abuser = Client(masters, config_addrs=[eps["config_server"]],
+                           block_size=256 * 1024, op_budget=6.0,
+                           rpc_timeout=1.0, initial_backoff=0.05, tls=tls,
+                           tenant="abuser", local_reads=False)
+        print("  tenant axis: budgeted fair reader vs 16-way abuser flood")
 
     wl_client = Client(masters, config_addrs=[eps["config_server"]],
                        rpc_timeout=3.0, max_retries=8,
@@ -342,8 +373,44 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
             ov_walls.append(time.monotonic() - t0)
             await asyncio.sleep(0.5)
 
+    async def tenant_flood() -> None:
+        if tn_abuser is None:
+            return
+
+        async def one() -> None:
+            nonlocal tn_abuser_shed
+            try:
+                await tn_abuser.get_file("/a/roulette-payload")
+            except DfsError as e:
+                if "Overloaded" in str(e):
+                    tn_abuser_shed += 1
+
+        while not tn_stop.is_set():
+            await asyncio.gather(*(one() for _ in range(16)))
+
+    async def tenant_fair_reader() -> None:
+        if tn_fair is None:
+            return
+        try:
+            for _ in range(6):
+                t0 = time.monotonic()
+                try:
+                    back = await tn_fair.get_file("/a/roulette-payload")
+                    assert hashlib.md5(back).hexdigest() == payload_md5, (
+                        f"tenant axis: fair read corrupt (round {rnd}); "
+                        f"plan: {plan}")
+                except DfsError as e:
+                    # Bounded failure under flood + kills + partitions is
+                    # acceptable; the wall-clock assert below catches hangs.
+                    tn_fair_errors.append(e)
+                tn_fair_walls.append(time.monotonic() - t0)
+                await asyncio.sleep(0.4)
+        finally:
+            tn_stop.set()  # always release the flood loop
+
     await asyncio.gather(workload, injector(), torn_killer(),
-                         overloaded_reader(), checkpointer())
+                         overloaded_reader(), checkpointer(),
+                         tenant_flood(), tenant_fair_reader())
     entries = workload.result()
     ok_ops = sum(1 for e in entries if e.get("return_ts") is not None)
     print(f"  workload: {len(entries)} ops ({ok_ops} returned)")
@@ -501,6 +568,31 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
               f"(resumed {resume or 'none'}; "
               f"degraded reads {ck_mgr.stats['degraded_shard_reads']}, "
               f"shards skipped on resume {ck_mgr.stats['shards_skipped']})")
+    if tn_fair is not None:
+        assert tn_fair_walls and max(tn_fair_walls) <= tn_budget_grace, (
+            f"tenant axis: fair read blew its deadline budget under the "
+            f"flood (walls {['%.2f' % w for w in tn_fair_walls]}, "
+            f"round {rnd}); plan: {plan}")
+        fair_ok = len(tn_fair_walls) - len(tn_fair_errors)
+        assert fair_ok >= 1, (
+            f"tenant axis: fair tenant STARVED — 0/{len(tn_fair_walls)} "
+            f"reads succeeded under the flood (round {rnd}); plan: {plan}")
+        fair_back = await settle(
+            "tenant-axis fair read",
+            lambda: tn_fair.get_file("/a/roulette-payload"))
+        assert hashlib.md5(fair_back).hexdigest() == payload_md5, \
+            f"tenant axis: post-fault fair read corrupt (round {rnd})"
+        # Re-admission: throttling the abuser must never be permanent.
+        ab_back = await settle(
+            "tenant-axis abuser re-admission read",
+            lambda: tn_abuser.get_file("/a/roulette-payload"))
+        assert hashlib.md5(ab_back).hexdigest() == payload_md5, \
+            f"tenant axis: post-fault abuser read corrupt (round {rnd})"
+        print(f"  tenant axis: fair walls "
+              f"{['%.2f' % w for w in tn_fair_walls]} <= "
+              f"{tn_budget_grace}s ({fair_ok} ok, "
+              f"{len(tn_fair_errors)} bounded failures; abuser shed "
+              f"{tn_abuser_shed}x); both tenants read clean post-faults")
     for prefix in ("/a/", "/z/"):
         deadline = time.time() + 45
         while True:
@@ -524,6 +616,9 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
         await ov_client.close()
     if ck_client is not None:
         await ck_client.close()
+    if tn_fair is not None:
+        await tn_fair.close()
+        await tn_abuser.close()
     await client.close()
     await wl_client.close()
     await v_client.close()
@@ -533,14 +628,25 @@ def one_cluster_round(rnd: int, rng: random.Random, use_tls: bool,
                       topology: str, axes: dict) -> None:
     from tpudfs.testing.livecluster import boot_cluster
 
-    tier_env = {"COLD_THRESHOLD_SECS": "1", "EC_THRESHOLD_SECS": "2",
-                "EC_SHAPE": "3,2",
-                # Scans every 3 s: the default 60 s scan fired at most
-                # once per round, at the edge — conversions must land
-                # INSIDE the fault window for the axis to mean anything.
-                "TIERING_INTERVAL_SECS": "3"} \
-        if axes.get("tiering") else None
-    with boot_cluster(topology, tls=use_tls, extra_env=tier_env) as eps:
+    extra_env: dict[str, str] = {}
+    if axes.get("tiering"):
+        extra_env.update({
+            "COLD_THRESHOLD_SECS": "1", "EC_THRESHOLD_SECS": "2",
+            "EC_SHAPE": "3,2",
+            # Scans every 3 s: the default 60 s scan fired at most
+            # once per round, at the edge — conversions must land
+            # INSIDE the fault window for the axis to mean anything.
+            "TIERING_INTERVAL_SECS": "3"})
+    if axes.get("tenant"):
+        # Per-tenant admission on every server; the rate only bites
+        # NAMED tenants (untenanted traffic maps to system, which is
+        # never rate-limited), so the other axes see stock admission.
+        extra_env.update({
+            "TPUDFS_QOS": "1", "TPUDFS_QOS_RATE": "120",
+            "TPUDFS_QOS_QUEUE_DEPTH": "16", "TPUDFS_QOS_QUEUE_WAIT": "0.3",
+            "TPUDFS_QOS_WEIGHTS": "fair=2"})
+    with boot_cluster(topology, tls=use_tls,
+                      extra_env=extra_env or None) as eps:
         asyncio.run(run_round(eps, rng, rnd, axes))
 
 

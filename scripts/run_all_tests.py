@@ -254,6 +254,15 @@ def main() -> None:
             [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
              "--seed=3579", "--force-axes=ckpt",
              "--topology", args.topology])
+        # Tenant-pinned round: the cluster boots with per-tenant QoS on
+        # and an abuser tenant floods the data path through the seeded
+        # fault window — the fair tenant stays inside its deadline budget
+        # and never starves, and both tenants read clean post-faults
+        # (the noisy-neighbor tier, docs/qos.md).
+        run("live chaos roulette (tenant axis)",
+            [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
+             "--seed=4680", "--force-axes=tenant",
+             "--topology", args.topology])
         # Add a 4th master to a RUNNING group under workload, remove the
         # old leader, verify discovery + no write loss (reference
         # dynamic_membership_test.sh / cluster_membership_test.sh).

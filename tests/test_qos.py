@@ -1,0 +1,505 @@
+"""Tenant QoS: fairness core (deficit round-robin, rate buckets, bounded
+queueing), tenant identity propagation on both RPC transports, the
+queue → rate-limit → shed degradation order, and an in-process
+noisy-neighbor chaos test where one flooding tenant saturates the cluster
+while a well-behaved tenant's latency and error rate stay bounded.
+
+Unit tests drive injected clocks; only the noisy-neighbor test touches a
+real MiniCluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import grpc
+import pytest
+
+from tpudfs.common.resilience import (
+    SYSTEM_TENANT,
+    Deadline,
+    DeficitRoundRobin,
+    LoadShedder,
+    QosRejected,
+    QosShedder,
+    RateBucket,
+    admission_controlled,
+    as_system_tenant,
+    current_tenant,
+    deadline_scope,
+    raw_tenant,
+    seed_retry_jitter,
+    set_deadline,
+    shedder_from_env,
+    tenant_scope,
+)
+from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+# ---------------------------------------------------------- tenant identity
+
+
+def test_tenant_scope_outer_wins_and_defaults_to_system():
+    assert raw_tenant() is None
+    assert current_tenant() == SYSTEM_TENANT
+    with tenant_scope("alice"):
+        assert current_tenant() == "alice"
+        with tenant_scope("bob"):  # outer identity wins, same as deadlines
+            assert current_tenant() == "alice"
+    assert raw_tenant() is None
+
+
+def test_as_system_tenant_forces_system_inside_tenant_scope():
+    with tenant_scope("alice"):
+        with as_system_tenant():
+            assert current_tenant() == SYSTEM_TENANT
+        assert current_tenant() == "alice"
+
+
+# ----------------------------------------------------- deficit round-robin
+
+
+def test_drr_ordering_under_unequal_weights():
+    drr = DeficitRoundRobin()
+    drr.weights = {"a": 2.0, "b": 1.0}
+    for i in range(6):
+        drr.push("a", f"a{i}")
+        drr.push("b", f"b{i}")
+    order = []
+    while (nxt := drr.pop()) is not None:
+        order.append(nxt[1])
+    assert len(order) == 12
+    # While both tenants are backlogged, a is served 2:1 against b.
+    while_contended = order[:9]  # b's last items drain uncontended
+    a_served = sum(1 for x in while_contended if x.startswith("a"))
+    b_served = len(while_contended) - a_served
+    assert a_served == 2 * b_served, order
+
+
+def test_drr_deep_queue_buys_no_extra_service():
+    """The noisy-neighbor property: an abuser with a 10x-deeper backlog
+    still alternates 1:1 with an equal-weight tenant."""
+    drr = DeficitRoundRobin()
+    for i in range(50):
+        drr.push("abuser", f"x{i}")
+    for i in range(5):
+        drr.push("fair", f"f{i}")
+    served = [drr.pop()[0] for _ in range(10)]
+    assert served.count("fair") == 5, served
+
+
+def test_drr_evict_and_retire():
+    drr = DeficitRoundRobin()
+    drr.push("a", 1)
+    drr.push("a", 2)
+    drr.push("b", 3)
+    assert drr.evict(lambda x: x != 2) == [1, 3]
+    assert len(drr) == 1 and drr.depth("a") == 1 and drr.depth("b") == 0
+    assert drr.pop() == ("a", 2)
+    assert drr.pop() is None
+
+
+def test_drr_skip_rate_limited_tenants():
+    drr = DeficitRoundRobin()
+    drr.push("a", 1)
+    drr.push("b", 2)
+    assert drr.pop(skip={"a"}) == ("b", 2)
+    assert drr.pop(skip={"a"}) is None  # only a left, and a is skipped
+    assert drr.pop() == ("a", 1)
+
+
+# ----------------------------------------------------------- rate buckets
+
+
+def test_rate_bucket_refill_is_monotonic_under_clock_regression():
+    clk = FakeClock()
+    b = RateBucket(rate=10.0, burst=5.0, clock=clk)
+    assert all(b.try_spend() for _ in range(5))  # burst drained
+    assert not b.try_spend()
+    clk.advance(-50.0)  # clock steps backwards
+    assert not b.try_spend()  # regression never mints tokens
+    clk.advance(50.0)  # back to where we were: no double-refill either
+    assert not b.try_spend()
+    clk.advance(0.1)  # one real token accrues
+    assert b.try_spend()
+    assert not b.try_spend()
+
+
+def test_rate_bucket_retry_after_names_the_refill_point():
+    clk = FakeClock()
+    b = RateBucket(rate=2.0, burst=1.0, clock=clk)
+    assert b.try_spend()
+    assert b.retry_after() == pytest.approx(0.5)
+    clk.advance(0.25)
+    assert b.retry_after() == pytest.approx(0.25)
+
+
+# --------------------------------------------------- QosShedder degradation
+
+
+def _shedder(**kw) -> QosShedder:
+    kw.setdefault("max_inflight", 2)
+    kw.setdefault("max_queue_wait", 0.05)
+    return QosShedder(**kw)
+
+
+async def test_qos_fast_path_admits_and_releases():
+    s = _shedder()
+    await s.acquire("alice")
+    assert s.inflight == 1
+    s.release("alice", 0.001)
+    assert s.inflight == 0
+    c = s.counters()
+    assert c["shed_admitted_total"] == 1
+    assert c["qos_tenant_alice_admitted_total"] == 1
+
+
+async def test_qos_queued_waiter_admitted_on_release_in_drr_order():
+    seed_retry_jitter(1)
+    s = _shedder(max_inflight=1, max_queue_wait=5.0,
+                 weights={"heavy": 2.0, "light": 1.0})
+    await s.acquire(SYSTEM_TENANT)  # hold the only slot
+    order: list[str] = []
+
+    async def one(tenant: str):
+        await s.acquire(tenant)
+        order.append(tenant)
+        s.release(tenant, 0.0)
+
+    tasks = [asyncio.ensure_future(one("heavy")) for _ in range(4)]
+    tasks += [asyncio.ensure_future(one("light")) for _ in range(2)]
+    await asyncio.sleep(0)  # let everyone park in the queue
+    assert len(s.queue) == 6
+    s.release(SYSTEM_TENANT, 0.0)  # frees the slot -> dispatch cascade
+    await asyncio.gather(*tasks)
+    contended = order[:6 - 1]
+    assert contended.count("heavy") >= contended.count("light"), order
+    assert s.counters()["qos_queued_total"] == 6
+
+
+async def test_qos_queue_depth_bounded_then_sheds():
+    s = _shedder(max_inflight=1, queue_depth=2, max_queue_wait=5.0)
+    await s.acquire("alice")
+    waiters = [asyncio.ensure_future(s.acquire("bob")) for _ in range(2)]
+    await asyncio.sleep(0)
+    assert s.queue.depth("bob") == 2
+    with pytest.raises(QosRejected) as ei:
+        await s.acquire("bob")  # third waiter: bob's queue slice is full
+    assert ei.value.detail == "tenant queue full"
+    assert ei.value.retry_after > 0
+    assert s.counters()["qos_tenant_bob_shed_total"] == 1
+    s.release("alice", 0.0)
+    await asyncio.wait_for(waiters[0], 1.0)
+    s.release("bob", 0.0)
+    await asyncio.wait_for(waiters[1], 1.0)
+    s.release("bob", 0.0)
+
+
+async def test_qos_deadline_expired_waiters_evicted_to_make_room():
+    clk = FakeClock()
+    s = _shedder(max_inflight=1, queue_depth=1, max_queue_wait=5.0)
+    await s.acquire("alice")
+    # Park a waiter whose ambient deadline then expires.
+    expired = Deadline(clk.now + 0.5, clk)
+    token = set_deadline(expired)
+    try:
+        stuck = asyncio.ensure_future(s.acquire("bob"))
+        await asyncio.sleep(0)
+        assert s.queue.depth("bob") == 1
+    finally:
+        from tpudfs.common import resilience as _r
+        _r._deadline.reset(token)
+    clk.advance(1.0)  # the parked waiter's deadline is now expired
+    # A fresh waiter finds bob's slice full, evicts the expired one, parks.
+    replacement = asyncio.ensure_future(s.acquire("bob"))
+    await asyncio.sleep(0.01)
+    with pytest.raises(QosRejected) as ei:
+        await stuck
+    assert "deadline expired" in ei.value.detail
+    assert s.counters()["qos_evicted_total"] == 1
+    s.release("alice", 0.0)
+    await asyncio.wait_for(replacement, 1.0)
+    s.release("bob", 0.0)
+
+
+async def test_qos_rate_limited_waiter_gets_per_tenant_retry_after():
+    seed_retry_jitter(3)
+    clk = FakeClock()
+    s = _shedder(max_inflight=8, rate=2.0, burst=1.0, max_queue_wait=0.02,
+                 clock=clk)
+    await s.acquire("bob")  # spends bob's burst token
+    with pytest.raises(QosRejected) as ei:
+        await s.acquire("bob")  # over rate: queued, then refused
+    assert ei.value.detail == "rate limited"
+    # The hint tracks bob's own refill schedule (0.5 s ± jitter).
+    assert 0.3 <= ei.value.retry_after <= 0.7
+    c = s.counters()
+    assert c["qos_rate_limited_total"] == 1
+    assert c["qos_tenant_bob_rate_limited_total"] == 1
+    # system is never rate-limited, even with the bucket configured.
+    await s.acquire(SYSTEM_TENANT)
+    s.release(SYSTEM_TENANT, 0.0)
+    s.release("bob", 0.0)
+
+
+async def test_qos_abuser_recovers_after_flood_stops():
+    """No permanent penalty: once the flood stops and tokens refill, the
+    former abuser is admitted on the fast path again."""
+    clk = FakeClock()
+    s = _shedder(max_inflight=4, rate=5.0, burst=2.0, max_queue_wait=0.02,
+                 clock=clk)
+    shed = 0
+    for _ in range(10):
+        try:
+            await s.acquire("abuser")
+            s.release("abuser", 0.0)
+        except QosRejected:
+            shed += 1
+    assert shed > 0
+    clk.advance(2.0)  # flood over; bucket refills to burst
+    await s.acquire("abuser")
+    s.release("abuser", 0.0)
+
+
+async def test_admission_controlled_takes_qos_path_and_names_tenant():
+    seed_retry_jitter(5)
+
+    class Svc:
+        def __init__(self):
+            self.shedder = _shedder(max_inflight=1, queue_depth=0,
+                                    max_queue_wait=0.01)
+
+        async def rpc_op(self, req):
+            return {"tenant": current_tenant()}
+
+    Svc.rpc_op = admission_controlled(Svc.rpc_op)
+    svc = Svc()
+    with tenant_scope("alice"):
+        assert (await svc.rpc_op({}))["tenant"] == "alice"
+    assert svc.shedder.inflight == 0  # release ran
+    svc.shedder.inflight = 1  # a stuck request holds the only slot
+    with tenant_scope("bob"), pytest.raises(RpcError) as ei:
+        await svc.rpc_op({})
+    assert ei.value.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+    assert "tenant=bob" in ei.value.message
+    assert ei.value.retry_after is not None
+
+
+def test_admission_controlled_legacy_loadshedder_path_unchanged():
+    """QoS off: the decorator must use the flat try_acquire/release plane
+    (bit-for-bit the pre-QoS behavior the overload chaos test pins)."""
+
+    class Svc:
+        def __init__(self):
+            self.shedder = LoadShedder(max_inflight=1)
+
+        async def rpc_op(self, req):
+            return {"ok": True}
+
+    Svc.rpc_op = admission_controlled(Svc.rpc_op)
+
+    async def drive():
+        svc = Svc()
+        assert (await svc.rpc_op({}))["ok"]
+        assert svc.shedder.counters()["shed_admitted_total"] == 1
+        svc.shedder.inflight = 1
+        with pytest.raises(RpcError):
+            await svc.rpc_op({})
+
+    asyncio.run(drive())
+
+
+# ------------------------------------------------------------ env plumbing
+
+
+def test_shedder_from_env_disabled_is_flat_loadshedder(monkeypatch):
+    monkeypatch.delenv("TPUDFS_QOS", raising=False)
+    monkeypatch.setenv("TPUDFS_CS_MAX_INFLIGHT", "7")
+    s = shedder_from_env("TPUDFS_CS_MAX_INFLIGHT", 64)
+    assert type(s) is LoadShedder
+    assert s.max_inflight == 7
+
+
+def test_shedder_from_env_enabled_builds_qos_from_knobs(monkeypatch):
+    monkeypatch.setenv("TPUDFS_QOS", "1")
+    monkeypatch.setenv("TPUDFS_QOS_WEIGHTS", "train=4, batch=1")
+    monkeypatch.setenv("TPUDFS_QOS_RATE", "25")
+    monkeypatch.setenv("TPUDFS_QOS_QUEUE_DEPTH", "9")
+    s = shedder_from_env("TPUDFS_MASTER_MAX_INFLIGHT", 256)
+    assert type(s) is QosShedder
+    assert s.max_inflight == 256
+    assert s.queue.weights["train"] == 4.0
+    assert s.queue.weights["batch"] == 1.0
+    assert s.rate == 25.0
+    assert s.queue_depth == 9
+
+
+# ---------------------------------------- tenant metadata over the wire
+
+
+async def test_tenant_metadata_round_trip_grpc():
+    seen = []
+
+    async def peek(_):
+        seen.append((raw_tenant(), current_tenant()))
+        return {}
+
+    server = RpcServer()
+    server.add_service("TestService", {"Peek": peek})
+    await server.start()
+    client = RpcClient()
+    try:
+        with tenant_scope("alice"):
+            await client.call(server.address, "TestService", "Peek", {})
+        await client.call(server.address, "TestService", "Peek", {})
+    finally:
+        await client.close()
+        await server.stop()
+    assert seen[0] == ("alice", "alice")
+    # Untenanted call: nothing leaks across requests; server sees system.
+    assert seen[1] == (None, SYSTEM_TENANT)
+
+
+async def test_tenant_metadata_round_trip_blockport():
+    from tpudfs.common.blocknet import BlockConnPool, BlockPortServer
+
+    seen = []
+
+    async def ping(req):
+        seen.append((raw_tenant(), current_tenant()))
+        return {"pong": True}
+
+    bp = BlockPortServer({"Ping": ping})
+    await bp.start()
+    pool = BlockConnPool()
+    try:
+        with tenant_scope("carol"):
+            resp = await pool._call_blockport(f"127.0.0.1:{bp.port}",
+                                              "Ping", {})
+        assert resp["pong"]
+        resp = await pool._call_blockport(f"127.0.0.1:{bp.port}", "Ping", {})
+        assert resp["pong"]
+    finally:
+        await pool.close()
+        await bp.stop()
+    assert seen[0] == ("carol", "carol")
+    assert seen[1] == (None, SYSTEM_TENANT)
+
+
+# ----------------------------------------------- noisy-neighbor (in-process)
+
+
+def _p99(samples: list[float]) -> float:
+    ordered = sorted(samples)
+    return ordered[min(len(ordered) - 1, int(0.99 * (len(ordered) - 1)))]
+
+
+async def test_noisy_neighbor_fair_tenant_latency_bounded(tmp_path):
+    """One tenant floods the data path at ~10x its fair share while a
+    well-behaved tenant keeps reading. The QoS contract under saturation:
+    the fair tenant's p99 stays within 3x its uncontended baseline (with an
+    absolute floor for CI noise) and its error rate under 1%, the abuser is
+    visibly throttled/shed on the chunkservers, and once the flood stops
+    the abuser is admitted again — no permanent penalty."""
+    from tests.test_master_service import MiniCluster
+    from tpudfs.client.client import Client, DfsError
+
+    seed_retry_jitter(1234)
+    c = MiniCluster(tmp_path, n_masters=1, n_cs=3,
+                    cs_kw={"python_data_plane": True})
+    await c.start()
+    try:
+        leader = await c.leader()
+        await c.wait_out_of_safe_mode(leader)
+
+        def make_client(tenant: str) -> Client:
+            return Client(list(c.masters), rpc_client=c.client,
+                          block_size=64 * 1024, op_budget=2.0,
+                          rpc_timeout=0.5, initial_backoff=0.05,
+                          local_reads=False, tenant=tenant)
+
+        fair = make_client("fair")
+        abuser = make_client("abuser")
+        payloads = {}
+        for i in range(3):
+            path = f"/qos/f{i}.bin"
+            payloads[path] = bytes([i]) * (2 * 64 * 1024)
+            await fair.create_file(path, payloads[path])
+        paths = list(payloads)
+
+        # Uncontended baseline for the fair tenant.
+        async def timed_read(client: Client, path: str,
+                             errors: list) -> float:
+            t0 = time.monotonic()
+            try:
+                assert await client.get_file(path) == payloads[path]
+            except DfsError as e:
+                errors.append(e)
+            return time.monotonic() - t0
+
+        baseline = [await timed_read(fair, p, []) for p in paths for _ in
+                    range(3)]
+        baseline_p99 = _p99(baseline)
+
+        # Swap every chunkserver's admission to the tenant-aware plane with
+        # a modest per-tenant rate — exactly what TPUDFS_QOS=1 +
+        # TPUDFS_QOS_RATE does at process start in the live chaos tier.
+        for cs in c.chunkservers:
+            cs.shedder = QosShedder(max_inflight=4, rate=30.0, burst=10,
+                                    queue_depth=8, max_queue_wait=0.2)
+
+        # Flood: the abuser launches ~10x the fair tenant's concurrency.
+        fair_errors: list = []
+        abuser_errors: list = []
+        stop = asyncio.Event()
+
+        async def flood():
+            while not stop.is_set():
+                await asyncio.gather(*(
+                    timed_read(abuser, p, abuser_errors)
+                    for p in paths for _ in range(10)
+                ))
+
+        flood_task = asyncio.ensure_future(flood())
+        await asyncio.sleep(0.1)  # let the flood build a backlog
+        fair_walls: list[float] = []
+        for _ in range(4):
+            fair_walls.extend(await asyncio.gather(
+                *(timed_read(fair, p, fair_errors) for p in paths)))
+        stop.set()
+        await flood_task
+
+        fair_ops = len(fair_walls)
+        assert len(fair_errors) / fair_ops < 0.01, fair_errors
+        bound = max(3 * baseline_p99, 1.5)  # CI floor: baseline can be ~ms
+        assert _p99(fair_walls) <= bound, \
+            f"fair p99 {_p99(fair_walls):.3f}s vs bound {bound:.3f}s"
+
+        # The abuser was actually throttled at the chunkservers.
+        throttled = 0.0
+        for cs in c.chunkservers:
+            cc = cs.shedder.counters()
+            throttled += cc.get("qos_tenant_abuser_shed_total", 0.0)
+            throttled += cc.get("qos_tenant_abuser_rate_limited_total", 0.0)
+        assert throttled > 0, \
+            [cs.shedder.counters() for cs in c.chunkservers]
+
+        # Recovery: flood over, the abuser reads clean again.
+        await asyncio.sleep(0.4)  # tokens refill
+        post: list = []
+        assert await timed_read(abuser, paths[0], post) < 2.0
+        assert not post, post
+    finally:
+        await c.stop()

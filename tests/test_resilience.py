@@ -24,11 +24,14 @@ from tpudfs.common.resilience import (
     RetryBudget,
     TokenBucket,
     attempt_timeout,
+    capped_by_key,
     current_deadline,
     deadline_scope,
     overloaded_message,
     remaining_budget,
+    retry_after_from_text,
     retry_after_hint,
+    seed_retry_jitter,
     set_deadline,
     shielded_from_deadline,
 )
@@ -215,7 +218,64 @@ def test_load_shedder_admits_to_limit_then_sheds():
     assert c["shed_total"] == 1
     assert c["shed_admitted_total"] == 3
     assert c["shed_peak_inflight"] == 2
-    assert s.retry_after() >= s.base_retry_after
+    # Hints are jittered ±25% so shed clients don't retry in lockstep.
+    assert s.retry_after() >= 0.75 * s.base_retry_after
+
+
+def test_retry_after_jitter_spreads_but_stays_bounded():
+    seed_retry_jitter(42)
+    s = LoadShedder(max_inflight=2, base_retry_after=0.1)
+    s.inflight = 2
+    hints = [s.retry_after() for _ in range(200)]
+    lo, hi = 0.75 * 0.15, 1.25 * 0.15  # pressure-scaled base ± 25%
+    assert all(lo <= h <= hi for h in hints)
+    assert len({round(h, 6) for h in hints}) > 10  # actually spread
+    seed_retry_jitter(None)
+
+
+def test_retry_after_from_text_finds_embedded_hint():
+    assert retry_after_from_text(
+        "GetFile shed by cs-a: Overloaded|0.250|limit") == 0.25
+    assert retry_after_from_text(overloaded_message(0.1, "x")) == 0.1
+    assert retry_after_from_text("no hint here") is None
+
+
+# ------------------------------------------- metrics cardinality capping
+
+
+def test_capped_by_key_top_n_plus_other_rollup():
+    counts = {f"t{i:02d}": float(i) for i in range(12)}
+    out = capped_by_key("qos_tenant", counts, top_n=3, suffix="_shed_total")
+    # Top 3 by value export individually; the other 9 roll up.
+    assert out["qos_tenant_t11_shed_total"] == 11.0
+    assert out["qos_tenant_t10_shed_total"] == 10.0
+    assert out["qos_tenant_t09_shed_total"] == 9.0
+    assert out["qos_tenant_other_shed_total"] == float(sum(range(9)))
+    assert len(out) == 4
+
+
+def test_retry_budget_counters_cap_per_target_keys():
+    rb = RetryBudget(ratio=0.0, burst=0.0)  # every retry denied
+    for i in range(RetryBudget.EXPORT_TOP_N + 5):
+        rb.acquire_retry(f"cs-{i:02d}")
+    c = rb.counters()
+    per_target = [k for k in c if k.startswith("retry_budget_denied_by_target")]
+    # Top-N individually + one _other rollup, never unbounded.
+    assert len(per_target) == RetryBudget.EXPORT_TOP_N + 1
+    assert "retry_budget_denied_by_target_other_total" in c
+    assert sum(c[k] for k in per_target) == RetryBudget.EXPORT_TOP_N + 5
+
+
+def test_breaker_board_counters_cap_per_addr_keys():
+    clk = FakeClock()
+    board = BreakerBoard(failure_threshold=1, clock=clk)
+    n = RetryBudget.EXPORT_TOP_N + 4
+    for i in range(n):
+        board.record_failure(f"10.0.0.{i}:70{i:02d}")
+    c = board.counters()
+    per_addr = [k for k in c if k.startswith("breaker_opens_by_addr")]
+    assert len(per_addr) == RetryBudget.EXPORT_TOP_N + 1
+    assert sum(c[k] for k in per_addr) == n
 
 
 # ------------------------------------------- deadline over the wire (RpcServer)

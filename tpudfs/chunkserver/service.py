@@ -36,8 +36,8 @@ from tpudfs.common.blocknet import BlockConnPool
 from tpudfs.common.checksum import crc32c, crc32c_chunks, crc32c_fold
 from tpudfs.common.erasure import encode as ec_encode, reconstruct
 from tpudfs.common.resilience import (
-    LoadShedder,
     admission_controlled,
+    shedder_from_env,
     shielded_from_deadline,
 )
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer, ServerTls
@@ -250,9 +250,10 @@ class ChunkServer:
         #: RESOURCE_EXHAUSTED + retry-after instead of queueing — control
         #: RPCs (DataPort/Stats/LocalAccess) stay exempt so discovery and
         #: liveness keep working while the data plane sheds.
-        self.shedder = LoadShedder(
-            max_inflight=int(os.environ.get("TPUDFS_CS_MAX_INFLIGHT", "64"))
-        )
+        # TPUDFS_QOS=1 upgrades this to the tenant-aware QosShedder
+        # (weighted-fair queue + per-tenant rate limits); default stays the
+        # flat LoadShedder.
+        self.shedder = shedder_from_env("TPUDFS_CS_MAX_INFLIGHT", 64)
         #: Testing failpoint (seconds of injected delay on data-path RPCs).
         #: Set/cleared via tpudfs.testing.netem.slow_server()/heal_server()
         #: — the overload chaos tiers use it to model a degraded disk/NIC.
@@ -393,8 +394,18 @@ class ChunkServer:
             # cluster NEVER falls back to a plaintext engine.
             # build_and_load may run make on first use — off the loop.
             lib = await asyncio.to_thread(native.build_and_load)
+            # Tenant QoS (TPUDFS_QOS=1) is enforced by admission_controlled
+            # wrappers on the Python handlers; the C++ engine serves reads
+            # and the write chain without ever entering Python, so a
+            # QoS-enabled chunkserver must run the asyncio blockport or the
+            # per-tenant fair queue would see none of the data traffic.
+            qos_active = getattr(self.shedder, "acquire", None) is not None
+            if qos_active and native.has_dataplane() \
+                    and not self.python_data_plane:
+                logger.info("tenant QoS active: using asyncio blockport so "
+                            "data-path traffic passes per-tenant admission")
             if native.has_dataplane() and not self.python_data_plane \
-                    and self._ici_group is None:
+                    and not qos_active and self._ici_group is None:
                 # ICI members run the asyncio blockport: its handlers
                 # route through rpc_write_block, where the collective
                 # write path lives (the C++ engine serves the whole chain

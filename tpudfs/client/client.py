@@ -24,6 +24,7 @@ lands blocks directly in device memory as sharded jax.Arrays.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import time
 import logging
@@ -43,6 +44,7 @@ from tpudfs.common.resilience import (
     deadline_scope,
     remaining_budget,
     shielded_from_deadline,
+    tenant_scope,
 )
 from tpudfs.common.rpc import ClientTls, RpcClient, RpcError
 from tpudfs.common.sharding import ShardMap
@@ -93,10 +95,13 @@ def _budgeted(fn):
     With ``op_budget`` set, every RPC attempt, retry sleep and hedge under
     this operation is clamped to one shared remaining budget that also rides
     RPC metadata to every downstream hop. An ambient deadline from an outer
-    caller always wins (deadline_scope only installs when none is active)."""
+    caller always wins (deadline_scope only installs when none is active).
+    The client's configured tenant identity is installed the same way, so
+    per-op RPCs carry ``x-tenant``/``_tn`` unless an outer caller (the S3
+    gateway's authenticated principal) already set one."""
 
     async def wrapped(self, *args, **kwargs):
-        with deadline_scope(self.op_budget):
+        with deadline_scope(self.op_budget), tenant_scope(self.tenant):
             return await fn(self, *args, **kwargs)
 
     wrapped.__name__ = fn.__name__
@@ -123,6 +128,7 @@ class Client:
         host_aliases: dict[str, str] | None = None,
         local_reads: bool | None = None,
         etag_mode: str = "md5",
+        tenant: str | None = None,
     ):
         if not master_addrs and not config_addrs:
             raise ValueError("need master_addrs or config_addrs")
@@ -140,6 +146,13 @@ class Client:
         #: rides RPC metadata to every downstream hop, and the op fails
         #: (bounded) instead of overshooting. None = legacy flat timeouts.
         self.op_budget = op_budget
+        #: Tenant identity sent as metadata on every RPC this client makes
+        #: (``x-tenant``/``_tn``) so server-side QoS charges this workload
+        #: its own fair share. An ambient tenant from an outer caller (e.g.
+        #: the S3 gateway's authenticated principal) always wins; None means
+        #: the servers account the traffic to ``system``.
+        self.tenant = tenant if tenant is not None else (
+            os.environ.get("TPUDFS_TENANT") or None)
         #: Token-bucket retry throttle per target address: retries/hedges
         #: are capped at a fixed fraction of first-try volume so a slow
         #: server sees shrinking — not amplified — load.
@@ -338,9 +351,15 @@ class Client:
     # --------------------------------------------------------- RPC executor
 
     def _op_scope(self):
-        """Deadline scope for one public operation (no-op when unbudgeted;
-        an ambient deadline from an outer caller always wins)."""
-        return deadline_scope(self.op_budget)
+        """Deadline + tenant scope for one public operation (no-op when
+        unbudgeted/untenanted; ambient values from an outer caller win)."""
+
+        @contextlib.contextmanager
+        def scope():
+            with deadline_scope(self.op_budget), tenant_scope(self.tenant):
+                yield
+
+        return scope()
 
     @staticmethod
     async def _paced_sleep(delay: float) -> None:

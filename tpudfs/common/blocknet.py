@@ -44,12 +44,15 @@ import grpc
 import msgpack
 
 from tpudfs.common.resilience import (
+    TENANT_FRAME_KEY,
     BreakerBoard,
     BudgetExhausted,
     Deadline,
     attempt_timeout,
+    raw_tenant,
     remaining_budget,
     set_deadline,
+    set_tenant,
 )
 from tpudfs.common.rpc import ClientTls, RpcClient, RpcError, ServerTls
 
@@ -265,6 +268,9 @@ class BlockPortServer:
                 dl_token = set_deadline(
                     Deadline.after(budget) if budget is not None else None
                 )
+                # Tenant parity with the gRPC plane's x-tenant metadata.
+                tn = req.pop(TENANT_FRAME_KEY, None)
+                tn_token = set_tenant(tn if isinstance(tn, str) and tn else None)
                 try:
                     resp = await fn(req)
                 except RpcError as e:
@@ -285,6 +291,10 @@ class BlockPortServer:
                 finally:
                     try:
                         dl_token.var.reset(dl_token)
+                    except ValueError:
+                        pass
+                    try:
+                        tn_token.var.reset(tn_token)
                     except ValueError:
                         pass
                 out = dict(resp)
@@ -491,6 +501,9 @@ class BlockConnPool:
             rem = remaining_budget()
             if rem is not None:
                 header["_db"] = rem
+            tenant = raw_tenant()
+            if tenant is not None:
+                header[TENANT_FRAME_KEY] = tenant
             w.writelines(_pack_frame(header, req.get("data")))
             await w.drain()
             resp, payload = await _read_frame(r, into=payload_into)

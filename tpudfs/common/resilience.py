@@ -30,19 +30,47 @@ retry storms. Four cooperating mechanisms, each usable on its own:
   message-prefix convention as ``Not Leader|``), mapped to S3 503 SlowDown at
   the gateway.
 
+- **Tenant QoS.** A tenant identity contextvar (propagated like the deadline
+  budget: ``x-tenant`` gRPC metadata / ``_tn`` blockport header) plus a
+  tenant-aware admission controller (:class:`QosShedder`): per-tenant
+  time-refilled token buckets and a deficit-round-robin weighted-fair queue
+  over per-tenant FIFOs. Overload degrades per tenant in order — queue
+  (bounded depth, deadline-expired waiters evicted), then rate-limit with a
+  per-tenant retry-after, then shed with the same ``Overloaded|`` message —
+  so one flooding tenant saturates its own queue while everyone else keeps
+  their fair share. Disabled (the default), admission is the flat
+  :class:`LoadShedder`, bit-for-bit.
+
 Everything here is clock-injectable so unit tests never sleep.
 """
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import contextvars
+import os
+import random
 import time
-from collections.abc import Callable, Iterator
+from collections import deque
+from collections.abc import Callable, Iterator, Mapping
 from typing import Any
 
 #: Metadata key carrying the remaining deadline budget in seconds (relative).
 DEADLINE_KEY = "x-deadline-budget"
+
+#: Metadata key carrying the tenant identity on the gRPC plane; the blockport
+#: twin is the ``_tn`` header field (same split as DEADLINE_KEY / ``_db``).
+TENANT_KEY = "x-tenant"
+
+#: Blockport frame-header key for the tenant identity.
+TENANT_FRAME_KEY = "_tn"
+
+#: The implicit tenant: control-plane traffic, background maintenance
+#: (re-replication, checkpoint staging GC), and clients that never configured
+#: an identity. Never rate-limited — throttling the cluster's own upkeep
+#: turns overload into data loss.
+SYSTEM_TENANT = "system"
 
 #: Floor for derived per-attempt timeouts: a nearly-expired budget still gets
 #: a short real timeout rather than a degenerate zero that can never succeed.
@@ -143,6 +171,110 @@ class BudgetExhausted(Exception):
 
 
 # ---------------------------------------------------------------------------
+# Tenant identity
+# ---------------------------------------------------------------------------
+
+_tenant: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "tpudfs_tenant", default=None
+)
+
+
+def raw_tenant() -> str | None:
+    """The ambient tenant, or None when none was ever established."""
+    return _tenant.get()
+
+
+def current_tenant() -> str:
+    """The tenant this work is accounted to; :data:`SYSTEM_TENANT` when no
+    identity was established anywhere up the chain."""
+    return _tenant.get() or SYSTEM_TENANT
+
+
+def set_tenant(tenant: str | None) -> contextvars.Token:
+    return _tenant.set(tenant)
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: str | None) -> Iterator[str]:
+    """Attribute the enclosed work to ``tenant`` unless an identity is
+    already ambient.
+
+    Outer wins, same rule as :func:`deadline_scope`: the S3 gateway sets the
+    auth principal per request, and the DFS client library (which may carry
+    its own configured identity) runs *inside* that request — the principal
+    must not be overwritten by the library's default."""
+    if tenant is None or _tenant.get() is not None:
+        yield current_tenant()
+        return
+    token = _tenant.set(tenant)
+    try:
+        yield tenant
+    finally:
+        _tenant.reset(token)
+
+
+@contextlib.contextmanager
+def as_system_tenant() -> Iterator[None]:
+    """FORCE the system tenant for background/maintenance work.
+
+    The counterpart of :func:`shielded_from_deadline`: a GC or healer task
+    spawned from a request context inherits the requester's tenant, and its
+    cleanup must not be queued/throttled against that tenant's quota — the
+    overload that produced the garbage would then starve its own cleanup."""
+    token = _tenant.set(SYSTEM_TENANT)
+    try:
+        yield
+    finally:
+        _tenant.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# Retry-after jitter + metrics-cardinality helpers
+# ---------------------------------------------------------------------------
+
+#: Module RNG for retry-after jitter; tests seed it for determinism.
+_jitter_rng = random.Random()
+
+
+def seed_retry_jitter(seed: int | None) -> None:
+    """Re-seed the retry-after jitter RNG (tests/chaos determinism)."""
+    _jitter_rng.seed(seed)
+
+
+def jittered(seconds: float, spread: float = 0.25) -> float:
+    """``seconds`` ±``spread`` (uniform), floored at 0.
+
+    Every retry-after hint a server hands out is jittered: a shed wave
+    answered with identical hints makes every client retry in lockstep,
+    re-creating the spike the shed was defending against."""
+    return max(0.0, seconds * (1.0 + spread * (2.0 * _jitter_rng.random() - 1.0)))
+
+
+def metric_key(raw: str) -> str:
+    """Sanitize an arbitrary tenant/address into a metric-name fragment."""
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in raw) or "_"
+
+
+def capped_by_key(prefix: str, counts: Mapping[str, float], *,
+                  top_n: int = 8, suffix: str = "_total") -> dict[str, float]:
+    """Per-key counters capped for the metrics page: the ``top_n`` largest
+    keys export individually, everything else rolls up into
+    ``{prefix}_other{suffix}`` — a many-tenant (or many-target) run must not
+    bloat /metrics without bound."""
+    out: dict[str, float] = {}
+    ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    other = 0.0
+    for i, (key, value) in enumerate(ranked):
+        if i < top_n:
+            out[f"{prefix}_{metric_key(key)}{suffix}"] = float(value)
+        else:
+            other += value
+    if other:
+        out[f"{prefix}_other{suffix}"] = other
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Retry budgets
 # ---------------------------------------------------------------------------
 
@@ -179,6 +311,10 @@ class RetryBudget:
     assertions (retry amplification ≤ 2×) and the ops /metrics endpoint.
     """
 
+    #: Per-target keys exported through /metrics (top-N by denial count +
+    #: ``_other`` rollup) — see :func:`capped_by_key`.
+    EXPORT_TOP_N = 8
+
     def __init__(self, ratio: float = 0.5, burst: float = 10.0):
         self.ratio = ratio
         self.burst = burst
@@ -186,6 +322,7 @@ class RetryBudget:
         self.first_tries = 0
         self.retries = 0
         self.denied = 0
+        self._denied_by_key: dict[str, int] = {}
 
     def _bucket(self, key: str) -> TokenBucket:
         b = self._buckets.get(key)
@@ -202,6 +339,7 @@ class RetryBudget:
             self.retries += 1
             return True
         self.denied += 1
+        self._denied_by_key[key] = self._denied_by_key.get(key, 0) + 1
         return False
 
     def counters(self) -> dict[str, float]:
@@ -209,6 +347,8 @@ class RetryBudget:
             "retry_budget_first_tries_total": float(self.first_tries),
             "retry_budget_retries_total": float(self.retries),
             "retry_budget_denied_total": float(self.denied),
+            **capped_by_key("retry_budget_denied_by_target",
+                            self._denied_by_key, top_n=self.EXPORT_TOP_N),
         }
 
 
@@ -297,6 +437,7 @@ class BreakerBoard:
         self._breakers: dict[str, CircuitBreaker] = {}
         self.opens_total = 0
         self.short_circuits_total = 0
+        self._opens_by_addr: dict[str, int] = {}
 
     def get(self, addr: str) -> CircuitBreaker:
         br = self._breakers.get(addr)
@@ -320,6 +461,7 @@ class BreakerBoard:
         br.record_failure()
         if br.state == OPEN and not was_open:
             self.opens_total += 1
+            self._opens_by_addr[addr] = self._opens_by_addr.get(addr, 0) + 1
 
     def healthy_first(self, addrs: list[str]) -> list[str]:
         """Stable partition: addresses with non-open breakers first.
@@ -338,6 +480,8 @@ class BreakerBoard:
                 sum(1 for b in self._breakers.values() if b.state == OPEN)),
             "breaker_opens_total": float(self.opens_total),
             "breaker_short_circuits_total": float(self.short_circuits_total),
+            **capped_by_key("breaker_opens_by_addr", self._opens_by_addr,
+                            top_n=RetryBudget.EXPORT_TOP_N),
         }
 
 
@@ -363,6 +507,17 @@ def retry_after_hint(message: str) -> float | None:
         return float(parts[1])
     except (IndexError, ValueError):
         return None
+
+
+def retry_after_from_text(message: str) -> float | None:
+    """Like :func:`retry_after_hint` but finds ``Overloaded|…`` anywhere in
+    the text — client-side error messages wrap the server hint in context
+    (e.g. ``"GetFile shed by target: Overloaded|0.100|…"``), and the S3
+    gateway needs the seconds back out for its ``Retry-After`` header."""
+    idx = message.find(OVERLOADED_PREFIX)
+    if idx < 0:
+        return None
+    return retry_after_hint(message[idx:])
 
 
 class LoadShedder:
@@ -396,7 +551,10 @@ class LoadShedder:
 
     def retry_after(self) -> float:
         over = max(0, self.inflight - self.max_inflight + 1)
-        return self.base_retry_after * (1.0 + over / max(1, self.max_inflight))
+        hint = self.base_retry_after * (1.0 + over / max(1, self.max_inflight))
+        # ±25% jitter so a shed wave's clients spread their comebacks
+        # instead of thundering back in lockstep at hint expiry.
+        return jittered(hint)
 
     def counters(self) -> dict[str, float]:
         return {
@@ -414,15 +572,39 @@ def admission_controlled(fn: Any) -> Any:
     exempt — shedding those turns overload into a false partition). The
     wrapped method keeps its ``(self, request)`` shape so the rpc-contract
     lint still resolves handler signatures.
+
+    Two admission planes share this decorator: the flat :class:`LoadShedder`
+    (``try_acquire``/``release``, the default — behavior unchanged) and the
+    tenant-aware :class:`QosShedder`, detected by its async ``acquire``
+    method, which may *queue* the request in the weighted-fair queue before
+    admitting or rejecting it.
     """
 
     async def wrapped(self: Any, request: Any) -> Any:
         shedder: LoadShedder | None = getattr(self, "shedder", None)
         if shedder is None:
             return await fn(self, request)
+        acquire = getattr(shedder, "acquire", None)
+        if acquire is not None:
+            # Tenant-aware plane: per-tenant fair queueing + rate limits.
+            tenant = current_tenant()
+            try:
+                await acquire(tenant)
+            except QosRejected as e:
+                # Local import: rpc.py imports this module for deadline
+                # clamping, so the top-level dependency must stay
+                # rpc -> resilience only.
+                from tpudfs.common.rpc import RpcError
+                raise RpcError.resource_exhausted(
+                    f"{type(self).__name__} {e.detail} (tenant={tenant})",
+                    retry_after=e.retry_after,
+                ) from None
+            t0 = time.monotonic()
+            try:
+                return await fn(self, request)
+            finally:
+                shedder.release(tenant, time.monotonic() - t0)
         if not shedder.try_acquire():
-            # Local import: rpc.py imports this module for deadline clamping,
-            # so the top-level dependency must point rpc -> resilience only.
             from tpudfs.common.rpc import RpcError
             raise RpcError.resource_exhausted(
                 f"{type(self).__name__} at admission limit "
@@ -439,3 +621,486 @@ def admission_controlled(fn: Any) -> Any:
     wrapped.__doc__ = fn.__doc__
     wrapped.__wrapped__ = fn
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Tenant QoS: rate buckets, weighted-fair queueing, tenant-aware admission
+# ---------------------------------------------------------------------------
+
+
+class RateBucket:
+    """Time-refilled token bucket for per-tenant request-rate limits.
+
+    Distinct from :class:`TokenBucket` (the *retry* throttle, refilled by
+    first attempts): this one refills with wall time at ``rate`` tokens/s up
+    to ``burst``. Refill is monotone — a clock that stalls or steps backwards
+    never drains tokens — which is what makes retry-after hints derived from
+    it trustworthy."""
+
+    __slots__ = ("rate", "burst", "tokens", "_last", "_clock")
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        if rate <= 0:
+            raise ValueError("rate must be > 0 (omit the bucket for "
+                             "unlimited tenants)")
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self.tokens = self.burst
+        self._last = clock()
+        self._clock = clock
+
+    def _refill(self) -> None:
+        now = self._clock()
+        if now > self._last:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._last) * self.rate)
+            self._last = now
+        # now <= _last: clock stall/regression — tokens unchanged, and
+        # _last keeps its high-water mark so the lost interval is never
+        # double-counted when the clock recovers.
+
+    def try_spend(self, n: float = 1.0) -> bool:
+        self._refill()
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def retry_after(self, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will be available (0 if they are)."""
+        self._refill()
+        if self.tokens >= n:
+            return 0.0
+        return (n - self.tokens) / self.rate
+
+
+class _Waiter:
+    """One queued admission request in the weighted-fair queue."""
+
+    __slots__ = ("future", "tenant", "enqueued_at", "deadline", "cost")
+
+    def __init__(self, future: Any, tenant: str, enqueued_at: float,
+                 deadline: Deadline | None, cost: float = 1.0):
+        self.future = future
+        self.tenant = tenant
+        self.enqueued_at = enqueued_at
+        self.deadline = deadline
+        self.cost = cost
+
+
+class DeficitRoundRobin:
+    """Deficit round-robin over per-tenant FIFOs (Shreedhar & Varghese).
+
+    Each tenant owns a FIFO; a round-robin ring visits tenants with queued
+    items, crediting ``quantum × weight`` per visit and serving while the
+    deficit covers the head item's cost. A tenant with weight 2 therefore
+    drains twice as fast as one with weight 1, and an arbitrarily deep
+    queue buys a tenant *zero* extra service — exactly the noisy-neighbor
+    property a flat FIFO lacks."""
+
+    def __init__(self, quantum: float = 1.0, default_weight: float = 1.0):
+        self.quantum = quantum
+        self.default_weight = default_weight
+        self.weights: dict[str, float] = {}
+        self._queues: dict[str, deque] = {}
+        self._ring: deque[str] = deque()
+        self._deficit: dict[str, float] = {}
+
+    def weight(self, tenant: str) -> float:
+        return max(self.weights.get(tenant, self.default_weight), 1e-6)
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def depth(self, tenant: str) -> int:
+        q = self._queues.get(tenant)
+        return len(q) if q is not None else 0
+
+    def tenants(self) -> list[str]:
+        return list(self._ring)
+
+    def push(self, tenant: str, item: Any, cost: float = 1.0) -> None:
+        q = self._queues.get(tenant)
+        if q is None:
+            q = self._queues[tenant] = deque()
+            self._ring.append(tenant)
+            self._deficit.setdefault(tenant, 0.0)
+        q.append((cost, item))
+
+    def push_front(self, tenant: str, item: Any, cost: float = 1.0) -> None:
+        """Return an item to the head of its FIFO (dispatch backed out —
+        e.g. the tenant's rate bucket was empty at dispatch time)."""
+        q = self._queues.get(tenant)
+        if q is None:
+            q = self._queues[tenant] = deque()
+            self._ring.append(tenant)
+            self._deficit.setdefault(tenant, 0.0)
+        q.appendleft((cost, item))
+
+    def _retire(self, tenant: str) -> None:
+        if not self._queues.get(tenant):
+            self._queues.pop(tenant, None)
+            self._deficit.pop(tenant, None)
+            try:
+                self._ring.remove(tenant)
+            except ValueError:
+                pass
+
+    def pop(self, skip: set[str] | None = None) -> tuple[str, Any] | None:
+        """Next (tenant, item) by DRR order; None when empty or every
+        queued tenant is in ``skip`` (rate-limited this dispatch round)."""
+        if not self._ring:
+            return None
+        # Termination: every eligible visit grows that tenant's deficit by
+        # quantum*weight > 0, so within bounded cycles some head is served.
+        visits = 0
+        max_visits = len(self._ring) * (
+            2 + int(1.0 / min(self.weight(t) for t in self._ring)))
+        while self._ring and visits <= max_visits:
+            visits += 1
+            tenant = self._ring[0]
+            if skip and tenant in skip:
+                if all(t in skip for t in self._ring):
+                    return None
+                self._ring.rotate(-1)
+                continue
+            q = self._queues[tenant]
+            cost = q[0][0]
+            if self._deficit[tenant] >= cost:
+                _, item = q.popleft()
+                self._deficit[tenant] -= cost
+                if not q:
+                    # A drained tenant forfeits its leftover deficit: credit
+                    # must not accumulate while idle (classic DRR rule).
+                    self._deficit[tenant] = 0.0
+                    self._retire(tenant)
+                return tenant, item
+            self._deficit[tenant] += self.quantum * self.weight(tenant)
+            self._ring.rotate(-1)
+        return None
+
+    def evict(self, pred: Callable[[Any], bool]) -> list[Any]:
+        """Remove and return every queued item matching ``pred`` (expired
+        waiters); tenants left empty retire from the ring."""
+        evicted: list[Any] = []
+        for tenant in list(self._queues):
+            q = self._queues[tenant]
+            kept: deque = deque()
+            for cost, item in q:
+                if pred(item):
+                    evicted.append(item)
+                else:
+                    kept.append((cost, item))
+            self._queues[tenant] = kept
+            self._retire(tenant)
+        return evicted
+
+
+class QosRejected(Exception):
+    """Admission refused by the QoS plane — carries the per-tenant hint."""
+
+    def __init__(self, detail: str, retry_after: float, tenant: str):
+        super().__init__(detail)
+        self.detail = detail
+        self.retry_after = retry_after
+        self.tenant = tenant
+
+
+#: p99 is computed over a bounded ring of recent handler latencies, so a
+#: quiet tenant's ancient spike ages out instead of pinning the gauge.
+_LATENCY_RING = 256
+
+
+class QosShedder:
+    """Tenant-aware admission: weighted-fair queue + per-tenant rate limits.
+
+    Drop-in replacement for :class:`LoadShedder` behind
+    :func:`admission_controlled` (detected by the async ``acquire``).
+    Degradation order per tenant when the inflight budget is full or the
+    tenant is over its rate:
+
+    1. **Queue** — the request parks in a deficit-round-robin weighted-fair
+       queue (bounded per-tenant depth; deadline-expired waiters evicted).
+    2. **Rate-limit** — a waiter that times out (its ambient deadline or
+       ``max_queue_wait``) is refused with that *tenant's* retry-after, from
+       its refill schedule.
+    3. **Shed** — a tenant whose queue slice is full fails fast with the
+       same ``Overloaded|`` message the flat shedder uses.
+
+    The ``system`` tenant (control plane, background maintenance, clients
+    with no configured identity) is never rate-limited and carries a higher
+    default weight, so enabling QoS cluster-wide changes nothing for
+    untenanted traffic until real tenants start competing.
+    """
+
+    def __init__(self, max_inflight: int = 64, base_retry_after: float = 0.1,
+                 *, weights: Mapping[str, float] | None = None,
+                 default_weight: float = 1.0, rate: float = 0.0,
+                 burst: float | None = None, queue_depth: int = 32,
+                 max_queue_wait: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_inflight = max_inflight
+        self.base_retry_after = base_retry_after
+        self.inflight = 0
+        self.admitted_total = 0
+        self.shed_total = 0
+        self.peak_inflight = 0
+        self.queue = DeficitRoundRobin(default_weight=default_weight)
+        self.queue.weights = dict(weights or {})
+        # System outweighs any single default-weight tenant unless the
+        # operator explicitly pinned it.
+        self.queue.weights.setdefault(SYSTEM_TENANT, max(4.0, default_weight))
+        self.rate = float(rate)
+        self.burst = float(burst) if burst else max(2.0 * self.rate, 1.0)
+        self.queue_depth = queue_depth
+        self.max_queue_wait = max_queue_wait
+        self._clock = clock
+        self._buckets: dict[str, RateBucket] = {}
+        self._admitted_by_tenant: dict[str, int] = {}
+        self._shed_by_tenant: dict[str, int] = {}
+        self._queued_by_tenant: dict[str, int] = {}
+        self._rate_limited_by_tenant: dict[str, int] = {}
+        self._latency_by_tenant: dict[str, deque] = {}
+        self.queued_total = 0
+        self.rate_limited_total = 0
+        self.evicted_total = 0
+        self._kick_scheduled = False
+
+    # -- per-tenant plumbing ------------------------------------------------
+
+    def _bucket(self, tenant: str) -> RateBucket | None:
+        if self.rate <= 0 or tenant == SYSTEM_TENANT:
+            return None
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = RateBucket(
+                self.rate, self.burst, self._clock)
+        return b
+
+    def retry_after_for(self, tenant: str) -> float:
+        """Per-tenant retry-after: the tenant's refill schedule when it has
+        one, else the pressure-scaled global hint."""
+        b = self._bucket(tenant)
+        if b is not None:
+            hinted = b.retry_after()
+            if hinted > 0:
+                return jittered(max(hinted, self.base_retry_after))
+        over = max(0, self.inflight - self.max_inflight + 1) + len(self.queue)
+        hint = self.base_retry_after * (1.0 + over / max(1, self.max_inflight))
+        return jittered(hint)
+
+    def _admit(self, tenant: str) -> None:
+        self.inflight += 1
+        self.admitted_total += 1
+        self.peak_inflight = max(self.peak_inflight, self.inflight)
+        self._admitted_by_tenant[tenant] = (
+            self._admitted_by_tenant.get(tenant, 0) + 1)
+
+    def _count_shed(self, tenant: str) -> None:
+        self.shed_total += 1
+        self._shed_by_tenant[tenant] = self._shed_by_tenant.get(tenant, 0) + 1
+
+    def _evict_expired(self) -> int:
+        """Drop queued waiters whose ambient deadline already expired —
+        admitting doomed work just burns an inflight slot."""
+        def expired(w: _Waiter) -> bool:
+            if w.future.done():
+                return True  # timed out / cancelled; just reap the slot
+            return w.deadline is not None and w.deadline.expired
+
+        evicted = self.queue.evict(expired)
+        n = 0
+        for w in evicted:
+            if w.future.done():
+                continue
+            n += 1
+            self._count_shed(w.tenant)
+            w.future.set_exception(QosRejected(
+                "deadline expired in admission queue",
+                retry_after=self.retry_after_for(w.tenant), tenant=w.tenant))
+        self.evicted_total += n
+        return len(evicted)
+
+    # -- the acquire/release pair used by admission_controlled --------------
+
+    async def acquire(self, tenant: str) -> None:
+        """Admit, queue, or refuse one request for ``tenant``.
+
+        Raises :class:`QosRejected` (rate-limited or shed); returns when
+        admitted. Callers must pair with :meth:`release`.
+        """
+        bucket = self._bucket(tenant)
+        if (self.inflight < self.max_inflight and len(self.queue) == 0
+                and (bucket is None or bucket.try_spend())):
+            self._admit(tenant)
+            return
+        # Contended (or over-rate): degrade to the fair queue.
+        if self.queue.depth(tenant) >= self.queue_depth:
+            self._evict_expired()
+            if self.queue.depth(tenant) >= self.queue_depth:
+                self._count_shed(tenant)
+                raise QosRejected(
+                    "tenant queue full",
+                    retry_after=self.retry_after_for(tenant), tenant=tenant)
+        loop = asyncio.get_running_loop()
+        waiter = _Waiter(loop.create_future(), tenant, self._clock(),
+                         current_deadline())
+        self.queue.push(tenant, waiter)
+        self.queued_total += 1
+        self._queued_by_tenant[tenant] = (
+            self._queued_by_tenant.get(tenant, 0) + 1)
+        self._kick()
+        timeout = self.max_queue_wait
+        rem = remaining_budget()
+        if rem is not None:
+            timeout = min(timeout, max(rem, 0.0))
+        try:
+            await asyncio.wait_for(waiter.future, timeout=timeout)
+        except asyncio.TimeoutError:
+            # Reap our queue slot now rather than waiting for a sweep.
+            self.queue.evict(lambda w: w is waiter)
+            self.rate_limited_total += 1
+            self._rate_limited_by_tenant[tenant] = (
+                self._rate_limited_by_tenant.get(tenant, 0) + 1)
+            self._count_shed(tenant)
+            raise QosRejected(
+                "rate limited",
+                retry_after=self.retry_after_for(tenant),
+                tenant=tenant) from None
+
+    def release(self, tenant: str, elapsed: float = 0.0) -> None:
+        self.inflight -= 1
+        ring = self._latency_by_tenant.get(tenant)
+        if ring is None:
+            ring = self._latency_by_tenant[tenant] = deque(
+                maxlen=_LATENCY_RING)
+        ring.append(elapsed)
+        self._kick()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _kick(self) -> None:
+        """Dispatch queued waiters into free inflight slots, DRR order.
+
+        Tenants whose rate bucket is empty are skipped this round (their
+        waiter returns to its FIFO head) and a timer re-kicks at the earliest
+        refill, so rate-limited waiters don't rely on unrelated traffic to
+        get unparked."""
+        skip: set[str] = set()
+        min_refill: float | None = None
+        while self.inflight < self.max_inflight:
+            nxt = self.queue.pop(skip=skip)
+            if nxt is None:
+                break
+            tenant, waiter = nxt
+            if waiter.future.done():
+                continue  # timed out while parked; slot already charged
+            if waiter.deadline is not None and waiter.deadline.expired:
+                self._count_shed(tenant)
+                self.evicted_total += 1
+                waiter.future.set_exception(QosRejected(
+                    "deadline expired in admission queue",
+                    retry_after=self.retry_after_for(tenant), tenant=tenant))
+                continue
+            bucket = self._bucket(tenant)
+            if bucket is not None and not bucket.try_spend():
+                self.queue.push_front(tenant, waiter)
+                skip.add(tenant)
+                refill = bucket.retry_after()
+                if min_refill is None or refill < min_refill:
+                    min_refill = refill
+                continue
+            self._admit(tenant)
+            waiter.future.set_result(None)
+        if min_refill is not None and len(self.queue) and not self._kick_scheduled:
+            self._kick_scheduled = True
+            asyncio.get_running_loop().call_later(
+                max(min_refill, 0.005), self._timer_kick)
+
+    def _timer_kick(self) -> None:
+        self._kick_scheduled = False
+        self._evict_expired()
+        self._kick()
+
+    # -- metrics ------------------------------------------------------------
+
+    def _p99(self, ring: deque) -> float:
+        if not ring:
+            return 0.0
+        ordered = sorted(ring)
+        return ordered[min(len(ordered) - 1, int(0.99 * (len(ordered) - 1)))]
+
+    def counters(self) -> dict[str, float]:
+        out = {
+            # Same keys as LoadShedder.counters() so dashboards and the
+            # overload chaos assertions read either plane unchanged.
+            "shed_inflight": float(self.inflight),
+            "shed_peak_inflight": float(self.peak_inflight),
+            "shed_admitted_total": float(self.admitted_total),
+            "shed_total": float(self.shed_total),
+            "qos_queue_depth": float(len(self.queue)),
+            "qos_queued_total": float(self.queued_total),
+            "qos_rate_limited_total": float(self.rate_limited_total),
+            "qos_evicted_total": float(self.evicted_total),
+        }
+        top = RetryBudget.EXPORT_TOP_N
+        out.update(capped_by_key("qos_tenant", self._admitted_by_tenant,
+                                 top_n=top, suffix="_admitted_total"))
+        out.update(capped_by_key("qos_tenant", self._shed_by_tenant,
+                                 top_n=top, suffix="_shed_total"))
+        out.update(capped_by_key("qos_tenant", self._rate_limited_by_tenant,
+                                 top_n=top, suffix="_rate_limited_total"))
+        depths = {t: float(self.queue.depth(t)) for t in self.queue.tenants()}
+        out.update(capped_by_key("qos_tenant", depths,
+                                 top_n=top, suffix="_queue_depth"))
+        p99s = {t: self._p99(ring)
+                for t, ring in self._latency_by_tenant.items()}
+        # Gauge rollup by max, not sum — an averaged-away p99 is a lie.
+        ranked = sorted(p99s.items(), key=lambda kv: (-kv[1], kv[0]))
+        for i, (t, v) in enumerate(ranked):
+            if i < top:
+                out[f"qos_tenant_{metric_key(t)}_p99_seconds"] = float(v)
+            else:
+                key = "qos_tenant_other_p99_seconds"
+                out[key] = max(out.get(key, 0.0), float(v))
+        return out
+
+
+def shedder_from_env(inflight_env: str, default_inflight: int
+                     ) -> "LoadShedder | QosShedder":
+    """Build a service's admission controller from the environment.
+
+    ``TPUDFS_QOS=1`` opts into the tenant-aware plane; anything else returns
+    the flat :class:`LoadShedder` so existing deployments (and the overload
+    chaos tier) keep today's behavior bit-for-bit. Knobs:
+
+    - ``inflight_env`` (e.g. ``TPUDFS_CS_MAX_INFLIGHT``): inflight budget.
+    - ``TPUDFS_QOS_WEIGHTS``: ``"tenantA=4,tenantB=1"`` fair-share weights.
+    - ``TPUDFS_QOS_RATE`` / ``TPUDFS_QOS_BURST``: per-tenant req/s + burst
+      (rate 0 = unlimited; ``system`` is always unlimited).
+    - ``TPUDFS_QOS_QUEUE_DEPTH`` / ``TPUDFS_QOS_QUEUE_WAIT``: per-tenant
+      queue bound and max park time before the rate-limited refusal.
+    """
+    max_inflight = int(os.environ.get(inflight_env, str(default_inflight)))
+    if os.environ.get("TPUDFS_QOS", "0") != "1":
+        return LoadShedder(max_inflight=max_inflight)
+    weights: dict[str, float] = {}
+    for part in os.environ.get("TPUDFS_QOS_WEIGHTS", "").split(","):
+        if "=" not in part:
+            continue
+        name, value = part.split("=", 1)
+        try:
+            weights[name.strip()] = float(value)
+        except ValueError:
+            continue
+    rate = float(os.environ.get("TPUDFS_QOS_RATE", "0") or 0.0)
+    burst_raw = os.environ.get("TPUDFS_QOS_BURST", "")
+    return QosShedder(
+        max_inflight=max_inflight,
+        weights=weights,
+        rate=rate,
+        burst=float(burst_raw) if burst_raw else None,
+        queue_depth=int(os.environ.get("TPUDFS_QOS_QUEUE_DEPTH", "32")),
+        max_queue_wait=float(os.environ.get("TPUDFS_QOS_QUEUE_WAIT", "0.25")),
+    )

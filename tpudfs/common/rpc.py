@@ -30,13 +30,16 @@ import msgpack
 
 from tpudfs.common.resilience import (
     DEADLINE_KEY,
+    TENANT_KEY,
     BudgetExhausted,
     Deadline,
     attempt_timeout,
     overloaded_message,
+    raw_tenant,
     remaining_budget,
     retry_after_hint,
     set_deadline,
+    set_tenant,
 )
 from tpudfs.common.telemetry import REQUEST_ID_KEY, current_request_id, set_request_id
 
@@ -246,6 +249,8 @@ class RpcServer:
             dl_token = set_deadline(
                 Deadline.after(budget) if budget is not None else None
             )
+            tn = md.get(TENANT_KEY)
+            tn_token = set_tenant(tn if isinstance(tn, str) and tn else None)
             try:
                 if budget is not None and budget <= 0:
                     await context.abort(
@@ -278,6 +283,10 @@ class RpcServer:
                     pass
                 try:
                     dl_token.var.reset(dl_token)
+                except ValueError:
+                    pass
+                try:
+                    tn_token.var.reset(tn_token)
                 except ValueError:
                     pass
 
@@ -381,6 +390,12 @@ class RpcClient:
             )
             self._stubs[addr, service, method] = rpc
         metadata = ((REQUEST_ID_KEY, current_request_id()),)
+        tenant = raw_tenant()
+        if tenant is not None:
+            # Tenant identity rides every hop so admission control at the
+            # master/chunkserver charges the originating principal, not the
+            # intermediate service account.
+            metadata += ((TENANT_KEY, tenant),)
         # Per-attempt timeout = min(explicit timeout, remaining op budget);
         # the budget also rides metadata (as relative seconds, skew-immune)
         # so every downstream hop inherits the same give-up point.

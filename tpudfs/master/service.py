@@ -28,8 +28,8 @@ import uuid
 
 from tpudfs.common import ckptpaths
 from tpudfs.common.resilience import (
-    LoadShedder,
     admission_controlled,
+    shedder_from_env,
     shielded_from_deadline,
 )
 from tpudfs.common.rpc import RpcClient, RpcError, RpcServer
@@ -179,9 +179,10 @@ class Master:
         # registration, Raft membership, safe mode, 2PC coordination) is
         # exempt: shedding it under load would turn congestion into false
         # liveness failures and stuck transactions.
-        self.shedder = LoadShedder(
-            max_inflight=int(os.environ.get("TPUDFS_MASTER_MAX_INFLIGHT", "256"))
-        )
+        # TPUDFS_QOS=1 upgrades this to the tenant-aware QosShedder
+        # (weighted-fair queue + per-tenant rate limits); default stays the
+        # flat LoadShedder.
+        self.shedder = shedder_from_env("TPUDFS_MASTER_MAX_INFLIGHT", 256)
         self._tasks: set[asyncio.Task] = set()
         #: Coalesced access-stats (see _note_access): path -> (at_ms, count)
         #: pending since the last batched proposal.

@@ -9,9 +9,16 @@ lines of text.
 from __future__ import annotations
 
 import time
-from collections import Counter
+from collections import Counter, deque
 
 _LATENCY_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+#: At most this many tenants export individual series; the rest roll up
+#: under tenant="_other" so a many-tenant flood can't bloat /metrics.
+TENANT_TOP_N = 8
+
+#: Bounded ring of recent per-tenant latencies backing the p99 gauge.
+_TENANT_LATENCY_RING = 256
 
 
 class Histogram:
@@ -52,7 +59,29 @@ class S3Metrics:
         self.request_latency = Histogram()
         self.sts_issued = 0
         self.jwks_fetches = 0
+        self.tenant_requests = Counter()   # tenant -> n
+        self.tenant_throttled = Counter()  # tenant -> 503 SlowDown count
+        self._tenant_latency: dict[str, deque] = {}
         self.started_at = time.time()
+
+    def observe_tenant(self, tenant: str, latency: float,
+                       throttled: bool = False) -> None:
+        """Per-tenant accounting for one finished request."""
+        self.tenant_requests[tenant] += 1
+        if throttled:
+            self.tenant_throttled[tenant] += 1
+        ring = self._tenant_latency.get(tenant)
+        if ring is None:
+            ring = self._tenant_latency[tenant] = deque(
+                maxlen=_TENANT_LATENCY_RING)
+        ring.append(latency)
+
+    def _top_tenants(self) -> tuple[list[str], list[str]]:
+        ranked = sorted(self.tenant_requests.items(),
+                        key=lambda kv: (-kv[1], kv[0]))
+        top = [t for t, _ in ranked[:TENANT_TOP_N]]
+        rest = [t for t, _ in ranked[TENANT_TOP_N:]]
+        return top, rest
 
     def render(self, audit=None) -> str:
         lines = [
@@ -73,6 +102,33 @@ class S3Metrics:
         lines.append(self.policy_eval.render("s3_policy_eval_seconds"))
         lines.append("# TYPE s3_request_seconds histogram")
         lines.append(self.request_latency.render("s3_request_seconds"))
+        if self.tenant_requests:
+            top, rest = self._top_tenants()
+            lines.append("# TYPE s3_tenant_requests_total counter")
+            for t in top:
+                lines.append(f's3_tenant_requests_total{{tenant="{t}"}} '
+                             f"{self.tenant_requests[t]}")
+            if rest:
+                other = sum(self.tenant_requests[t] for t in rest)
+                lines.append(
+                    f's3_tenant_requests_total{{tenant="_other"}} {other}')
+            lines.append("# TYPE s3_tenant_throttled_total counter")
+            for t in top:
+                lines.append(f's3_tenant_throttled_total{{tenant="{t}"}} '
+                             f"{self.tenant_throttled[t]}")
+            if rest:
+                other = sum(self.tenant_throttled[t] for t in rest)
+                lines.append(
+                    f's3_tenant_throttled_total{{tenant="_other"}} {other}')
+            lines.append("# TYPE s3_tenant_p99_seconds gauge")
+            for t in top:
+                ring = self._tenant_latency.get(t)
+                if not ring:
+                    continue
+                ordered = sorted(ring)
+                p99 = ordered[min(len(ordered) - 1,
+                                  int(0.99 * (len(ordered) - 1)))]
+                lines.append(f's3_tenant_p99_seconds{{tenant="{t}"}} {p99:.6f}')
         lines.append("# TYPE s3_uptime_seconds gauge")
         lines.append(f"s3_uptime_seconds {time.time() - self.started_at:.1f}")
         if audit is not None:

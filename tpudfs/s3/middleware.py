@@ -33,6 +33,7 @@ from tpudfs.auth.errors import AuthError
 from tpudfs.auth.policy import PolicyEngine
 from tpudfs.auth.presign import MAX_EXPIRY_SECONDS
 from tpudfs.auth.sts import StsTokenService
+from tpudfs.common.resilience import set_tenant
 
 CLOCK_SKEW_SECONDS = 15 * 60  # reference ±15 min (auth_middleware.rs)
 ANONYMOUS = "-"
@@ -146,6 +147,12 @@ class AuthMiddleware:
         except AuthError as e:
             self._audit(req, ANONYMOUS, "Error", e.http_status, e.code)
             raise
+        # The authenticated principal IS the QoS tenant: set it on the task's
+        # context here (contextvars survive the awaits of the same task) so
+        # every DFS RPC the handler makes carries x-tenant/_tn and the
+        # master/chunkserver charge this principal its own fair share.
+        # Anonymous/auth-disabled requests stay untenanted (-> ``system``).
+        set_tenant(result.principal if result.principal != ANONYMOUS else None)
         return result
 
     async def _authenticate_inner(self, req: S3Request, now: float) -> AuthResult:

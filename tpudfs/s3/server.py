@@ -38,6 +38,7 @@ from tpudfs.auth.policy import PolicyEngine
 from tpudfs.auth.sse import SseEngine
 from tpudfs.auth.sts import StsTokenService
 from tpudfs.client.client import Client, DfsError, OverloadedError
+from tpudfs.common.resilience import current_tenant, retry_after_from_text
 from tpudfs.s3.audit import AuditLog
 from tpudfs.s3.handlers import S3Handlers, S3Response, _err, is_reserved_key
 from tpudfs.s3.metrics import S3Metrics
@@ -137,6 +138,7 @@ class Gateway:
             secure=request.secure,
             source_ip=request.remote or "",
         )
+        throttled = False
         try:
             resp = await self.handle(req)
             outcome = f"{resp.status // 100}xx"
@@ -149,9 +151,18 @@ class Gateway:
         except OverloadedError as e:
             # SlowDown is S3's shed signal: real clients back off and retry,
             # while InternalError makes them give up or page an operator.
-            logger.warning("shed on %s %s: %s", req.method, req.path, e)
+            # The throttled tenant (= authenticated principal) goes in the
+            # log line and the per-tenant counters, and the server's
+            # per-tenant hint rides back as a real Retry-After header.
+            tenant = current_tenant()
+            throttled = True
+            logger.warning("shed on %s %s (tenant=%s): %s",
+                           req.method, req.path, tenant, e)
             resp = _err("SlowDown", "Please reduce your request rate.",
                         503, req.path)
+            hint = retry_after_from_text(str(e))
+            resp.headers["Retry-After"] = (
+                f"{max(hint if hint is not None else 1.0, 0.001):.3f}")
             outcome = "5xx"
         except DfsError as e:
             logger.warning("DFS error on %s %s: %s", req.method, req.path, e)
@@ -162,7 +173,13 @@ class Gateway:
             resp = _err("InternalError", "internal error", 500, req.path)
             outcome = "5xx"
         self.metrics.requests[(req.method, outcome)] += 1
-        self.metrics.request_latency.observe(time.perf_counter() - t0)
+        elapsed = time.perf_counter() - t0
+        self.metrics.request_latency.observe(elapsed)
+        if outcome != "auth":
+            # Tenant is the authenticated principal (set by the auth
+            # middleware on this task's context); "system" = anonymous.
+            self.metrics.observe_tenant(current_tenant(), elapsed,
+                                        throttled=throttled)
         headers = dict(resp.headers)
         headers["x-amz-request-id"] = req.request_id
         return web.Response(status=resp.status, body=resp.body,

@@ -43,6 +43,7 @@ CRC; the manifest aggregates the specs of all shards.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import json
 import logging
@@ -59,8 +60,10 @@ from tpudfs.common import ckptpaths
 from tpudfs.common.checksum import crc32c, crc32c_combine
 from tpudfs.common.resilience import (
     BudgetExhausted,
+    as_system_tenant,
     deadline_scope,
     shielded_from_deadline,
+    tenant_scope,
 )
 
 logger = logging.getLogger(__name__)
@@ -192,7 +195,8 @@ class CheckpointManager:
     def __init__(self, client: Client, base: str, *, num_shards: int,
                  ec: tuple[int, int] | None = (3, 2), hot_copies: bool = True,
                  reader=None, save_budget_s: float | None = None,
-                 restore_budget_s: float | None = None):
+                 restore_budget_s: float | None = None,
+                 tenant: str | None = None):
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
         if not hot_copies and not ec:
@@ -212,6 +216,11 @@ class CheckpointManager:
         self.reader = reader
         self.save_budget_s = save_budget_s
         self.restore_budget_s = restore_budget_s
+        #: Tenant identity stamped on save/restore RPCs (QoS attribution of
+        #: the training job). Falls back to the client's configured tenant;
+        #: staging GC always runs as ``system`` regardless (maintenance must
+        #: not be rate-limited against a tenant quota).
+        self.tenant = tenant
         #: Observability for tests/chaos: how work actually happened.
         self.stats = {
             "shards_written": 0,    # payload puts that hit the wire
@@ -222,6 +231,13 @@ class CheckpointManager:
             "degraded_shard_reads": 0,  # hot copy dead -> EC cold copy
             "gc_deleted": 0,
         }
+
+    @contextlib.contextmanager
+    def _op_scope(self, budget: float | None):
+        """Deadline + tenant scope for one public op (ambient values from
+        the training loop's own scope always win)."""
+        with deadline_scope(budget), tenant_scope(self.tenant):
+            yield
 
     # ------------------------------------------------------------------ save
 
@@ -248,7 +264,7 @@ class CheckpointManager:
             if self.hot_copies else None
         ec_path = ckptpaths.shard_ec_path(self.base, step, shard) \
             if self.ec else None
-        with deadline_scope(self.save_budget_s):
+        with self._op_scope(self.save_budget_s):
             if data_path is not None:
                 await self._put_if_absent(data_path, payload, etag, attrs,
                                           ec=None)
@@ -293,7 +309,7 @@ class CheckpointManager:
         proves this ordering on the CFG. Any replica (or an external
         coordinator) may call commit; it needs no tensor data, only the
         staged specs."""
-        with deadline_scope(self.save_budget_s):
+        with self._op_scope(self.save_budget_s):
             shards = await self._verify_staged(step)
             manifest = {
                 "format": FORMAT, "base": self.base, "step": step,
@@ -347,7 +363,7 @@ class CheckpointManager:
             raise ValueError(
                 f"save(step={step}) needs trees for shards "
                 f"0..{self.num_shards - 1}, got {sorted(trees)}")
-        with deadline_scope(self.save_budget_s):
+        with self._op_scope(self.save_budget_s):
             await asyncio.gather(*(
                 self.save_shard(step, shard, tree)
                 for shard, tree in trees.items()
@@ -400,7 +416,7 @@ class CheckpointManager:
         manifest = await self.read_manifest(step)
         by_id = {s["shard"]: s for s in manifest["shards"]}
         want = sorted(by_id) if shards is None else list(shards)
-        with deadline_scope(self.restore_budget_s):
+        with self._op_scope(self.restore_budget_s):
             trees = await asyncio.gather(*(
                 self.restore_shard(manifest, s, device=device) for s in want
             ))
@@ -416,7 +432,7 @@ class CheckpointManager:
         if spec is None:
             raise CheckpointNotFoundError(
                 f"manifest step {manifest['step']} has no shard {shard}")
-        with deadline_scope(self.restore_budget_s):
+        with self._op_scope(self.restore_budget_s):
             if device is not None and self.reader is not None:
                 tree = await self._restore_shard_device(spec, device)
             else:
@@ -550,12 +566,13 @@ class CheckpointManager:
         Removes staging files of unpublished steps that are superseded or
         older than ``max_age_ms``. Runs shielded from any ambient deadline
         for the same reason the master loop does: cleanup must not be
-        starved by exactly the overload that produced the garbage. (Only
-        complete-but-unpublished files are visible here; files torn
-        mid-put are invisible to clients and only the master GC frees
-        them.)"""
+        starved by exactly the overload that produced the garbage — and as
+        the ``system`` tenant, so QoS never rate-limits GC against the
+        training job's quota. (Only complete-but-unpublished files are
+        visible here; files torn mid-put are invisible to clients and only
+        the master GC frees them.)"""
         deleted: list[str] = []
-        with shielded_from_deadline():
+        with shielded_from_deadline(), as_system_tenant():
             published = set(await self.list_steps())
             latest = max(published, default=-1)
             now = int(time.time() * 1000)

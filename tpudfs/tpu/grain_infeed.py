@@ -213,9 +213,17 @@ class DfsSourceBase:
     """
 
     def __init__(self, master_addrs: Sequence[str],
-                 client_kwargs: dict | None = None):
+                 client_kwargs: dict | None = None,
+                 tenant: str | None = None):
         self.master_addrs = list(master_addrs)
         self.client_kwargs = dict(client_kwargs or {})
+        if tenant is not None:
+            # Training reads are attributable: the per-process Client stamps
+            # this identity on every RPC (x-tenant/_tn) so server-side QoS
+            # charges the infeed its own fair share. The contextvar itself
+            # can't cross into _ClientLoop's thread — the Client's per-op
+            # scope is what carries it.
+            self.client_kwargs.setdefault("tenant", tenant)
         # Held only on sync grain-worker threads; see class docstring.
         self._lock = threading.Lock()
         self._cl: _ClientLoop | None = None
@@ -299,6 +307,7 @@ class DfsRecordSource(DfsSourceBase):
         record_bytes: int,
         dtype: str = "uint8",
         client_kwargs: dict | None = None,
+        tenant: str | None = None,
     ):
         if record_bytes <= 0:
             raise ValueError("record_bytes must be positive")
@@ -308,7 +317,7 @@ class DfsRecordSource(DfsSourceBase):
                 f"record_bytes={record_bytes} is not a multiple of "
                 f"dtype {dtype} itemsize {itemsize}"
             )
-        super().__init__(master_addrs, client_kwargs)
+        super().__init__(master_addrs, client_kwargs, tenant=tenant)
         self.paths = list(paths)
         self.record_bytes = int(record_bytes)
         self.dtype = dtype
