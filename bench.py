@@ -355,6 +355,113 @@ async def _run() -> dict:
         tmp.cleanup()
 
 
+# ------------------------------------------------- write-stage occupancy
+#
+# ``bench.py --write-stages``: drive the streamed 3x write path and emit
+# per-stage occupancy (net / crc / disk / fanout wall-ns shares) from
+# every chunkserver's ``stream_stages`` counters — the localizer for
+# write-path regressions: a future slowdown shows up as ONE stage's
+# share growing, instead of an opaque GB/s drop. Counters are summed
+# across the native engine and the asyncio fallback (whichever plane
+# served), so the breakdown is meaningful on any cluster.
+
+
+async def _run_write_stages() -> dict:
+    import tempfile
+
+    from tpudfs.client.client import Client
+    from tpudfs.common.rpc import RpcClient
+
+    tmp = tempfile.TemporaryDirectory(prefix="tpudfs-wstages-")
+    maddr, cs_addrs, procs = _spawn_cluster(tmp.name)
+    try:
+        rpc = RpcClient()
+        client = Client([maddr], rpc_client=rpc, block_size=BLOCK_MB << 20,
+                        etag_mode="crc64")
+        deadline = asyncio.get_event_loop().time() + 60
+        while True:
+            try:
+                await client.create_file("/ws/probe", b"x")
+                await client.delete_file("/ws/probe")
+                break
+            except Exception:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.3)
+        data = np.random.default_rng(3).integers(
+            0, 256, BLOCK_MB << 20, dtype=np.uint8
+        ).tobytes()
+        wsem = asyncio.Semaphore(WRITE_CONCURRENCY)
+
+        async def put(rep: int, i: int) -> None:
+            async with wsem:
+                await client.create_file(f"/ws/r{rep}/f{i:04d}", data)
+
+        samples = []
+        for rep in range(REPS):
+            t0 = time.perf_counter()
+            await asyncio.gather(*(put(rep, i) for i in range(FILES)))
+            samples.append(
+                FILES * len(data) / (time.perf_counter() - t0) / 1e9)
+            _tick(f"wstages-rep{rep}")
+
+        stage_keys = ("net_ns", "crc_ns", "disk_ns", "fanout_ns")
+        count_keys = ("frames", "streams", "stream_bytes", "aborts")
+        totals = dict.fromkeys(stage_keys + count_keys, 0)
+        per_cs = {}
+        for addr in cs_addrs:
+            stats = await rpc.call(addr, "ChunkServerService", "Stats", {},
+                                   timeout=15.0)
+            st = stats.get("stream_stages") or {}
+            for k in totals:
+                totals[k] += int(st.get(k, 0))
+            busy = sum(int(st.get(k, 0)) for k in stage_keys)
+            per_cs[addr] = {
+                k.removesuffix("_ns"): round(int(st.get(k, 0)) / busy, 3)
+                for k in stage_keys
+            } if busy else {}
+        await rpc.close()
+        busy = sum(totals[k] for k in stage_keys)
+        med = statistics.median
+        return {
+            "metric": ("streamed 3x write GB/s + per-stage occupancy "
+                       "(net/crc/disk/fanout share of pipeline wall time, "
+                       "summed across chunkservers and serving planes)"),
+            "value": round(med(samples), 3),
+            "unit": "GB/s",
+            "windows": REPS,
+            "write_pipeline_GBps": round(med(samples), 3),
+            "write_pipeline_win": _winmm(samples),
+            "stage_occupancy": {
+                k.removesuffix("_ns"): round(totals[k] / busy, 3)
+                for k in stage_keys
+            } if busy else {},
+            "stage_occupancy_per_cs": per_cs,
+            "stream_frames": totals["frames"],
+            "streams": totals["streams"],
+            "stream_bytes": totals["stream_bytes"],
+            "stream_aborts": totals["aborts"],
+            "files": FILES,
+            "platform": "cpu",
+        }
+    finally:
+        from tpudfs.testing.procs import terminate_all
+
+        terminate_all(procs)
+        tmp.cleanup()
+
+
+def main_write_stages() -> None:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _tick("wstages-start")
+    _start_watchdog()
+    result = asyncio.run(_run_write_stages())
+    _progress["t"] = None
+    _emit_once(result)
+
+
 # ----------------------------------------------------- checkpoint bench
 #
 # ``bench.py --ckpt``: the fault-tolerant sharded-checkpoint data path
@@ -1777,6 +1884,8 @@ if __name__ == "__main__":
         main_sprint()
     elif "--ckpt" in sys.argv:
         main_ckpt()
+    elif "--write-stages" in sys.argv:
+        main_write_stages()
     elif "--tenants" in sys.argv:
         main_tenants()
     else:

@@ -104,6 +104,9 @@ namespace {
 constexpr int64_t kCorrupt = -200002;
 constexpr uint64_t kMaxHeader = 1 << 20;
 constexpr uint64_t kMaxPayload = 100ull * 1024 * 1024;
+// Watermark ack cadence of the streaming write path — must match
+// tpudfs/common/writestream.py ACK_EVERY.
+constexpr uint64_t kAckEvery = 8;
 
 // ----------------------------------------------------------- msgpack mini
 
@@ -274,6 +277,12 @@ struct Writer {
     else { raw(0xcf); be(v, 8); }
   }
   void boolean(bool b) { raw(b ? 0xc3 : 0xc2); }
+  void flt(double v) {
+    raw(0xcb);
+    uint64_t bits;
+    std::memcpy(&bits, &v, sizeof(bits));
+    be(bits, 8);
+  }
   void map_head(size_t n) {
     if (n < 16) raw(0x80 | n);
     else { raw(0xde); be(n, 2); }
@@ -521,6 +530,115 @@ bool recv_frame(Stream& s, std::map<std::string, Value>* header,
   return parse_header(hbuf.data(), hl, header);
 }
 
+// Streaming variant: the payload lands in a caller-owned reusable buffer
+// (the frame ring) instead of a fresh vector. A payload larger than `cap`
+// cannot be consumed without losing the request boundary, so it reports a
+// transport tear.
+bool recv_frame_into(Stream& s, std::map<std::string, Value>* header,
+                     uint8_t* buf, uint64_t cap, uint64_t* plen) {
+  uint32_t hl;
+  if (!read_exact(s, &hl, 4)) return false;
+  if (hl > kMaxHeader) return false;
+  std::vector<uint8_t> hbuf(hl);
+  if (!read_exact(s, hbuf.data(), hl)) return false;
+  uint64_t pl;
+  if (!read_exact(s, &pl, 8)) return false;
+  if (pl > cap) return false;
+  if (pl && !read_exact(s, buf, pl)) return false;
+  *plen = pl;
+  return parse_header(hbuf.data(), hl, header);
+}
+
+// Relative deadline budget (`_db`, seconds) — float on the wire normally,
+// but accept ints too (a client may send a whole-second budget).
+bool deadline_budget(std::map<std::string, Value>& h, double* out) {
+  auto it = h.find("_db");
+  if (it == h.end()) return false;
+  if (it->second.kind == Value::FLT) { *out = it->second.f; return true; }
+  if (it->second.kind == Value::INT) {
+    *out = static_cast<double>(it->second.i);
+    return true;
+  }
+  return false;
+}
+
+bool write_fd_all(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += static_cast<size_t>(r);
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Sidecar for a streamed block, chunk CRCs accumulated frame-by-frame —
+// byte-identical to blockio.cc block_write_impl's meta ("<4sHHII" + <u4
+// array; x86-64 is LE so native-width stores match the wire layout).
+bool write_meta_tmp(const std::string& path, uint32_t chunk,
+                    const std::vector<uint32_t>& sums) {
+  int fd = ::open(path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  uint8_t hdr[16];
+  std::memcpy(hdr, "TPUM", 4);
+  uint16_t ver = 1, reserved = 0;
+  std::memcpy(hdr + 4, &ver, 2);
+  std::memcpy(hdr + 6, &reserved, 2);
+  uint32_t count = static_cast<uint32_t>(sums.size());
+  std::memcpy(hdr + 8, &chunk, 4);
+  std::memcpy(hdr + 12, &count, 4);
+  bool ok = write_fd_all(fd, hdr, sizeof(hdr)) &&
+            (sums.empty() ||
+             write_fd_all(fd, sums.data(), sums.size() * sizeof(uint32_t)));
+  ::close(fd);
+  return ok;
+}
+
+// ---------------------------------------------------- crc32c GF(2) combine
+//
+// Mirror of tpudfs/common/checksum.py crc32c_combine/_zero_operator (the
+// zlib crc32_combine structure): crc(A+B) = M_{len(B)} * crc(A) ^ crc(B),
+// where M_n is the GF(2) matrix advancing a CRC register across n zero
+// bytes. The streaming write path folds per-frame CRCs into the
+// whole-block CRC with this — no second pass over the data.
+
+constexpr uint32_t kCrcPoly = 0x82F63B78u;
+
+uint32_t crc_matrix_times(const uint32_t mat[32], uint32_t vec) {
+  uint32_t total = 0;
+  for (int i = 0; vec; vec >>= 1, i++)
+    if (vec & 1) total ^= mat[i];
+  return total;
+}
+
+void crc_matrix_square(uint32_t out[32], const uint32_t mat[32]) {
+  for (int i = 0; i < 32; i++) out[i] = crc_matrix_times(mat, mat[i]);
+}
+
+void crc_zero_operator(uint64_t len2, uint32_t result[32]) {
+  uint32_t odd[32], even[32];
+  odd[0] = kCrcPoly;  // operator for one zero bit
+  for (int i = 1; i < 32; i++) odd[i] = 1u << (i - 1);
+  crc_matrix_square(even, odd);  // two zero bits
+  crc_matrix_square(odd, even);  // four zero bits
+  for (int i = 0; i < 32; i++) result[i] = 1u << i;  // identity
+  uint64_t n = len2;
+  while (n) {
+    crc_matrix_square(even, odd);  // next power-of-two byte count
+    if (n & 1) {
+      uint32_t tmp[32];
+      for (int i = 0; i < 32; i++) tmp[i] = crc_matrix_times(even, result[i]);
+      std::memcpy(result, tmp, sizeof(tmp));
+    }
+    std::memcpy(odd, even, sizeof(even));
+    n >>= 1;
+  }
+}
+
 // --------------------------------------------------------------- engine
 
 struct CommitEntry {
@@ -726,6 +844,21 @@ class Engine {
     out[7] = rename_ns_.load();       // publish renames
   }
 
+  // Streaming write pipeline occupancy — slot order MUST match the
+  // Python service's _stream_stats keys (service.py stream_stage_stats
+  // zips them): net_ns, crc_ns, disk_ns, fanout_ns, frames, streams,
+  // stream_bytes, aborts.
+  void stream_stage_stats(uint64_t out[8]) const {
+    out[0] = stream_net_ns_.load();
+    out[1] = stream_crc_ns_.load();
+    out[2] = stream_disk_ns_.load();
+    out[3] = stream_fanout_ns_.load();
+    out[4] = stream_frames_.load();
+    out[5] = streams_started_.load();
+    out[6] = stream_bytes_.load();
+    out[7] = stream_aborts_.load();
+  }
+
   // ------------------------------------------------------ LRU block cache
 
   using CacheData = std::shared_ptr<std::vector<uint8_t>>;
@@ -860,6 +993,11 @@ class Engine {
       bool has_data = h.count("_d") && h["_d"].i;
       if (method == "WriteBlock" || method == "ReplicateBlock") {
         handle_write(s, h, has_data ? &payload : nullptr, &downstream);
+      } else if (method == "WriteStream") {
+        // false = the stream aborted after the ready ack: pipelined
+        // frames may still sit unread in the socket, so the request
+        // boundary is lost and the connection must close.
+        if (!handle_write_stream(s, h, &downstream)) break;
       } else if (method == "ReadBlock") {
         handle_read(s, h);
       } else if (method == "ReadBlocks") {
@@ -925,6 +1063,17 @@ class Engine {
       respond_err(s, "INVALID_ARGUMENT", "bad block id or missing data");
       return;
     }
+    // QoS parity with the asyncio blockport: an already-expired deadline
+    // budget is rejected before any disk work, and the remaining budget /
+    // tenant header ride every chain hop (computed at the forward below).
+    double budget = 0.0;
+    const bool has_db = deadline_budget(h, &budget);
+    if (has_db && budget <= 0) {
+      respond_err(s, "DEADLINE_EXCEEDED",
+                  "deadline budget exhausted before WriteBlock executed");
+      return;
+    }
+    const uint64_t t_recv = now_ns();
     uint64_t req_term =
         h.count("master_term") ? static_cast<uint64_t>(h["master_term"].i) : 0;
     const std::string shard =
@@ -973,9 +1122,10 @@ class Engine {
       } else {
         std::string host = next[0].substr(0, next[0].rfind(':'));
         std::string key = host + ":" + std::to_string(port);
+        double db_left = budget - (now_ns() - t_recv) * 1e-9;
         fwd = forward_request(downstream, key, host,
                               static_cast<uint16_t>(port), h, next,
-                              next_ports, *data, &fwd_err);
+                              next_ports, *data, has_db, db_left, &fwd_err);
       }
     }
 
@@ -1015,13 +1165,470 @@ class Engine {
     respond_write(s, true, fwd_err, replicas);
   }
 
-  Stream* forward_request(std::map<std::string, Stream>* downstream,
+  // ------------------------------------------------ streaming write path
+  //
+  // WriteStream: the block arrives as sub-block frames (protocol spec:
+  // tpudfs/common/writestream.py) and is CRC-folded, staged, and fanned
+  // out hop-by-hop without ever materializing in memory. Stage overlap:
+  // this (receiver) thread runs net read -> CRC fold -> fanout send over
+  // a small ring of reusable frame buffers, a per-stream writer thread
+  // drains the ring to the staged file, and the shared commit thread
+  // makes the block durable (group commit) before the final ack.
+  // Returns false when the connection must close: any post-ready failure
+  // leaves pipelined frames unread in the socket, so the request boundary
+  // is lost. Pre-ready rejections answer an error frame and return true
+  // (the connection stays poolable).
+  bool handle_write_stream(Stream& s, std::map<std::string, Value>& h,
+                           std::map<std::string, Stream>* downstream) {
+    writes_.fetch_add(1);
+    const std::string block_id =
+        h.count("block_id") ? h["block_id"].s : "";
+    if (block_id.empty() || block_id[0] == '.' ||
+        block_id.find('/') != std::string::npos) {
+      respond_err(s, "INVALID_ARGUMENT", "bad block id");
+      return true;
+    }
+    uint64_t req_term =
+        h.count("master_term") ? static_cast<uint64_t>(h["master_term"].i) : 0;
+    const std::string shard =
+        h.count("master_shard") ? h["master_shard"].s : "";
+    uint64_t known = term(shard);
+    if (req_term > 0 && req_term < known) {
+      respond_err(s, "FAILED_PRECONDITION",
+                  "Stale master term: request has " +
+                      std::to_string(req_term) + " but known term is " +
+                      std::to_string(known));
+      return true;
+    }
+    if (req_term > known) set_term(shard, req_term);
+    int64_t size_i = h.count("size") ? h["size"].i : -1;
+    int64_t fsz_i = h.count("frame_size") ? h["frame_size"].i : 0;
+    if (size_i < 0 || fsz_i <= 0 ||
+        static_cast<uint64_t>(fsz_i) > kMaxPayload) {
+      respond_err(s, "INVALID_ARGUMENT", "bad stream size or frame_size");
+      return true;
+    }
+    const uint64_t size = static_cast<uint64_t>(size_i);
+    const uint64_t frame_size = static_cast<uint64_t>(fsz_i);
+    const uint64_t nframes =
+        std::max<uint64_t>(1, (size + frame_size - 1) / frame_size);
+    const uint32_t expected =
+        h.count("expected_crc32c")
+            ? static_cast<uint32_t>(h["expected_crc32c"].i)
+            : 0;
+    double budget = 0.0;
+    const bool has_db = deadline_budget(h, &budget);
+    if (has_db && budget <= 0) {
+      respond_err(s, "DEADLINE_EXCEEDED",
+                  "deadline budget exhausted before WriteStream started");
+      return true;
+    }
+    const uint64_t t_start = now_ns();
+    const uint64_t deadline_ns =
+        has_db ? t_start + static_cast<uint64_t>(budget * 1e9) : 0;
+
+    // Open the staged file before acking ready; a failure here is still a
+    // clean in-sync rejection.
+    uint64_t token = token_seq_.fetch_add(1);
+    std::string base = hot_ + "/" + block_id;
+    auto entry = std::make_shared<CommitEntry>();
+    entry->data_tmp = base + ".tmp-n" + std::to_string(token);
+    entry->meta_tmp = base + ".meta.tmp-n" + std::to_string(token);
+    entry->data_final = base;
+    entry->meta_final = base + ".meta";
+    int dfd = ::open(entry->data_tmp.c_str(),
+                     O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (dfd < 0) {
+      respond_err(s, "INTERNAL",
+                  "stage open failed: " + std::string(::strerror(errno)));
+      return true;
+    }
+
+    // Relay the stream when the next hop has a data port; port 0 or any
+    // begin failure degrades like a dead tail (healer repairs) while the
+    // local replica still lands. Downstream acks are deliberately NOT
+    // read until the tail drain below — they are tiny (one watermark per
+    // kAckEvery frames) and fit in socket buffers, so deferring them
+    // keeps this thread off the ack path while frames flow.
+    std::vector<std::string> next =
+        h.count("next_servers") ? h["next_servers"].astr
+                                : std::vector<std::string>{};
+    std::vector<int64_t> next_ports =
+        h.count("next_data_ports") ? h["next_data_ports"].aint
+                                   : std::vector<int64_t>{};
+    Stream* fwd = nullptr;
+    std::string fwd_key;
+    if (!next.empty() && !next_ports.empty() && next_ports[0] > 0) {
+      std::string host = next[0].substr(0, next[0].rfind(':'));
+      fwd_key = host + ":" + std::to_string(next_ports[0]);
+      std::string dial_err;
+      fwd = open_downstream(downstream, fwd_key, host,
+                            static_cast<uint16_t>(next_ports[0]), &dial_err);
+      if (fwd != nullptr) {
+        forwards_.fetch_add(1);
+        const std::string tenant = h.count("_tn") ? h["_tn"].s : "";
+        Writer w;
+        w.map_head(9 + (has_db ? 1 : 0) + (tenant.empty() ? 0 : 1));
+        w.str("m");
+        w.str("WriteStream");
+        w.str("block_id");
+        w.str(block_id);
+        w.str("size");
+        w.uint(size);
+        w.str("frame_size");
+        w.uint(frame_size);
+        w.str("expected_crc32c");
+        w.uint(expected);
+        w.str("master_term");
+        w.uint(req_term);
+        w.str("master_shard");
+        w.str(shard);
+        w.str("next_servers");
+        w.astr(std::vector<std::string>(next.begin() + 1, next.end()));
+        w.str("next_data_ports");
+        w.aint(next_ports.size() > 1
+                   ? std::vector<int64_t>(next_ports.begin() + 1,
+                                          next_ports.end())
+                   : std::vector<int64_t>{});
+        if (has_db) {
+          w.str("_db");
+          w.flt(budget - (now_ns() - t_start) * 1e-9);
+        }
+        if (!tenant.empty()) {
+          w.str("_tn");
+          w.str(tenant);
+        }
+        std::map<std::string, Value> rh;
+        std::vector<uint8_t> rp;
+        if (!send_frame(*fwd, w.out, nullptr, 0) ||
+            !recv_frame(*fwd, &rh, &rp)) {
+          close_downstream(*fwd);
+          downstream->erase(fwd_key);
+          fwd = nullptr;
+        } else if (!(rh.count("ready") && rh["ready"].i)) {
+          // Clean pre-ready rejection (e.g. an ICI collective member or
+          // an older peer): the downstream connection stays in sync, so
+          // keep it pooled and just skip the relay.
+          fwd = nullptr;
+        }
+      }
+    }
+
+    {
+      Writer w;
+      w.map_head(2);
+      w.str("ok");
+      w.boolean(true);
+      w.str("ready");
+      w.uint(1);
+      if (!send_frame(s, w.out, nullptr, 0)) {
+        ::close(dfd);
+        ::unlink(entry->data_tmp.c_str());
+        if (fwd != nullptr) {
+          close_downstream(*fwd);
+          downstream->erase(fwd_key);
+        }
+        return false;
+      }
+    }
+    streams_started_.fetch_add(1);
+
+    // Ring of reusable frame buffers between this thread and the disk
+    // writer thread; a slot is refilled only after its disk write
+    // finished, so net/CRC/fanout of frame N overlap the write of N-1.
+    constexpr size_t kRing = 4;
+    struct Slot {
+      std::vector<uint8_t> buf;
+      uint64_t len = 0;
+    };
+    Slot ring[kRing];
+    for (auto& sl : ring) sl.buf.resize(frame_size);
+    std::mutex ring_mu;
+    std::condition_variable ring_free_cv, ring_full_cv;
+    size_t ring_head = 0, ring_tail = 0, ring_count = 0;
+    bool ring_done = false, disk_failed = false;
+    std::thread disk([&] {
+      std::unique_lock<std::mutex> lk(ring_mu);
+      for (;;) {
+        ring_full_cv.wait(lk, [&] { return ring_count > 0 || ring_done; });
+        if (ring_count == 0) return;
+        Slot& sl = ring[ring_tail];
+        bool prior_fail = disk_failed;
+        lk.unlock();
+        uint64_t t0 = now_ns();
+        bool wrote =
+            !prior_fail && write_fd_all(dfd, sl.buf.data(), sl.len);
+        stream_disk_ns_.fetch_add(now_ns() - t0);
+        lk.lock();
+        if (!wrote) disk_failed = true;
+        ring_tail = (ring_tail + 1) % kRing;
+        ring_count--;
+        ring_free_cv.notify_one();
+      }
+    });
+
+    // Per-chunk sidecar CRCs carry across frame boundaries; the
+    // whole-block CRC is folded from per-frame CRCs via the GF(2)
+    // combine — one CRC pass per cache-hot frame, none over the
+    // assembled block.
+    std::vector<uint32_t> sums;
+    sums.reserve(size / chunk_ + 2);
+    uint32_t carry_crc = 0;
+    uint64_t carry_len = 0;
+    uint32_t whole = 0;
+    uint32_t op_frame[32];
+    crc_zero_operator(frame_size, op_frame);
+
+    bool torn = false;
+    std::string err_code, err_msg;
+    uint64_t received = 0;
+    for (uint64_t seq = 0; seq < nframes; seq++) {
+      if (has_db && now_ns() > deadline_ns) {
+        err_code = "DEADLINE_EXCEEDED";
+        err_msg = "deadline budget exhausted at frame " +
+                  std::to_string(seq);
+        break;
+      }
+      Slot* sl;
+      {
+        std::unique_lock<std::mutex> lk(ring_mu);
+        ring_free_cv.wait(lk, [&] { return ring_count < kRing; });
+        if (disk_failed) {
+          err_code = "INTERNAL";
+          err_msg = "staged stream write failed";
+          break;
+        }
+        sl = &ring[ring_head];
+      }
+      uint64_t t0 = now_ns();
+      std::map<std::string, Value> fh;
+      uint64_t plen = 0;
+      if (!recv_frame_into(s, &fh, sl->buf.data(), frame_size, &plen)) {
+        torn = true;
+        break;
+      }
+      uint64_t t1 = now_ns();
+      stream_net_ns_.fetch_add(t1 - t0);
+      uint64_t want = std::min(frame_size, size - received);
+      int64_t fseq = fh.count("q") ? fh["q"].i : -1;
+      if (static_cast<uint64_t>(fseq) != seq ||
+          !(fh.count("_d") && fh["_d"].i) || plen != want) {
+        err_code = "INVALID_ARGUMENT";
+        err_msg = "unexpected frame " + std::to_string(fseq) +
+                  " (want " + std::to_string(seq) + ")";
+        break;
+      }
+      uint32_t fcrc = tpudfs_crc32c(0, sl->buf.data(), plen);
+      uint32_t want_crc =
+          fh.count("c") ? static_cast<uint32_t>(fh["c"].i) : 0;
+      if (fcrc != want_crc) {
+        err_code = "DATA_LOSS";
+        err_msg = "frame " + std::to_string(seq) +
+                  " CRC mismatch; staged block " + block_id +
+                  " quarantined";
+        break;
+      }
+      if (seq == 0) {
+        whole = fcrc;
+      } else if (plen == frame_size) {
+        whole = crc_matrix_times(op_frame, whole) ^ fcrc;
+      } else {
+        uint32_t op_tail[32];
+        crc_zero_operator(plen, op_tail);
+        whole = crc_matrix_times(op_tail, whole) ^ fcrc;
+      }
+      uint64_t off = 0;
+      if (carry_len) {
+        uint64_t take = std::min<uint64_t>(chunk_ - carry_len, plen);
+        carry_crc = tpudfs_crc32c(carry_crc, sl->buf.data(), take);
+        carry_len += take;
+        off = take;
+        if (carry_len == chunk_) {
+          sums.push_back(carry_crc);
+          carry_crc = 0;
+          carry_len = 0;
+        }
+      }
+      while (off + chunk_ <= plen) {
+        sums.push_back(tpudfs_crc32c(0, sl->buf.data() + off, chunk_));
+        off += chunk_;
+      }
+      if (off < plen) {
+        carry_crc = tpudfs_crc32c(0, sl->buf.data() + off, plen - off);
+        carry_len = plen - off;
+      }
+      uint64_t t2 = now_ns();
+      stream_crc_ns_.fetch_add(t2 - t1);
+      // Fan out before handing the slot to the disk stage (the slot is
+      // reused only after its disk write, so the send reads stable bytes).
+      if (fwd != nullptr) {
+        Writer w;
+        w.map_head(3);
+        w.str("q");
+        w.uint(seq);
+        w.str("c");
+        w.uint(fcrc);
+        w.str("_d");
+        w.uint(1);
+        if (!send_frame(*fwd, w.out, sl->buf.data(), plen)) {
+          // Downstream died mid-stream: degrade like a dead tail, keep
+          // the local replica going.
+          close_downstream(*fwd);
+          downstream->erase(fwd_key);
+          fwd = nullptr;
+        }
+      }
+      uint64_t t3 = now_ns();
+      stream_fanout_ns_.fetch_add(t3 - t2);
+      {
+        std::lock_guard<std::mutex> lk(ring_mu);
+        sl->len = plen;
+        ring_head = (ring_head + 1) % kRing;
+        ring_count++;
+      }
+      ring_full_cv.notify_one();
+      received += plen;
+      stream_frames_.fetch_add(1);
+      stream_bytes_.fetch_add(plen);
+      // Group-committed acks: per-frame progress coalesces into watermark
+      // acks; the covering ack for the last frames is the final frame,
+      // sent only after the durable commit below.
+      if ((seq + 1) % kAckEvery == 0 && seq + 1 < nframes) {
+        Writer w;
+        w.map_head(2);
+        w.str("ok");
+        w.boolean(true);
+        w.str("w");
+        w.uint(seq + 1);
+        if (!send_frame(s, w.out, nullptr, 0)) {
+          torn = true;
+          break;
+        }
+      }
+    }
+
+    // Drain the disk stage before touching the staged file.
+    {
+      std::lock_guard<std::mutex> lk(ring_mu);
+      ring_done = true;
+    }
+    ring_full_cv.notify_all();
+    disk.join();
+    ::close(dfd);
+
+    auto scrap = [&] {
+      stream_aborts_.fetch_add(1);
+      ::unlink(entry->data_tmp.c_str());
+      ::unlink(entry->meta_tmp.c_str());
+      if (fwd != nullptr) {
+        // Tear the relay too so the abort propagates down the chain.
+        close_downstream(*fwd);
+        downstream->erase(fwd_key);
+        fwd = nullptr;
+      }
+    };
+    if (torn) {  // transport tear: nobody left to answer
+      scrap();
+      return false;
+    }
+    if (!err_code.empty()) {
+      scrap();
+      respond_err(s, err_code, err_msg);
+      return false;
+    }
+    if (disk_failed) {
+      scrap();
+      respond_err(s, "INTERNAL", "staged stream write failed");
+      return false;
+    }
+
+    if (carry_len) sums.push_back(carry_crc);
+    bool success = true;
+    std::string errmsg;
+    if (expected != 0 && whole != expected) {
+      // Every frame CRC-verified yet the whole disagrees (sender-side
+      // corruption before framing): quarantine the staged bytes and
+      // report a soft failure — all frames were consumed, so the
+      // protocol stays in sync.
+      ::unlink(entry->data_tmp.c_str());
+      success = false;
+      errmsg = "Checksum mismatch: expected " + std::to_string(expected) +
+               ", actual " + std::to_string(whole);
+    }
+    if (success && !write_meta_tmp(entry->meta_tmp, chunk_, sums)) {
+      ::unlink(entry->data_tmp.c_str());
+      ::unlink(entry->meta_tmp.c_str());
+      success = false;
+      errmsg = "meta stage failed";
+    }
+    int64_t replicas = 0;
+    if (success) {
+      staged_bytes_.fetch_add(size);
+      std::string cerr;
+      if (commit_entry_and_wait(entry, &cerr)) {
+        replicas = 1;
+      } else {
+        success = false;
+        errmsg = cerr;
+      }
+      cache_invalidate(block_id);
+    }
+
+    if (fwd != nullptr) {
+      // Drain the relay's coalesced watermarks down to its final verdict
+      // (sent only after ITS durable commit and its own tail's final).
+      uint64_t ta = now_ns();
+      for (;;) {
+        std::map<std::string, Value> ah;
+        std::vector<uint8_t> ap;
+        if (!recv_frame(*fwd, &ah, &ap)) {
+          close_downstream(*fwd);
+          downstream->erase(fwd_key);
+          fwd = nullptr;
+          break;
+        }
+        if (ah.count("final") && ah["final"].i) {
+          if (ah.count("success") && ah["success"].b)
+            replicas +=
+                ah.count("replicas_written") ? ah["replicas_written"].i : 0;
+          break;
+        }
+        if (!(ah.count("ok") && ah["ok"].b)) {
+          // Error frame ends the downstream stream; the peer closes.
+          close_downstream(*fwd);
+          downstream->erase(fwd_key);
+          fwd = nullptr;
+          break;
+        }
+      }
+      fwd_ack_ns_.fetch_add(now_ns() - ta);
+    }
+
+    // Final group-commit ack: the watermark covers the whole block and
+    // the local replica (plus everything downstream reported) is durable.
+    Writer w;
+    w.map_head(6);
+    w.str("ok");
+    w.boolean(true);
+    w.str("final");
+    w.uint(1);
+    w.str("w");
+    w.uint(nframes);
+    w.str("success");
+    w.boolean(success);
+    w.str("error_message");
+    w.str(errmsg);
+    w.str("replicas_written");
+    w.uint(static_cast<uint64_t>(replicas));
+    return send_frame(s, w.out, nullptr, 0);
+  }
+
+  // Dial (or reuse) the per-connection downstream stream for `key`,
+  // including the outbound TLS policy (never plaintext off a secured
+  // listener). Shared by the whole-block forward and the stream relay.
+  Stream* open_downstream(std::map<std::string, Stream>* downstream,
                           const std::string& key, const std::string& host,
-                          uint16_t port, std::map<std::string, Value>& h,
-                          const std::vector<std::string>& next,
-                          const std::vector<int64_t>& next_ports,
-                          const std::vector<uint8_t>& data,
-                          std::string* err) {
+                          uint16_t port, std::string* err) {
     auto it = downstream->find(key);
     if (it == downstream->end()) {
       int dfd = dial(host, port);
@@ -1067,9 +1674,22 @@ class Engine {
       std::lock_guard<std::mutex> g(conns_mu_);
       conns_.insert(dfd);
     }
-    Stream* d = &it->second;
+    return &it->second;
+  }
+
+  Stream* forward_request(std::map<std::string, Stream>* downstream,
+                          const std::string& key, const std::string& host,
+                          uint16_t port, std::map<std::string, Value>& h,
+                          const std::vector<std::string>& next,
+                          const std::vector<int64_t>& next_ports,
+                          const std::vector<uint8_t>& data,
+                          bool has_db, double db_left,
+                          std::string* err) {
+    Stream* d = open_downstream(downstream, key, host, port, err);
+    if (d == nullptr) return nullptr;
+    const std::string tenant = h.count("_tn") ? h["_tn"].s : "";
     Writer w;
-    w.map_head(8);
+    w.map_head(8 + (has_db ? 1 : 0) + (tenant.empty() ? 0 : 1));
     w.str("m");
     w.str("ReplicateBlock");
     w.str("_d");
@@ -1092,6 +1712,14 @@ class Engine {
                                   : 0);
     w.str("master_shard");
     w.str(h.count("master_shard") ? h["master_shard"].s : "");
+    if (has_db) {
+      w.str("_db");
+      w.flt(db_left);
+    }
+    if (!tenant.empty()) {
+      w.str("_tn");
+      w.str(tenant);
+    }
     if (!send_frame(*d, w.out, data.data(), data.size())) {
       close_downstream(*d);
       downstream->erase(key);
@@ -1157,6 +1785,13 @@ class Engine {
       *err = "stage failed: errno " + std::to_string(-rc);
       return false;
     }
+    return commit_entry_and_wait(entry, err);
+  }
+
+  // Queue a staged entry for the group-commit loop and block until its
+  // verdict — shared tail of the whole-block and streaming write paths.
+  bool commit_entry_and_wait(const std::shared_ptr<CommitEntry>& entry,
+                             std::string* err) {
     uint64_t tq = now_ns();
     std::unique_lock<std::mutex> lk(commit_mu_);
     commit_queue_.push_back(entry);
@@ -1501,6 +2136,9 @@ class Engine {
   std::atomic<uint64_t> stage_ns_{0}, commit_wait_ns_{0}, syncfs_ns_{0},
       fwd_ack_ns_{0}, commit_batches_{0}, commit_entries_{0},
       staged_bytes_{0}, rename_ns_{0};
+  std::atomic<uint64_t> stream_net_ns_{0}, stream_crc_ns_{0},
+      stream_disk_ns_{0}, stream_fanout_ns_{0}, stream_frames_{0},
+      streams_started_{0}, stream_bytes_{0}, stream_aborts_{0};
   std::thread accept_thread_, commit_thread_;
   std::atomic<int> active_{0};
   std::mutex conns_mu_;
@@ -1539,7 +2177,7 @@ extern "C" {
 // Bumped on any signature/behavior change of the dataplane C ABI; the
 // Python loader refuses to bind mismatched prebuilt libraries
 // (TPUDFS_NATIVE_LIB) instead of calling with wrong arity.
-int64_t tpudfs_dataplane_abi(void) { return 4; }
+int64_t tpudfs_dataplane_abi(void) { return 5; }
 
 int64_t tpudfs_dataplane_start(const char* host, const char* hot_dir,
                                const char* cold_dir, uint32_t chunk_size,
@@ -1609,6 +2247,14 @@ void tpudfs_dataplane_stats(int64_t h, uint64_t out[6]) {
 void tpudfs_dataplane_stage_stats(int64_t h, uint64_t out[8]) {
   Engine* e = get_engine(h);
   if (e) e->stage_stats(out);
+  else for (int i = 0; i < 8; i++) out[i] = 0;
+}
+
+// Streaming write pipeline occupancy: net_ns, crc_ns, disk_ns,
+// fanout_ns, frames, streams, stream_bytes, aborts.
+void tpudfs_dataplane_stream_stats(int64_t h, uint64_t out[8]) {
+  Engine* e = get_engine(h);
+  if (e) e->stream_stage_stats(out);
   else for (int i = 0; i < 8; i++) out[i] = 0;
 }
 

@@ -40,6 +40,14 @@ AXES (round-5 expansion — the round-4 plans centered on kills):
   RS(2,1) rather than (3,2) on purpose: killed chunkservers stay dead
   for the round, and the post-fault resume must still be able to place
   k+m EC shards on the 3 guaranteed survivors.
+- ``stream``: a dedicated 4 MiB-block client (every block rides the
+  sub-block WriteStream frame pipeline, docs/write-pipeline.md) writes
+  a sequence of 12 MiB files THROUGH the fault window, and the axis
+  SIGKILLs one extra chain chunkserver while a streamed write is
+  verifiably in flight (within the 2-CS kill cap). Post-faults: every
+  acked file reads back md5-exact, and any UN-acked path that is
+  visible at all must also read back exact — a torn or partially
+  committed streamed block surfacing is the bug this axis hunts.
 - ``tenant``: the cluster boots with per-tenant QoS on (TPUDFS_QOS=1:
   weighted-fair queueing + a per-tenant rate), and a 16-way "abuser"
   flood runs through the whole fault window while a budgeted "fair"
@@ -139,6 +147,7 @@ def make_axes(rng: random.Random) -> dict:
         "overload": "overload" in forced or rng.random() < 0.4,
         "ckpt": "ckpt" in forced or rng.random() < 0.35,
         "tenant": "tenant" in forced or rng.random() < 0.35,
+        "stream": "stream" in forced or rng.random() < 0.4,
     }
 
 
@@ -260,6 +269,33 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
                            tenant="abuser", local_reads=False)
         print("  tenant axis: budgeted fair reader vs 16-way abuser flood")
 
+    # Stream axis: a 4 MiB-block client (every block >= MIN_STREAM_BYTES
+    # rides the WriteStream frame pipeline) writes files through the
+    # fault window, and ONE extra chunkserver — inside the 2-kill safety
+    # cap — is SIGKILLed only once a streamed write is verifiably in
+    # flight, so the kill lands mid-chain, mid-stream.
+    st_client = None
+    st_md5 = None
+    st_results: list[tuple[str, bool]] = []
+    st_inflight = asyncio.Event()
+    st_victim = None
+    if axes.get("stream"):
+        st_client = Client(masters, config_addrs=[eps["config_server"]],
+                           block_size=4 * 1024 * 1024, rpc_timeout=3.0,
+                           max_retries=8, tls=tls)
+        st_payload = os.urandom(12 * 1024 * 1024)
+        st_md5 = hashlib.md5(st_payload).hexdigest()
+        plan_killed = {p for _, k, p in plan if k == "kill_cs"}
+        spare = sorted(n for n in procs
+                       if n.startswith("cs") and n not in plan_killed)
+        if len(plan_killed) < 2 and spare:
+            st_victim = rng.choice(spare)
+            print(f"  stream axis: will SIGKILL {st_victim} "
+                  f"({procs[st_victim]['addr']}) mid-streamed-write")
+        else:
+            print("  stream axis: kill cap reached by the plan; riding "
+                  "the plan's own CS kills")
+
     wl_client = Client(masters, config_addrs=[eps["config_server"]],
                        rpc_timeout=3.0, max_retries=8,
                        host_aliases=aliases, tls=tls)
@@ -356,6 +392,31 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
                       f"({type(e).__name__})")
             await asyncio.sleep(rng.uniform(0.2, 0.8))
 
+    async def stream_writer() -> None:
+        if st_client is None:
+            return
+        for i in range(10):
+            path = f"/a/roulette-stream-{i}"
+            st_inflight.set()
+            try:
+                await st_client.create_file(path, st_payload)
+                st_results.append((path, True))
+            except DfsError:
+                # Clean bounded failure under faults is acceptable; the
+                # post-fault sweep decides whether anything torn became
+                # visible.
+                st_results.append((path, False))
+            await asyncio.sleep(rng.uniform(0.05, 0.2))
+
+    async def stream_killer() -> None:
+        if st_victim is None:
+            return
+        await st_inflight.wait()
+        await asyncio.sleep(rng.uniform(0.1, 0.6))
+        os.kill(procs[st_victim]["pid"], signal.SIGKILL)
+        print(f"  stream axis: SIGKILL {st_victim} "
+              f"({procs[st_victim]['addr']}) during streamed writes")
+
     async def overloaded_reader() -> None:
         if ov_client is None:
             return
@@ -410,7 +471,8 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
 
     await asyncio.gather(workload, injector(), torn_killer(),
                          overloaded_reader(), checkpointer(),
-                         tenant_flood(), tenant_fair_reader())
+                         tenant_flood(), tenant_fair_reader(),
+                         stream_writer(), stream_killer())
     entries = workload.result()
     ok_ops = sum(1 for e in entries if e.get("return_ts") is not None)
     print(f"  workload: {len(entries)} ops ({ok_ops} returned)")
@@ -568,6 +630,38 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
               f"(resumed {resume or 'none'}; "
               f"degraded reads {ck_mgr.stats['degraded_shard_reads']}, "
               f"shards skipped on resume {ck_mgr.stats['shards_skipped']})")
+    if st_client is not None:
+        acked = [p for p, ok in st_results if ok]
+        failed = [p for p, ok in st_results if not ok]
+        assert acked, (
+            f"stream axis: 0/{len(st_results)} streamed writes completed "
+            f"(round {rnd}); plan: {plan}")
+        # Every acked streamed file is chain-durable by contract (final
+        # ack = group-committed watermark covering the block) and must
+        # read back byte-exact even with the victim still dead.
+        for path in acked:
+            back = await settle(f"stream read {path}",
+                                lambda p=path: v_client.get_file(p))
+            assert hashlib.md5(back).hexdigest() == st_md5, (
+                f"stream axis: acked streamed file {path} corrupt "
+                f"(round {rnd}); plan: {plan}")
+        # Un-acked paths: invisible is fine (the abort discarded staged
+        # frames), but anything VISIBLE must be byte-exact — a torn
+        # partially-committed streamed block must never surface.
+        torn_visible = 0
+        for path in failed:
+            try:
+                back = await v_client.get_file(path)
+            except DfsError:
+                continue
+            torn_visible += 1
+            assert hashlib.md5(back).hexdigest() == st_md5, (
+                f"stream axis: un-acked streamed file {path} surfaced "
+                f"TORN (round {rnd}); plan: {plan}")
+        print(f"  stream axis: {len(acked)}/{len(st_results)} streamed "
+              f"writes acked + byte-exact; {len(failed)} clean failures "
+              f"({torn_visible} visible-and-exact); victim "
+              f"{st_victim or 'plan-drawn'}")
     if tn_fair is not None:
         assert tn_fair_walls and max(tn_fair_walls) <= tn_budget_grace, (
             f"tenant axis: fair read blew its deadline budget under the "
@@ -616,6 +710,8 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
         await ov_client.close()
     if ck_client is not None:
         await ck_client.close()
+    if st_client is not None:
+        await st_client.close()
     if tn_fair is not None:
         await tn_fair.close()
         await tn_abuser.close()

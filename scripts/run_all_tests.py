@@ -254,6 +254,15 @@ def main() -> None:
             [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
              "--seed=3579", "--force-axes=ckpt",
              "--topology", args.topology])
+        # Stream-pinned round: 4 MiB-block streamed writes (the sub-block
+        # frame pipeline) run through the seeded fault window and one
+        # extra chain chunkserver is SIGKILLed mid-stream — acked files
+        # must read back byte-exact and no torn partially-committed block
+        # may ever surface (docs/write-pipeline.md abort semantics).
+        run("live chaos roulette (stream axis)",
+            [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
+             "--seed=5791", "--force-axes=stream",
+             "--topology", args.topology])
         # Tenant-pinned round: the cluster boots with per-tenant QoS on
         # and an abuser tenant floods the data path through the seeded
         # fault window — the fair tenant stays inside its deadline budget

@@ -1874,3 +1874,109 @@ def test_tpl034_silent_for_small_control_dict(tmp_path):
                         w.write(body)
         """,
     }, rules=["TPL034"]) == []
+
+
+# ------------------------------------------------------------------ TPL026
+
+
+def test_tpl026_flags_whole_block_gulp_on_write_path(tmp_path):
+    """A single readexactly of a header-declared size materializes the
+    whole block before anything downstream sees a byte."""
+    findings = lint_tree(tmp_path, {
+        "tpudfs/chunkserver/service.py": """
+            class ChunkServer:
+                async def rpc_write_block(self, r, w, req):
+                    size = req["size"]
+                    data = await r.readexactly(size)
+                    await self.store.write(req["block_id"], data)
+        """,
+    }, rules=["TPL026"])
+    assert [f.rule for f in findings] == ["TPL026"]
+    assert "gulps" in findings[0].message
+
+
+def test_tpl026_silent_for_capped_and_guarded_reads(tmp_path):
+    """The disciplined shapes: a size bounds-checked against a protocol
+    cap before the read (the generic frame reader), and a min()-capped
+    chunk read (the scatter loop)."""
+    assert lint_tree(tmp_path, {
+        "tpudfs/chunkserver/service.py": """
+            MAX_FRAME = 1 << 20
+
+            class ChunkServer:
+                async def rpc_write_block(self, r, w, req):
+                    plen = req["frame_len"]
+                    if plen > MAX_FRAME:
+                        raise ConnectionError("frame too large")
+                    payload = await r.readexactly(plen)
+                    header = await r.readexactly(4)
+                    remaining = req["size"]
+                    while remaining > 0:
+                        chunk = await r.read(min(65536, remaining))
+                        w.write(chunk)
+                        remaining -= len(chunk)
+        """,
+    }, rules=["TPL026"]) == []
+
+
+def test_tpl026_flags_accumulate_only_read_loop(tmp_path):
+    """Chunked reads whose ONLY use is growing a local buffer: linear,
+    so TPL031 is silent — but still store-and-forward, which is the
+    discipline this rule owns."""
+    findings = lint_tree(tmp_path, {
+        "tpudfs/chunkserver/service.py": """
+            class ChunkServer:
+                async def rpc_write_block(self, r, w, req):
+                    buf = bytearray()
+                    while len(buf) < req["size"]:
+                        chunk = await r.read(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                    await self.store.write(req["block_id"], bytes(buf))
+        """,
+    }, rules=["TPL026"])
+    assert [f.rule for f in findings] == ["TPL026"]
+    assert "accumulates" in findings[0].message
+
+
+def test_tpl026_silent_when_each_chunk_is_also_consumed(tmp_path):
+    """The mixed-chain fallback shape: the loop buffers for a
+    whole-block downstream forward, but each frame ALSO goes to the
+    staged writer as it lands — buffering is a declared fallback next
+    to the streaming path, not the path."""
+    assert lint_tree(tmp_path, {
+        "tpudfs/chunkserver/service.py": """
+            import asyncio
+
+            class ChunkServer:
+                async def rpc_write_block(self, r, w, req, writer):
+                    fwd_buf = bytearray()
+                    while len(fwd_buf) < req["size"]:
+                        chunk = await r.read(65536)
+                        if not chunk:
+                            break
+                        fwd_buf += chunk
+                        await asyncio.to_thread(writer.append, chunk)
+                    await self.finish(writer, bytes(fwd_buf))
+        """,
+    }, rules=["TPL026"]) == []
+
+
+def test_tpl026_silent_off_the_write_hot_path(tmp_path):
+    """Scope: the same gulp in a cold helper (unreachable from the
+    data-plane roots) and in a hot READ handler stays silent — a read's
+    caller asked for whole bytes; frames are the WRITE contract."""
+    assert lint_tree(tmp_path, {
+        "tpudfs/common/util.py": """
+            async def write_snapshot(r, store, size):
+                data = await r.readexactly(size)
+                await store.write("snap", data)
+        """,
+        "tpudfs/chunkserver/service.py": """
+            class ChunkServer:
+                async def rpc_read_blocks(self, r, w, req):
+                    body = await r.readexactly(req["size"])
+                    return body
+        """,
+    }, rules=["TPL026"]) == []

@@ -26,6 +26,7 @@ from tpudfs.analysis.rules import (  # noqa: F401
     resources,
     raft_durability,
     ckpt_publish,
+    stream_discipline,
     # tpuperf performance rules (hotpath.py + bufferflow.py backed)
     perf,
 )

@@ -1,0 +1,269 @@
+"""TPL026 — stream discipline on the framed write path.
+
+Sub-block framing is the write-path contract
+(``tpudfs/common/writestream.py``, docs/write-pipeline.md): payload
+moves as ~256 KiB frames that are CRC-folded, staged to disk and fanned
+out downstream *as they arrive*. Code on that path that gulps a whole
+header-declared payload in one ``await r.read(size)`` — or loops reads
+into a local buffer that nothing consumes until the last byte lands —
+reintroduces exactly the store-and-forward latency and O(block) memory
+the pipeline removed, one layer at a time.
+
+Scope is deliberately narrow: hot-path *async* functions (reachability
+from the bench/data-plane roots, :mod:`tpudfs.analysis.hotpath`) whose
+qualified name marks them as write-path or serve-loop code. The
+disciplined idioms that remain legitimate stay silent:
+
+- fixed-size reads (header peeks, constant chunk sizes);
+- reads capped with ``min(...)`` — the bounded scatter-chunk loop;
+- reads of a size the function first validates against a protocol cap
+  (``if plen > _MAX_PAYLOAD: raise``) — the generic frame reader shape;
+- accumulation where each chunk is ALSO handed to a per-iteration
+  consumer (staged disk append, downstream relay send): the buffer is
+  then a declared fallback alongside the streaming path, not the path.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tpudfs.analysis.callgraph import FunctionInfo
+from tpudfs.analysis.linter import Finding, ProjectRule, register
+from tpudfs.analysis.rules.perf import _call_name, _hot_functions
+
+#: Function qualnames this rule polices: the write/forward/staging path
+#: and the transport serve loops that carry it. Read paths are exempt by
+#: design — a read's caller asked for whole bytes; the write path's
+#: contract is frames.
+_WRITE_PATH_RE = re.compile(
+    r"write|replicat|stream|stage|persist|ingest|upload|_handle|_serve",
+    re.IGNORECASE,
+)
+
+#: Stream-reader methods whose await pulls payload off a socket.
+_READ_ATTRS = {"read", "readexactly"}
+
+#: Call-name prefixes treated as "reads a chunk" for the accumulation
+#: detector (covers in-tree helpers like ``_read_frame``).
+_READ_CALL_PREFIXES = ("read", "_read", "recv", "_recv")
+
+#: Constructors of local grow-only buffers. Scatter writes into a
+#: buffer handed in from elsewhere (``segments[i][off:] = chunk``) are
+#: the caller's discipline, not accumulation, and are not matched.
+_CONTAINER_FACTORIES = {"bytearray", "list", "deque", "BytesIO"}
+
+
+def _cap_guarded_names(fn_node: ast.AST) -> set[str]:
+    """Names the function bounds-checks with a compare that raises or
+    returns — ``if plen > _MAX_PAYLOAD: raise`` marks ``plen`` as a
+    protocol-capped size, so reading it is a frame read, not a gulp."""
+    out: set[str] = set()
+    for n in ast.walk(fn_node):
+        if not isinstance(n, ast.If):
+            continue
+        test = n.test
+        if not isinstance(test, ast.Compare):
+            continue
+        if not any(isinstance(op, (ast.Gt, ast.GtE, ast.Lt, ast.LtE))
+                   for op in test.ops):
+            continue
+        if not any(isinstance(b, (ast.Raise, ast.Return)) for b in n.body):
+            continue
+        for name in ast.walk(test):
+            if isinstance(name, ast.Name):
+                out.add(name.id)
+    return out
+
+
+def _container_names(fn_node: ast.AST) -> set[str]:
+    """Local names bound to a fresh grow-only container."""
+    out: set[str] = set()
+    for n in ast.walk(fn_node):
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)):
+            continue
+        v = n.value
+        if isinstance(v, ast.Call) and _call_name(v) in _CONTAINER_FACTORIES:
+            out.add(n.targets[0].id)
+        elif isinstance(v, ast.List) and not v.elts:
+            out.add(n.targets[0].id)
+        elif isinstance(v, ast.Constant) and v.value == b"":
+            out.add(n.targets[0].id)
+    return out
+
+
+@register
+class WritePathStreamDiscipline(ProjectRule):
+    id = "TPL026"
+    name = "write-path-stream-discipline"
+    summary = ("whole-block `await r.read(size)` gulp or read-loop that "
+               "only accumulates bytes on the framed write hot path — "
+               "stage/forward each frame as it arrives instead of "
+               "materializing the block")
+    doc = (
+        "Sub-block framing is the write-path contract (writestream.py, "
+        "docs/write-pipeline.md): each ~256 KiB frame is CRC-folded, "
+        "staged to disk and relayed downstream the moment it arrives, "
+        "so chain latency is ~one block time plus a frame time per hop "
+        "instead of a full store-and-forward per hop. This rule flags "
+        "the two shapes that silently undo that on hot write/serve "
+        "functions: (1) a single `await r.read(size)`/`readexactly(size)` "
+        "of a variable, un-capped size — the whole-payload gulp; (2) a "
+        "read loop whose chunks' ONLY use is growing a local buffer, so "
+        "nothing downstream sees a byte until the loop ends. Fixed-size "
+        "reads, `min(...)`-capped chunk reads, sizes the function "
+        "bounds-checks against a protocol cap before reading, and loops "
+        "that also hand each chunk to a per-iteration consumer (staged "
+        "append, relay send) all stay silent."
+    )
+    example = """\
+async def rpc_write_block(self, r, w, req):
+    size = req["size"]
+    data = await r.readexactly(size)     # whole-block gulp
+    await self.store.write(req["block_id"], data)
+"""
+    fix = ("Consume the payload frame-at-a-time: read bounded chunks "
+           "(`await r.read(min(FRAME_SIZE, remaining))` or the "
+           "writestream frame protocol) and hand each one to the staged "
+           "writer / downstream relay as it lands — see "
+           "tpudfs/common/writestream.py and docs/write-pipeline.md.")
+
+    def check_project(self, project) -> Iterator[Finding]:
+        for fn, _entry in _hot_functions(project, self.id):
+            if not fn.is_async:
+                continue
+            if not _WRITE_PATH_RE.search(fn.qualname):
+                continue
+            yield from self._gulp_reads(fn)
+            yield from self._accumulate_only_loops(fn)
+
+    # -------------------------------------------------- whole-payload gulp
+
+    def _gulp_reads(self, fn: FunctionInfo) -> Iterator[Finding]:
+        module = fn.module
+        guarded = _cap_guarded_names(fn.node)
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.Await):
+                continue
+            call = n.value
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _READ_ATTRS):
+                continue
+            if module.enclosing_function(n) is not fn.node:
+                continue
+            if not call.args:
+                yield self.finding(
+                    module, call,
+                    f"`{call.func.attr}()` with no size in hot "
+                    f"`{fn.short()}` reads the entire remaining payload "
+                    "in one await — consume it as bounded frames "
+                    "(writestream discipline)")
+                continue
+            size = call.args[0]
+            if isinstance(size, ast.Constant):
+                continue
+            walked = list(ast.walk(size))
+            if any(isinstance(s, ast.Call) and _call_name(s) == "min"
+                   for s in walked):
+                continue
+            names = [s.id for s in walked if isinstance(s, ast.Name)]
+            if names and all(nm in guarded for nm in names):
+                continue
+            yield self.finding(
+                module, call,
+                f"`{call.func.attr}(...)` of a variable, un-capped size "
+                f"in hot `{fn.short()}` gulps a whole header-declared "
+                "payload into memory — read bounded frames and stage/"
+                "forward each as it arrives (writestream discipline)")
+
+    # -------------------------------------------- accumulate-only read loop
+
+    def _accumulate_only_loops(self, fn: FunctionInfo) -> Iterator[Finding]:
+        module = fn.module
+        containers = _container_names(fn.node)
+        if not containers:
+            return
+        for loop in ast.walk(fn.node):
+            if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            if module.enclosing_function(loop) is not fn.node:
+                continue
+            for accum, chunk, container in self._accum_only(
+                    module, loop, containers):
+                yield self.finding(
+                    module, accum,
+                    f"read loop in hot `{fn.short()}` only accumulates "
+                    f"`{chunk}` into `{container}` — nothing consumes a "
+                    "byte until the last frame lands; CRC/stage/forward "
+                    "each frame per iteration instead of materializing "
+                    "the whole block (writestream discipline)")
+
+    @classmethod
+    def _accum_only(cls, module, loop: ast.AST, containers: set[str]
+                    ) -> Iterator[tuple[ast.AST, str, str]]:
+        body = [n for stmt in loop.body for n in ast.walk(stmt)]
+        for chunk, defining in cls._chunk_vars(body):
+            accum = consumed = None
+            for use in body:
+                if not (isinstance(use, ast.Name) and use.id == chunk
+                        and isinstance(use.ctx, ast.Load)):
+                    continue
+                if any(anc is defining for anc in module.ancestors(use)):
+                    continue
+                hit = cls._accumulation_use(module, use, containers)
+                if hit is not None:
+                    accum = hit
+                elif not cls._neutral_use(module, use):
+                    consumed = use
+            if accum is not None and consumed is None:
+                node, container = accum
+                yield node, chunk, container
+
+    @staticmethod
+    def _chunk_vars(body: list[ast.AST]) -> Iterator[tuple[str, ast.AST]]:
+        """(name, defining assignment) for loop-body names bound from an
+        awaited read-like call (tuple unpack included)."""
+        for n in body:
+            if not (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Await)
+                    and isinstance(n.value.value, ast.Call)):
+                continue
+            if not _call_name(n.value.value).startswith(_READ_CALL_PREFIXES):
+                continue
+            for t in n.targets:
+                targets = t.elts if isinstance(t, ast.Tuple) else [t]
+                for tt in targets:
+                    if isinstance(tt, ast.Name):
+                        yield tt.id, n
+
+    @staticmethod
+    def _accumulation_use(module, use: ast.Name, containers: set[str]
+                          ) -> tuple[ast.AST, str] | None:
+        parent = module.parent(use)
+        if isinstance(parent, ast.AugAssign) \
+                and isinstance(parent.op, ast.Add) \
+                and isinstance(parent.target, ast.Name) \
+                and parent.target.id in containers:
+            return parent, parent.target.id
+        if isinstance(parent, ast.Call) and use in parent.args \
+                and isinstance(parent.func, ast.Attribute) \
+                and parent.func.attr in ("append", "extend", "write") \
+                and isinstance(parent.func.value, ast.Name) \
+                and parent.func.value.id in containers:
+            return parent, parent.func.value.id
+        return None
+
+    @staticmethod
+    def _neutral_use(module, use: ast.Name) -> bool:
+        """len()/truthiness/comparison: flow control, not consumption."""
+        parent = module.parent(use)
+        if isinstance(parent, ast.Call) and _call_name(parent) == "len":
+            return True
+        if isinstance(parent, (ast.UnaryOp, ast.Compare, ast.BoolOp)):
+            return True
+        if isinstance(parent, (ast.If, ast.While)) and use is parent.test:
+            return True
+        return False

@@ -30,7 +30,7 @@ from pathlib import Path
 import numpy as np
 
 from tpudfs.common import native
-from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE, crc32c_chunks
+from tpudfs.common.checksum import CHECKSUM_CHUNK_SIZE, crc32c, crc32c_chunks
 from tpudfs.common.fsutil import write_durable
 
 #: Native block engine status codes (native/blockio.cc).
@@ -248,6 +248,16 @@ class BlockStore:
     def discard_staged(self, block_id: str, token: str) -> None:
         for p in self._staged_paths(block_id, token):
             p.unlink(missing_ok=True)
+
+    def stage_writer(self, block_id: str, token: str) -> "StagedBlockWriter":
+        """Incremental stager for the streaming write path: frames append
+        to the ``.tmp-<token>`` data file as they arrive while per-chunk
+        CRCs accumulate across frame boundaries, so the sidecar never
+        needs a second pass over the payload. ``finish()`` leaves the
+        pair exactly where ``write_staged`` would — ready for
+        ``publish_staged_batch`` / ``discard_staged``."""
+        dtmp, mtmp = self._staged_paths(block_id, token)
+        return StagedBlockWriter(self, dtmp, mtmp)
 
     def _syncfs(self) -> None:
         lib = native.get_lib()
@@ -483,3 +493,75 @@ class BlockStore:
             "used_space": used,
             "available_space": vfs.f_bavail * vfs.f_frsize,
         }
+
+
+class StagedBlockWriter:
+    """Append-only stager for streamed writes (see BlockStore.stage_writer).
+
+    Frames land with arbitrary sizes, so per-chunk CRCs carry partial-chunk
+    state across append() calls — no ``frame_size % chunk_size`` alignment
+    requirement, and the sidecar is ready the moment the last frame lands.
+    Synchronous like the rest of BlockStore; the asyncio handler runs
+    append/finish in threads, the native engine has its own C++ twin."""
+
+    def __init__(self, store: BlockStore, dtmp: Path, mtmp: Path):
+        self._store = store
+        self._dtmp = dtmp
+        self._mtmp = mtmp
+        self._f = open(dtmp, "wb")
+        self._chunk = store.chunk_size
+        self._sums: list[int] = []
+        self._carry_crc = 0
+        self._carry_len = 0
+        self.total = 0
+        self._closed = False
+
+    def append(self, payload) -> None:
+        mv = memoryview(payload)
+        self._f.write(mv)
+        self.total += len(mv)
+        chunk = self._chunk
+        off = 0
+        if self._carry_len:
+            take = min(chunk - self._carry_len, len(mv))
+            self._carry_crc = crc32c(mv[:take], self._carry_crc)
+            self._carry_len += take
+            off = take
+            if self._carry_len == chunk:
+                self._sums.append(self._carry_crc)
+                self._carry_crc = 0
+                self._carry_len = 0
+        n_full = (len(mv) - off) // chunk
+        if n_full:
+            self._sums.extend(
+                crc32c_chunks(mv[off:off + n_full * chunk], chunk).tolist()
+            )
+            off += n_full * chunk
+        if off < len(mv):
+            self._carry_crc = crc32c(mv[off:], 0)
+            self._carry_len = len(mv) - off
+
+    def finish(self) -> np.ndarray:
+        """Flush the carry chunk, close the data file, and write the
+        sidecar tmp. The pair is then publishable via
+        ``publish_staged_batch`` exactly like a ``write_staged`` result."""
+        if self._carry_len:
+            self._sums.append(self._carry_crc)
+            self._carry_crc = 0
+            self._carry_len = 0
+        self._f.close()
+        self._closed = True
+        checksums = np.asarray(self._sums, dtype=np.uint32)
+        with open(self._mtmp, "wb") as f:
+            f.write(self._store._encode_meta(checksums))
+        return checksums
+
+    def abort(self) -> None:
+        """Quarantine a torn/corrupt stream: drop both tmp files. The
+        previously PUBLISHED block (if any) is untouched — partial
+        streamed data can never reach the visible namespace."""
+        if not self._closed:
+            self._f.close()
+            self._closed = True
+        self._dtmp.unlink(missing_ok=True)
+        self._mtmp.unlink(missing_ok=True)

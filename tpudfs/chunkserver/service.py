@@ -26,17 +26,23 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 import uuid
 from collections import OrderedDict
 
 import grpc
 
-from tpudfs.common import blocknet, native
+from tpudfs.common import blocknet, native, writestream
 from tpudfs.common.blocknet import BlockConnPool
 from tpudfs.common.checksum import crc32c, crc32c_chunks, crc32c_fold
 from tpudfs.common.erasure import encode as ec_encode, reconstruct
 from tpudfs.common.resilience import (
+    TENANT_FRAME_KEY,
+    QosRejected,
     admission_controlled,
+    current_tenant,
+    raw_tenant,
+    remaining_budget,
     shedder_from_env,
     shielded_from_deadline,
 )
@@ -124,6 +130,15 @@ class GroupCommitter:
             await asyncio.to_thread(self.store.discard_staged,
                                     block_id, token)
             raise
+        await self.commit_staged(block_id, token)
+
+    async def commit_staged(self, block_id: str, token: str) -> None:
+        """Group-commit a block the caller ALREADY staged under ``token``
+        (the streaming path: StagedBlockWriter finished the tmp pair as
+        frames arrived) — enqueue it for the drain loop's batched publish
+        and wait for durability, exactly like the tail of :meth:`write`."""
+        if self._closed:
+            raise OSError("chunkserver stopping")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         fut.add_done_callback(
             lambda f: None if f.cancelled() else f.exception()
@@ -245,6 +260,13 @@ class ChunkServer:
         #: recovery, EC shard distribution); falls back to gRPC per peer.
         self.blocks = BlockConnPool(tls=self.client.tls)
         self.committer = GroupCommitter(store)
+        #: Streamed-write per-stage occupancy (ns totals + counts) on the
+        #: asyncio fallback path; the native engine keeps its own twin
+        #: (tpudfs_dataplane_stream_stats). ``bench.py --write-stages``
+        #: reads whichever plane served the stream via Stats.
+        self._stream_stats = dict.fromkeys(
+            ("net_ns", "crc_ns", "disk_ns", "fanout_ns",
+             "frames", "streams", "stream_bytes", "aborts"), 0)
         #: Inflight-bounded admission control for the DATA-path RPCs (reads,
         #: writes, chain forwards). Over the limit, requests fail fast with
         #: RESOURCE_EXHAUSTED + retry-after instead of queueing — control
@@ -337,9 +359,13 @@ class ChunkServer:
         """Blockport discovery (tpudfs.common.blocknet): port 0 = none.
         ``native`` tells chain writers whether this blockport is the C++
         engine — which forwards ONLY to blockports — or the asyncio
-        server, which re-resolves per hop and handles mixed chains."""
+        server, which re-resolves per hop and handles mixed chains.
+        ``stream`` advertises the WriteStream frame protocol
+        (tpudfs/common/writestream.py); collective-write-group members
+        stay whole-block so chain writes keep riding the ICI rounds."""
         return {"port": self.data_port,
-                "native": self._native_dp is not None}
+                "native": self._native_dp is not None,
+                "stream": bool(self.data_port) and self._ici_group is None}
 
     async def rpc_local_access(self, req: dict) -> dict:
         """Short-circuit local-read handshake (the HDFS short-circuit idea,
@@ -397,8 +423,13 @@ class ChunkServer:
             # Tenant QoS (TPUDFS_QOS=1) is enforced by admission_controlled
             # wrappers on the Python handlers; the C++ engine serves reads
             # and the write chain without ever entering Python, so a
-            # QoS-enabled chunkserver must run the asyncio blockport or the
+            # QoS-enabled chunkserver runs the asyncio blockport or the
             # per-tenant fair queue would see none of the data traffic.
+            # That no longer costs the streamed write path: the asyncio
+            # blockport speaks the same WriteStream frames (per-stream
+            # admission in rpc_write_stream), and native hops elsewhere in
+            # the chain preserve `_db`/`_tn`, so budgets and tenant
+            # attribution survive mixed QoS/non-QoS chains.
             qos_active = getattr(self.shedder, "acquire", None) is not None
             if qos_active and native.has_dataplane() \
                     and not self.python_data_plane:
@@ -440,7 +471,9 @@ class ChunkServer:
                     "ReplicateBlock": self.rpc_replicate_block,
                     "ReadBlock": self.rpc_read_block,
                     "ReadBlocks": self.rpc_read_blocks,
-                }, tls=tls)
+                }, tls=tls, stream_handlers={
+                    "WriteStream": self.rpc_write_stream,
+                })
                 self.data_port = await self._blockport.start(host)
         if not self.address:
             self.address = server.address
@@ -724,6 +757,319 @@ class ChunkServer:
         return {"success": True, "error_message": "",
                 "replicas_written": replicas_written}
 
+    # ----------------------------------------------------- streaming writes
+
+    async def _stream_err(self, w, code: str, message: str) -> None:
+        w.writelines(blocknet._pack_frame(
+            {"ok": False, "code": code, "message": message}, None))
+        await blocknet._drain_backpressure(w)
+
+    async def rpc_write_stream(self, req, r, w) -> bool:
+        """Streamed WriteBlock over the blockport — the asyncio fallback
+        twin of the native engine's ``handle_write_stream`` (protocol:
+        tpudfs/common/writestream.py). Admission mirrors the
+        ``admission_controlled`` wrapper by hand because stream handlers
+        take the connection, not a ``(self, request)`` call: rejection
+        happens BEFORE the ready ack, so the connection stays framed and
+        the client falls back to the whole-block path."""
+        shedder = self.shedder
+        acquire = getattr(shedder, "acquire", None)
+        if acquire is not None:
+            tenant = current_tenant()
+            try:
+                await acquire(tenant)
+            except QosRejected as e:
+                await self._stream_err(
+                    w, "RESOURCE_EXHAUSTED",
+                    f"{type(self).__name__} {e.detail} (tenant={tenant})")
+                return True
+            t0 = time.monotonic()
+            try:
+                return await self._serve_write_stream(req, r, w)
+            finally:
+                shedder.release(tenant, time.monotonic() - t0)
+        if not shedder.try_acquire():
+            await self._stream_err(
+                w, "RESOURCE_EXHAUSTED",
+                f"{type(self).__name__} at admission limit "
+                f"({shedder.max_inflight} inflight)")
+            return True
+        try:
+            return await self._serve_write_stream(req, r, w)
+        finally:
+            shedder.release()
+
+    async def _serve_write_stream(self, req: dict, r, w) -> bool:
+        if self.fault_delay:
+            await asyncio.sleep(self.fault_delay)
+        stale = self._check_term(int(req.get("master_term", 0)),
+                                 str(req.get("master_shard") or ""))
+        if stale:
+            await self._stream_err(w, "FAILED_PRECONDITION", stale)
+            return True
+        if self._ici_group is not None:
+            # Collective members take chain writes whole-block so ring
+            # matches ride ICI; UNIMPLEMENTED flips the client's cached
+            # stream capability off for this peer.
+            await self._stream_err(w, "UNIMPLEMENTED",
+                                   "streamed writes disabled on collective "
+                                   "write group members")
+            return True
+        block_id = str(req.get("block_id") or "")
+        size = int(req.get("size", -1))
+        frame_size = int(req.get("frame_size") or 0)
+        if not block_id or size < 0 \
+                or size > writestream.MAX_STREAM_BYTES \
+                or not 0 < frame_size <= blocknet._MAX_PAYLOAD:
+            await self._stream_err(w, "INVALID_ARGUMENT",
+                                   "bad write stream parameters")
+            return True
+        expected = int(req.get("expected_crc32c", 0))
+        nframes = writestream.frame_count(size, frame_size)
+        token = uuid.uuid4().hex
+        try:
+            writer = await asyncio.to_thread(
+                self.store.stage_writer, block_id, token)
+        except (OSError, ValueError) as e:
+            await self._stream_err(w, "INTERNAL", f"staging failed: {e}")
+            return True
+
+        # Downstream relay leg. Stream-capable whole chain -> open a
+        # ForwardStream and relay each verified frame as it arrives; any
+        # other chain buffers frames and forwards one whole-block
+        # ReplicateBlock at the end — mixed chains never under-replicate.
+        next_servers = list(req.get("next_servers") or [])
+        fwd = fwd_conn = fwd_hostport = fwd_req = fwd_buf = None
+        hop_safe = False
+        if next_servers:
+            ports, hop_safe = await self.blocks.chain_info(
+                self.client, next_servers, SERVICE)
+            fwd_req = {
+                "block_id": block_id,
+                "next_servers": next_servers[1:],
+                "next_data_ports": ports[1:],
+                "expected_crc32c": expected,
+                "master_term": int(req.get("master_term", 0)),
+                "master_shard": str(req.get("master_shard") or ""),
+            }
+            if hop_safe and self.blocks.stream_chain_ok(next_servers):
+                try:
+                    co = await self.blocks.stream_checkout(
+                        self.client, next_servers[0], SERVICE)
+                except (OSError, ConnectionError) as e:
+                    logger.warning("stream checkout to %s failed: %s",
+                                   next_servers[0], e)
+                    co = None
+                if co is not None:
+                    fwd_hostport, fwd_conn = co
+                    fwd = writestream.ForwardStream(*fwd_conn)
+                    begin = dict(fwd_req)
+                    begin.update(m="WriteStream", size=size,
+                                 frame_size=frame_size)
+                    rem = remaining_budget()
+                    if rem is not None:
+                        begin["_db"] = rem
+                    tenant = raw_tenant()
+                    if tenant is not None:
+                        begin[TENANT_FRAME_KEY] = tenant
+                    try:
+                        await fwd.begin(begin)
+                    except (RpcError, ConnectionError, OSError,
+                            asyncio.IncompleteReadError) as e:
+                        logger.warning(
+                            "downstream stream begin to %s failed: %s",
+                            next_servers[0], e)
+                        self.blocks.stream_discard(next_servers[0],
+                                                   fwd_conn)
+                        fwd = None
+            if fwd is None:
+                fwd_buf = bytearray()
+
+        async def _abort(code: str, message: str) -> bool:
+            # Mid-stream abort: the frame boundary is gone (unread frames
+            # may sit in the socket), so discard the staged tmps, tear
+            # the downstream relay so the abort propagates down the
+            # chain, send the error frame, and close the connection.
+            self._stream_stats["aborts"] += 1
+            await asyncio.to_thread(writer.abort)
+            if fwd is not None:
+                self.blocks.stream_discard(next_servers[0], fwd_conn)
+            await self._stream_err(w, code, message)
+            return False
+
+        stats = self._stream_stats
+        stats["streams"] += 1
+        w.writelines(blocknet._pack_frame({"ok": True, "ready": 1}, None))
+        await blocknet._drain_backpressure(w)
+        received = 0
+        try:
+            for seq in range(nframes):
+                rem = remaining_budget()
+                if rem is not None and rem <= 0:
+                    # Satellite of the QoS plane: a budget that expires
+                    # MID-STREAM aborts the whole chain cleanly instead
+                    # of letting a doomed write keep consuming disk and
+                    # downstream bandwidth (docs/resilience.md).
+                    return await _abort(
+                        "DEADLINE_EXCEEDED",
+                        f"deadline budget exhausted at frame {seq}")
+                t0 = time.monotonic_ns()
+                try:
+                    h, payload = await blocknet._read_frame(r)
+                except (asyncio.IncompleteReadError, ConnectionError,
+                        ConnectionResetError):
+                    # Torn upstream mid-frame: silent cleanup (no peer
+                    # left to read an error frame), abort downstream.
+                    stats["aborts"] += 1
+                    await asyncio.to_thread(writer.abort)
+                    if fwd is not None:
+                        self.blocks.stream_discard(next_servers[0],
+                                                   fwd_conn)
+                    return False
+                t1 = time.monotonic_ns()
+                if payload is None or int(h.get("q", -1)) != seq:
+                    return await _abort("INVALID_ARGUMENT",
+                                        f"stream frame {seq} out of order")
+                fcrc = crc32c(payload)
+                t2 = time.monotonic_ns()
+                if fcrc != int(h.get("c", -1)):
+                    return await _abort(
+                        "DATA_LOSS",
+                        f"frame {seq} CRC mismatch; staged block "
+                        f"{block_id} quarantined")
+                if fwd is not None:
+                    try:
+                        await fwd.send(seq, fcrc, payload)
+                    except (ConnectionError, OSError):
+                        # Downstream died mid-stream: same policy as a
+                        # dead chain tail on the whole-block path — keep
+                        # the local write going, the healer repairs the
+                        # replica count.
+                        logger.error(
+                            "downstream stream relay to %s died mid-block",
+                            next_servers[0])
+                        self.blocks.stream_discard(next_servers[0],
+                                                   fwd_conn)
+                        fwd = None
+                elif fwd_buf is not None:
+                    fwd_buf += payload
+                t3 = time.monotonic_ns()
+                await asyncio.to_thread(writer.append, payload)
+                t4 = time.monotonic_ns()
+                received += len(payload)
+                stats["net_ns"] += t1 - t0
+                stats["crc_ns"] += t2 - t1
+                stats["fanout_ns"] += t3 - t2
+                stats["disk_ns"] += t4 - t3
+                stats["frames"] += 1
+                stats["stream_bytes"] += len(payload)
+                if (seq + 1) % writestream.ACK_EVERY == 0 \
+                        and seq + 1 < nframes:
+                    w.writelines(blocknet._pack_frame(
+                        {"ok": True, "w": seq + 1}, None))
+                    await blocknet._drain_backpressure(w)
+        except BaseException:
+            await asyncio.to_thread(writer.abort)
+            if fwd is not None:
+                self.blocks.stream_discard(next_servers[0], fwd_conn)
+            raise
+        if received != size:
+            return await _abort(
+                "INVALID_ARGUMENT",
+                f"stream delivered {received} of {size} bytes")
+
+        try:
+            checksums = await asyncio.to_thread(writer.finish)
+        except (OSError, ValueError) as e:
+            return await _abort("INTERNAL", f"staging failed: {e}")
+        success = True
+        errmsg = ""
+        if expected:
+            actual = crc32c_fold(checksums, size, self.store.chunk_size)
+            if actual != expected:
+                # Every frame CRC passed but the whole-block CRC didn't:
+                # all frames were consumed, so the connection is still in
+                # sync — quarantine the staged pair and report the same
+                # soft failure the whole-block path returns.
+                logger.error(
+                    "checksum mismatch for streamed block %s: "
+                    "expected %d actual %d", block_id, expected, actual)
+                await asyncio.to_thread(self.store.discard_staged,
+                                        block_id, token)
+                success = False
+                errmsg = (f"Checksum mismatch: expected {expected}, "
+                          f"actual {actual}")
+
+        # Buffered whole-block forward (mixed chain) starts concurrently
+        # with the local group commit, like _write_and_forward.
+        fwd_task = None
+        if success and fwd_buf is not None and next_servers:
+            fwd_req["data"] = bytes(fwd_buf)
+            if hop_safe:
+                fwd_task = asyncio.create_task(self.blocks.call(
+                    self.client, next_servers[0], SERVICE,
+                    "ReplicateBlock", fwd_req, timeout=30.0))
+            else:
+                fwd_task = asyncio.create_task(self.client.call(
+                    next_servers[0], SERVICE, "ReplicateBlock",
+                    fwd_req, timeout=30.0))
+
+        local_err: str | None = None
+        replicas = 0
+        if success:
+            try:
+                await self.committer.commit_staged(block_id, token)
+                replicas = 1
+            except (OSError, ValueError) as e:
+                local_err = str(e)
+            except BaseException:
+                if fwd_task is not None:
+                    fwd_task.cancel()
+                if fwd is not None:
+                    self.blocks.stream_discard(next_servers[0], fwd_conn)
+                raise
+            self.invalidate_cached(block_id)
+
+        # The downstream final only lands after ITS durable watermark
+        # covers the block — awaiting it here is what makes this hop's
+        # final a group-committed, chain-durable ack.
+        if fwd is not None:
+            try:
+                down = await fwd.finish()
+                self.blocks.stream_release(fwd_hostport, fwd_conn)
+                if down.get("success"):
+                    replicas += int(down.get("replicas_written", 0))
+                else:
+                    logger.error(
+                        "downstream stream replication failed at %s: %s",
+                        next_servers[0], down.get("error_message"))
+            except (RpcError, ConnectionError, OSError,
+                    asyncio.IncompleteReadError) as e:
+                logger.error("downstream stream finish at %s failed: %s",
+                             next_servers[0], e)
+                self.blocks.stream_discard(next_servers[0], fwd_conn)
+        elif fwd_task is not None:
+            try:
+                resp = await fwd_task
+                if resp.get("success"):
+                    replicas += int(resp.get("replicas_written", 0))
+                else:
+                    logger.error(
+                        "downstream replication failed at %s: %s",
+                        next_servers[0], resp.get("error_message"))
+            except RpcError as e:
+                logger.error("failed to replicate to %s: %s",
+                             next_servers[0], e.message)
+
+        w.writelines(blocknet._pack_frame({
+            "ok": True, "final": 1, "w": nframes,
+            "success": success and not local_err,
+            "error_message": errmsg or local_err or "",
+            "replicas_written": replicas,
+        }, None))
+        await blocknet._drain_backpressure(w)
+        return True
+
     # ------------------------------------------------- collective write path
 
     def attach_ici_group(self, group, position: int) -> None:
@@ -918,6 +1264,24 @@ class ChunkServer:
         lib.tpudfs_dataplane_stage_stats(self._native_dp, vals)
         return dict(zip(keys, [int(v) for v in vals]))
 
+    def stream_stage_stats(self) -> dict:
+        """Per-stage occupancy of the streaming write pipeline (net/crc/
+        disk/fanout ns plus frame/stream/abort counts) — the localizer
+        for future write regressions (``bench.py --write-stages``).
+        Sums the asyncio fallback's counters with the native engine's."""
+        out = dict(self._stream_stats)
+        if self._native_dp is not None:
+            lib = native.get_lib()
+            if lib is not None and \
+                    hasattr(lib, "tpudfs_dataplane_stream_stats"):
+                import ctypes
+
+                vals = (ctypes.c_uint64 * 8)()
+                lib.tpudfs_dataplane_stream_stats(self._native_dp, vals)
+                for k, v in zip(out, vals):
+                    out[k] += int(v)
+        return out
+
     def _block_sig(self, block_id: str) -> tuple | None:
         try:
             st = os.stat(self.store.block_path(block_id))
@@ -972,6 +1336,7 @@ class ChunkServer:
             cache_hits=self.cache.hits + dp["cache_hits"],
             cache_misses=self.cache.misses + dp["cache_misses"],
             write_stages=self.write_stage_stats(),
+            stream_stages=self.stream_stage_stats(),
         )
         return stats
 

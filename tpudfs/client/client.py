@@ -32,6 +32,7 @@ import os
 import uuid
 from pathlib import Path
 
+from tpudfs.common import writestream
 from tpudfs.common.blocknet import BlockConnPool
 from tpudfs.common.checksum import crc32c
 from tpudfs.common.erasure import decode as ec_decode
@@ -588,7 +589,11 @@ class Client:
             )
         except IndeterminateError:
             raise
-        except DfsError as e:
+        except (DfsError, RpcError) as e:
+            # RpcError here means the DATA path died mid-write (e.g. every
+            # chain entry unreachable): same indeterminate outcome as a
+            # DfsError, and callers hold the DfsError contract — never the
+            # transport exception.
             if blind_resend and "stale write session" in str(e):
                 # Mint a fresh session with an atomic replace and retry
                 # once: our payload wins exactly as it would have before
@@ -612,7 +617,7 @@ class Client:
                     return
                 except IndeterminateError:
                     raise
-                except DfsError as e2:
+                except (DfsError, RpcError) as e2:
                     raise IndeterminateError(
                         f"write failed after namespace create for "
                         f"{path}: {e2}"
@@ -750,6 +755,35 @@ class Client:
                 )
                 if first_hop_safe and all(ports):
                     req["next_data_ports"] = ports[1:]
+                    if writestream.MIN_STREAM_BYTES <= len(data) \
+                            <= writestream.MAX_STREAM_BYTES \
+                            and self.block_pool.stream_chain_ok(chain):
+                        # Streaming entry: pipeline sub-block frames
+                        # through the chain (writestream.py). A None
+                        # result (peer can't stream after all) falls
+                        # through to the whole-block path on the SAME
+                        # rotation; UNAVAILABLE rotates like the
+                        # whole-block path.
+                        begin = writestream.begin_header(
+                            block_id, len(data), expected_crc32c=expected,
+                            master_term=term, master_shard=shard,
+                            next_servers=chain[1:],
+                            next_data_ports=ports[1:])
+                        try:
+                            resp = await self.block_pool.write_stream(
+                                self.rpc, chain[0], CS, begin, data,
+                                timeout=timeout)
+                        except RpcError as e:
+                            if e.code.name != "UNAVAILABLE":
+                                raise
+                            last_err = e
+                            self.breakers.record_failure(chain[0])
+                            logger.warning(
+                                "chain entry %s unreachable (%s); rotating",
+                                chain[0], e.message)
+                            continue
+                        if resp is not None:
+                            break
             try:
                 resp = await self._data_call(chain[0], "WriteBlock", req,
                                              timeout=timeout,

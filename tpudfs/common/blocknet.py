@@ -197,8 +197,15 @@ class BlockPortServer:
     registers — the payload rides outside msgpack, everything else is
     identical (handlers see ``req["data"]``, reads return ``resp["data"]``)."""
 
-    def __init__(self, handlers: dict, tls: ServerTls | None = None):
+    def __init__(self, handlers: dict, tls: ServerTls | None = None,
+                 stream_handlers: dict | None = None):
         self.handlers = handlers
+        #: method -> ``async fn(req, reader, writer) -> bool`` taking over
+        #: the connection for a multi-frame exchange (the write-stream
+        #: protocol, tpudfs/common/writestream.py). The handler writes its
+        #: own response frames; returning False means the connection can
+        #: no longer be framed (torn/aborted stream) and must close.
+        self.stream_handlers = stream_handlers or {}
         self._tls = tls
         self._server: asyncio.AbstractServer | None = None
         self.port: int = 0
@@ -243,7 +250,8 @@ class BlockPortServer:
                     return
                 method = header.pop("m", "")
                 fn = self.handlers.get(method)
-                if fn is None:
+                sfn = self.stream_handlers.get(method)
+                if fn is None and sfn is None:
                     w.writelines(_pack_frame(
                         {"ok": False, "code": "UNIMPLEMENTED",
                          "message": f"no blockport method {method!r}"}, None))
@@ -271,6 +279,38 @@ class BlockPortServer:
                 # Tenant parity with the gRPC plane's x-tenant metadata.
                 tn = req.pop(TENANT_FRAME_KEY, None)
                 tn_token = set_tenant(tn if isinstance(tn, str) and tn else None)
+                if sfn is not None:
+                    # Stream handler: owns the connection for a
+                    # multi-frame exchange and writes its own responses.
+                    try:
+                        keep = await sfn(req, r, w)
+                    except asyncio.CancelledError:
+                        raise
+                    except (asyncio.IncompleteReadError, ConnectionError,
+                            ConnectionResetError):
+                        return
+                    except Exception:
+                        logger.exception(
+                            "blockport stream handler %s failed", method)
+                        w.writelines(_pack_frame(
+                            {"ok": False, "code": "INTERNAL",
+                             "message": "internal error"}, None))
+                        await _drain_backpressure(w)
+                        # Stream position unknown: the frame boundary may
+                        # be lost, so the connection cannot be reused.
+                        return
+                    finally:
+                        try:
+                            dl_token.var.reset(dl_token)
+                        except ValueError:
+                            pass
+                        try:
+                            tn_token.var.reset(tn_token)
+                        except ValueError:
+                            pass
+                    if not keep:
+                        return
+                    continue
                 try:
                     resp = await fn(req)
                 except RpcError as e:
@@ -334,6 +374,11 @@ class BlockConnPool:
         #: addr -> whether the advertised blockport is the native engine
         #: (chain-forwards only to blockports; see chain_info()).
         self._native: dict[str, bool] = {}
+        #: addr -> whether the peer speaks the WriteStream frame protocol
+        #: (tpudfs/common/writestream.py). FAIL CLOSED on version skew: a
+        #: peer that predates the `stream` probe field gets False and
+        #: keeps receiving whole-block writes.
+        self._stream: dict[str, bool] = {}
         #: Per-address breakers replacing the old flat retry-at negative
         #: cache: one failure opens for 5 s, consecutive opens double the
         #: window up to 30 s, and a single half-open probe per window
@@ -397,6 +442,7 @@ class BlockConnPool:
         # (which forwards only to blockports) — treat it as such so mixed
         # chains route around it instead of silently under-replicating.
         self._native[addr] = bool(resp.get("native", port is not None))
+        self._stream[addr] = bool(resp.get("stream", False))
         return port
 
     async def data_ports(self, rpc: RpcClient, addrs: list[str],
@@ -425,6 +471,118 @@ class BlockConnPool:
         if all(ports):
             return ports, True
         return ports, not self._native.get(addrs[0], False)
+
+    def stream_chain_ok(self, addrs: list[str]) -> bool:
+        """True when EVERY chain member's probed blockport speaks the
+        WriteStream frame protocol (probe data cached by a prior
+        chain_info/data_ports call). The native engine relays streams
+        only to stream-capable blockports, so a mixed chain takes the
+        whole-block path instead — never silent under-replication."""
+        return bool(addrs) and all(self._stream.get(a, False) for a in addrs)
+
+    async def write_stream(self, rpc: RpcClient, addr: str, service: str,
+                           req: dict, data,
+                           timeout: float = 60.0) -> dict | None:
+        """Send one block as a pipelined write stream to ``addr``'s
+        blockport. Returns the final response dict, or None when the peer
+        can't take a stream (no blockport / no stream support) — the
+        caller then falls back to the whole-block ``call`` path. Failure
+        mapping mirrors ``call``: transport failures surface UNAVAILABLE
+        and open the per-address breaker."""
+        if not enabled():
+            return None
+        try:
+            timeout = attempt_timeout(timeout)
+        except BudgetExhausted:
+            raise RpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"deadline budget exhausted before WriteStream to {addr}",
+            ) from None
+        port = await self._data_port(rpc, addr, service)
+        if port is None or not self._stream.get(addr, False):
+            return None
+        from tpudfs.common import writestream  # noqa: PLC0415 (cycle)
+
+        host = addr.rsplit(":", 1)[0]
+        hostport = f"{host}:{port}"
+        try:
+            conn = await self._checkout(hostport)
+        except (OSError, ConnectionError) as e:
+            # Dead/refusing peer at dial time (e.g. the chain head was
+            # just SIGKILLed): same UNAVAILABLE mapping as a mid-stream
+            # transport failure, so caller failover loops keep working.
+            self._ports.pop(addr, None)
+            self.breakers.record_failure(addr)
+            raise RpcError(grpc.StatusCode.UNAVAILABLE,
+                           f"write stream dial {hostport}: {e!r}") from None
+        r, w = conn
+        header = dict(req)
+        rem = remaining_budget()
+        if rem is not None:
+            header["_db"] = rem
+        tenant = raw_tenant()
+        if tenant is not None:
+            header[TENANT_FRAME_KEY] = tenant
+        try:
+            resp = await asyncio.wait_for(
+                writestream.send_block_stream(r, w, header, data),
+                timeout=timeout,
+            )
+        except RpcError as e:
+            if getattr(e, "stream_clean", False):
+                # Pre-stream rejection (no data frames on the wire): the
+                # connection is still framed — reuse it.
+                self._release(hostport, conn)
+                if e.code == grpc.StatusCode.UNIMPLEMENTED:
+                    # Peer advertised streams but doesn't serve them
+                    # (restart race onto an older build): remember and
+                    # fall back to the whole-block path.
+                    self._stream[addr] = False
+                    return None
+            else:
+                w.close()
+            raise
+        except asyncio.TimeoutError:
+            w.close()
+            raise RpcError(grpc.StatusCode.DEADLINE_EXCEEDED,
+                           f"write stream to {hostport} timed out") from None
+        except asyncio.CancelledError:
+            w.close()
+            raise
+        except (OSError, ConnectionError, asyncio.IncompleteReadError,
+                ValueError, msgpack.exceptions.UnpackException) as e:
+            w.close()
+            self._ports.pop(addr, None)
+            self.breakers.record_failure(addr)
+            raise RpcError(grpc.StatusCode.UNAVAILABLE,
+                           f"write stream {hostport}: {e!r}") from None
+        self.breakers.record_success(addr)
+        self._release(hostport, conn)
+        return resp
+
+    async def stream_checkout(self, rpc: RpcClient, addr: str,
+                              service: str) -> tuple[str, tuple] | None:
+        """Checkout a (possibly pooled) blockport connection to a
+        stream-capable peer for a hop's downstream relay leg. Returns
+        ``(hostport, (reader, writer))`` or None when the peer can't take
+        a stream. Pair with :meth:`stream_release` (clean finish) or
+        :meth:`stream_discard` (mid-stream failure)."""
+        if not enabled():
+            return None
+        port = await self._data_port(rpc, addr, service)
+        if port is None or not self._stream.get(addr, False):
+            return None
+        host = addr.rsplit(":", 1)[0]
+        hostport = f"{host}:{port}"
+        return hostport, await self._checkout(hostport)
+
+    def stream_release(self, hostport: str, conn) -> None:
+        self._release(hostport, conn)
+
+    def stream_discard(self, addr: str, conn) -> None:
+        conn[1].close()
+        self._ports.pop(addr, None)
+        self.breakers.record_failure(addr)
 
     async def call(self, rpc: RpcClient, addr: str, service: str,
                    method: str, req: dict, timeout: float = 30.0,
@@ -474,26 +632,38 @@ class BlockConnPool:
         self.breakers.record_success(addr)
         return resp
 
-    async def _call_blockport(self, hostport: str, method: str,
-                              req: dict, payload_into=None) -> dict:
-        conn = None
+    async def _checkout(self, hostport: str):
+        """Pop a pooled connection to ``hostport`` or open a fresh one."""
         free = self._free.setdefault(hostport, [])
         while free:
             conn = free.pop()
             if conn[1].is_closing():
-                conn = None
                 continue
-            break
-        if conn is None:
-            host, port = hostport.rsplit(":", 1)
-            conn = await asyncio.open_connection(
-                host, int(port), ssl=self._ssl_ctx,
-                server_hostname=host if self._ssl_ctx is not None else None,
-                limit=_STREAM_LIMIT,
-            )
-            sock = conn[1].get_extra_info("socket")
-            if sock is not None:
-                _tune_socket(sock)
+            return conn
+        host, port = hostport.rsplit(":", 1)
+        conn = await asyncio.open_connection(
+            host, int(port), ssl=self._ssl_ctx,
+            server_hostname=host if self._ssl_ctx is not None else None,
+            limit=_STREAM_LIMIT,
+        )
+        sock = conn[1].get_extra_info("socket")
+        if sock is not None:
+            _tune_socket(sock)
+        return conn
+
+    def _release(self, hostport: str, conn) -> None:
+        """Return a still-framed connection to the idle pool (extras
+        close). Only call when the frame boundary is intact — a torn or
+        aborted stream must close the connection instead."""
+        free = self._free.setdefault(hostport, [])
+        if len(free) < self.MAX_IDLE_PER_PEER and not conn[1].is_closing():
+            free.append(conn)
+        else:
+            conn[1].close()
+
+    async def _call_blockport(self, hostport: str, method: str,
+                              req: dict, payload_into=None) -> dict:
+        conn = await self._checkout(hostport)
         r, w = conn
         try:
             header = {k: v for k, v in req.items() if k != "data"}
@@ -510,10 +680,7 @@ class BlockConnPool:
         except BaseException:
             w.close()
             raise
-        if len(free) < self.MAX_IDLE_PER_PEER:
-            free.append(conn)
-        else:
-            w.close()
+        self._release(hostport, conn)
         has_data = resp.pop("_d", 0)
         if not resp.pop("ok", False):
             code = getattr(grpc.StatusCode, str(resp.get("code")),

@@ -200,6 +200,14 @@ def _locked_load() -> ctypes.CDLL | None:
         # Prebuilt library predating write-stage budgets.
         pass
     try:
+        lib.tpudfs_dataplane_stream_stats.restype = None
+        lib.tpudfs_dataplane_stream_stats.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p,
+        ]
+    except AttributeError:
+        # Prebuilt library predating the streaming write engine.
+        pass
+    try:
         lib.tpudfs_block_write_staged.restype = ctypes.c_int64
         lib.tpudfs_block_write_staged.argtypes = \
             list(lib.tpudfs_block_write.argtypes)
@@ -216,7 +224,7 @@ def _locked_load() -> ctypes.CDLL | None:
         # symbols and call them with mismatched arguments.
         lib.tpudfs_dataplane_abi.restype = ctypes.c_int64
         lib.tpudfs_dataplane_abi.argtypes = []
-        if lib.tpudfs_dataplane_abi() != 4:
+        if lib.tpudfs_dataplane_abi() != 5:
             raise AttributeError("dataplane ABI mismatch")
         lib.tpudfs_dataplane_start.restype = ctypes.c_int64
         lib.tpudfs_dataplane_start.argtypes = [
