@@ -107,6 +107,12 @@ constexpr uint64_t kMaxPayload = 100ull * 1024 * 1024;
 // Watermark ack cadence of the streaming write path — must match
 // tpudfs/common/writestream.py ACK_EVERY.
 constexpr uint64_t kAckEvery = 8;
+// Streamed-block ceiling — must match tpudfs/common/writestream.py
+// MAX_STREAM_BYTES (the per-frame kMaxPayload cap does not bound the
+// whole stream; without this check a native hop would accept streams
+// the Python side rejects, and a rogue begin header could stage
+// unbounded bytes).
+constexpr uint64_t kMaxStreamBytes = 1ull << 30;
 
 // ----------------------------------------------------------- msgpack mini
 
@@ -671,6 +677,10 @@ class Engine {
   // Returns false when libssl or the cert material is unusable — the
   // caller must NOT fall back to plaintext (it reports start failure and
   // Python uses the asyncio blockport instead).
+  // Runs on the ctypes caller's thread before start() spawns the
+  // accept/commit threads — srv_ctx_/cli_ctx_ are set-once config
+  // after this returns.
+  // tpulint: pre-start
   bool configure_tls(const std::string& srv_cert, const std::string& srv_key,
                      const std::string& srv_client_ca,
                      const std::string& out_ca, const std::string& out_cert,
@@ -708,6 +718,8 @@ class Engine {
     return true;
   }
 
+  // tpulint: pre-start (listener setup; listen_fd_/port_ are written
+  // only here, before the accept/commit threads spawn at the end)
   int64_t start(uint16_t port) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return -errno;
@@ -768,7 +780,15 @@ class Engine {
     // waits immediately. Allow a generous window for in-flight disk work.
     for (int i = 0; i < 1000 && active_.load() > 0; i++)
       std::this_thread::sleep_for(std::chrono::milliseconds(10));
-    commit_cv_.notify_all();
+    {
+      // Notify under commit_mu_: the commit loop's predicated wait
+      // re-checks running_ with the mutex held, so pairing the notify
+      // with the lock means it can never fire in the window between the
+      // loop's predicate check and its block — the shutdown wakeup
+      // cannot be lost.
+      std::lock_guard<std::mutex> g(commit_mu_);
+      commit_cv_.notify_all();
+    }
     if (commit_thread_.joinable()) commit_thread_.join();
     return active_.load() == 0;
   }
@@ -1204,6 +1224,7 @@ class Engine {
     int64_t size_i = h.count("size") ? h["size"].i : -1;
     int64_t fsz_i = h.count("frame_size") ? h["frame_size"].i : 0;
     if (size_i < 0 || fsz_i <= 0 ||
+        static_cast<uint64_t>(size_i) > kMaxStreamBytes ||
         static_cast<uint64_t>(fsz_i) > kMaxPayload) {
       respond_err(s, "INVALID_ARGUMENT", "bad stream size or frame_size");
       return true;
@@ -1843,7 +1864,15 @@ class Engine {
     std::unique_lock<std::mutex> lk(commit_mu_);
     while (running_.load() || !commit_queue_.empty()) {
       if (commit_queue_.empty()) {
-        commit_cv_.wait_for(lk, std::chrono::milliseconds(50));
+        // Predicated wait, not a 50 ms wait_for poll: stop() notifies
+        // under commit_mu_ after flipping running_, so the wakeup cannot
+        // be lost — and wait() stays on pthread_cond_wait, which the
+        // TSan gate (scripts/native_sanitize.py) can model (glibc's
+        // pthread_cond_clockwait behind wait_for has no interceptor and
+        // corrupts its lock state, drowning real races in noise).
+        commit_cv_.wait(lk, [&] {
+          return !commit_queue_.empty() || !running_.load();
+        });
         continue;
       }
       std::deque<std::shared_ptr<CommitEntry>> batch;

@@ -1,0 +1,475 @@
+#!/usr/bin/env python3
+"""Sanitizer gate for the native C++ data plane (native/dataplane.cc).
+
+tpulint's TPL042/TPL043 prove lock discipline *statically*; this script is
+the dynamic half of the contract: it builds the native library with
+ThreadSanitizer (or ASan/UBSan via --sanitizer), LD_PRELOADs the sanitizer
+runtime into a child Python, and stress-drives the streaming write engine
+the way a hot chunkserver does — concurrent WriteStream connections,
+mid-stream aborts, deliberately corrupt frames, and a second OS thread
+polling the stats/term/bad-block exports the whole time. Any sanitizer
+report anchored in native/ sources fails the gate.
+
+Hosts that cannot run the sanitizer (no compiler, no libtsan, container
+ASLR/mmap restrictions) print ``SKIP native-sanitize: <reason>`` and exit
+0, so the CI stage degrades gracefully instead of flaking.
+
+  python scripts/native_sanitize.py                       # TSan gate
+  python scripts/native_sanitize.py --sanitizer address   # ASan instead
+  python scripts/native_sanitize.py --keep-going --rounds 5
+
+The instrumented .so is built into a temp directory via the Makefile's
+tsan/asan/ubsan targets; native/libtpudfs_native.so is never touched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+NATIVE_DIR = REPO / "native"
+
+#: Per-sanitizer plumbing: Makefile target + output-path variable, runtime
+#: libraries to try for LD_PRELOAD (newest-first sonames across gcc
+#: versions), the options env var, and the report marker scanned for in
+#: the child's output. exitcode=66 distinguishes "reports were emitted"
+#: from an ordinary child crash.
+SANITIZERS = {
+    "thread": {
+        "target": "tsan",
+        "makevar": "TSAN_LIB",
+        "runtimes": ("libtsan.so", "libtsan.so.2", "libtsan.so.0"),
+        "opts_env": "TSAN_OPTIONS",
+        "opts": "exitcode=66 halt_on_error=0 report_thread_leaks=0",
+        "markers": ("WARNING: ThreadSanitizer",),
+    },
+    "address": {
+        "target": "asan",
+        "makevar": "ASAN_LIB",
+        "runtimes": ("libasan.so", "libasan.so.8", "libasan.so.6",
+                     "libasan.so.5"),
+        "opts_env": "ASAN_OPTIONS",
+        # detect_leaks=0: the interpreter "leaks" by design at exit;
+        # verify_asan_link_order=0: the runtime arrives via LD_PRELOAD,
+        # not as the first linked DSO.
+        "opts": "exitcode=66 detect_leaks=0 verify_asan_link_order=0",
+        "markers": ("ERROR: AddressSanitizer", "WARNING: AddressSanitizer"),
+    },
+    "undefined": {
+        "target": "ubsan",
+        "makevar": "UBSAN_LIB",
+        "runtimes": ("libubsan.so", "libubsan.so.1"),
+        "opts_env": "UBSAN_OPTIONS",
+        "opts": "exitcode=66 print_stacktrace=1 halt_on_error=0",
+        "markers": ("runtime error:",),
+    },
+}
+
+#: A report is a *finding* only when a frame lands in our native sources —
+#: the child interpreter and its C extensions are uninstrumented, and
+#: races reported wholly inside them are noise this gate cannot act on.
+NATIVE_MARKERS = ("dataplane.cc", "blockio.cc", "crc32c.cc", "crc64.cc",
+                  "gf256.cc", "libtpudfs_native")
+
+
+def skip(reason: str) -> None:
+    print(f"SKIP native-sanitize: {reason}")
+    raise SystemExit(0)
+
+
+def fail(reason: str) -> None:
+    print(f"FAIL native-sanitize: {reason}")
+    raise SystemExit(1)
+
+
+def _first_line(text: str) -> str:
+    for line in text.splitlines():
+        if line.strip():
+            return line.strip()
+    return "(no output)"
+
+
+def find_runtime(cxx: str, names: tuple[str, ...]) -> str | None:
+    """Resolve the sanitizer runtime .so for LD_PRELOAD via the compiler's
+    search path (-print-file-name echoes the name back when not found)."""
+    for name in names:
+        try:
+            r = subprocess.run([cxx, f"-print-file-name={name}"],
+                               capture_output=True, text=True, timeout=30)
+        except (subprocess.SubprocessError, OSError):
+            return None
+        path = r.stdout.strip()
+        if path and path != name and pathlib.Path(path).exists():
+            return str(pathlib.Path(path).resolve())
+    return None
+
+
+def probe(cxx: str, mode: str, runtime: str, tmp: pathlib.Path) -> None:
+    """Prove the host can compile AND execute instrumented code under this
+    interpreter before paying for the full build — every failure here is a
+    host limitation, not a code finding, so it skips."""
+    src = tmp / "probe.cc"
+    so = tmp / "probe.so"
+    src.write_text('extern "C" int tpudfs_sanitize_probe() { return 7; }\n')
+    r = subprocess.run(
+        [cxx, "-O1", "-g", "-fPIC", "-shared", "-std=c++17",
+         f"-fsanitize={mode}", "-o", str(so), str(src)],
+        capture_output=True, text=True, timeout=120)
+    if r.returncode != 0:
+        skip(f"{cxx} cannot link -fsanitize={mode}: "
+             f"{_first_line(r.stderr)}")
+    spec = SANITIZERS[mode]
+    env = {**os.environ, "LD_PRELOAD": runtime, spec["opts_env"]: spec["opts"]}
+    r = subprocess.run(
+        [sys.executable, "-c",
+         f"import ctypes; lib = ctypes.CDLL({str(so)!r}); "
+         f"assert lib.tpudfs_sanitize_probe() == 7; "
+         f"print('sanitizer-probe-ok')"],
+        capture_output=True, text=True, timeout=120, env=env)
+    if r.returncode != 0 or "sanitizer-probe-ok" not in r.stdout:
+        skip(f"{mode} runtime cannot preload into this interpreter: "
+             f"{_first_line(r.stderr or r.stdout)}")
+
+
+def build_instrumented(mode: str, tmp: pathlib.Path) -> pathlib.Path:
+    spec = SANITIZERS[mode]
+    out = tmp / f"libtpudfs_native_{spec['target']}.so"
+    r = subprocess.run(
+        ["make", "-C", str(NATIVE_DIR), spec["target"],
+         f"{spec['makevar']}={out}"],
+        capture_output=True, text=True, timeout=240)
+    if r.returncode != 0:
+        # The probe proved the toolchain works, so a build break here is a
+        # real finding in the sources (e.g. code that only compiles at -O3).
+        fail(f"instrumented build failed:\n{r.stdout}\n{r.stderr}")
+    return out
+
+
+def split_reports(out: str, mode: str) -> list[str]:
+    markers = SANITIZERS[mode]["markers"]
+    if mode == "undefined":
+        return [ln for ln in out.splitlines()
+                if any(m in ln for m in markers)]
+    reports: list[str] = []
+    current: list[str] | None = None
+    for line in out.splitlines():
+        if any(m in line for m in markers):
+            if current:
+                reports.append("\n".join(current))
+            current = [line]
+        elif current is not None:
+            current.append(line)
+            if line.startswith("=================="):
+                reports.append("\n".join(current))
+                current = None
+    if current:
+        reports.append("\n".join(current))
+    return reports
+
+
+def gate(args: argparse.Namespace) -> int:
+    mode = args.sanitizer
+    spec = SANITIZERS[mode]
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which(cxx) is None:
+        skip(f"no C++ compiler ({cxx} not on PATH)")
+    if shutil.which("make") is None:
+        skip("make not on PATH")
+    runtime = find_runtime(cxx, spec["runtimes"])
+    if runtime is None:
+        skip(f"no {mode}-sanitizer runtime library "
+             f"(tried {', '.join(spec['runtimes'])})")
+
+    with tempfile.TemporaryDirectory(prefix="tpudfs-sanitize-") as tmpdir:
+        tmp = pathlib.Path(tmpdir)
+        probe(cxx, mode, runtime, tmp)
+        lib_path = build_instrumented(mode, tmp)
+
+        env = {
+            **os.environ,
+            "LD_PRELOAD": runtime,
+            spec["opts_env"]: spec["opts"],
+            "TPUDFS_NATIVE_LIB": str(lib_path),
+            "PYTHONPATH": str(REPO),
+            # Keep uninstrumented thread pools out of the child: every
+            # extra runtime thread is pure report noise.
+            "OPENBLAS_NUM_THREADS": "1",
+            "OMP_NUM_THREADS": "1",
+        }
+        cmd = [sys.executable, "-u", str(pathlib.Path(__file__).resolve()),
+               "--stress", "--sanitizer", mode,
+               "--rounds", str(args.rounds), "--streams", str(args.streams)]
+        try:
+            r = subprocess.run(cmd, env=env, cwd=REPO, timeout=args.timeout,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"")
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            print(out[-4000:])
+            fail(f"stress harness hung for {args.timeout}s under {mode} "
+                 "sanitizer (possible deadlock)")
+            return 1
+        out = r.stdout or ""
+
+        reports = split_reports(out, mode)
+        relevant = [rep for rep in reports
+                    if any(m in rep for m in NATIVE_MARKERS)]
+        if relevant:
+            for rep in relevant:
+                print(rep)
+                print()
+            fail(f"{len(relevant)} {mode}-sanitizer report(s) in native/ "
+                 f"sources (of {len(reports)} total)")
+        if reports:
+            print(f"native-sanitize: ignoring {len(reports)} report(s) "
+                  "outside native/ sources (uninstrumented interpreter "
+                  "noise)")
+        if r.returncode not in (0, 66):
+            print(out[-4000:])
+            fail(f"stress harness exited rc={r.returncode} under {mode} "
+                 "sanitizer")
+        if r.returncode == 66 and not reports:
+            print(out[-4000:])
+            fail(f"{mode} sanitizer flagged the run (rc=66) but no report "
+                 "could be parsed from the output above")
+        summary = _first_line("\n".join(
+            ln for ln in out.splitlines() if ln.startswith("stress:")))
+        print(f"native-sanitize: PASS ({mode} sanitizer, {summary})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Child: the stress harness (runs with LD_PRELOAD + TPUDFS_NATIVE_LIB set)
+# ---------------------------------------------------------------------------
+
+# Wire constants, mirrored from tpudfs/common/{blocknet,writestream}.py.
+# The codec is inlined (rather than importing blocknet) so the instrumented
+# child never loads grpc's uninstrumented C core; tpulint TPL041 pins the
+# canonical values on both sides of the real protocol.
+FRAME_SIZE = 256 * 1024
+
+
+def _pack_frame(header: dict, payload) -> list[bytes]:
+    import msgpack
+    import struct
+
+    if payload is not None:
+        header["_d"] = 1
+    h = msgpack.packb(header, use_bin_type=True)
+    out = [struct.pack("<I", len(h)), h,
+           struct.pack("<Q", len(payload) if payload else 0)]
+    if payload:
+        out.append(payload)
+    return out
+
+
+async def _read_frame(r):
+    import msgpack
+    import struct
+
+    hlen = struct.unpack("<I", await r.readexactly(4))[0]
+    header = msgpack.unpackb(await r.readexactly(hlen), raw=False,
+                             strict_map_key=False)
+    plen = struct.unpack("<Q", await r.readexactly(8))[0]
+    payload = await r.readexactly(plen) if plen else b""
+    return header, payload
+
+
+def _begin(lib, block_id: str, data: bytes) -> dict:
+    crc = int(lib.tpudfs_crc32c(0, data, len(data))) & 0xFFFFFFFF
+    return {"m": "WriteStream", "block_id": block_id, "size": len(data),
+            "frame_size": FRAME_SIZE, "expected_crc32c": crc,
+            "master_term": 0, "master_shard": "", "next_servers": [],
+            "next_data_ports": [], "_tn": "sanitize", "_db": 60.0}
+
+
+async def _open_stream(port: int, lib, block_id: str, data: bytes):
+    import asyncio
+
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    w.writelines(_pack_frame(_begin(lib, block_id, data), None))
+    await w.drain()
+    ready, _ = await _read_frame(r)
+    if ready.get("ready") != 1:
+        raise RuntimeError(f"no ready ack for {block_id}: {ready}")
+    return r, w
+
+
+def _frames(data: bytes):
+    mv = memoryview(data)
+    n = max(1, (len(data) + FRAME_SIZE - 1) // FRAME_SIZE)
+    for seq in range(n):
+        yield seq, bytes(mv[seq * FRAME_SIZE:(seq + 1) * FRAME_SIZE])
+
+
+async def _full_stream(port: int, lib, block_id: str, size: int) -> None:
+    """Happy path: stream every frame, then consume watermark acks through
+    the final — asserting the engine acked a successful durable commit."""
+    data = os.urandom(size)
+    r, w = await _open_stream(port, lib, block_id, data)
+    try:
+        for seq, payload in _frames(data):
+            crc = int(lib.tpudfs_crc32c(0, payload, len(payload)))
+            w.writelines(_pack_frame({"q": seq, "c": crc}, payload))
+        await w.drain()
+        while True:
+            ack, _ = await _read_frame(r)
+            if not ack.get("ok"):
+                raise RuntimeError(f"stream {block_id} failed: {ack}")
+            if ack.get("final"):
+                break
+        if not ack.get("success"):
+            raise RuntimeError(f"final nack for {block_id}: {ack}")
+    finally:
+        w.close()
+
+
+async def _aborted_stream(port: int, lib, block_id: str, size: int) -> None:
+    """Mid-stream torn connection: one good frame, then an RST — the
+    engine's abort path (staged-file discard + stream teardown) races
+    against concurrent happy-path streams."""
+    data = os.urandom(size)
+    r, w = await _open_stream(port, lib, block_id, data)
+    seq, payload = next(_frames(data))
+    crc = int(lib.tpudfs_crc32c(0, payload, len(payload)))
+    w.writelines(_pack_frame({"q": seq, "c": crc}, payload))
+    await w.drain()
+    w.transport.abort()
+
+
+async def _corrupt_stream(port: int, lib, block_id: str, size: int) -> None:
+    """Frame-CRC mismatch: drives the quarantine/abort path and expects
+    the engine's error frame back."""
+    data = os.urandom(size)
+    r, w = await _open_stream(port, lib, block_id, data)
+    try:
+        seq, payload = next(_frames(data))
+        crc = int(lib.tpudfs_crc32c(0, payload, len(payload))) ^ 0xBAD
+        w.writelines(_pack_frame({"q": seq, "c": crc}, payload))
+        await w.drain()
+        err, _ = await _read_frame(r)
+        if err.get("ok") is not False:
+            raise RuntimeError(f"corrupt frame not rejected: {err}")
+    finally:
+        w.close()
+
+
+def stress(args: argparse.Namespace) -> int:
+    import asyncio
+    import ctypes
+    import threading
+
+    sys.path.insert(0, str(REPO))
+    from tpudfs.common import native
+
+    lib = native.get_lib()
+    if lib is None:
+        print("stress: instrumented library failed to load")
+        return 1
+    if not native.has_dataplane():
+        print("stress: instrumented library has no current dataplane ABI")
+        return 1
+
+    with tempfile.TemporaryDirectory(prefix="tpudfs-stress-") as tmpdir:
+        hot = pathlib.Path(tmpdir) / "hot"
+        hot.mkdir()
+        handle = lib.tpudfs_dataplane_start(
+            b"127.0.0.1", str(hot).encode(), b"", 4 * 1024 * 1024, 0,
+            32 << 20, b"", b"", b"", b"", b"", b"")
+        if handle < 0:
+            print(f"stress: dataplane failed to start ({handle})")
+            return 1
+        port = int(lib.tpudfs_dataplane_port(handle))
+
+        # Stats poller on a second OS thread: every export that a live
+        # chunkserver calls off the serving path, hammered concurrently
+        # with the stream traffic below.
+        stop_evt = threading.Event()
+
+        def poll() -> None:
+            vals6 = (ctypes.c_uint64 * 6)()
+            vals8 = (ctypes.c_uint64 * 8)()
+            buf = ctypes.create_string_buffer(4096)
+            while not stop_evt.is_set():
+                lib.tpudfs_dataplane_stats(handle, vals6)
+                lib.tpudfs_dataplane_stream_stats(handle, vals8)
+                lib.tpudfs_dataplane_stage_stats(handle, vals8)
+                lib.tpudfs_dataplane_take_bad(handle, buf, len(buf))
+                lib.tpudfs_dataplane_take_terms(handle, buf, len(buf))
+                lib.tpudfs_dataplane_term(handle, b"shard-0")
+                stop_evt.wait(0.002)
+
+        poller = threading.Thread(target=poll, name="stats-poller")
+        poller.start()
+
+        async def one_round(rnd: int) -> None:
+            size = FRAME_SIZE * 2 + 1031  # 3 frames, last one partial
+            tasks = []
+            for i in range(args.streams):
+                tasks.append(_full_stream(
+                    port, lib, f"san-{rnd}-ok{i}", size + i * 17))
+            tasks.append(_aborted_stream(port, lib, f"san-{rnd}-torn0", size))
+            tasks.append(_aborted_stream(port, lib, f"san-{rnd}-torn1", size))
+            tasks.append(_corrupt_stream(port, lib, f"san-{rnd}-crc", size))
+            await asyncio.gather(*tasks)
+            # Control-plane calls interleaved from the loop thread while
+            # the poller thread reads the same state.
+            lib.tpudfs_dataplane_invalidate(handle, f"san-{rnd}-ok0".encode())
+            lib.tpudfs_dataplane_set_term(handle, b"shard-0", rnd + 1)
+
+        try:
+            for rnd in range(args.rounds):
+                asyncio.run(one_round(rnd))
+        finally:
+            stop_evt.set()
+            poller.join()
+
+        vals8 = (ctypes.c_uint64 * 8)()
+        lib.tpudfs_dataplane_stream_stats(handle, vals8)
+        streams, aborts = int(vals8[5]), int(vals8[7])
+        rc_stop = int(lib.tpudfs_dataplane_stop(handle))
+        expect = args.rounds * args.streams
+        if streams < expect:
+            print(f"stress: engine reports {streams} streams, "
+                  f"expected >= {expect}")
+            return 1
+        if aborts < args.rounds:
+            print(f"stress: engine reports {aborts} aborts, "
+                  f"expected >= {args.rounds}")
+            return 1
+        if rc_stop != 0:
+            print(f"stress: dataplane_stop returned {rc_stop}")
+            return 1
+        print(f"stress: {streams} streams, {aborts} aborts, "
+              f"{args.rounds} rounds ok")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser("tpudfs-native-sanitize")
+    ap.add_argument("--sanitizer", choices=sorted(SANITIZERS),
+                    default="thread")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--streams", type=int, default=4,
+                    help="happy-path streams per round (plus 2 aborted "
+                         "and 1 corrupt)")
+    ap.add_argument("--timeout", type=int, default=300,
+                    help="stress child wall-clock limit, seconds")
+    ap.add_argument("--stress", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child mode
+    args = ap.parse_args()
+    if args.stress:
+        return stress(args)
+    return gate(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -209,6 +209,14 @@ def main() -> None:
     run("lint (tpulint.sarif artifact)",
         [sys.executable, "-m", "tpudfs.analysis",
          "--format", "sarif", "--output", "tpulint.sarif", "-q"])
+    # Dynamic half of the TPL042/TPL043 native-concurrency contract: build
+    # dataplane.cc with -fsanitize=thread and stress the streaming write
+    # engine (concurrent streams, mid-stream aborts, stats polling from a
+    # second thread). Any race report anchored in native/ fails the run;
+    # hosts without a usable TSan toolchain print "SKIP native-sanitize:
+    # <reason>" and the stage passes (the script exits 0 on skip).
+    run("native sanitizer gate (TSan stress)",
+        [sys.executable, "-u", "scripts/native_sanitize.py"])
     if not args.skip_unit:
         run("unit + integration suite",
             [sys.executable, "-m", "pytest", "tests/", "-x", "-q"])

@@ -9,7 +9,9 @@ changed since the last lint — at file-hash speed:
   are stored post-suppression (suppressions are derived from the same
   content, so content addressing is sound).
 - Project-rule findings are keyed by a tree hash over every (path, hash)
-  pair, because any edit anywhere can change the call graph.
+  pair — including the ``native/*.cc``/``.h`` sources the TPL04x
+  cross-language rules read, so a dataplane.cc edit invalidates the
+  project entry even though no Python file changed.
 - Both are salted with a hash of ``tpudfs/analysis/**/*.py`` itself, so
   editing a rule invalidates everything.
 
@@ -40,7 +42,7 @@ from tpudfs.analysis.linter import (
     iter_python_files,
 )
 
-CACHE_VERSION = 2
+CACHE_VERSION = 3
 
 DEFAULT_CACHE_NAME = ".tpulint_cache.json"
 
@@ -109,9 +111,23 @@ def analyze_tree_cached(
                 digest = ""
             file_list.append((path, rel, digest))
 
+    # Native sources enter the tree hash (not the per-file cache: they
+    # run no module rules) so that a .cc edit re-runs the project pass.
+    from tpudfs.analysis.nativesrc import iter_native_files
+
+    native_list: list[tuple[str, str]] = []
+    for path in iter_native_files(root):
+        try:
+            digest = hashlib.sha256(path.read_bytes()).hexdigest()
+        except OSError:
+            digest = ""
+        native_list.append(
+            (path.resolve().relative_to(root.resolve()).as_posix(),
+             digest))
+
     tree_hash = hashlib.sha256(
-        "\n".join(f"{rel}\x1f{h}" for _, rel, h in
-                  sorted(file_list, key=lambda t: t[1])).encode()
+        "\n".join(f"{rel}\x1f{h}" for rel, h in sorted(
+            [(rel, h) for _, rel, h in file_list] + native_list)).encode()
     ).hexdigest()
 
     cache = _load(cache_path)
@@ -157,8 +173,9 @@ def analyze_tree_cached(
             modules[module.rel_path] = module
 
     project_findings: list[Finding] = []
-    if project_rules and modules:
-        project_findings = _project_findings(modules, project_rules)
+    if project_rules and (modules or native_list):
+        project_findings = _project_findings(modules, project_rules,
+                                             root=root)
     findings.extend(project_findings)
 
     # Merge (don't replace): a subset run — `--changed` pre-commit lints —

@@ -73,6 +73,12 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--write-rule-table", action="store_true",
                    help="regenerate the rule table in "
                         "docs/static-analysis.md from rule metadata")
+    p.add_argument("--write-native-abi", action="store_true",
+                   help="regenerate the native ABI manifest "
+                        "(tpudfs/analysis/native_abi.json) from the "
+                        "current extern \"C\" dataplane exports; refuses "
+                        "if signatures changed without an ABI version "
+                        "bump")
     p.add_argument("--rule", action="append", dest="rules", metavar="TPLxxx",
                    help="run only these rule ids (repeatable)")
     p.add_argument("--format", choices=("text", "json", "sarif"),
@@ -98,10 +104,32 @@ def _git_lines(root: pathlib.Path, *args: str) -> list[str]:
     return [line for line in out.splitlines() if line.strip()]
 
 
+#: Python modules a changed native/*.cc or *.h file maps to. The TPL04x
+#: rules are project rules that read native sources straight from the
+#: repo root, so a native edit only needs SOME analyzed module for the
+#: project pass to run — but it needs the RIGHT ones for the diff to be
+#: meaningful: the ctypes bindings (TPL040) and every wire module whose
+#: constants/literals TPL041 pairs against the C++.
+NATIVE_COUNTERPART_MODULES: tuple[str, ...] = (
+    "tpudfs/common/native.py",
+    "tpudfs/common/writestream.py",
+    "tpudfs/common/blocknet.py",
+    "tpudfs/common/checksum.py",
+    "tpudfs/common/resilience.py",
+    "tpudfs/chunkserver/service.py",
+)
+
+
 def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
     """Python files differing from ``git merge-base HEAD main``, plus
     untracked ones. None when git/merge-base is unavailable (detached
-    checkouts, exported trees) — the caller falls back to a full lint."""
+    checkouts, exported trees) — the caller falls back to a full lint.
+
+    A changed ``.cc``/``.h`` under ``native/`` does not enter the path
+    list itself (the tree walker lints Python sources); instead it pulls
+    in :data:`NATIVE_COUNTERPART_MODULES`, which makes the TPL04x
+    cross-language rules re-check the native tree against its Python
+    counterparts — previously a dataplane.cc edit ran zero rules."""
     try:
         base = _git_lines(root, "merge-base", "HEAD", "main")[0]
         names = _git_lines(root, "diff", "--name-only", base)
@@ -110,11 +138,20 @@ def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
     except (subprocess.CalledProcessError, OSError, IndexError):
         return None
     out = []
+    native_changed = False
     for name in sorted(set(names)):
         p = root / name
         if name.endswith(".py") and p.exists():
             out.append(p)
-    return out
+        elif name.endswith((".cc", ".h")) and name.startswith("native/") \
+                and p.exists():
+            native_changed = True
+    if native_changed:
+        for rel in NATIVE_COUNTERPART_MODULES:
+            p = root / rel
+            if p.exists():
+                out.append(p)
+    return sorted(set(out))
 
 
 def hot_caller_files(
@@ -159,6 +196,60 @@ def hot_caller_files(
     return sorted(extra)
 
 
+def write_native_abi(root: pathlib.Path) -> int:
+    """Regenerate ``tpudfs/analysis/native_abi.json`` from the current
+    ``extern "C"`` dataplane exports. Refuses (exit 2) when a pinned
+    signature changed while ``tpudfs_dataplane_abi()`` still returns the
+    manifest's version — the whole point of the manifest is that such an
+    edit must bump the version, not rewrite history."""
+    import json
+
+    from tpudfs.analysis.nativesrc import load_native_sources
+    from tpudfs.analysis.rules.native_abi import (
+        ABI_MANIFEST_REL,
+        current_abi_surface,
+        load_abi_manifest,
+    )
+
+    sources = load_native_sources(root)
+    version, sigs = current_abi_surface(sources)
+    if version is None or not sigs:
+        print("tpulint: --write-native-abi: no tpudfs_dataplane_* "
+              f"exports (or no ABI version) found under {root / 'native'}",
+              file=sys.stderr)
+        return 2
+    old = load_abi_manifest(root)
+    if old is not None and old.get("abi_version") == version \
+            and old.get("exports") != sigs:
+        drifted = sorted(
+            name for name in set(old["exports"]) | set(sigs)
+            if old["exports"].get(name) != sigs.get(name))
+        print("tpulint: --write-native-abi: refusing to regenerate — "
+              f"dataplane export(s) changed ({', '.join(drifted)}) but "
+              f"tpudfs_dataplane_abi() still returns {version}. Bump the "
+              "ABI version in native/dataplane.cc and the guard in "
+              "tpudfs/common/native.py first, then regenerate.",
+              file=sys.stderr)
+        return 2
+    path = root / ABI_MANIFEST_REL
+    data = {
+        "version": 1,
+        "comment": (
+            "Pinned signatures of the tpudfs_dataplane_* C ABI at the "
+            "current TPUDFS_DATAPLANE_ABI version. TPL040 fails lint "
+            "when a signature drifts from this file without a version "
+            "bump. Regenerate with `python -m tpudfs.analysis "
+            "--write-native-abi` — never edit by hand."
+        ),
+        "abi_version": version,
+        "exports": dict(sorted(sigs.items())),
+    }
+    path.write_text(json.dumps(data, indent=2) + "\n")
+    print(f"wrote ABI manifest: {len(sigs)} dataplane export(s) at "
+          f"version {version} -> {path}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _parser().parse_args(argv)
 
@@ -186,6 +277,9 @@ def main(argv: list[str] | None = None) -> int:
         print(f"{doc}: rule table "
               f"{'updated' if changed else 'already in sync'}")
         return 0
+
+    if args.write_native_abi:
+        return write_native_abi(args.root)
 
     selected = None
     if args.rules:
@@ -238,8 +332,8 @@ def main(argv: list[str] | None = None) -> int:
                   "falling back to a full-tree lint", file=sys.stderr)
         elif not subset:
             if not args.quiet:
-                print("tpulint: no python files changed since "
-                      "merge-base with main")
+                print("tpulint: no lintable files (python or native) "
+                      "changed since merge-base with main")
             return 0
         else:
             extra = hot_caller_files(args.root, subset)

@@ -400,10 +400,15 @@ def _module_findings(module: ModuleInfo,
 
 
 def _project_findings(modules: dict[str, ModuleInfo],
-                      rules: Iterable[Rule]) -> list[Finding]:
+                      rules: Iterable[Rule],
+                      root: pathlib.Path | None = None) -> list[Finding]:
     from tpudfs.analysis.callgraph import Project  # deferred: import cycle
 
     project = Project(modules)
+    # The TPL04x native rules need the repo root to find native/*.cc;
+    # attached here (rather than a Project ctor change) so every driver
+    # path — tree, single file, cache — feeds them uniformly.
+    project.root = root
     findings: list[Finding] = []
     for rule in rules:
         t0 = time.perf_counter()
@@ -437,7 +442,8 @@ def analyze_file(
     project_rules = [r for r in rules if isinstance(r, ProjectRule)]
     if project_rules:
         findings.extend(
-            _project_findings({module.rel_path: module}, project_rules)
+            _project_findings({module.rel_path: module}, project_rules,
+                              root=root)
         )
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
@@ -481,28 +487,62 @@ def analyze_tree(
                 continue
             modules[module.rel_path] = module
             findings.extend(_module_findings(module, module_rules))
-    if project_rules and modules:
-        findings.extend(_project_findings(modules, project_rules))
+    if project_rules and (modules or _tree_has_native(root)):
+        # Native-only trees (a fixture holding just native/*.cc, or a
+        # --changed run touching only .cc files) still need the TPL04x
+        # project rules; the Python-backed project rules see an empty
+        # module map and stay silent.
+        findings.extend(_project_findings(modules, project_rules,
+                                          root=root))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def _tree_has_native(root: pathlib.Path) -> bool:
+    from tpudfs.analysis.nativesrc import has_native_sources
+
+    return has_native_sources(root)
+
+
+#: C++ variant of the suppression grammar (``// tpulint: disable=...``),
+#: honored by the TPL04x native rules (tpudfs/analysis/nativesrc.py).
+_SUPPRESS_CC_RE = re.compile(
+    r"//\s*tpulint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\s]+)"
+)
+
+
+def _iter_suppressible_files(base: pathlib.Path) -> Iterator[pathlib.Path]:
+    """Python sources plus native ``.cc``/``.h`` — everything whose
+    suppressions the inventory gate must count."""
+    yield from iter_python_files(base)
+    if base.is_file():
+        return
+    for pattern in ("*.cc", "*.h"):
+        for p in sorted(base.rglob(pattern)):
+            if any(part in DEFAULT_EXCLUDE for part in p.parts):
+                continue
+            yield p
 
 
 def scan_suppressions(
     paths: Iterable[pathlib.Path], root: pathlib.Path
 ) -> list[dict]:
-    """Every ``# tpulint: disable``/``disable-file`` comment in the tree,
-    as ``{"path", "line", "kind", "rules"}`` — the raw material for the
-    suppression-inventory gate (tpudfs/analysis/suppressions.json)."""
+    """Every ``# tpulint: disable``/``disable-file`` comment in the tree
+    (and its ``//`` C++ form in native sources), as ``{"path", "line",
+    "kind", "rules"}`` — the raw material for the suppression-inventory
+    gate (tpudfs/analysis/suppressions.json)."""
     out: list[dict] = []
     for base in paths:
-        for path in iter_python_files(base):
+        for path in _iter_suppressible_files(base):
             rel = path.resolve().relative_to(root.resolve()).as_posix()
+            regex = _SUPPRESS_CC_RE if path.suffix in (".cc", ".h") \
+                else _SUPPRESS_RE
             try:
                 text = path.read_text(encoding="utf-8")
             except (OSError, UnicodeDecodeError):
                 continue
             for lineno, line in enumerate(text.splitlines(), start=1):
-                m = _SUPPRESS_RE.search(line)
+                m = regex.search(line)
                 if not m:
                     continue
                 # Doc examples quote the grammar in backticks; those are

@@ -1,0 +1,1253 @@
+"""C++ source extraction for the native-conformance rules (TPL040-TPL043).
+
+The native data plane (native/dataplane.cc) re-implements the blockport
+wire protocol and the dataplane C ABI that tpudfs/common/native.py binds
+with ctypes — two hand-maintained copies of one contract, on opposite
+sides of a language boundary no type checker crosses. This module gives
+the tpulint rules a view of the C++ side without a real C++ frontend:
+
+- a comment/string-aware tokenizer with multi-char operators,
+- ``extern "C"`` export signatures (name, return/param C types, arity)
+  normalized into the same canonical vocabulary the ctypes declarations
+  map into (:data:`CTYPES_CANON`, :func:`ctype_compatible`),
+- file-scope ``constexpr`` integer constants, evaluated (``1 << 20``,
+  ``100ull * 1024 * 1024``) so they can be diffed against the Python
+  protocol constants,
+- every string literal (msgpack header keys, status codes),
+- a structural map of classes/fields/methods plus a lexical
+  lock-region tracker (``lock_guard``/``unique_lock`` scopes, including
+  mid-scope ``.unlock()``/``.lock()`` toggles) for the concurrency
+  rules, and
+- the ctypes declarations of native.py parsed from its AST
+  (:func:`parse_ctypes_decls`).
+
+This is a pragmatic lexical pass, not a compiler: it understands the
+subset of C++ the native engine is written in (and that the fixtures
+exercise), and the rules built on it bias toward zero false positives on
+the real tree. Suppression grammar mirrors the Python one with C++
+comments: ``// tpulint: disable=TPL042`` (line or line above) and
+``// tpulint: disable-file=TPL042``; ``// tpulint: pre-start`` above a
+method marks it as running before any engine thread exists (constructor
+and destructor get that for free).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+__all__ = [
+    "NativeSource",
+    "CFunc",
+    "CParam",
+    "CClass",
+    "CMethod",
+    "CField",
+    "PyCtypesDecls",
+    "parse_native",
+    "load_native_sources",
+    "iter_native_files",
+    "has_native_sources",
+    "project_root",
+    "parse_ctypes_decls",
+    "py_int_constants",
+    "py_string_literals",
+    "ctype_compatible",
+    "format_ctype_for_human",
+]
+
+NATIVE_DIR_NAME = "native"
+
+_NATIVE_SUFFIXES = (".cc", ".h")
+
+# --------------------------------------------------------------- tokenizer
+
+_MULTI_OPS = (
+    "<<=", ">>=", "->*", "...",
+    "::", "->", "++", "--", "<<", ">>", "<=", ">=", "==", "!=",
+    "&&", "||", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=",
+)
+
+_ID_START = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_")
+_ID_CONT = _ID_START | set("0123456789")
+_DIGITS = set("0123456789")
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # "id" | "num" | "str" | "char" | "punct"
+    text: str
+    line: int
+
+
+def tokenize(text: str) -> tuple[list[Token], list[tuple[int, str]]]:
+    """Tokens plus ``(line, comment_text)`` pairs (comments stripped)."""
+    toks: list[Token] = []
+    comments: list[tuple[int, str]] = []
+    i, n, line = 0, len(text), 1
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        if c == "#":
+            # Preprocessor directive: skip to end of line (no
+            # continuations in the sources this pass targets).
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            comments.append((line, text[i:j]))
+            i = j
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            chunk = text[i:j + 2]
+            comments.append((line, chunk))
+            line += chunk.count("\n")
+            i = j + 2
+            continue
+        if c == '"':
+            j, buf = i + 1, []
+            while j < n and text[j] != '"':
+                if text[j] == "\\" and j + 1 < n:
+                    buf.append(text[j:j + 2])
+                    j += 2
+                    continue
+                buf.append(text[j])
+                j += 1
+            raw = "".join(buf)
+            try:
+                # Unescape via the C-ish subset Python shares.
+                val = raw.encode().decode("unicode_escape")
+            except UnicodeDecodeError:
+                val = raw
+            toks.append(Token("str", val, line))
+            i = j + 1
+            continue
+        if c == "'":
+            j = i + 1
+            while j < n and text[j] != "'":
+                j += 2 if text[j] == "\\" else 1
+            toks.append(Token("char", text[i + 1:j], line))
+            i = j + 1
+            continue
+        if c in _ID_START:
+            j = i + 1
+            while j < n and text[j] in _ID_CONT:
+                j += 1
+            toks.append(Token("id", text[i:j], line))
+            i = j
+            continue
+        if c in _DIGITS:
+            j = i + 1
+            while j < n and (text[j] in _ID_CONT or text[j] in ".'"):
+                j += 1
+            toks.append(Token("num", text[i:j], line))
+            i = j
+            continue
+        matched = False
+        for op in _MULTI_OPS:
+            if text.startswith(op, i):
+                toks.append(Token("punct", op, line))
+                i += len(op)
+                matched = True
+                break
+        if not matched:
+            toks.append(Token("punct", c, line))
+            i += 1
+    return toks, comments
+
+
+# ------------------------------------------------------ constant evaluation
+
+
+def _parse_c_int(text: str) -> int | None:
+    t = text.replace("'", "")
+    while t and t[-1] in "uUlL":
+        t = t[:-1]
+    try:
+        return int(t, 0)
+    except ValueError:
+        return None
+
+
+class _ExprEval:
+    """Tiny recursive-descent evaluator for constexpr integer RHS."""
+
+    def __init__(self, toks: list[Token], env: dict[str, int]):
+        self.toks = toks
+        self.env = env
+        self.i = 0
+
+    def _peek(self) -> Token | None:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def eval(self) -> int | None:
+        try:
+            v = self._or()
+        except (ValueError, TypeError):
+            return None
+        return v if self._peek() is None else None
+
+    def _binop(self, sub, ops):
+        v = sub()
+        while True:
+            t = self._peek()
+            if t is None or t.kind != "punct" or t.text not in ops:
+                return v
+            self.i += 1
+            rhs = sub()
+            v = ops[t.text](v, rhs)
+
+    def _or(self):
+        return self._binop(self._xor, {"|": lambda a, b: a | b})
+
+    def _xor(self):
+        return self._binop(self._and, {"^": lambda a, b: a ^ b})
+
+    def _and(self):
+        return self._binop(self._shift, {"&": lambda a, b: a & b})
+
+    def _shift(self):
+        return self._binop(self._add, {"<<": lambda a, b: a << b,
+                                       ">>": lambda a, b: a >> b})
+
+    def _add(self):
+        return self._binop(self._mul, {"+": lambda a, b: a + b,
+                                       "-": lambda a, b: a - b})
+
+    def _mul(self):
+        return self._binop(self._unary, {"*": lambda a, b: a * b,
+                                         "/": lambda a, b: a // b,
+                                         "%": lambda a, b: a % b})
+
+    def _unary(self):
+        t = self._peek()
+        if t is None:
+            raise ValueError("eof")
+        if t.kind == "punct" and t.text == "-":
+            self.i += 1
+            return -self._unary()
+        if t.kind == "punct" and t.text == "~":
+            self.i += 1
+            return ~self._unary()
+        if t.kind == "punct" and t.text == "(":
+            self.i += 1
+            v = self._or()
+            t2 = self._peek()
+            if t2 is None or t2.text != ")":
+                raise ValueError("unbalanced")
+            self.i += 1
+            return v
+        if t.kind == "num":
+            self.i += 1
+            v = _parse_c_int(t.text)
+            if v is None:
+                raise ValueError("bad literal")
+            return v
+        if t.kind == "id":
+            self.i += 1
+            if t.text in self.env:
+                return self.env[t.text]
+            # static_cast<...>(x) and friends are out of scope.
+            raise ValueError("unknown name")
+        raise ValueError("unexpected")
+
+
+# ----------------------------------------------------- C type normalization
+
+_SCALAR_CANON = {
+    "void": "void", "bool": "bool",
+    "char": "char", "signedchar": "i8", "int8_t": "i8",
+    "uint8_t": "u8", "unsignedchar": "u8",
+    "uint16_t": "u16", "unsignedshort": "u16", "unsignedshortint": "u16",
+    "int16_t": "i16", "short": "i16", "shortint": "i16",
+    "uint32_t": "u32", "unsigned": "u32", "unsignedint": "u32",
+    "int32_t": "i32", "int": "i32",
+    # LP64: size_t/unsigned long and ssize_t/long alias the 64-bit
+    # families — that is the ABI the ctypes layer targets.
+    "uint64_t": "u64", "size_t": "u64", "unsignedlong": "u64",
+    "unsignedlonglong": "u64", "unsignedlongint": "u64",
+    "int64_t": "i64", "ssize_t": "i64", "long": "i64", "longlong": "i64",
+    "longint": "i64", "ptrdiff_t": "i64",
+    "float": "f32", "double": "f64",
+}
+
+
+def _canon_c_type(type_toks: list[Token], array: bool = False) -> str:
+    """Canonical form of a C parameter/return type. Pointers collapse to
+    ``cstr``/``cstr2`` (char*/char**) and ``ptr``/``ptr2`` (anything
+    else); scalars map via :data:`_SCALAR_CANON`; arrays decay."""
+    stars = sum(1 for t in type_toks if t.kind == "punct" and t.text == "*")
+    if array:
+        stars += 1
+    words = [t.text for t in type_toks
+             if t.kind == "id" and t.text not in ("const", "struct",
+                                                  "volatile", "restrict")]
+    base = "".join(words)
+    if stars:
+        if base == "char":
+            return "cstr" if stars == 1 else "cstr2"
+        return "ptr" if stars == 1 else "ptr2"
+    return _SCALAR_CANON.get(base, f"other:{base}")
+
+
+_HUMAN = {
+    "void": "void", "bool": "bool", "char": "char",
+    "i8": "int8_t", "u8": "uint8_t", "i16": "int16_t", "u16": "uint16_t",
+    "i32": "int32_t", "u32": "uint32_t", "i64": "int64_t", "u64": "uint64_t",
+    "f32": "float", "f64": "double",
+    "cstr": "char*", "cstr2": "char**", "ptr": "T*", "ptr2": "T**",
+    "anyptr": "void*",
+}
+
+
+def format_ctype_for_human(canon: str) -> str:
+    return _HUMAN.get(canon, canon)
+
+
+def ctype_compatible(py_canon: str, c_canon: str) -> bool:
+    """Is a ctypes declaration (canonical) ABI-compatible with a C type?
+
+    ``c_void_p`` (``anyptr``) matches any pointer; ``c_char_p`` requires
+    ``char*`` exactly (an out-buffer ``char*`` is also bound as
+    ``c_char_p``); scalars must land in the same width/signedness
+    family."""
+    if py_canon == "anyptr":
+        return c_canon in ("cstr", "cstr2", "ptr", "ptr2")
+    if py_canon == "ptr2":
+        return c_canon in ("ptr2", "cstr2")
+    return py_canon == c_canon
+
+
+# ------------------------------------------------------------- structures
+
+
+@dataclass(frozen=True)
+class CParam:
+    canon: str
+    name: str
+
+
+@dataclass
+class CFunc:
+    name: str
+    ret: str
+    params: list[CParam]
+    line: int
+    defined: bool
+    rel: str = ""
+
+    @property
+    def signature(self) -> str:
+        return f"{self.ret}({','.join(p.canon for p in self.params)})"
+
+
+@dataclass
+class CField:
+    name: str
+    type_text: str
+    line: int
+    sync: bool  # atomic / mutex / condition_variable / thread
+    const: bool
+
+
+@dataclass
+class CMethod:
+    name: str
+    line: int
+    body: list[Token] = field(default_factory=list)
+    is_ctor: bool = False
+    is_dtor: bool = False
+    pre_start: bool = False
+
+
+@dataclass
+class CClass:
+    name: str
+    line: int
+    fields: dict[str, CField] = field(default_factory=dict)
+    methods: list[CMethod] = field(default_factory=list)
+
+    @property
+    def has_sync(self) -> bool:
+        return any(f.sync for f in self.fields.values())
+
+
+_SYNC_TYPE_WORDS = ("atomic", "mutex", "condition_variable", "thread",
+                    "shared_mutex", "once_flag")
+
+_DECL_SKIP_LEADERS = {
+    "using", "typedef", "friend", "static", "constexpr", "template",
+    "enum", "union", "extern", "namespace", "return", "if", "for",
+    "while", "switch", "public", "private", "protected", "operator",
+    "include", "define", "inline", "virtual",
+}
+
+_KEYWORD_IDS = {
+    "nullptr", "true", "false", "sizeof", "new", "delete", "this",
+    "const", "volatile", "struct", "class", "void", "auto", "default",
+}
+
+
+def _find_matching(toks: list[Token], i: int, open_t: str,
+                   close_t: str) -> int:
+    """Index of the token closing the ``open_t`` at ``toks[i]``."""
+    depth = 0
+    for j in range(i, len(toks)):
+        t = toks[j]
+        if t.kind == "punct":
+            if t.text == open_t:
+                depth += 1
+            elif t.text == close_t:
+                depth -= 1
+                if depth == 0:
+                    return j
+    return len(toks) - 1
+
+
+def _decl_names(unit: list[Token]) -> list[tuple[str, int, bool]]:
+    """Declared variable names in a (non-function) declaration statement:
+    ``(name, line, is_array)`` triples. Tracks template/paren/brace/
+    bracket depth so initializers and template arguments don't leak
+    names."""
+    names: list[tuple[str, int, bool]] = []
+    angle = paren = brace = bracket = 0
+    for idx, t in enumerate(unit):
+        if t.kind == "punct":
+            if t.text == "<":
+                angle += 1
+            elif t.text == ">":
+                angle = max(0, angle - 1)
+            elif t.text == ">>":
+                angle = max(0, angle - 2)
+            elif t.text == "(":
+                paren += 1
+            elif t.text == ")":
+                paren -= 1
+            elif t.text == "{":
+                brace += 1
+            elif t.text == "}":
+                brace -= 1
+            elif t.text == "[":
+                bracket += 1
+            elif t.text == "]":
+                bracket -= 1
+            continue
+        if angle or paren or brace or bracket:
+            continue
+        if t.kind != "id" or t.text in _KEYWORD_IDS:
+            continue
+        nxt = unit[idx + 1] if idx + 1 < len(unit) else None
+        prv = unit[idx - 1] if idx > 0 else None
+        # Units arrive without their trailing ';', so end-of-unit is a
+        # terminator too — `std::mutex mu_;` declares mu_ even though
+        # no punct follows it inside the unit.
+        if nxt is not None and nxt.kind != "punct":
+            continue
+        if nxt is not None and nxt.text not in (";", ",", "=", "{", "["):
+            continue
+        if prv is None:
+            continue
+        prev_ok = (prv.kind == "id" and prv.text not in ("return",)) or \
+            (prv.kind == "punct" and prv.text in (">", "*", "&", ",", "]"))
+        if not prev_ok:
+            continue
+        names.append((t.text, t.line,
+                      nxt is not None and nxt.text == "["))
+    return names
+
+
+def _first_top_level_paren(unit: list[Token]) -> int | None:
+    angle = 0
+    for idx, t in enumerate(unit):
+        if t.kind != "punct":
+            continue
+        if t.text == "<":
+            angle += 1
+        elif t.text == ">":
+            angle = max(0, angle - 1)
+        elif t.text == ">>":
+            angle = max(0, angle - 2)
+        elif t.text == "(" and angle == 0:
+            return idx
+        elif t.text == "=":
+            # `= lambda` etc: anything after an initializer is not a
+            # function declarator.
+            return None
+    return None
+
+
+def _split_params(toks: list[Token]) -> list[list[Token]]:
+    """Split a parameter token list on top-level commas."""
+    out: list[list[Token]] = [[]]
+    angle = paren = 0
+    for t in toks:
+        if t.kind == "punct":
+            if t.text == "<":
+                angle += 1
+            elif t.text == ">":
+                angle = max(0, angle - 1)
+            elif t.text == ">>":
+                angle = max(0, angle - 2)
+            elif t.text == "(":
+                paren += 1
+            elif t.text == ")":
+                paren -= 1
+            elif t.text == "," and angle == 0 and paren == 0:
+                out.append([])
+                continue
+        out[-1].append(t)
+    return [p for p in out if p]
+
+
+def _parse_param(toks: list[Token]) -> CParam | None:
+    if not toks:
+        return None
+    if len(toks) == 1 and toks[0].text == "void":
+        return None
+    # Strip default values.
+    for idx, t in enumerate(toks):
+        if t.kind == "punct" and t.text == "=":
+            toks = toks[:idx]
+            break
+    array = any(t.kind == "punct" and t.text == "[" for t in toks)
+    if array:
+        toks = toks[:next(i for i, t in enumerate(toks)
+                          if t.kind == "punct" and t.text == "[")]
+    name = ""
+    if toks and toks[-1].kind == "id" and toks[-1].text not in _SCALAR_CANON \
+            and toks[-1].text not in ("const", "void"):
+        # `const char* host` — trailing id is the parameter name unless
+        # the whole declarator is an unnamed scalar (`uint64_t`).
+        if len(toks) > 1:
+            name = toks[-1].text
+            toks = toks[:-1]
+    return CParam(_canon_c_type(toks, array=array), name)
+
+
+def _parse_function(unit: list[Token], body: list[Token],
+                    defined: bool) -> CFunc | None:
+    paren_i = _first_top_level_paren(unit)
+    if paren_i is None or paren_i == 0:
+        return None
+    name_tok = unit[paren_i - 1]
+    if name_tok.kind != "id":
+        return None
+    close_i = _find_matching(unit, paren_i, "(", ")")
+    params = [p for p in (_parse_param(pt)
+                          for pt in _split_params(unit[paren_i + 1:close_i]))
+              if p is not None]
+    ret_toks = unit[:paren_i - 1]
+    fn = CFunc(name=name_tok.text, ret=_canon_c_type(ret_toks),
+               params=params, line=name_tok.line, defined=defined)
+    fn.body = body  # type: ignore[attr-defined]
+    return fn
+
+
+# --------------------------------------------------------------- the parse
+
+
+_SUPPRESS_CC_RE = re.compile(
+    r"//\s*tpulint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\s]+)"
+)
+_PRE_START_RE = re.compile(r"//\s*tpulint:\s*pre-start\b")
+
+
+class NativeSource:
+    """One parsed ``native/*.cc`` (or ``.h``) file."""
+
+    def __init__(self, path: pathlib.Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tokens, self.comments = tokenize(text)
+        self.exports: list[CFunc] = []       # extern "C" decls + defs
+        self.constants: dict[str, int] = {}  # file-scope constexpr ints
+        self.constant_lines: dict[str, int] = {}
+        self.abi_version: int | None = None
+        self.abi_line: int = 0
+        self.string_literals: dict[str, int] = {}  # literal -> first line
+        self.classes: list[CClass] = []
+        self.free_funcs: list[CMethod] = []
+        self.globals: dict[str, CField] = {}
+        self.status_codes: list[tuple[str, int]] = []
+        self.has_threads = False
+        self._line_suppressions: dict[int, set[str]] = {}
+        self._file_suppressions: set[str] = set()
+        self._pre_start_lines: set[int] = set()
+        self._parse_comments()
+        self._parse()
+
+    # -- suppressions / annotations ------------------------------------
+
+    def _parse_comments(self) -> None:
+        for line, text in self.comments:
+            if _PRE_START_RE.search(text):
+                self._pre_start_lines.add(line)
+            m = _SUPPRESS_CC_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip().upper()
+                     for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-file":
+                self._file_suppressions |= rules
+            else:
+                # Applies to its own line and the next code line.
+                self._line_suppressions.setdefault(line, set()).update(rules)
+                self._line_suppressions.setdefault(line + 1,
+                                                   set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rule = rule.upper()
+        for pool in (self._file_suppressions,
+                     self._line_suppressions.get(line, ())):
+            if rule in pool or "ALL" in pool:
+                return True
+        return False
+
+    def _is_pre_start(self, decl_line: int) -> bool:
+        return any(ln in self._pre_start_lines
+                   for ln in range(decl_line - 2, decl_line + 1))
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    # -- main parse ----------------------------------------------------
+
+    def _parse(self) -> None:
+        toks = self.tokens
+        self.has_threads = any(
+            t.kind == "id" and t.text == "thread" for t in toks)
+        for lit_tok in toks:
+            if lit_tok.kind == "str":
+                self.string_literals.setdefault(lit_tok.text, lit_tok.line)
+        self._collect_status_codes()
+        self._walk_scope(0, len(toks), extern_c=False)
+        self._finish_abi()
+
+    def _collect_status_codes(self) -> None:
+        toks = self.tokens
+        for i, t in enumerate(toks):
+            if t.kind != "id" or t.text != "respond_err":
+                continue
+            if i + 1 >= len(toks) or toks[i + 1].text != "(":
+                continue
+            close = _find_matching(toks, i + 1, "(", ")")
+            for j in range(i + 2, close):
+                if toks[j].kind == "str":
+                    self.status_codes.append((toks[j].text, toks[j].line))
+                    break
+
+    def _walk_scope(self, start: int, end: int, extern_c: bool) -> None:
+        """Walk namespace-level statements in ``tokens[start:end]``."""
+        toks = self.tokens
+        i = start
+        unit_start = start
+        while i < end:
+            t = toks[i]
+            if t.kind == "punct" and t.text == ";":
+                self._handle_statement(toks[unit_start:i], extern_c)
+                i += 1
+                unit_start = i
+                continue
+            if t.kind == "id" and t.text == "extern" and i + 1 < end \
+                    and toks[i + 1].kind == "str" and toks[i + 1].text == "C":
+                if i + 2 < end and toks[i + 2].text == "{":
+                    close = _find_matching(toks, i + 2, "{", "}")
+                    self._walk_scope(i + 3, close, extern_c=True)
+                    i = close + 1
+                else:
+                    # Single `extern "C" <decl-or-def>`: let the scope
+                    # walker continue, but mark from here.
+                    j = i + 2
+                    stmt_end, body = self._statement_span(j, end)
+                    self._handle_unit(toks[j:stmt_end], body, extern_c=True)
+                    i = stmt_end if body is None else stmt_end
+                    i += 1 if body is None else 0
+                unit_start = i
+                continue
+            if t.kind == "id" and t.text == "namespace":
+                # namespace [name] { ... }
+                j = i + 1
+                while j < end and toks[j].kind == "id":
+                    j += 1
+                if j < end and toks[j].text == "{":
+                    close = _find_matching(toks, j, "{", "}")
+                    self._walk_scope(j + 1, close, extern_c=extern_c)
+                    i = close + 1
+                    unit_start = i
+                    continue
+                i += 1
+                continue
+            if t.kind == "id" and t.text in ("class", "struct") \
+                    and i + 1 < end and toks[i + 1].kind == "id":
+                # Peek: type definition (body) or a declaration/return
+                # type (no body before ; or ().
+                j = i + 2
+                while j < end and toks[j].kind == "punct" \
+                        and toks[j].text in (":", ","):
+                    # base clause
+                    while j < end and toks[j].text != "{":
+                        j += 1
+                    break
+                while j < end and toks[j].kind == "id":
+                    j += 1
+                if j < end and toks[j].text == "{":
+                    close = _find_matching(toks, j, "{", "}")
+                    self._parse_class(toks[i + 1].text, toks[i].line,
+                                      j + 1, close)
+                    # Skip `};` — possible trailing declarator names are
+                    # out of scope for this pass.
+                    i = close + 1
+                    while i < end and toks[i].text != ";":
+                        i += 1
+                    i += 1
+                    unit_start = i
+                    continue
+            if t.kind == "punct" and t.text == "{":
+                # A function definition body (the unit so far is its
+                # declarator) or a brace initializer.
+                unit = toks[unit_start:i]
+                paren_i = _first_top_level_paren(unit)
+                close = _find_matching(toks, i, "{", "}")
+                if paren_i is not None and paren_i > 0:
+                    self._handle_unit(unit, toks[i + 1:close], extern_c)
+                    i = close + 1
+                    unit_start = i
+                    continue
+                # Brace initializer inside a declaration: keep scanning
+                # the same unit past the balanced braces.
+                i = close + 1
+                continue
+            i += 1
+        if unit_start < end:
+            self._handle_statement(toks[unit_start:end], extern_c)
+
+    def _statement_span(self, start: int,
+                        end: int) -> tuple[int, list[Token] | None]:
+        """From ``start``, find either the terminating ``;`` (returns
+        ``(index_of_semicolon, None)``) or a function body (returns
+        ``(index_after_close_brace, body_tokens)``)."""
+        toks = self.tokens
+        i = start
+        while i < end:
+            t = toks[i]
+            if t.kind == "punct" and t.text == ";":
+                return i, None
+            if t.kind == "punct" and t.text == "{":
+                unit = toks[start:i]
+                if _first_top_level_paren(unit) is not None:
+                    close = _find_matching(toks, i, "{", "}")
+                    return close + 1, toks[i + 1:close]
+                close = _find_matching(toks, i, "{", "}")
+                i = close + 1
+                continue
+            i += 1
+        return end, None
+
+    def _handle_statement(self, unit: list[Token], extern_c: bool) -> None:
+        self._handle_unit(unit, None, extern_c)
+
+    def _handle_unit(self, unit: list[Token], body: list[Token] | None,
+                     extern_c: bool) -> None:
+        if not unit:
+            return
+        lead = unit[0]
+        if lead.kind == "id" and lead.text == "constexpr":
+            self._parse_constexpr(unit)
+            return
+        if lead.kind == "id" and lead.text in _DECL_SKIP_LEADERS:
+            # `static`, `using`, control keywords... — but a `static`
+            # function definition still matters for the blocking-call
+            # closure.
+            if body is not None and lead.text in ("static", "inline"):
+                fn = _parse_function(unit[1:], body, defined=True)
+                if fn is not None:
+                    self.free_funcs.append(
+                        CMethod(fn.name, fn.line, body))
+            return
+        paren_i = _first_top_level_paren(unit)
+        if paren_i is not None and paren_i > 0:
+            fn = _parse_function(unit, body or [], defined=body is not None)
+            if fn is None:
+                return
+            if extern_c:
+                fn.rel = self.rel
+                self.exports.append(fn)
+            if body is not None:
+                self.free_funcs.append(CMethod(fn.name, fn.line, body))
+            return
+        if body is not None:
+            return
+        # Plain namespace-scope declaration: candidate globals.
+        type_words = {t.text for t in unit if t.kind == "id"}
+        is_const = "const" in type_words or "constexpr" in type_words
+        sync = any(w in type_words for w in _SYNC_TYPE_WORDS)
+        for name, line, _arr in _decl_names(unit):
+            self.globals[name] = CField(
+                name=name, line=line, sync=sync, const=is_const,
+                type_text=" ".join(t.text for t in unit[:3]))
+
+    def _parse_constexpr(self, unit: list[Token]) -> None:
+        # constexpr TYPE NAME = EXPR
+        eq = next((i for i, t in enumerate(unit)
+                   if t.kind == "punct" and t.text == "="), None)
+        if eq is None or eq == 0:
+            return
+        name_tok = unit[eq - 1]
+        if name_tok.kind != "id":
+            return
+        val = _ExprEval(unit[eq + 1:], self.constants).eval()
+        if val is not None:
+            self.constants[name_tok.text] = val
+            self.constant_lines[name_tok.text] = name_tok.line
+
+    def _parse_class(self, name: str, line: int, start: int,
+                     end: int) -> None:
+        toks = self.tokens
+        cls = CClass(name=name, line=line)
+        i = start
+        unit_start = start
+        while i < end:
+            t = toks[i]
+            if t.kind == "punct" and t.text == ";":
+                self._class_field_unit(cls, toks[unit_start:i])
+                i += 1
+                unit_start = i
+                continue
+            if t.kind == "id" and t.text in ("public", "private",
+                                             "protected") \
+                    and i + 1 < end and toks[i + 1].text == ":":
+                i += 2
+                unit_start = i
+                continue
+            if t.kind == "id" and t.text in ("class", "struct", "enum") \
+                    and unit_start == i:
+                # Nested type: skip its body entirely.
+                j = i
+                while j < end and toks[j].text != "{" \
+                        and toks[j].text != ";":
+                    j += 1
+                if j < end and toks[j].text == "{":
+                    j = _find_matching(toks, j, "{", "}")
+                    while j < end and toks[j].text != ";":
+                        j += 1
+                i = j + 1
+                unit_start = i
+                continue
+            if t.kind == "punct" and t.text == "{":
+                unit = toks[unit_start:i]
+                paren_i = _first_top_level_paren(unit)
+                close = _find_matching(toks, i, "{", "}")
+                if paren_i is not None and paren_i > 0:
+                    m_name_tok = unit[paren_i - 1]
+                    is_dtor = paren_i >= 2 and \
+                        unit[paren_i - 2].kind == "punct" and \
+                        unit[paren_i - 2].text == "~"
+                    method = CMethod(
+                        name=("~" if is_dtor else "") + m_name_tok.text,
+                        line=unit[0].line,
+                        body=toks[i + 1:close],
+                        is_ctor=m_name_tok.text == name and not is_dtor,
+                        is_dtor=is_dtor,
+                        pre_start=self._is_pre_start(unit[0].line),
+                    )
+                    cls.methods.append(method)
+                    i = close + 1
+                    unit_start = i
+                    continue
+                i = close + 1
+                continue
+            i += 1
+        self.classes.append(cls)
+
+    def _class_field_unit(self, cls: CClass, unit: list[Token]) -> None:
+        if not unit:
+            return
+        lead = unit[0]
+        if lead.kind == "id" and lead.text in _DECL_SKIP_LEADERS:
+            return
+        if _first_top_level_paren(unit) is not None:
+            return  # method declaration without body
+        type_words = {t.text for t in unit if t.kind == "id"}
+        is_const = lead.kind == "id" and lead.text == "const"
+        sync = any(w in type_words for w in _SYNC_TYPE_WORDS)
+        for name, line, _arr in _decl_names(unit):
+            cls.fields[name] = CField(
+                name=name, line=line, sync=sync, const=is_const,
+                type_text=" ".join(t.text for t in unit[:4]))
+
+    def _finish_abi(self) -> None:
+        for fn in self.exports:
+            if fn.name != "tpudfs_dataplane_abi" or not fn.defined:
+                continue
+            body = getattr(fn, "body", [])
+            for i, t in enumerate(body):
+                if t.kind == "id" and t.text == "return" \
+                        and i + 1 < len(body) and body[i + 1].kind == "num":
+                    v = _parse_c_int(body[i + 1].text)
+                    if v is not None:
+                        self.abi_version = v
+                        self.abi_line = t.line
+                    break
+
+
+# -------------------------------------------------------- lock-region pass
+
+
+_LOCK_TYPES = ("lock_guard", "unique_lock", "scoped_lock", "shared_lock")
+
+
+@dataclass
+class _HeldLock:
+    var: str
+    mutex: str
+    depth: int
+    active: bool = True
+
+
+def iter_with_locks(body: list[Token]):
+    """Yield ``(index, token, held)`` for each token of a method body,
+    where ``held`` is the tuple of mutex names lexically locked at that
+    point (``lock_guard``/``unique_lock`` declarations, honoring
+    ``.unlock()``/``.lock()`` toggles and scope ends)."""
+    depth = 0
+    locks: list[_HeldLock] = []
+    n = len(body)
+    i = 0
+    while i < n:
+        t = body[i]
+        if t.kind == "punct":
+            if t.text == "{":
+                depth += 1
+            elif t.text == "}":
+                locks = [lk for lk in locks if lk.depth < depth]
+                depth -= 1
+        if t.kind == "id" and t.text in _LOCK_TYPES and i + 1 < n \
+                and body[i + 1].kind == "punct" and body[i + 1].text == "<":
+            close_a = _find_matching(body, i + 1, "<", ">")
+            j = close_a + 1
+            if j < n and body[j].kind == "id" and j + 1 < n \
+                    and body[j + 1].text == "(":
+                var = body[j].text
+                k = j + 2
+                while k < n and body[k].kind == "punct" \
+                        and body[k].text in ("&", "*"):
+                    k += 1
+                if k < n and body[k].kind == "id":
+                    locks.append(_HeldLock(var=var, mutex=body[k].text,
+                                           depth=depth))
+                # The declaration tokens themselves are not "under" the
+                # new lock for access purposes; skip past the ctor args.
+                close_p = _find_matching(body, j + 1, "(", ")")
+                for idx in range(i, close_p + 1):
+                    yield idx, body[idx], tuple(
+                        lk.mutex for lk in locks[:-1] if lk.active)
+                i = close_p + 1
+                continue
+        if t.kind == "id" and i + 2 < n and body[i + 1].kind == "punct" \
+                and body[i + 1].text == "." and body[i + 2].kind == "id" \
+                and body[i + 2].text in ("lock", "unlock"):
+            for lk in reversed(locks):
+                if lk.var == t.text:
+                    lk.active = body[i + 2].text == "lock"
+                    break
+        yield i, t, tuple(lk.mutex for lk in locks if lk.active)
+        i += 1
+
+
+# ------------------------------------------------------------ file loading
+
+
+def iter_native_files(root: pathlib.Path) -> list[pathlib.Path]:
+    base = root / NATIVE_DIR_NAME
+    if not base.is_dir():
+        return []
+    return sorted(p for p in base.iterdir()
+                  if p.is_file() and p.suffix in _NATIVE_SUFFIXES)
+
+
+def has_native_sources(root: pathlib.Path) -> bool:
+    return bool(iter_native_files(root))
+
+
+def parse_native(path: pathlib.Path,
+                 root: pathlib.Path) -> NativeSource | None:
+    try:
+        text = path.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return None
+    rel = path.resolve().relative_to(root.resolve()).as_posix()
+    return NativeSource(path, rel, text)
+
+
+_SOURCE_CACHE: dict[tuple[str, float, int], NativeSource] = {}
+
+
+def load_native_sources(root: pathlib.Path) -> list[NativeSource]:
+    """Parsed native sources under ``root/native``, memoized on
+    ``(path, mtime, size)`` so the four TPL04x rules share one parse."""
+    out: list[NativeSource] = []
+    for path in iter_native_files(root):
+        try:
+            st = path.stat()
+        except OSError:
+            continue
+        key = (str(path.resolve()), st.st_mtime, st.st_size)
+        src = _SOURCE_CACHE.get(key)
+        if src is None:
+            src = parse_native(path, root)
+            if src is None:
+                continue
+            if len(_SOURCE_CACHE) > 64:  # bound: fixture churn in tests
+                _SOURCE_CACHE.clear()
+            _SOURCE_CACHE[key] = src
+        out.append(src)
+    return out
+
+
+def project_root(project) -> pathlib.Path | None:
+    """Repo root for a :class:`~tpudfs.analysis.callgraph.Project`: the
+    explicit ``root`` the driver attached, else derived from any
+    module's ``path``/``rel_path`` pair."""
+    root = getattr(project, "root", None)
+    if root is not None:
+        return pathlib.Path(root)
+    for mod in project.modules.values():
+        rel = pathlib.PurePosixPath(mod.rel_path)
+        p = mod.path.resolve()
+        if len(p.parts) > len(rel.parts):
+            return pathlib.Path(*p.parts[:len(p.parts) - len(rel.parts)])
+    return None
+
+
+# ----------------------------------------------- Python-side declarations
+
+
+@dataclass
+class PyDecl:
+    name: str
+    argtypes: list[str] | None = None
+    argtypes_line: int = 0
+    restype: str | None = None  # canonical; "void" for None
+    restype_line: int = 0
+
+
+@dataclass
+class PyCtypesDecls:
+    decls: dict[str, PyDecl] = field(default_factory=dict)
+    abi_checks: list[tuple[int, int]] = field(default_factory=list)
+    # (expected_version, line)
+
+
+_CTYPES_CANON = {
+    "c_char_p": "cstr",
+    "c_wchar_p": "other:wchar",
+    "c_void_p": "anyptr",
+    "c_bool": "bool",
+    "c_uint8": "u8", "c_ubyte": "u8",
+    "c_int8": "i8", "c_byte": "i8",
+    "c_uint16": "u16", "c_ushort": "u16",
+    "c_int16": "i16", "c_short": "i16",
+    "c_uint32": "u32", "c_uint": "u32",
+    "c_int32": "i32", "c_int": "i32",
+    "c_uint64": "u64", "c_ulonglong": "u64", "c_size_t": "u64",
+    "c_ulong": "u64",
+    "c_int64": "i64", "c_longlong": "i64", "c_ssize_t": "i64",
+    "c_long": "i64",
+    "c_float": "f32", "c_double": "f64",
+}
+
+
+def _ctypes_name(node: ast.AST) -> str | None:
+    """``ctypes.c_uint32`` / bare ``c_uint32`` -> the attribute name."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _canon_ctypes_node(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "void"
+    if isinstance(node, ast.Call):
+        fn = _ctypes_name(node.func)
+        if fn == "POINTER" and node.args:
+            inner = _ctypes_name(node.args[0])
+            if inner == "c_char_p":
+                return "cstr2"
+            return "ptr2"
+        return None
+    name = _ctypes_name(node)
+    if name is None:
+        return None
+    return _CTYPES_CANON.get(name)
+
+
+def _lib_symbol_attr(node: ast.AST) -> tuple[str, str] | None:
+    """``lib.tpudfs_x.argtypes`` -> ("tpudfs_x", "argtypes")."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    if node.attr not in ("argtypes", "restype"):
+        return None
+    base = node.value
+    if not isinstance(base, ast.Attribute):
+        return None
+    if not isinstance(base.value, ast.Name) or base.value.id != "lib":
+        return None
+    return base.attr, node.attr
+
+
+def parse_ctypes_decls(tree: ast.AST) -> PyCtypesDecls:
+    """Every ``lib.NAME.restype``/``.argtypes`` assignment plus the ABI
+    version guard (``lib.tpudfs_dataplane_abi() != N``) in native.py."""
+    out = PyCtypesDecls()
+
+    def decl(name: str) -> PyDecl:
+        return out.decls.setdefault(name, PyDecl(name=name))
+
+    # Source order matters: `lib.a.argtypes = list(lib.b.argtypes)` must
+    # see b's declaration first, and ast.walk is breadth-first.
+    nodes = sorted(
+        (n for n in ast.walk(tree) if isinstance(n, (ast.Assign,
+                                                     ast.Compare))),
+        key=lambda n: (n.lineno, n.col_offset))
+    for node in nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            sym = _lib_symbol_attr(node.targets[0])
+            if sym is None:
+                continue
+            name, attr = sym
+            d = decl(name)
+            if attr == "restype":
+                d.restype = _canon_ctypes_node(node.value) or "other:?"
+                d.restype_line = node.lineno
+                continue
+            d.argtypes_line = node.lineno
+            val = node.value
+            if isinstance(val, ast.Call) and \
+                    isinstance(val.func, ast.Name) and \
+                    val.func.id == "list" and len(val.args) == 1:
+                alias = _lib_symbol_attr(val.args[0])
+                if alias is not None and alias[1] == "argtypes":
+                    src = out.decls.get(alias[0])
+                    d.argtypes = list(src.argtypes) \
+                        if src is not None and src.argtypes is not None \
+                        else None
+                    continue
+            if isinstance(val, (ast.List, ast.Tuple)):
+                d.argtypes = [_canon_ctypes_node(e) or "other:?"
+                              for e in val.elts]
+            continue
+        if not isinstance(node, ast.Compare):
+            continue
+        if len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.NotEq, ast.Eq)):
+            left, right = node.left, node.comparators[0]
+            call = left if isinstance(left, ast.Call) else \
+                right if isinstance(right, ast.Call) else None
+            const = right if isinstance(right, ast.Constant) else \
+                left if isinstance(left, ast.Constant) else None
+            if call is None or const is None:
+                continue
+            if not isinstance(const.value, int):
+                continue
+            target = call.func
+            if isinstance(target, ast.Attribute) \
+                    and target.attr == "tpudfs_dataplane_abi":
+                out.abi_checks.append((const.value, node.lineno))
+    return out
+
+
+def py_int_constants(tree: ast.AST) -> dict[str, tuple[int, int]]:
+    """Module-level integer constants ``{name: (value, line)}``, with
+    simple arithmetic (``1 << 30``, ``2 * FRAME_SIZE``) folded against
+    earlier constants in the same module."""
+    env: dict[str, tuple[int, int]] = {}
+
+    def ev(node: ast.AST) -> int | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name):
+            hit = env.get(node.id)
+            return hit[0] if hit else None
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = ev(node.operand)
+            return -v if v is not None else None
+        if isinstance(node, ast.BinOp):
+            a, b = ev(node.left), ev(node.right)
+            if a is None or b is None:
+                return None
+            op = node.op
+            if isinstance(op, ast.LShift):
+                return a << b
+            if isinstance(op, ast.RShift):
+                return a >> b
+            if isinstance(op, ast.Mult):
+                return a * b
+            if isinstance(op, ast.Add):
+                return a + b
+            if isinstance(op, ast.Sub):
+                return a - b
+            if isinstance(op, ast.FloorDiv) and b:
+                return a // b
+            if isinstance(op, ast.BitOr):
+                return a | b
+            if isinstance(op, ast.BitAnd):
+                return a & b
+            if isinstance(op, ast.BitXor):
+                return a ^ b
+        return None
+
+    body = getattr(tree, "body", [])
+    for stmt in body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            v = ev(stmt.value)
+            if v is not None:
+                env[stmt.targets[0].id] = (v, stmt.lineno)
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            v = ev(stmt.value)
+            if v is not None:
+                env[stmt.target.id] = (v, stmt.lineno)
+    return env
+
+
+def py_string_literals(tree: ast.AST) -> dict[str, int]:
+    """``{literal: first line}`` excluding module/class/function
+    docstrings."""
+    doc_nodes: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                doc_nodes.add(id(body[0].value))
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and id(node) not in doc_nodes:
+            line = getattr(node, "lineno", 0)
+            if node.value not in out or line < out[node.value]:
+                out[node.value] = line
+    return out

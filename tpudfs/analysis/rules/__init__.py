@@ -29,4 +29,8 @@ from tpudfs.analysis.rules import (  # noqa: F401
     stream_discipline,
     # tpuperf performance rules (hotpath.py + bufferflow.py backed)
     perf,
+    # tpunative cross-language rules (nativesrc.py C++ extraction backed)
+    native_abi,
+    native_wire,
+    native_threads,
 )

@@ -1,0 +1,351 @@
+"""TPL040 — C ABI conformance between native exports and ctypes bindings.
+
+The native engine exports a hand-written C ABI (``extern "C"`` functions
+in ``native/*.cc``) that ``tpudfs/common/native.py`` binds with equally
+hand-written ctypes declarations. Nothing checks the two against each
+other: an extra parameter added on the C side, a ``uint32_t`` narrowed
+to ``uint16_t``, or a forgotten ``TPUDFS_DATAPLANE_ABI`` bump all load
+and link fine — and then corrupt arguments at call time, on whatever
+machine rebuilds the ``.so`` first. This rule parses both sides
+(:mod:`tpudfs.analysis.nativesrc`) and proves them in lockstep:
+
+- every ``lib.tpudfs_*`` ctypes declaration must name a real export,
+  with matching arity and ABI-compatible parameter/return types
+  (``c_void_p`` accepts any pointer; ``c_char_p`` means ``char*``;
+  scalars must match width and signedness, with ``size_t``/``uint64_t``
+  and ``ssize_t``/``int64_t`` treated as the LP64 aliases they are);
+- when one ``.cc`` file re-declares another's export (dataplane.cc
+  declares the blockio.cc staging functions it calls), the duplicate
+  declarations must agree;
+- the dataplane ABI version must be the same number in
+  ``tpudfs_dataplane_abi()``'s return and native.py's version guard; and
+- the checked-in ABI manifest (``tpudfs/analysis/native_abi.json``,
+  regenerated via ``tpulint --write-native-abi``) pins every
+  ``tpudfs_dataplane_*`` signature at the current version — changing a
+  signature without bumping the version is a finding even though both
+  sides changed in lockstep, because old ``.so`` files stay loadable.
+
+This module also hosts the helpers the other TPL04x rules share
+(:func:`native_context`, :func:`native_finding`).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Iterator
+
+from tpudfs.analysis.linter import Finding, ProjectRule, register
+from tpudfs.analysis.nativesrc import (
+    CFunc,
+    NativeSource,
+    ctype_compatible,
+    format_ctype_for_human,
+    load_native_sources,
+    parse_ctypes_decls,
+    project_root,
+)
+
+#: Repo-relative path of the ABI manifest.
+ABI_MANIFEST_REL = "tpudfs/analysis/native_abi.json"
+
+#: Exports pinned by the manifest: the dataplane family, whose loadable
+#: lifetime is governed by the ``tpudfs_dataplane_abi()`` version gate.
+ABI_FAMILY_PREFIX = "tpudfs_dataplane_"
+
+
+def native_context(project) -> tuple[pathlib.Path | None,
+                                     list[NativeSource]]:
+    """(repo root, parsed native sources) for a project — the shared
+    entry point of every TPL04x rule. Empty sources = rules inert."""
+    root = project_root(project)
+    if root is None:
+        return None, []
+    return root, load_native_sources(root)
+
+
+def native_finding(rule_id: str, src: NativeSource, line: int,
+                   scope: str, message: str) -> Finding | None:
+    """A finding anchored in a C++ file, honoring its ``// tpulint:``
+    suppressions (the driver only applies Python-module suppressions)."""
+    if src.suppressed(rule_id, line):
+        return None
+    return Finding(rule=rule_id, path=src.rel, line=line, col=0,
+                   message=message, scope=scope,
+                   snippet=src.snippet(line))
+
+
+def py_finding(rule_id: str, module, line: int, scope: str,
+               message: str) -> Finding:
+    """A finding anchored in a Python module at a known line (the driver
+    applies the module's suppressions)."""
+    return Finding(rule=rule_id, path=module.rel_path, line=line, col=0,
+                   message=message, scope=scope,
+                   snippet=module.snippet(line))
+
+
+def collect_exports(
+    sources: list[NativeSource],
+) -> dict[str, list[tuple[CFunc, NativeSource]]]:
+    """Every ``extern "C"`` declaration/definition by symbol name."""
+    out: dict[str, list[tuple[CFunc, NativeSource]]] = {}
+    for src in sources:
+        for fn in src.exports:
+            out.setdefault(fn.name, []).append((fn, src))
+    return out
+
+
+def best_export(entries: list[tuple[CFunc, NativeSource]]
+                ) -> tuple[CFunc, NativeSource]:
+    """Prefer the definition over redeclarations."""
+    for fn, src in entries:
+        if fn.defined:
+            return fn, src
+    return entries[0]
+
+
+def load_abi_manifest(root: pathlib.Path) -> dict | None:
+    path = root / ABI_MANIFEST_REL
+    if not path.exists():
+        return None
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or "exports" not in data:
+        return None
+    return data
+
+
+def current_abi_surface(
+    sources: list[NativeSource],
+) -> tuple[int | None, dict[str, str]]:
+    """(dataplane ABI version, {export name: canonical signature}) as
+    the tree defines them right now — the manifest's ground truth."""
+    version = None
+    for src in sources:
+        if src.abi_version is not None:
+            version = src.abi_version
+    sigs: dict[str, str] = {}
+    for name, entries in collect_exports(sources).items():
+        if not name.startswith(ABI_FAMILY_PREFIX):
+            continue
+        fn, _src = best_export(entries)
+        if fn.defined:
+            sigs[name] = fn.signature
+    return version, sigs
+
+
+def _human_sig(fn: CFunc) -> str:
+    params = ", ".join(format_ctype_for_human(p.canon) for p in fn.params)
+    return f"{format_ctype_for_human(fn.ret)}({params})"
+
+
+@register
+class NativeAbiConformance(ProjectRule):
+    id = "TPL040"
+    name = "native-abi-conformance"
+    summary = ("ctypes declaration in native.py out of lockstep with the "
+               "`extern \"C\"` export it binds (missing symbol, arity or "
+               "type mismatch, ABI version drift, or a dataplane "
+               "signature changed without a TPUDFS_DATAPLANE_ABI bump)")
+    doc = (
+        "native.py's ctypes declarations and the `extern \"C\"` exports "
+        "in native/*.cc are two hand-written copies of one C ABI; "
+        "ctypes trusts the Python copy blindly, so a drifted parameter "
+        "list or return type loads fine and silently corrupts arguments "
+        "at call time. This rule parses both sides and flags: a bound "
+        "symbol no native file exports; argtypes whose arity differs "
+        "from the C parameter list; a parameter or return whose ctypes "
+        "type is not ABI-compatible with the C type (c_void_p matches "
+        "any pointer, c_char_p means char*, scalars must match width "
+        "and signedness — size_t/uint64_t and ssize_t/int64_t are LP64 "
+        "aliases); two .cc files declaring the same export with "
+        "different signatures; the version returned by "
+        "tpudfs_dataplane_abi() differing from the guard in native.py; "
+        "and any tpudfs_dataplane_* signature differing from the "
+        "checked-in manifest (tpudfs/analysis/native_abi.json) while "
+        "the ABI version stayed the same — lockstep edits still break "
+        "previously-built .so files, which the version gate exists to "
+        "reject."
+    )
+    example = """\
+// dataplane.cc
+int64_t tpudfs_dataplane_start(const char* host, uint32_t port,
+                               uint16_t shards);  // 3 params
+# native.py
+lib.tpudfs_dataplane_start.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+"""
+    fix = ("Make the ctypes declaration mirror the C signature "
+           "parameter-for-parameter; when a tpudfs_dataplane_* "
+           "signature legitimately changes, bump the version returned "
+           "by tpudfs_dataplane_abi(), update the guard in native.py, "
+           "and regenerate the manifest with `python -m tpudfs.analysis "
+           "--write-native-abi`.")
+
+    def check_project(self, project) -> Iterator[Finding]:
+        root, sources = native_context(project)
+        if not sources:
+            return
+        exports = collect_exports(sources)
+        yield from self._redeclaration_findings(exports)
+        yield from self._python_side_findings(project, exports)
+        yield from self._abi_version_findings(project, sources)
+        yield from self._manifest_findings(root, sources)
+
+    # ------------------------------------------- cross-TU redeclarations
+
+    def _redeclaration_findings(self, exports) -> Iterator[Finding]:
+        for name, entries in exports.items():
+            if len(entries) < 2:
+                continue
+            ref, ref_src = best_export(entries)
+            for fn, src in entries:
+                if fn is ref or fn.signature == ref.signature:
+                    continue
+                f = native_finding(
+                    self.id, src, fn.line, name,
+                    f"`{name}` is declared here as `{_human_sig(fn)}` "
+                    f"but {'defined' if ref.defined else 'declared'} in "
+                    f"{ref_src.rel}:{ref.line} as `{_human_sig(ref)}` — "
+                    "the redeclaration lies about the real ABI")
+                if f is not None:
+                    yield f
+
+    # ------------------------------------------------- ctypes vs exports
+
+    def _python_side_findings(self, project, exports) -> Iterator[Finding]:
+        for module in project.modules.values():
+            decls = parse_ctypes_decls(module.tree)
+            for name in sorted(decls.decls):
+                d = decls.decls[name]
+                if not name.startswith("tpudfs_"):
+                    continue
+                entries = exports.get(name)
+                line = d.argtypes_line or d.restype_line
+                if not entries:
+                    yield py_finding(
+                        self.id, module, line, name,
+                        f"ctypes binds `lib.{name}` but no native/*.cc "
+                        "file exports that symbol — the call will raise "
+                        "AttributeError (or bind a stale .so) at "
+                        "runtime")
+                    continue
+                fn, src = best_export(entries)
+                yield from self._signature_findings(module, d, fn, src)
+
+    def _signature_findings(self, module, d, fn: CFunc,
+                            src: NativeSource) -> Iterator[Finding]:
+        name = fn.name
+        if d.argtypes is not None and len(d.argtypes) != len(fn.params):
+            f = native_finding(
+                self.id, src, fn.line, name,
+                f"`{name}` takes {len(fn.params)} parameter(s) here "
+                f"(`{_human_sig(fn)}`) but native.py declares "
+                f"{len(d.argtypes)} argtype(s) "
+                f"({module.rel_path}:{d.argtypes_line}) — arity "
+                "mismatch corrupts the call frame")
+            if f is not None:
+                yield f
+            return
+        if d.argtypes is not None:
+            for i, (py_t, param) in enumerate(zip(d.argtypes, fn.params)):
+                if ctype_compatible(py_t, param.canon):
+                    continue
+                pname = f" `{param.name}`" if param.name else ""
+                f = native_finding(
+                    self.id, src, fn.line, name,
+                    f"`{name}` parameter {i + 1}{pname} is "
+                    f"`{format_ctype_for_human(param.canon)}` here but "
+                    f"native.py declares "
+                    f"`{format_ctype_for_human(py_t)}` "
+                    f"({module.rel_path}:{d.argtypes_line}) — not "
+                    "ABI-compatible")
+                if f is not None:
+                    yield f
+        if d.restype is not None \
+                and not ctype_compatible(d.restype, fn.ret):
+            f = native_finding(
+                self.id, src, fn.line, name,
+                f"`{name}` returns "
+                f"`{format_ctype_for_human(fn.ret)}` here but native.py "
+                f"declares restype "
+                f"`{format_ctype_for_human(d.restype)}` "
+                f"({module.rel_path}:{d.restype_line}) — not "
+                "ABI-compatible")
+            if f is not None:
+                yield f
+
+    # -------------------------------------------------- ABI version gate
+
+    def _abi_version_findings(self, project, sources) -> Iterator[Finding]:
+        cc_version = None
+        cc_src = None
+        for src in sources:
+            if src.abi_version is not None:
+                cc_version, cc_src = src.abi_version, src
+        if cc_version is None:
+            return
+        for module in project.modules.values():
+            for expected, line in parse_ctypes_decls(module.tree).abi_checks:
+                if expected == cc_version:
+                    continue
+                yield py_finding(
+                    self.id, module, line, "tpudfs_dataplane_abi",
+                    f"native.py gates the dataplane bindings on ABI "
+                    f"version {expected} but tpudfs_dataplane_abi() in "
+                    f"{cc_src.rel}:{cc_src.abi_line} returns "
+                    f"{cc_version} — the two sides will refuse (or "
+                    "worse, mis-accept) each other")
+
+    # --------------------------------------------- manifest / bump gate
+
+    def _manifest_findings(self, root, sources) -> Iterator[Finding]:
+        manifest = load_abi_manifest(root)
+        if manifest is None:
+            return
+        version, sigs = current_abi_surface(sources)
+        if version is None or not sigs:
+            return
+        abi_src = next(s for s in sources if s.abi_version is not None)
+        man_version = manifest.get("abi_version")
+        man_exports = manifest.get("exports", {})
+        if man_version != version:
+            f = native_finding(
+                self.id, abi_src, abi_src.abi_line, "tpudfs_dataplane_abi",
+                f"tpudfs_dataplane_abi() returns {version} but the ABI "
+                f"manifest ({ABI_MANIFEST_REL}) records "
+                f"{man_version} — regenerate it with `python -m "
+                "tpudfs.analysis --write-native-abi`")
+            if f is not None:
+                yield f
+            return  # signature diffs against a stale manifest are noise
+        for name in sorted(set(sigs) | set(man_exports)):
+            cur, pinned = sigs.get(name), man_exports.get(name)
+            if cur == pinned:
+                continue
+            if cur is None:
+                f = native_finding(
+                    self.id, abi_src, abi_src.abi_line, name,
+                    f"dataplane export `{name}` was removed (or "
+                    "un-exported) without bumping "
+                    f"tpudfs_dataplane_abi() — still pinned at version "
+                    f"{version} in {ABI_MANIFEST_REL}; bump the version "
+                    "and regenerate with --write-native-abi")
+                if f is not None:
+                    yield f
+                continue
+            fn, src = best_export(collect_exports(sources)[name])
+            what = ("is new" if pinned is None else
+                    f"changed signature (manifest pins `{pinned}`, now "
+                    f"`{cur}`)")
+            f = native_finding(
+                self.id, src, fn.line, name,
+                f"dataplane export `{name}` {what} but "
+                f"tpudfs_dataplane_abi() still returns {version} — "
+                "previously built .so files would pass the version gate "
+                "with a different ABI; bump the version, update the "
+                "native.py guard, and regenerate the manifest with "
+                "`python -m tpudfs.analysis --write-native-abi`")
+            if f is not None:
+                yield f

@@ -1,0 +1,414 @@
+"""TPL042/TPL043 — concurrency discipline in the native C++ engine.
+
+The native data plane is the one place in the tree where real OS
+threads share mutable state: the accept loop, per-connection handlers,
+the group-commit thread, the stream disk thread, and ctypes callers
+polling stats all touch the same ``Engine``. TSan catches what the
+stress harness happens to execute; these rules check the whole file,
+lexically, on every lint:
+
+- **TPL042** maps each threaded class's shared state (non-atomic,
+  non-const fields; file-scope globals in files that spawn threads) and
+  flags accesses outside any lock — or guarded by no consistent mutex.
+  Fields written only during single-threaded setup (constructor, or
+  methods annotated ``// tpulint: pre-start``) are configuration and
+  may be read anywhere; atomics/mutexes/threads are exempt by type; the
+  destructor is exempt (join-then-teardown).
+- **TPL043** flags blocking syscalls executed while a lexically tracked
+  ``lock_guard``/``unique_lock`` is held — ``pread`` under the cache
+  mutex serializes every reader behind one disk seek. The blocking set
+  is transitive across ``native/*.cc``: a helper that calls ``fsync``
+  makes its callers blocking too. ``cv.wait`` is exempt (it releases
+  the lock); ``unique_lock.unlock()``/``.lock()`` toggles are honored,
+  which is exactly the pattern the commit loop uses around ``syncfs``.
+
+Both rules are pragmatic lexical passes tuned for the native sources'
+idiom (members named ``foo_``, ``std::lock_guard<std::mutex> g(mu_)``),
+biased to zero false positives on the real tree; genuinely clever code
+can opt out per line with ``// tpulint: disable=TPL042``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tpudfs.analysis.linter import Finding, ProjectRule, register
+from tpudfs.analysis.nativesrc import (
+    CClass,
+    CMethod,
+    NativeSource,
+    Token,
+    iter_with_locks,
+)
+from tpudfs.analysis.rules.native_abi import native_context, native_finding
+
+#: Method calls that do not mutate the receiver — reads for the purpose
+#: of the config-field classification.
+_CONST_METHODS = frozenset({
+    "size", "empty", "count", "find", "begin", "end", "cbegin", "cend",
+    "c_str", "data", "length", "at", "front", "back", "load", "substr",
+    "rfind", "compare", "capacity", "get", "lower_bound", "upper_bound",
+    "contains", "native_handle",
+})
+
+#: Blocking primitives matched as non-member calls (``::read`` and
+#: ``std::this_thread::sleep_for`` count; ``obj.read()`` does not).
+#: Deliberately excludes ``wait`` (a condition variable releases its
+#: lock), ``close``/``shutdown``/``rename``/``unlink`` (metadata ops
+#: the engine treats as non-blocking fast paths).
+_BLOCKING_CALLS = frozenset({
+    "read", "write", "pread", "pwrite", "readv", "writev", "recv",
+    "send", "recvmsg", "sendmsg", "recvfrom", "sendto", "accept",
+    "accept4", "connect", "poll", "ppoll", "select", "getaddrinfo",
+    "fsync", "fdatasync", "syncfs", "sync_file_range", "open", "openat",
+    "fopen", "sleep", "usleep", "nanosleep", "sleep_for", "sleep_until",
+    "flock", "fallocate", "posix_fallocate", "sendfile", "copy_file_range",
+})
+
+#: Blocking member calls (``thread.join()`` parks the caller).
+_BLOCKING_MEMBER_CALLS = frozenset({"join", "sleep_for", "sleep_until"})
+
+
+def _is_member_access(body: list[Token], i: int) -> bool:
+    """``x.f`` / ``x->f`` / ``ns::f`` — but ``this->f`` counts as a bare
+    member access and returns False."""
+    if i == 0:
+        return False
+    prev = body[i - 1]
+    if prev.kind != "punct" or prev.text not in (".", "->", "::"):
+        return False
+    if i >= 2 and body[i - 2].kind == "id" and body[i - 2].text == "this":
+        return False
+    return True
+
+
+def _is_write_site(body: list[Token], i: int) -> tuple[bool, bool]:
+    """(is_access_written, via_mutating_method) for identifier at i."""
+    nxt = body[i + 1] if i + 1 < len(body) else None
+    prv = body[i - 1] if i > 0 else None
+    if prv is not None and prv.kind == "punct" and prv.text in ("++", "--"):
+        return True, False
+    if nxt is None or nxt.kind != "punct":
+        return False, False
+    if nxt.text in ("=", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=",
+                    "<<=", ">>=", "++", "--"):
+        return True, False
+    if nxt.text in (".", "->") and i + 2 < len(body) \
+            and body[i + 2].kind == "id":
+        meth = body[i + 2].text
+        if i + 3 < len(body) and body[i + 3].kind == "punct" \
+                and body[i + 3].text == "(" \
+                and meth not in _CONST_METHODS:
+            return True, True
+    if nxt.text == "[":
+        # Indexed store? conservatively: `x[i] =` — scan to the matching
+        # bracket and peek.
+        depth = 0
+        for j in range(i + 1, len(body)):
+            t = body[j]
+            if t.kind == "punct":
+                if t.text == "[":
+                    depth += 1
+                elif t.text == "]":
+                    depth -= 1
+                    if depth == 0:
+                        k = body[j + 1] if j + 1 < len(body) else None
+                        return (k is not None and k.kind == "punct"
+                                and k.text == "="), False
+        return False, False
+    return False, False
+
+
+class _Access:
+    __slots__ = ("line", "method", "write", "held")
+
+    def __init__(self, line: int, method: str, write: bool,
+                 held: tuple[str, ...]):
+        self.line = line
+        self.method = method
+        self.write = write
+        self.held = held
+
+
+def _field_accesses(cls: CClass, field_name: str,
+                    methods: list[CMethod]) -> list[_Access]:
+    out: list[_Access] = []
+    for m in methods:
+        body = m.body
+        for i, tok, held in iter_with_locks(body):
+            if tok.kind != "id" or tok.text != field_name:
+                continue
+            if _is_member_access(body, i):
+                continue
+            write, _ = _is_write_site(body, i)
+            out.append(_Access(tok.line, m.name, write, held))
+    return out
+
+
+@register
+class NativeSharedStateGuard(ProjectRule):
+    id = "TPL042"
+    name = "native-shared-state-guard"
+    summary = ("non-atomic shared state of a threaded native class (or "
+               "a file-scope global in a thread-spawning file) accessed "
+               "outside its mutex, or guarded by no single consistent "
+               "mutex")
+    doc = (
+        "Classes in native/*.cc that own a std::thread or std::mutex "
+        "are concurrent by construction: the accept loop, connection "
+        "handlers, the commit thread, and ctypes stats callers all "
+        "enter the same object. This rule classifies each non-atomic, "
+        "non-const field: written only in the constructor or in "
+        "methods annotated `// tpulint: pre-start` (setup that runs "
+        "before any thread exists) means configuration — reads anywhere "
+        "are fine; everything else is shared state, and every access "
+        "in a normal method must happen while a lexically tracked "
+        "lock_guard/unique_lock is held, with one mutex common to all "
+        "of the field's guarded accesses (a field guarded by conns_mu_ "
+        "here and cache_mu_ there is a race with extra steps). "
+        "Destructors are exempt (threads are joined first). File-scope "
+        "globals get the same treatment in any file that mentions "
+        "threads, unless no function ever writes them (lookup tables)."
+    )
+    example = """\
+struct Engine {
+  std::mutex mu_;
+  std::map<std::string, uint64_t> terms_;
+  void set_term(const std::string& s, uint64_t t) {
+    terms_[s] = t;                      // no lock held
+  }
+};
+"""
+    fix = ("Take the field's mutex (`std::lock_guard<std::mutex> "
+           "g(mu_);`) around the access, make the field std::atomic if "
+           "it is a scalar counter, or — if the method really runs "
+           "before any thread is spawned — annotate it with "
+           "`// tpulint: pre-start` on the line above.")
+
+    def check_project(self, project) -> Iterator[Finding]:
+        _root, sources = native_context(project)
+        for src in sources:
+            for cls in src.classes:
+                if cls.has_sync:
+                    yield from self._check_class(src, cls)
+            if src.has_threads:
+                yield from self._check_globals(src)
+
+    # ------------------------------------------------------ class fields
+
+    def _check_class(self, src: NativeSource, cls: CClass
+                     ) -> Iterator[Finding]:
+        normal = [m for m in cls.methods
+                  if not (m.is_ctor or m.is_dtor or m.pre_start)]
+        for name, fld in cls.fields.items():
+            if fld.sync or fld.const:
+                continue
+            accesses = _field_accesses(cls, name, normal)
+            if not accesses:
+                continue
+            normal_writes = [a for a in accesses if a.write]
+            if not normal_writes:
+                # Config field: mutated only (if ever) during setup
+                # (ctor / `// tpulint: pre-start`). A field nothing
+                # ever writes is likewise inert.
+                continue
+            unguarded = [a for a in accesses if not a.held]
+            guarded = [a for a in accesses if a.held]
+            for a in unguarded:
+                f = native_finding(
+                    self.id, src, a.line, f"{cls.name}.{a.method}",
+                    f"`{cls.name}::{name}` is shared state (written in "
+                    f"`{next(w.method for w in normal_writes)}`) but "
+                    f"this {'write' if a.write else 'read'} in "
+                    f"`{a.method}` holds no lock"
+                    + (f" — other accesses hold "
+                       f"`{guarded[0].held[-1]}`" if guarded else ""))
+                if f is not None:
+                    yield f
+            if not unguarded and guarded:
+                common = set(guarded[0].held)
+                for a in guarded[1:]:
+                    common &= set(a.held)
+                if not common:
+                    a = guarded[-1]
+                    f = native_finding(
+                        self.id, src, a.line, f"{cls.name}.{a.method}",
+                        f"`{cls.name}::{name}` is guarded by different "
+                        "mutexes at different sites ("
+                        + ", ".join(sorted({h for g in guarded
+                                            for h in g.held}))
+                        + ") — no single lock orders its accesses")
+                    if f is not None:
+                        yield f
+
+    # ---------------------------------------------------------- globals
+
+    def _check_globals(self, src: NativeSource) -> Iterator[Finding]:
+        bodies: list[CMethod] = list(src.free_funcs)
+        for cls in src.classes:
+            bodies.extend(cls.methods)
+        for name, g in src.globals.items():
+            if g.sync or g.const:
+                continue
+            accesses: list[_Access] = []
+            for m in bodies:
+                body = m.body
+                for i, tok, held in iter_with_locks(body):
+                    if tok.kind != "id" or tok.text != name:
+                        continue
+                    if _is_member_access(body, i):
+                        continue
+                    write, _ = _is_write_site(body, i)
+                    accesses.append(_Access(tok.line, m.name, write, held))
+            if not any(a.write for a in accesses):
+                continue  # read-only table
+            for a in accesses:
+                if a.held:
+                    continue
+                f = native_finding(
+                    self.id, src, a.line, a.method,
+                    f"file-scope global `{name}` is mutated across "
+                    f"threads but this "
+                    f"{'write' if a.write else 'read'} in `{a.method}` "
+                    "holds no lock")
+                if f is not None:
+                    yield f
+
+
+@register
+class NativeBlockingUnderMutex(ProjectRule):
+    id = "TPL043"
+    name = "native-blocking-under-mutex"
+    summary = ("blocking syscall (disk/network/sleep/join, directly or "
+               "via a native helper) executed while a mutex is held in "
+               "native/*.cc — every thread contending that lock stalls "
+               "behind one I/O")
+    doc = (
+        "A mutex in the native engine orders map updates measured in "
+        "nanoseconds; a pread or fsync inside the critical section "
+        "turns it into a disk-latency lock, and the accept loop, every "
+        "connection handler, and the stats poller pile up behind it. "
+        "This rule tracks lock_guard/unique_lock scopes lexically — "
+        "including unique_lock's mid-scope .unlock()/.lock() toggles, "
+        "the exact idiom the commit loop uses to drop the queue lock "
+        "around syncfs+rename — and flags any call to a blocking "
+        "primitive (read/write/pread/pwrite/send/recv/accept/connect/"
+        "poll/open/fsync/syncfs/sleep_for/join/...) made while a lock "
+        "is held. The blocking property is transitive across "
+        "native/*.cc: calling a helper that calls fsync is as blocking "
+        "as fsync. cv.wait is exempt (it releases the lock while "
+        "parked)."
+    )
+    example = """\
+int64_t persist(const std::string& id, const uint8_t* p, uint64_t n) {
+  std::lock_guard<std::mutex> g(commit_mu_);
+  int64_t rc = tpudfs_block_write_staged(hot_.c_str(), id.c_str(),
+                                         p, n, chunk_, nullptr);  // disk I/O
+  return rc;
+}
+"""
+    fix = ("Move the I/O out of the critical section: copy what you "
+           "need under the lock, drop it (scope exit or "
+           "unique_lock.unlock()), do the blocking work, re-acquire to "
+           "publish the result — the group-commit loop in dataplane.cc "
+           "is the template.")
+
+    def check_project(self, project) -> Iterator[Finding]:
+        _root, sources = native_context(project)
+        if not sources:
+            return
+        blocking = self._transitive_blocking(sources)
+        for src in sources:
+            bodies: list[tuple[str, CMethod]] = [
+                (m.name, m) for m in src.free_funcs]
+            for cls in src.classes:
+                bodies.extend((f"{cls.name}.{m.name}", m)
+                              for m in cls.methods)
+            for scope, m in bodies:
+                yield from self._check_body(src, scope, m, blocking)
+
+    # ---------------------------------------------- transitive closure
+
+    @staticmethod
+    def _direct_calls(body: list[Token]) -> Iterator[tuple[int, str, bool]]:
+        """(index, callee, is_member) for each call site in a body."""
+        for i in range(len(body) - 1):
+            t, nxt = body[i], body[i + 1]
+            if t.kind != "id" or nxt.kind != "punct" or nxt.text != "(":
+                continue
+            member = _is_member_access(body, i) and \
+                body[i - 1].text in (".", "->")
+            yield i, t.text, member
+
+    def _transitive_blocking(self, sources: list[NativeSource]
+                             ) -> dict[str, str]:
+        """``{function name: blocking witness}`` over every function/
+        method defined in the native tree."""
+        defined: dict[str, list[CMethod]] = {}
+        for src in sources:
+            for m in src.free_funcs:
+                defined.setdefault(m.name, []).append(m)
+            for cls in src.classes:
+                for m in cls.methods:
+                    defined.setdefault(m.name, []).append(m)
+        blocking: dict[str, str] = {}
+        changed = True
+        while changed:
+            changed = False
+            for name, impls in defined.items():
+                if name in blocking:
+                    continue
+                witness = None
+                for m in impls:
+                    for _i, callee, member in self._direct_calls(m.body):
+                        if member:
+                            if callee in _BLOCKING_MEMBER_CALLS:
+                                witness = callee
+                                break
+                            continue
+                        if callee in _BLOCKING_CALLS:
+                            witness = callee
+                            break
+                        if callee in blocking and callee != name:
+                            witness = f"{callee} -> {blocking[callee]}"
+                            break
+                    if witness:
+                        break
+                if witness:
+                    blocking[name] = witness
+                    changed = True
+        return blocking
+
+    # -------------------------------------------------- per-body check
+
+    def _check_body(self, src: NativeSource, scope: str, m: CMethod,
+                    blocking: dict[str, str]) -> Iterator[Finding]:
+        body = m.body
+        for i, tok, held in iter_with_locks(body):
+            if not held or tok.kind != "id":
+                continue
+            nxt = body[i + 1] if i + 1 < len(body) else None
+            if nxt is None or nxt.kind != "punct" or nxt.text != "(":
+                continue
+            member = _is_member_access(body, i) and \
+                body[i - 1].text in (".", "->")
+            name = tok.text
+            if member:
+                if name not in _BLOCKING_MEMBER_CALLS:
+                    continue
+                why = name
+            elif name in _BLOCKING_CALLS:
+                why = name
+            elif name in blocking and name != m.name:
+                why = f"{name} (-> {blocking[name]})"
+            else:
+                continue
+            f = native_finding(
+                self.id, src, tok.line, scope,
+                f"blocking call `{why}` while holding "
+                f"`{held[-1]}` — every thread contending this mutex "
+                "stalls behind the I/O; drop the lock around the "
+                "blocking work (unique_lock.unlock()/.lock(), as in "
+                "the commit loop)")
+            if f is not None:
+                yield f

@@ -1,0 +1,263 @@
+"""TPL041 — wire-contract conformance between Python and the native engine.
+
+The blockport wire protocol exists twice: once in Python
+(``blocknet.py`` framing + ``writestream.py`` stream protocol +
+``service.py`` handlers) and once re-implemented by hand in C++
+(``native/dataplane.cc``). PR 8's chain-hop outage was exactly this
+class of bug — one side packed a float ``_db`` header the other side's
+integer-only reader dropped — and nothing but an integration test deep
+in a chain topology could see it. This rule diffs the contract
+lexically, on every lint:
+
+- paired numeric constants (``ACK_EVERY`` ↔ ``kAckEvery``,
+  ``_MAX_HEADER`` ↔ ``kMaxHeader``, ``_MAX_PAYLOAD`` ↔ ``kMaxPayload``,
+  ``MAX_STREAM_BYTES`` ↔ ``kMaxStreamBytes``, the CRC32C polynomial)
+  must exist on both sides with equal values — edit one and lint fails;
+- every required msgpack header key (``m``/``q``/``c``/``w``/``final``/
+  ``_d``/``_db``/``_tn``/... ) must appear as a string literal on both
+  sides — a renamed or dropped key is drift even before values diverge;
+- every status code the native engine sends (``respond_err``) must be a
+  canonical ``grpc.StatusCode`` name, because the Python side mints the
+  enum from that string and silently degrades unknown names to
+  ``INTERNAL``;
+- ``blocknet.py`` must keep its ``"<I"``/``"<Q"`` little-endian framing
+  structs — the C++ side hardcodes LE u32/u64 framing, so changing the
+  Python structs breaks interop with zero type errors.
+
+A pair is only enforced when both of its files are in the analyzed set,
+so single-file fixture lints stay quiet.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tpudfs.analysis.linter import Finding, ProjectRule, register
+from tpudfs.analysis.nativesrc import (
+    py_int_constants,
+    py_string_literals,
+)
+from tpudfs.analysis.rules.native_abi import (
+    native_context,
+    native_finding,
+    py_finding,
+)
+
+#: (python rel path, python constant, native rel path, C++ constant).
+#: Enforced only when both files are present in the analyzed set.
+CONSTANT_PAIRS: tuple[tuple[str, str, str, str], ...] = (
+    ("tpudfs/common/writestream.py", "ACK_EVERY",
+     "native/dataplane.cc", "kAckEvery"),
+    ("tpudfs/common/writestream.py", "MAX_STREAM_BYTES",
+     "native/dataplane.cc", "kMaxStreamBytes"),
+    ("tpudfs/common/blocknet.py", "_MAX_HEADER",
+     "native/dataplane.cc", "kMaxHeader"),
+    ("tpudfs/common/blocknet.py", "_MAX_PAYLOAD",
+     "native/dataplane.cc", "kMaxPayload"),
+    ("tpudfs/common/checksum.py", "_POLY",
+     "native/dataplane.cc", "kCrcPoly"),
+    ("tpudfs/common/checksum.py", "_POLY",
+     "native/crc32c.cc", "kPoly"),
+)
+
+#: Python modules whose (non-docstring) string literals form the Python
+#: side of the header-key contract.
+WIRE_MODULES: tuple[str, ...] = (
+    "tpudfs/common/writestream.py",
+    "tpudfs/common/blocknet.py",
+    "tpudfs/common/resilience.py",
+    "tpudfs/chunkserver/service.py",
+)
+
+#: msgpack header keys both sides must spell out. Grouped for messages.
+REQUIRED_KEYS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("blockport envelope", ("m", "_d", "code", "message")),
+    ("deadline/tenant propagation", ("_db", "_tn")),
+    ("stream begin", ("WriteStream", "block_id", "size", "frame_size",
+                      "expected_crc32c", "master_term", "master_shard",
+                      "next_servers", "next_data_ports")),
+    ("stream acks", ("ok", "ready", "q", "c", "w", "final", "success",
+                     "error_message", "replicas_written")),
+)
+
+#: The canonical grpc.StatusCode names. Hardcoded (not imported from
+#: grpc) so fixture lints don't need the dependency — and so the rule
+#: pins the *wire* vocabulary, not whatever the installed grpc exposes.
+GRPC_STATUS_NAMES = frozenset({
+    "OK", "CANCELLED", "UNKNOWN", "INVALID_ARGUMENT", "DEADLINE_EXCEEDED",
+    "NOT_FOUND", "ALREADY_EXISTS", "PERMISSION_DENIED",
+    "RESOURCE_EXHAUSTED", "FAILED_PRECONDITION", "ABORTED", "OUT_OF_RANGE",
+    "UNIMPLEMENTED", "INTERNAL", "UNAVAILABLE", "DATA_LOSS",
+    "UNAUTHENTICATED",
+})
+
+#: The LE framing structs blocknet.py must keep (C++ hardcodes them).
+FRAMING_STRUCTS = ("<I", "<Q")
+
+BLOCKNET_REL = "tpudfs/common/blocknet.py"
+DATAPLANE_REL = "native/dataplane.cc"
+
+
+@register
+class NativeWireConformance(ProjectRule):
+    id = "TPL041"
+    name = "native-wire-conformance"
+    summary = ("wire-protocol drift between the Python blockport/stream "
+               "implementation and native/dataplane.cc — a paired "
+               "constant, msgpack header key, status code, or framing "
+               "struct edited on one side only")
+    doc = (
+        "dataplane.cc re-implements the blockport framing and the "
+        "WriteStream protocol byte-for-byte; mixed native/asyncio "
+        "chains interop only while both copies agree. This rule "
+        "extracts the contract from both sides — evaluated constexpr "
+        "constants from the C++ (via the tpulint C++ tokenizer) and "
+        "module constants/string literals from the Python AST — and "
+        "diffs them: paired constants (ack cadence, header/payload "
+        "caps, stream size gate, CRC polynomial) must be equal; every "
+        "required msgpack header key must appear as a literal on both "
+        "sides; every respond_err status code must be a canonical "
+        "grpc.StatusCode name (unknown names silently degrade to "
+        "INTERNAL on the Python side, hiding the real error); and "
+        "blocknet.py must keep its '<I'/'<Q' little-endian structs, "
+        "which the C++ reader hardcodes. PR 8's float-_db bug — one "
+        "side packing a header the other dropped — is the class this "
+        "catches at lint time instead of in a chain topology test."
+    )
+    example = """\
+# writestream.py
+ACK_EVERY = 4          # retuned ack cadence...
+// dataplane.cc (unchanged)
+constexpr uint64_t kAckEvery = 8;   // ...but only on one side
+"""
+    fix = ("Change both sides in the same commit — the paired constant "
+           "in native/dataplane.cc is commented with its Python twin "
+           "(and vice versa); for header keys, add the literal to the "
+           "reader AND writer on the lagging side. If a constant is "
+           "genuinely one-sided now, remove it from the pair table in "
+           "tpudfs/analysis/rules/native_wire.py with a comment saying "
+           "why.")
+
+    def check_project(self, project) -> Iterator[Finding]:
+        root, sources = native_context(project)
+        if not sources:
+            return
+        by_rel = {src.rel: src for src in sources}
+        yield from self._constant_pairs(project, by_rel)
+        dataplane = by_rel.get(DATAPLANE_REL)
+        if dataplane is not None:
+            yield from self._header_keys(project, dataplane)
+            yield from self._status_codes(dataplane)
+            yield from self._framing_pin(project, dataplane)
+
+    # -------------------------------------------------- constant pairs
+
+    def _constant_pairs(self, project, by_rel) -> Iterator[Finding]:
+        for py_rel, py_name, cc_rel, cc_name in CONSTANT_PAIRS:
+            module = project.modules.get(py_rel)
+            src = by_rel.get(cc_rel)
+            if module is None or src is None:
+                continue
+            py_consts = py_int_constants(module.tree)
+            py_hit = py_consts.get(py_name)
+            cc_val = src.constants.get(cc_name)
+            if py_hit is None and cc_val is None:
+                continue
+            if py_hit is None:
+                f = native_finding(
+                    self.id, src, src.constant_lines.get(cc_name, 1),
+                    cc_name,
+                    f"`{cc_name}` has no Python twin — `{py_name}` is "
+                    f"missing from {py_rel}; the wire contract exists "
+                    "on one side only")
+                if f is not None:
+                    yield f
+                continue
+            py_val, py_line = py_hit
+            if cc_val is None:
+                yield py_finding(
+                    self.id, module, py_line, py_name,
+                    f"`{py_name}` ({py_val:#x}) has no native twin — "
+                    f"`{cc_name}` is missing from {cc_rel}; the native "
+                    "engine does not enforce this wire constant")
+                continue
+            if py_val != cc_val:
+                f = native_finding(
+                    self.id, src, src.constant_lines.get(cc_name, 1),
+                    cc_name,
+                    f"`{cc_name}` = {cc_val} here but its Python twin "
+                    f"`{py_name}` = {py_val} ({py_rel}:{py_line}) — "
+                    "the two protocol implementations disagree")
+                if f is not None:
+                    yield f
+
+    # ---------------------------------------------------- header keys
+
+    def _header_keys(self, project, dataplane) -> Iterator[Finding]:
+        wire_mods = [project.modules[rel] for rel in WIRE_MODULES
+                     if rel in project.modules]
+        if not wire_mods:
+            return
+        py_lits: dict[str, tuple[str, int]] = {}
+        for mod in wire_mods:
+            for lit, line in py_string_literals(mod.tree).items():
+                py_lits.setdefault(lit, (mod.rel_path, line))
+        wire_rels = ", ".join(m.rel_path for m in wire_mods)
+        for group, keys in REQUIRED_KEYS:
+            for key in keys:
+                in_py = key in py_lits
+                in_cc = key in dataplane.string_literals
+                if in_py and in_cc:
+                    continue
+                if in_py and not in_cc:
+                    rel, line = py_lits[key]
+                    yield py_finding(
+                        self.id, project.modules[rel], line, key,
+                        f"required {group} header key `{key}` appears "
+                        f"here but nowhere in {DATAPLANE_REL} — the "
+                        "native engine will drop or never send it")
+                elif in_cc and not in_py:
+                    f = native_finding(
+                        self.id, dataplane,
+                        dataplane.string_literals[key], key,
+                        f"required {group} header key `{key}` appears "
+                        f"here but in none of the Python wire modules "
+                        f"({wire_rels}) — the asyncio side will drop "
+                        "or never send it")
+                    if f is not None:
+                        yield f
+                # Missing on BOTH sides: the contract table is stale for
+                # this tree (fixture lints); stay quiet.
+
+    # --------------------------------------------------- status codes
+
+    def _status_codes(self, dataplane) -> Iterator[Finding]:
+        for code, line in dataplane.status_codes:
+            if code in GRPC_STATUS_NAMES:
+                continue
+            f = native_finding(
+                self.id, dataplane, line, "respond_err",
+                f"native error frame uses status code `{code}`, which "
+                "is not a grpc.StatusCode name — the Python side "
+                "(writestream._raise_error_frame) silently degrades "
+                "unknown codes to INTERNAL, hiding the real error from "
+                "fallback logic")
+            if f is not None:
+                yield f
+
+    # ---------------------------------------------------- framing pin
+
+    def _framing_pin(self, project, dataplane) -> Iterator[Finding]:
+        blocknet = project.modules.get(BLOCKNET_REL)
+        if blocknet is None:
+            return
+        lits = py_string_literals(blocknet.tree)
+        for fmt in FRAMING_STRUCTS:
+            if fmt in lits:
+                continue
+            yield py_finding(
+                self.id, blocknet, 1, "framing",
+                f"blocknet.py no longer defines a struct.Struct("
+                f"'{fmt}') — {DATAPLANE_REL} hardcodes little-endian "
+                "u32/u64 blockport framing, so changing the Python "
+                "framing structs breaks native interop")
