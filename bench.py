@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 import statistics
 import time
 import urllib.request
@@ -1233,13 +1234,28 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         s = await rpc.call(addr, "ChunkServerService", "Stats", {})
         before.append((s["cache_hits"], s["cache_misses"]))
     cache_samples = []
+    # Per-op wall latency across every file read in the sweep: the
+    # throughput median can hide a fat tail (one straggling replica, a
+    # cache-miss stall), and the roadmap cache regression needs the
+    # per-op distribution to tell "all reads slowed" from "a few reads
+    # stalled". Ops run CACHE_FILES-wide, so this is latency under the
+    # sweep's own concurrency — the number a training input pipeline
+    # actually experiences.
+    cache_lat: list[float] = []
+
+    async def _timed_cache_read(path: str):
+        t = time.perf_counter()
+        blocks = await cache_reader.read_file_to_device_blocks(
+            path, verify="lazy")
+        cache_lat.append(time.perf_counter() - t)
+        return blocks
+
     for _ in range(REPS):
         t0 = time.perf_counter()
         nbytes = 0
         for _pass in range(CACHE_PASSES):
             blocks_lists = await asyncio.gather(*(
-                cache_reader.read_file_to_device_blocks(
-                    f"/bench/r0/f{i:04d}", verify="lazy")
+                _timed_cache_read(f"/bench/r0/f{i:04d}")
                 for i in range(CACHE_FILES)
             ))
             flat = [b for bs in blocks_lists for b in bs]
@@ -1315,6 +1331,9 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "files": FILES,
         "cache_read_GBps": round(med(cache_samples), 3),
         "cache_read_win": _winmm(cache_samples),
+        "cache_read_p50_ms": round(_pct(cache_lat, 0.50) * 1e3, 2),
+        "cache_read_p99_ms": round(_pct(cache_lat, 0.99) * 1e3, 2),
+        "cache_read_ops": len(cache_lat),
         "cs_cache_hit_rate": round(
             cache_hits / max(1, cache_hits + cache_misses), 3
         ),
@@ -1338,6 +1357,15 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
 
 def _winmm(xs: list, nd: int = 3) -> list:
     return [round(min(xs), nd), round(max(xs), nd)]
+
+
+def _pct(xs: list, q: float) -> float:
+    """Nearest-rank percentile (p99 of 80 samples = the worst sample, not
+    an interpolated value that no op actually experienced)."""
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
 
 
 def _probe_tpu(timeout_s: float = 90.0, attempts: int = 2,

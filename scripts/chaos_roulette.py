@@ -152,7 +152,8 @@ def make_axes(rng: random.Random) -> dict:
 
 
 async def run_round(eps: dict, rng: random.Random, rnd: int,
-                    axes: dict | None = None) -> None:
+                    axes: dict | None = None,
+                    linearize: bool = False) -> None:
     from tpudfs.client.checker import check_linearizability
     from tpudfs.client.client import Client, DfsError
     from tpudfs.client.workload import (
@@ -702,6 +703,31 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
                 await asyncio.sleep(1.0)
     print(f"  round {rnd}: md5 + post-chaos writes ok")
 
+    if linearize:
+        # Post-fault WGL pass: the mid-fault history above proves nothing
+        # about the HEALED cluster (elections settled, partitions lifted,
+        # proxies still aliased). Run a fresh per-op-history workload
+        # against the recovered endpoints and require it strictly
+        # linearizable — recovery bugs (stale leader serving reads, a
+        # replayed rename) surface here, not as md5 mismatches.
+        pf_cfg = WorkloadConfig(clients=3, ops_per_client=10, keys=6,
+                                seed=rng.randrange(1 << 30),
+                                rename_pod_size=3)
+        pf_entries = await run_workload(v_client, pf_cfg)
+        pf_ok = sum(1 for e in pf_entries
+                    if e.get("return_ts") is not None)
+        pf_path = tempfile.mkstemp(suffix=".post.jsonl")[1]
+        dump_history(pf_entries, pf_path)
+        pf_result = check_linearizability(pf_entries,
+                                          max_states=2_000_000)
+        if not pf_result.linearizable and not pf_result.exhausted:
+            raise SystemExit(
+                f"POST-FAULT LINEARIZABILITY VIOLATION (round {rnd}): "
+                f"{pf_result.message}\nhistory: {pf_path}\nplan: {plan}")
+        print(f"  post-fault history "
+              f"{'linearizable' if pf_result.linearizable else 'UNKNOWN'}"
+              f" ({pf_ok}/{len(pf_entries)} ops returned, {pf_path})")
+
     for proxy in proxies.values():
         await proxy.stop()
     if ov_proxy is not None:
@@ -721,7 +747,8 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
 
 
 def one_cluster_round(rnd: int, rng: random.Random, use_tls: bool,
-                      topology: str, axes: dict) -> None:
+                      topology: str, axes: dict,
+                      linearize: bool = False) -> None:
     from tpudfs.testing.livecluster import boot_cluster
 
     extra_env: dict[str, str] = {}
@@ -743,7 +770,7 @@ def one_cluster_round(rnd: int, rng: random.Random, use_tls: bool,
             "TPUDFS_QOS_WEIGHTS": "fair=2"})
     with boot_cluster(topology, tls=use_tls,
                       extra_env=extra_env or None) as eps:
-        asyncio.run(run_round(eps, rng, rnd, axes))
+        asyncio.run(run_round(eps, rng, rnd, axes, linearize=linearize))
 
 
 def main() -> None:
@@ -760,6 +787,10 @@ def main() -> None:
     ap.add_argument("--force-axes", default="",
                     help="comma-separated axes pinned on every round "
                          "(same as CHAOS_FORCE_AXES env)")
+    ap.add_argument("--linearize", action="store_true",
+                    help="after faults heal, run a fresh per-op-history "
+                         "workload and WGL-check it (post-fault "
+                         "linearizability, on in CI's seeded rounds)")
     args = ap.parse_args()
     if args.force_axes:
         merged = set(filter(None, os.environ.get(
@@ -770,7 +801,8 @@ def main() -> None:
     for rnd in range(1, args.rounds + 1):
         axes = make_axes(rng)
         retry_start(lambda: one_cluster_round(rnd, rng, args.tls,
-                                              args.topology, axes))
+                                              args.topology, axes,
+                                              linearize=args.linearize))
     print(f"CHAOS ROULETTE PASSED ({args.rounds} rounds, seed {args.seed}, "
           f"tls={args.tls})")
 

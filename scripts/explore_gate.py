@@ -1,0 +1,738 @@
+#!/usr/bin/env python3
+"""tpusched exploration gate: real components under explored schedules.
+
+Runs the production writestream chain, Raft commit, checkpoint
+stage→publish, and QoS admission code on the deterministic virtual-clock
+loop (tpudfs/testing/vclock.py), systematically exploring bounded task
+interleavings around their await points. Every schedule asserts the
+declared invariants — ack⇒durable, no-torn-visible, monotonic step
+fence, admission never overshoots — plus Wing-Gong-Leung
+linearizability of the recorded client histories
+(tpudfs/analysis/linearize.py).
+
+A failing schedule writes a replayable trace artifact under
+``.tpusched/`` and prints the exact replay command; ``--replay`` re-runs
+the recorded choice sequence and must reproduce the identical failure.
+``--mutate`` re-introduces a known-fixed ordering bug (publish before
+durable, the group-commit lost wakeup) so the gate can prove it still
+catches them at its pinned seed.
+
+Usage:
+    explore_gate.py                         # all scenarios, pinned seed
+    explore_gate.py --scenario ckpt --seed 1234
+    explore_gate.py --replay .tpusched/ckpt-....trace.json --scenario ckpt
+    explore_gate.py --mutate publish_before_durable --scenario ckpt
+    explore_gate.py --changed               # only scenarios mapped to
+                                            # modules changed vs HEAD
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "tests"))
+
+from tpudfs.analysis.linearize import HistoryRecorder, check_history
+from tpudfs.testing.vclock import (
+    InvariantViolation,
+    explore,
+    replay,
+    trace_from_json,
+    trace_to_json,
+)
+
+ART_DIR = pathlib.Path(os.environ.get("TPUSCHED_ART_DIR",
+                                      ROOT / ".tpusched"))
+
+#: Per-scenario exploration budget: (preemption_bound, max_runs, seeds).
+#: Seeds are pinned — the gate's verdict is reproducible by construction.
+BUDGETS = {
+    "writestream": (2, 18, (101, 102)),
+    "raft": (2, 14, (201,)),
+    "ckpt": (2, 20, (301, 302)),
+    "qos": (2, 20, (401, 402)),
+}
+
+#: ``--changed`` routing: path prefix -> scenarios that exercise it.
+CHANGED_MAP = [
+    ("tpudfs/chunkserver/", ("writestream", "qos")),
+    ("tpudfs/common/writestream.py", ("writestream",)),
+    ("tpudfs/common/blocknet.py", ("writestream",)),
+    ("tpudfs/common/resilience.py", ("qos", "writestream")),
+    ("tpudfs/raft/", ("raft",)),
+    ("tpudfs/tpu/checkpoint.py", ("ckpt",)),
+    ("tpudfs/common/ckptpaths.py", ("ckpt",)),
+    ("tpudfs/client/", ("ckpt",)),
+    ("tpudfs/testing/vclock.py",
+     ("writestream", "raft", "ckpt", "qos")),
+    ("tpudfs/analysis/linearize.py",
+     ("writestream", "raft", "ckpt", "qos")),
+    ("scripts/explore_gate.py",
+     ("writestream", "raft", "ckpt", "qos")),
+]
+
+
+# ---------------------------------------------------------------------------
+# In-memory duplex plumbing for the writestream scenario
+# ---------------------------------------------------------------------------
+
+
+class _MemTransport:
+    def get_write_buffer_size(self) -> int:
+        return 0  # never above the backpressure watermark
+
+
+class _MemWriter:
+    """StreamWriter lookalike feeding a peer StreamReader directly."""
+
+    def __init__(self, peer: asyncio.StreamReader):
+        self._peer = peer
+        self.transport = _MemTransport()
+        self._closed = False
+
+    def write(self, data) -> None:
+        if not self._closed:
+            self._peer.feed_data(bytes(data))
+
+    def writelines(self, bufs) -> None:
+        for b in bufs:
+            self.write(b)
+
+    async def drain(self) -> None:
+        await asyncio.sleep(0)  # a real drain is a suspension point
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._peer.feed_eof()
+
+    def is_closing(self) -> bool:
+        return self._closed
+
+    async def wait_closed(self) -> None:
+        return
+
+    def get_extra_info(self, name, default=None):
+        return default
+
+
+def _duplex() -> tuple[asyncio.StreamReader, _MemWriter,
+                       asyncio.StreamReader, _MemWriter]:
+    """(client_reader, client_writer, server_reader, server_writer)."""
+    to_server = asyncio.StreamReader(limit=1 << 22)
+    to_client = asyncio.StreamReader(limit=1 << 22)
+    return to_client, _MemWriter(to_server), to_server, _MemWriter(to_client)
+
+
+# ---------------------------------------------------------------------------
+# Scenarios — each call builds FRESH components and returns one coroutine
+# ---------------------------------------------------------------------------
+
+
+def scenario_writestream():
+    """Two concurrent streamed writes then a late third, all through the
+    REAL ChunkServer frame pipeline (stage → CRC → group commit → final
+    ack) over in-memory duplex connections. The late write lands after
+    the first group-commit drain task has finished — the lost-wakeup
+    window in the committer's respawn check. Invariants: a success ack
+    implies the block is durably readable with the exact bytes
+    (ack⇒durable), and the write/read history is linearizable per
+    block."""
+    from tpudfs.chunkserver.blockstore import BlockStore
+    from tpudfs.chunkserver.service import ChunkServer
+    from tpudfs.common import blocknet, writestream
+    from tpudfs.common.checksum import crc32c
+
+    async def body():
+        tmp = tempfile.mkdtemp(prefix="tpusched-ws-")
+        try:
+            store = BlockStore(pathlib.Path(tmp) / "hot")
+            cs = ChunkServer(store)
+            loop = asyncio.get_running_loop()
+            rec = HistoryRecorder(loop.time)
+            payloads = {
+                "blk-a": b"alpha-frame-" * 600,
+                "blk-b": b"bravo-frame-" * 800,
+            }
+            acks: dict[str, dict] = {}
+
+            async def one_write(bid: str, data: bytes):
+                cr, cw, sr, sw = _duplex()
+
+                async def serve():
+                    header, _ = await blocknet._read_frame(sr)
+                    await cs.rpc_write_stream(header, sr, sw)
+
+                server_task = asyncio.ensure_future(serve())
+                e = rec.invoke(f"writer-{bid}", "write", bid,
+                               value=f"{bid}-v1")
+                begin = {
+                    "m": "WriteStream", "block_id": bid,
+                    "size": len(data), "frame_size": 2048,
+                    "expected_crc32c": crc32c(data),
+                }
+                try:
+                    resp = await writestream.send_block_stream(
+                        cr, cw, begin, data)
+                except Exception as exc:  # determinate refusal
+                    rec.ret(e, {"ok": False})
+                    acks[bid] = {"success": False, "error": repr(exc)}
+                else:
+                    rec.ret(e, {"ok": bool(resp.get("success"))})
+                    acks[bid] = resp
+                await server_task
+
+            await asyncio.gather(*(
+                one_write(bid, data) for bid, data in payloads.items()))
+
+            # Late arrival: by now the committer's drain task exists and
+            # is done — a "respawn only when _task is None" regression
+            # parks this writer forever (DeadlockError under vclock).
+            payloads["blk-c"] = b"charlie-frame-" * 500
+            await one_write("blk-c", payloads["blk-c"])
+
+            for bid, data in payloads.items():
+                e = rec.invoke("verifier", "read", bid)
+                try:
+                    got = store.read_verified(bid)
+                except Exception:
+                    got = None
+                rec.ret(e, f"{bid}-v1" if got == data else None)
+                if acks[bid].get("success") and got != data:
+                    raise InvariantViolation(
+                        f"ack⇒durable violated: {bid} acked success but "
+                        f"readback {'differs' if got is not None else 'is missing'}")
+            res = check_history(rec.entries)
+            if not res.linearizable:
+                raise InvariantViolation(
+                    f"writestream history not linearizable: {res.message}")
+            await cs.committer.stop()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    return body()
+
+
+def scenario_raft():
+    """Three-node Raft commit with explorer-ordered message delivery:
+    every Send becomes its own task, so the schedule explorer reorders
+    deliveries. Invariants: applied logs are pairwise prefix-consistent
+    (no divergence), and every entry the leader reports committed is
+    durable in a quorum of logs (ack⇒durable)."""
+    import raft_sim
+
+    async def body():
+        cluster = raft_sim.SimCluster(3, seed=11)
+        lead = cluster.wait_for_leader()
+        loop = asyncio.get_running_loop()
+        inflight_tasks: set[asyncio.Task] = set()
+
+        async def deliver(src: str, dst: str, msg: dict):
+            await asyncio.sleep(0)  # the explorer's reorder point
+            node = cluster.nodes[dst]
+            if not node.alive or frozenset((src, dst)) in cluster.cut:
+                return
+            cluster._process_effects(
+                node, node.core.handle_message(msg, cluster.now))
+            pump()
+
+        def pump() -> None:
+            while cluster.inflight:
+                src, dst, msg = cluster.inflight.pop(0)
+                t = loop.create_task(
+                    deliver(src, dst, msg),
+                    name=f"deliver:{msg.get('type')}:{src}->{dst}")
+                inflight_tasks.add(t)
+                t.add_done_callback(inflight_tasks.discard)
+
+        def tick(dt: float) -> None:
+            cluster.now += dt
+            for n in cluster.nodes.values():
+                if n.alive:
+                    cluster._process_effects(n, n.core.tick(cluster.now))
+            pump()
+
+        from tpudfs.raft.core import NotLeaderError
+
+        proposed: list[tuple[int, tuple]] = []
+        for k in range(3):
+            cmd = ("set", f"k{k}")
+            for _attempt in range(25):
+                leader = cluster.leader()
+                if leader is None:
+                    tick(0.02)
+                    await asyncio.sleep(0.02)
+                    continue
+                try:
+                    idx, effects = leader.core.propose(cmd, cluster.now)
+                except NotLeaderError:
+                    tick(0.02)
+                    await asyncio.sleep(0.02)
+                    continue
+                cluster._process_effects(leader, effects)
+                pump()
+                proposed.append((idx, cmd))
+                break
+            for _ in range(6):
+                await asyncio.sleep(0.01)
+                tick(0.01)
+
+        for _ in range(60):
+            if all(len(n.applied) >= len(proposed)
+                   for n in cluster.nodes.values()) and not inflight_tasks:
+                break
+            await asyncio.sleep(0.02)
+            tick(0.02)
+        while inflight_tasks:
+            await asyncio.sleep(0.01)
+
+        seqs = {nid: list(n.applied) for nid, n in cluster.nodes.items()}
+        ordered = sorted(seqs.items(), key=lambda kv: len(kv[1]))
+        for (a_id, a), (b_id, b) in zip(ordered, ordered[1:]):
+            if b[:len(a)] != a:
+                raise InvariantViolation(
+                    f"applied logs diverged: {a_id}={a} vs {b_id}={b}")
+        lead = cluster.leader() or lead
+        for idx, cmd in proposed:
+            if lead.core.commit_index < idx:
+                continue  # never acked committed: no durability claim
+            holders = sum(
+                1 for n in cluster.nodes.values()
+                if any(e.index == idx and e.command == cmd
+                       for e in n.durable["log"]))
+            if holders < 2:
+                raise InvariantViolation(
+                    f"committed entry {idx} {cmd} durable on only "
+                    f"{holders}/3 logs (ack⇒durable)")
+
+    return body()
+
+
+class _MemDfsClient:
+    """In-memory async stand-in for the DFS client surface
+    CheckpointManager uses. Each op suspends at least once so the
+    explorer can interleave concurrent savers/readers mid-metadata."""
+
+    block_size = 1 << 20
+    tenant = None
+
+    def __init__(self):
+        self.files: dict[str, bytes] = {}
+        self.meta: dict[str, dict] = {}
+
+    async def _yield(self):
+        await asyncio.sleep(0)
+
+    def _stamp(self, path: str, data: bytes, etag: str | None):
+        self.files[path] = bytes(data)
+        self.meta[path] = {
+            "size": len(data),
+            "etag_md5": etag or f"mem-{len(data)}",
+        }
+
+    async def create_file(self, path, data, ec=None, etag=None,
+                          overwrite=False, attrs=None):
+        from tpudfs.client.client import DfsError
+        await self._yield()
+        if not overwrite and path in self.files:
+            raise DfsError(f"{path} exists")
+        await self._yield()  # widen the metadata/payload window
+        self._stamp(path, data, etag)
+
+    async def get_file(self, path):
+        from tpudfs.client.client import DfsError
+        await self._yield()
+        if path not in self.files:
+            raise DfsError(f"{path} not found")
+        return self.files[path]
+
+    async def get_file_info(self, path):
+        await self._yield()
+        return dict(self.meta[path]) if path in self.meta else None
+
+    async def publish_checkpoint(self, base, step, src, dst) -> bool:
+        from tpudfs.client.client import DfsError
+        await self._yield()
+        if dst in self.files:
+            return False  # idempotent re-publish
+        body = self.files.get(src)
+        if body is None:
+            raise DfsError(f"staged manifest {src} missing")
+        await self._yield()
+        self._stamp(dst, body, None)
+        return True
+
+    async def list_files_with_meta(self, prefix, meta=True, basename=None):
+        await self._yield()
+        return sorted(
+            (p, dict(self.meta[p]) if meta else None)
+            for p in self.files if p.startswith(prefix))
+
+    async def delete_file(self, path):
+        await self._yield()
+        self.files.pop(path, None)
+        self.meta.pop(path, None)
+
+
+def scenario_ckpt():
+    """Checkpoint stage→publish with a straggling shard save racing an
+    external coordinator's commit, while a reader polls. Invariants: a
+    listed step is fully durable (no-torn-visible), latest_step never
+    moves backwards (monotonic step fence), and the publish/list/latest
+    history is linearizable against the checkpoint model."""
+    import numpy as np
+
+    from tpudfs.tpu.checkpoint import (
+        CheckpointManager,
+        IncompleteCheckpointError,
+    )
+
+    base = "/ckpt/run"
+
+    async def body():
+        client = _MemDfsClient()
+        mgr = CheckpointManager(client, base, num_shards=2, ec=None,
+                                hot_copies=True)
+        loop = asyncio.get_running_loop()
+        rec = HistoryRecorder(loop.time)
+
+        def tree(step: int, shard: int) -> dict:
+            return {"w": np.arange(8, dtype=np.float32) * (step + shard + 1)}
+
+        async def commit_step(who: str, step: int) -> bool:
+            e = rec.invoke(who, "ckpt_publish", base, value=step)
+            try:
+                await mgr.commit(step)
+            except IncompleteCheckpointError:
+                rec.ret(e, {"ok": False})  # may-drop for the checker
+                return False
+            rec.ret(e, {"ok": True})
+            return True
+
+        writer_done = asyncio.Event()
+
+        async def writer():
+            try:
+                await asyncio.gather(mgr.save_shard(1, 0, tree(1, 0)),
+                                     mgr.save_shard(1, 1, tree(1, 1)))
+                await commit_step("writer", 1)
+                # Step 2: the straggler — an external coordinator fires
+                # commit while the shards are still saving. Correct
+                # ordering (verify THEN publish) just fails the early
+                # commit; publish-before-durable exposes a torn step
+                # until the saves land.
+                commit_t = asyncio.ensure_future(
+                    commit_step("coordinator", 2))
+                save = asyncio.ensure_future(asyncio.gather(
+                    mgr.save_shard(2, 0, tree(2, 0)),
+                    mgr.save_shard(2, 1, tree(2, 1))))
+                await commit_t
+                await save
+                await commit_step("writer", 2)
+            finally:
+                writer_done.set()
+
+        def incomplete_reason(step: int) -> str | None:
+            # Ground-truth durability oracle over the fake client's
+            # state, deliberately SYNCHRONOUS: it runs in the same
+            # scheduler step as the list that returned ``step``, so a
+            # torn window a few yields wide cannot slip between the
+            # observation and the check.
+            import json as _json
+
+            from tpudfs.common import ckptpaths
+            for shard in range(mgr.num_shards):
+                spec_path = ckptpaths.shard_spec_path(base, step, shard)
+                body = client.files.get(spec_path)
+                if body is None:
+                    return f"shard {shard} spec missing"
+                spec = _json.loads(body)
+                for path in (spec.get("path"), spec.get("ec_path")):
+                    if path is None:
+                        continue
+                    info = client.meta.get(path)
+                    if info is None or info.get("etag_md5") != spec["etag"] \
+                            or int(info.get("size", -1)) != spec["size"]:
+                        return f"shard {shard} payload {path} not durable"
+            return None
+
+        async def reader():
+            prev_latest = None
+            polls = 0
+            last_seen = object()  # record reads only when the view moves,
+            # else the spin-poll floods the WGL search with identical ops
+            while not (writer_done.is_set() and polls >= 2):
+                polls += 1
+                if polls > 400:  # safety valve, never hit in practice
+                    break
+                record = False
+                e = rec.invoke("reader", "ckpt_list", base)
+                steps = await mgr.list_steps()
+                if tuple(steps) != last_seen:
+                    record = True
+                    last_seen = tuple(steps)
+                    rec.ret(e, tuple(steps))
+                else:
+                    rec.entries.remove(e)
+                for step in steps:
+                    reason = incomplete_reason(step)
+                    if reason is not None:
+                        raise InvariantViolation(
+                            f"torn checkpoint visible: step {step} is "
+                            f"listed but incomplete ({reason})")
+                latest = steps[-1] if steps else None
+                if record:
+                    e = rec.invoke("reader", "ckpt_latest", base)
+                    rec.ret(e, latest)
+                if prev_latest is not None and (
+                        latest is None or latest < prev_latest):
+                    raise InvariantViolation(
+                        f"step fence moved backwards: latest went "
+                        f"{prev_latest} -> {latest}")
+                if latest is not None:
+                    prev_latest = latest
+                await asyncio.sleep(0)
+
+        await asyncio.gather(writer(), reader())
+        res = check_history(rec.entries)
+        if not res.linearizable and not res.exhausted:
+            raise InvariantViolation(
+                f"checkpoint history not linearizable: {res.message}")
+
+    return body()
+
+
+def scenario_qos():
+    """Six tenants contending for two admission slots on the real
+    QosShedder. Invariants: inflight never exceeds the limit (the
+    TPL050 stale-guard overshoot), and every admit is paired with a
+    release (no leaked slots at quiescence)."""
+    from tpudfs.common.resilience import QosRejected, QosShedder
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        shed = QosShedder(max_inflight=2, base_retry_after=0.01,
+                          max_queue_wait=0.5, queue_depth=4,
+                          clock=loop.time)
+        admitted = [0]
+
+        async def worker(i: int):
+            tenant = f"t{i % 3}"
+            try:
+                await shed.acquire(tenant)
+            except QosRejected:
+                return
+            admitted[0] += 1
+            try:
+                if shed.inflight > shed.max_inflight:
+                    raise InvariantViolation(
+                        f"admission overshoot: {shed.inflight} inflight "
+                        f"> limit {shed.max_inflight}")
+                await asyncio.sleep(0.005 * (i + 1))
+            finally:
+                shed.release(tenant, 0.005)
+
+        await asyncio.gather(*(worker(i) for i in range(6)))
+        if shed.peak_inflight > shed.max_inflight:
+            raise InvariantViolation(
+                f"peak inflight {shed.peak_inflight} exceeded limit "
+                f"{shed.max_inflight}")
+        if shed.inflight != 0:
+            raise InvariantViolation(
+                f"leaked admission slots: {shed.inflight} inflight at "
+                "quiescence")
+        if admitted[0] == 0:
+            raise InvariantViolation("no worker was ever admitted")
+
+    return body()
+
+
+SCENARIOS = {
+    "writestream": scenario_writestream,
+    "raft": scenario_raft,
+    "ckpt": scenario_ckpt,
+    "qos": scenario_qos,
+}
+
+
+# ---------------------------------------------------------------------------
+# Mutations: re-introduce known-fixed ordering bugs (gate self-proof)
+# ---------------------------------------------------------------------------
+
+
+def mutate_publish_before_durable() -> None:
+    """The TPL025-proven checkpoint ordering, reversed: publish the
+    manifest FIRST, verify shard durability after. A reader between the
+    two observes a torn step."""
+    import json as _json
+    import time as _time
+
+    from tpudfs.common import ckptpaths
+    from tpudfs.tpu import checkpoint as _ckpt
+
+    async def buggy_commit(self, step: int) -> dict:
+        with self._op_scope(self.save_budget_s):
+            manifest = {
+                "format": _ckpt.FORMAT, "base": self.base, "step": step,
+                "num_shards": self.num_shards,
+                "ec": list(self.ec) if self.ec else None,
+                "created_at_ms": int(_time.time() * 1000),
+                "shards": [],
+            }
+            body = _json.dumps(manifest, sort_keys=True).encode()
+            staged = ckptpaths.staged_manifest_path(self.base, step)
+            await self.client.create_file(staged, body, overwrite=True)
+            await self.client.publish_checkpoint(
+                self.base, step, src=staged,
+                dst=ckptpaths.manifest_path(self.base, step))
+            manifest["shards"] = await self._verify_staged(step)
+            self.stats["commits"] += 1
+        return manifest
+
+    _ckpt.CheckpointManager.commit = buggy_commit
+
+
+def mutate_lost_wakeup() -> None:
+    """The group-commit lost wakeup: a writer that enqueues after the
+    drain task already finished never respawns it, so its durability
+    future is never resolved — the loop reports a deadlock."""
+    from tpudfs.chunkserver import service as _svc
+
+    async def buggy_commit_staged(self, block_id: str, token: str) -> None:
+        if self._closed:
+            raise OSError("chunkserver stopping")
+        fut = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(
+            lambda f: None if f.cancelled() else f.exception())
+        self._pending.append((block_id, token, fut))
+        if self._task is None:  # BUG: a finished drain is never respawned
+            self._task = asyncio.create_task(self._drain())
+        await asyncio.shield(fut)
+
+    _svc.GroupCommitter.commit_staged = buggy_commit_staged
+
+
+MUTATIONS = {
+    "publish_before_durable": mutate_publish_before_durable,
+    "lost_wakeup": mutate_lost_wakeup,
+}
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def changed_scenarios() -> list[str]:
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=ROOT, capture_output=True, text=True, check=True,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return list(SCENARIOS)  # can't tell: run everything
+    picked: list[str] = []
+    for path in out.split():
+        for prefix, names in CHANGED_MAP:
+            if path.startswith(prefix):
+                for n in names:
+                    if n not in picked:
+                        picked.append(n)
+    return picked
+
+
+def run_scenario(name: str, *, seed: int, runs: int | None,
+                 bound: int | None) -> int:
+    factory = SCENARIOS[name]
+    pbound, max_runs, base_seeds = BUDGETS[name]
+    if bound is not None:
+        pbound = bound
+    if runs is not None:
+        max_runs = runs
+    seeds = tuple(seed + s for s in base_seeds)
+    report = explore(factory, preemption_bound=pbound, max_runs=max_runs,
+                     seeds=seeds)
+    if report.ok:
+        print(f"  {name}: ok — {report.runs} schedules, "
+              f"{report.decision_points} decision points")
+        return 0
+    failure = report.failure
+    ART_DIR.mkdir(parents=True, exist_ok=True)
+    art = ART_DIR / f"{name}-seed{seed}.trace.json"
+    art.write_text(trace_to_json(failure.trace) + "\n")
+    print(f"  {name}: FAIL after {report.runs} schedules")
+    print(f"    {failure.describe()}")
+    print(f"    trace: {art}")
+    print(f"    replay: python scripts/explore_gate.py "
+          f"--scenario {name} --replay {art}")
+    return 1
+
+
+def run_replay(name: str, trace_path: str) -> int:
+    trace = trace_from_json(pathlib.Path(trace_path).read_text())
+    result = replay(SCENARIOS[name], trace)
+    print(f"  {name} replay: {result.describe()}")
+    return 0 if result.ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
+                    action="append",
+                    help="scenario(s) to run (default: all)")
+    ap.add_argument("--seed", type=int, default=1234,
+                    help="base seed for the random-walk schedules")
+    ap.add_argument("--runs", type=int, default=None,
+                    help="override per-scenario schedule budget")
+    ap.add_argument("--bound", type=int, default=None,
+                    help="override preemption bound")
+    ap.add_argument("--replay", metavar="TRACE",
+                    help="replay a recorded trace (requires --scenario)")
+    ap.add_argument("--mutate", choices=sorted(MUTATIONS),
+                    help="re-introduce a known-fixed ordering bug first")
+    ap.add_argument("--changed", action="store_true",
+                    help="run only scenarios mapped to modules changed "
+                         "vs HEAD")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for n, fn in sorted(SCENARIOS.items()):
+            print(f"{n}: {' '.join((fn.__doc__ or '').split()[:18])}…")
+        return 0
+
+    if args.mutate:
+        MUTATIONS[args.mutate]()
+        print(f"mutation applied: {args.mutate}")
+
+    if args.replay:
+        if not args.scenario or len(args.scenario) != 1:
+            ap.error("--replay needs exactly one --scenario")
+        return run_replay(args.scenario[0], args.replay)
+
+    names = args.scenario or (
+        changed_scenarios() if args.changed else list(SCENARIOS))
+    if not names:
+        print("explore gate: no scenarios mapped to the change — skipped")
+        return 0
+
+    print(f"explore gate: {', '.join(names)} (seed={args.seed})")
+    rc = 0
+    for name in names:
+        rc |= run_scenario(name, seed=args.seed, runs=args.runs,
+                           bound=args.bound)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

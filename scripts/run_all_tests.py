@@ -217,6 +217,15 @@ def main() -> None:
     # <reason>" and the stage passes (the script exits 0 on skip).
     run("native sanitizer gate (TSan stress)",
         [sys.executable, "-u", "scripts/native_sanitize.py"])
+    # tpusched: real components (writestream chain, Raft commit,
+    # checkpoint stage→publish, QoS admission) on the deterministic
+    # virtual-clock loop under seeded bounded-preemption schedule
+    # exploration, asserting ack⇒durable / no-torn-visible / monotonic
+    # step fence plus WGL linearizability of the recorded histories. A
+    # failing schedule leaves a replayable trace in .tpusched/ and
+    # prints the replay command (docs/static-analysis.md).
+    run("tpusched exploration gate (seeded)",
+        [sys.executable, "-u", "scripts/explore_gate.py"])
     if not args.skip_unit:
         run("unit + integration suite",
             [sys.executable, "-m", "pytest", "tests/", "-x", "-q"])
@@ -243,9 +252,11 @@ def main() -> None:
         # Randomized fault plan, seeded for CI determinism — explores
         # interleavings around the fixed schedule (the plan is printed, so
         # a failure is reproducible from the log).
+        # --linearize adds a post-fault WGL pass: once the faults heal, a
+        # fresh per-op-history workload must be strictly linearizable.
         run("live chaos roulette (seeded)",
             [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
-             "--seed=1234", "--topology", args.topology])
+             "--seed=1234", "--linearize", "--topology", args.topology])
         # Overload-pinned round: one chunkserver bandwidth-shaped while a
         # deadline-budgeted client reads through it — asserts bounded op
         # latency, <= 2x retry amplification, and post-heal recovery on

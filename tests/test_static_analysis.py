@@ -391,7 +391,8 @@ def test_every_rule_is_registered():
     assert {"TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
             "TPL007", "TPL010", "TPL011", "TPL012", "TPL013", "TPL014",
             "TPL020", "TPL021", "TPL022", "TPL023", "TPL024", "TPL025",
-            "TPL030", "TPL031", "TPL032", "TPL033", "TPL034"} <= ids
+            "TPL030", "TPL031", "TPL032", "TPL033", "TPL034",
+            "TPL050", "TPL051", "TPL052"} <= ids
 
 
 def test_every_rule_carries_explain_metadata():
@@ -862,6 +863,18 @@ def test_suppression_inventory_and_baseline_have_not_grown():
             f"suppression of a TPL04x native rule at "
             f"{s['path']}:{s['line']} — fix the C++/Python drift instead "
             "(see docs/static-analysis.md)"
+        )
+    # And for the protocol-ordering rules (TPL050-TPL052): every finding
+    # was burned down with a real fix (swap-then-await, re-read under
+    # increment, invalidation epochs), and each one marks an ordering
+    # hazard the tpusched explorer can turn into a reproducible failing
+    # schedule — suppressing the lint just defers the flake.
+    sched_rules = {f"TPL05{i}" for i in range(3)}
+    for s in current:
+        assert not sched_rules & set(s["rules"]), (
+            f"suppression of a TPL05x protocol-ordering rule at "
+            f"{s['path']}:{s['line']} — fix the interleaving hazard "
+            "instead (see docs/static-analysis.md)"
         )
     baseline = load_baseline(BASELINE)
     assert len(baseline) <= committed["baseline_size"]

@@ -1,0 +1,426 @@
+"""Wing-Gong-Leung linearizability checking over DFS op histories.
+
+``tpudfs/client/checker.py`` is the workload checker for the live
+cluster's put/get/delete/rename histories. This module is the *model
+layer* the schedule explorer (``tpudfs/testing/vclock.py``,
+``scripts/explore_gate.py``) and chaos roulette's ``--linearize`` mode
+share: the same WGL search, but over a pluggable object model so one
+checker covers the three object families the explored scenarios produce:
+
+- **registers** (``create/write/read/delete`` on a path) — the file
+  namespace as seen through the client surface; ``create`` is
+  create-once (fails on an existing path), ``write`` is upsert;
+- **checkpoints** (``ckpt_publish/ckpt_list/ckpt_latest`` on a base) —
+  the published-step set; a publish is idempotent, a list observes
+  exactly the published set, and ``ckpt_latest`` makes the monotonic
+  step fence checkable: once ``latest`` returned step N, no later
+  ``latest`` may linearize to a smaller step without violating real time;
+- **shard maps** (``map_move/map_read`` on a map name) — a move
+  reassigns a range and bumps the epoch; a read observes the owner (and
+  optionally the epoch) of one range. Stale epochs going backwards in
+  real time are exactly the non-linearizable histories.
+
+History entries use the workload JSONL shape
+(``tpudfs/client/workload.py``)::
+
+    {"id": int, "client": str,
+     "op": {"type": str, "key": str, "value": ..., ...},
+     "invoke_ts": float, "return_ts": float | None, "result": ...}
+
+``return_ts: None`` marks a crashed op; a mutator whose ``result`` is
+``{"ok": false}`` is indeterminate (retry/recovery may still apply it) —
+both get the Jepsen treatment: an open window, and the search may drop
+them entirely.
+
+Linearizability is local (Herlihy & Wing), so the history is partitioned
+per object (register key / checkpoint base / shard-map name) and each
+subhistory is searched independently with a shared state budget.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Hashable
+
+INF = float("inf")
+
+__all__ = [
+    "CheckResult",
+    "CheckpointModel",
+    "LinOp",
+    "Model",
+    "RegisterModel",
+    "ShardMapModel",
+    "check_history",
+    "load_history",
+    "op_entry",
+]
+
+#: Mutator op types (may-be-applied when crashed/indeterminate).
+_MUTATORS = frozenset({
+    "create", "write", "put", "delete",
+    "ckpt_publish", "map_move",
+})
+
+#: Read-only op types (a crashed read observed nothing — always droppable,
+#: and keeping it would force its observation on the search).
+_OBSERVERS = frozenset({
+    "read", "get", "ckpt_list", "ckpt_latest", "map_read",
+})
+
+
+@dataclass(frozen=True)
+class LinOp:
+    op_id: int
+    kind: str
+    key: str
+    value: Any
+    invoke: float
+    ret: float  # INF for crashed/indeterminate ops
+    result: Any
+    crashed: bool
+    client: str = "?"
+
+    @classmethod
+    def from_entry(cls, e: dict) -> "LinOp":
+        op = e["op"]
+        ret = e.get("return_ts")
+        kind = str(op["type"])
+        crashed = ret is None
+        result = e.get("result")
+        if (kind in _MUTATORS and not crashed
+                and isinstance(result, dict)
+                and result.get("ok") is False):
+            # Indeterminate failure: retries and 2PC/publish recovery can
+            # apply the effect after the error reached the client.
+            crashed = True
+        return cls(
+            op_id=int(e["id"]),
+            kind=kind,
+            key=str(op.get("key", "")),
+            value=_hashable(op.get("value")),
+            invoke=float(e["invoke_ts"]),
+            ret=INF if crashed else float(ret),
+            result=_hashable(result),
+            crashed=crashed,
+            client=str(e.get("client", "?")),
+        )
+
+    def describe(self, t0: float = 0.0) -> str:
+        ret = "OPEN" if self.ret == INF else f"{self.ret - t0:.3f}"
+        res = "" if self.result is None and self.kind in _MUTATORS \
+            else f" = {self.result!r}"
+        return (f"#{self.op_id} {self.client} "
+                f"{self.kind}({self.key!r}, {self.value!r}){res} "
+                f"[{self.invoke - t0:.3f}, {ret}]")
+
+
+def _hashable(v: Any) -> Hashable:
+    """History values arrive as JSON types; the memoized search needs
+    hashable ops and states."""
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def op_entry(op_id: int, client: str, kind: str, key: str, *,
+             value: Any = None, invoke: float = 0.0,
+             ret: float | None = None, result: Any = None,
+             **extra: Any) -> dict:
+    """Convenience constructor for in-process recorders (the explore-gate
+    scenarios and chaos history hooks) — one call per op, JSONL-shaped."""
+    op: dict = {"type": kind, "key": key, "value": value}
+    op.update(extra)
+    return {"id": op_id, "client": client, "op": op,
+            "invoke_ts": invoke, "return_ts": ret, "result": result}
+
+
+# ------------------------------------------------------------------- models
+
+
+class Model:
+    """Sequential specification of one object. States must be hashable;
+    ``apply`` returns the post-state, or None when the op's recorded
+    observation contradicts ``state``."""
+
+    name = "object"
+
+    def init(self) -> Hashable:
+        raise NotImplementedError
+
+    def apply(self, state: Hashable, op: LinOp) -> Hashable | None:
+        raise NotImplementedError
+
+
+class RegisterModel(Model):
+    """Per-path register with DFS create-once semantics. State:
+    ``(exists, value)``."""
+
+    name = "register"
+
+    def init(self):
+        return (False, None)
+
+    def apply(self, state, op: LinOp):
+        exists, value = state
+        if op.kind == "create":
+            ok = _ok_of(op)
+            if ok is False:
+                # A determinate AlreadyExists is itself an observation.
+                return state if exists else None
+            if exists and ok is True:
+                return None  # create-once succeeded over a live path
+            return (True, op.value)
+        if op.kind in ("write", "put"):
+            return (True, op.value)
+        if op.kind == "delete":
+            ok = _ok_of(op)
+            if ok is False:
+                return None if exists else state
+            if ok is True and not exists:
+                return None
+            return (False, None)
+        if op.kind in ("read", "get"):
+            observed = op.result
+            actual = value if exists else None
+            return state if observed == actual else None
+        return None
+
+
+def _ok_of(op: LinOp) -> bool | None:
+    result = op.result
+    if isinstance(result, tuple):
+        d = dict(result)
+        ok = d.get("ok")
+        if isinstance(ok, bool):
+            return ok
+    if isinstance(result, bool):
+        return result
+    return None
+
+
+class CheckpointModel(Model):
+    """Published-step set per checkpoint base. ``ckpt_publish(step)`` is
+    idempotent; ``ckpt_list`` observes the full set; ``ckpt_latest``
+    observes the max (the monotonic step fence: two latests ordered by
+    real time must not observe a shrinking max)."""
+
+    name = "checkpoint"
+
+    def init(self):
+        return frozenset()
+
+    def apply(self, state: frozenset, op: LinOp):
+        if op.kind == "ckpt_publish":
+            return state | {int(op.value)}
+        if op.kind == "ckpt_list":
+            observed = op.result
+            if observed is None:
+                return None
+            return state if frozenset(int(s) for s in observed) == state \
+                else None
+        if op.kind == "ckpt_latest":
+            latest = max(state) if state else None
+            return state if op.result == latest else None
+        return None
+
+
+class ShardMapModel(Model):
+    """Range -> owner assignment with a move epoch. ``map_move`` carries
+    ``value=(range, owner)`` and bumps the epoch; ``map_read`` of a range
+    observes ``result={"owner": ..., "epoch": ...}`` (epoch optional).
+    State: ``(epoch, frozenset((range, owner)))``."""
+
+    name = "shardmap"
+
+    def init(self):
+        return (0, frozenset())
+
+    def apply(self, state, op: LinOp):
+        epoch, assign = state
+        if op.kind == "map_move":
+            rng, owner = op.value
+            assign = frozenset(
+                {(r, o) for r, o in assign if r != rng} | {(rng, owner)})
+            return (epoch + 1, assign)
+        if op.kind == "map_read":
+            rng = op.value
+            owner = dict(assign).get(rng)
+            observed = dict(op.result) if isinstance(op.result, tuple) \
+                else {"owner": op.result}
+            if observed.get("owner") != owner:
+                return None
+            if "epoch" in observed and observed["epoch"] != epoch:
+                return None
+            return state
+        return None
+
+
+_KIND_FAMILY = {
+    "create": "register", "write": "register", "put": "register",
+    "read": "register", "get": "register", "delete": "register",
+    "ckpt_publish": "checkpoint", "ckpt_list": "checkpoint",
+    "ckpt_latest": "checkpoint",
+    "map_move": "shardmap", "map_read": "shardmap",
+}
+
+_FAMILY_MODEL = {
+    "register": RegisterModel,
+    "checkpoint": CheckpointModel,
+    "shardmap": ShardMapModel,
+}
+
+
+# ------------------------------------------------------------------- search
+
+
+@dataclass
+class CheckResult:
+    linearizable: bool
+    message: str
+    witness: list[int] | None = None
+    exhausted: bool = False
+
+
+def _search(ops: list[LinOp], model: Model,
+            max_states: int) -> tuple[list[int] | None, bool]:
+    """WGL core: memoized DFS for a real-time-respecting total order in
+    which every observation matches the model (Wing & Gong '93, Lowe's
+    just-linearizable-prefix memoization)."""
+    seen: set[tuple[frozenset, Hashable]] = set()
+    budget = [max_states]
+    by_id = {o.op_id: o for o in ops}
+
+    def search(remaining: frozenset, state: Hashable) -> list[int] | None:
+        if not remaining:
+            return []
+        key = (remaining, state)
+        if key in seen or budget[0] <= 0:
+            return None
+        budget[0] -= 1
+        seen.add(key)
+        rem_ops = [by_id[i] for i in remaining]
+        min_ret = min(o.ret for o in rem_ops)
+        for op in rem_ops:
+            if op.invoke > min_ret:
+                continue  # another remaining op returned before this began
+            nxt = model.apply(state, op)
+            if nxt is not None:
+                rest = search(remaining - {op.op_id}, nxt)
+                if rest is not None:
+                    return [op.op_id] + rest
+            if op.crashed:
+                rest = search(remaining - {op.op_id}, state)
+                if rest is not None:
+                    return rest
+        return None
+
+    # A crashed observer saw nothing and constrains nothing: drop it up
+    # front instead of doubling the branch factor.
+    ops = [o for o in ops if not (o.crashed and o.kind in _OBSERVERS)]
+    witness = search(frozenset(o.op_id for o in ops), model.init())
+    return witness, budget[0] <= 0
+
+
+def check_history(entries: list[dict],
+                  max_states: int = 2_000_000) -> CheckResult:
+    """Partition the history per object and WGL-search each subhistory."""
+    ops = sorted((LinOp.from_entry(e) for e in entries),
+                 key=lambda o: (o.invoke, o.op_id))
+    if not ops:
+        return CheckResult(True, "empty history")
+
+    objects: dict[tuple[str, str], list[LinOp]] = {}
+    for o in ops:
+        family = _KIND_FAMILY.get(o.kind)
+        if family is None:
+            return CheckResult(False, f"unknown op type {o.kind!r} "
+                                      f"in {o.describe()}")
+        objects.setdefault((family, o.key), []).append(o)
+
+    any_exhausted = False
+    witness: list[int] | None = None
+    for (family, key), group in objects.items():
+        model = _FAMILY_MODEL[family]()
+        found, exhausted = _search(group, model, max_states)
+        if found is not None:
+            witness = found if len(objects) == 1 else None
+            continue
+        if exhausted:
+            any_exhausted = True
+            continue
+        return CheckResult(
+            False,
+            _diagnose(family, key, group, model, max_states))
+    if any_exhausted:
+        return CheckResult(
+            False,
+            f"UNKNOWN: search budget exhausted after {max_states} states",
+            exhausted=True)
+    return CheckResult(
+        True,
+        f"linearizable ({len(ops)} ops, {len(objects)} objects)",
+        witness)
+
+
+def _diagnose(family: str, key: str, ops: list[LinOp], model: Model,
+              max_states: int) -> str:
+    """Minimal failing window in completion order (the same narrowing
+    discipline as the workload checker's diagnosis)."""
+    t0 = min(o.invoke for o in ops)
+    ordered = sorted(ops, key=lambda o: (o.ret, o.invoke))
+    budget = max(10_000, max_states // 20)
+    for k in range(1, len(ordered) + 1):
+        found, exhausted = _search(ordered[:k], model, budget)
+        if exhausted:
+            break
+        if found is None:
+            trigger = ordered[k - 1]
+            window = [
+                o for o in ordered[:k]
+                if o is trigger
+                or (o.invoke <= trigger.ret and o.ret >= trigger.invoke)
+            ]
+            lines = "\n  ".join(o.describe(t0) for o in window)
+            return (
+                f"not linearizable: {family} object {key!r} first breaks "
+                f"at {trigger.describe(t0)}; ops concurrent with it:\n"
+                f"  {lines}")
+    return (f"not linearizable: {family} object {key!r} admits no valid "
+            f"linearization order ({len(ops)} ops)")
+
+
+def load_history(path: str) -> list[dict]:
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    return entries
+
+
+class HistoryRecorder:
+    """In-process invoke/return recorder for vclock scenarios: ids are
+    sequential, timestamps come from the virtual clock, and the entries
+    feed straight into :func:`check_history`."""
+
+    def __init__(self, clock):
+        self._clock = clock
+        self._next = 0
+        self.entries: list[dict] = []
+
+    def invoke(self, client: str, kind: str, key: str,
+               value: Any = None) -> dict:
+        self._next += 1
+        e = op_entry(self._next, client, kind, key, value=value,
+                     invoke=self._clock(), ret=None)
+        self.entries.append(e)
+        return e
+
+    def ret(self, e: dict, result: Any = None) -> None:
+        e["return_ts"] = self._clock()
+        e["result"] = result

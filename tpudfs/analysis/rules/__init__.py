@@ -33,4 +33,7 @@ from tpudfs.analysis.rules import (  # noqa: F401
     native_abi,
     native_wire,
     native_threads,
+    # tpusched protocol-ordering rules (explorer targets, see
+    # tpudfs/testing/vclock.py + tpudfs/analysis/linearize.py)
+    interleave,
 )

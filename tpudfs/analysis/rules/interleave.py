@@ -1,0 +1,676 @@
+"""TPL050-TPL052 — protocol-ordering lints: the static half of tpusched.
+
+The schedule explorer (``tpudfs/testing/vclock.py``) can only check the
+interleavings a scenario drives; these rules enumerate, on the CFG, the
+*shapes* that make an interleaving dangerous in the first place — the
+await points where shared state can shear, the handler paths that can
+double-respond or go silent, the retry loops that replay a
+non-idempotent effect. Findings double as explorer targets: each one
+names an await-crossing region worth a scenario.
+
+- **TPL050 await-atomicity**: shared ``self``-state is read (a guard
+  test, or a local bound from the attribute), an ``await`` suspends the
+  task, and the same attribute is then mutated with no re-validation
+  between the suspension and the write. Every other task ran in that
+  window; the guard's truth and the local's value are stale.
+- **TPL051 one-terminal-response**: a framed stream handler (the
+  blockport ``(req, r, w)`` shape) must send exactly one terminal frame
+  — an error frame or the final ack — per connection-preserving path.
+  Zero leaves the peer waiting on a live socket; two desyncs framing for
+  every later request on the pooled connection.
+- **TPL052 retry-of-non-idempotent-op-without-fence**: a retry loop
+  re-awaits a create/rename/complete-class mutation whose request
+  carries no fence (etag / overwrite / token / txid / term). If attempt
+  one applied and its ack was lost, the replay double-applies or
+  misreports AlreadyExists/NotFound as failure.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis.cfg import Node, cfg_for
+from tpudfs.analysis.linter import Finding, ModuleInfo, Rule, register
+
+def _walk_shallow(root: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function bodies:
+    a nested ``def`` statement DEFINES code, it doesn't run it."""
+    work = [root]
+    while work:
+        node = work.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # yield the def itself, never its body
+        work.extend(ast.iter_child_nodes(node))
+
+
+#: Mutating method names on an attribute (``self.A.append(...)``).
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "update", "remove", "discard",
+    "pop", "popitem", "clear", "setdefault", "appendleft", "popleft",
+}
+
+
+def _self_attr_reads(expr: ast.AST) -> set[str]:
+    """Attributes of ``self`` loaded anywhere inside ``expr``."""
+    out: set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                and isinstance(n.value, ast.Name) and n.value.id == "self":
+            out.add(n.attr)
+    return out
+
+
+def _self_attr_of(node: ast.AST) -> str | None:
+    """``self.A`` -> "A" for a bare attribute node."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _mutated_attrs(stmt: ast.AST) -> set[str]:
+    """``self`` attributes a single statement's exprs mutate: assignment
+    to ``self.A`` / ``self.A[...]``, augmented assignment, ``del``, or a
+    mutating method call ``self.A.append(...)``."""
+    out: set[str] = set()
+    for n in _walk_shallow(stmt):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                out |= _target_attrs(t)
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                out |= _target_attrs(t)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATING_METHODS:
+            a = _self_attr_of(n.func.value)
+            if a is not None:
+                out.add(a)
+    return out
+
+
+def _target_attrs(t: ast.AST) -> set[str]:
+    out: set[str] = set()
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            out |= _target_attrs(e)
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_attrs(t.value)
+    if isinstance(t, ast.Subscript):
+        a = _self_attr_of(t.value)
+        if a is not None:
+            out.add(a)
+        return out
+    a = _self_attr_of(t)
+    if a is not None:
+        out.add(a)
+    return out
+
+
+def _node_mutates(node: Node, attr: str) -> bool:
+    return any(attr in _mutated_attrs(e) for e in node.exprs())
+
+
+def _node_tests_attr(node: Node, attr: str) -> bool:
+    """A test/compare over the attribute at this node — re-validation."""
+    if node.kind in ("if_test", "while_test"):
+        return any(attr in _self_attr_reads(e) for e in node.exprs())
+    return False
+
+
+def _shares_async_with(module: ModuleInfo, a: ast.AST, b: ast.AST) -> bool:
+    """Both statements sit inside the SAME ``async with`` block: every
+    other task that respects that lock is excluded from the window, which
+    is the one re-validation-free shape that is actually safe."""
+    anc_a = {id(n) for n in module.ancestors(a)
+             if isinstance(n, ast.AsyncWith)}
+    if not anc_a:
+        return False
+    return any(id(n) in anc_a for n in module.ancestors(b)
+               if isinstance(n, ast.AsyncWith))
+
+
+def _async_functions(module: ModuleInfo) -> Iterator[ast.AsyncFunctionDef]:
+    for n in ast.walk(module.tree):
+        if isinstance(n, ast.AsyncFunctionDef):
+            yield n
+
+
+@register
+class AwaitAtomicity(Rule):
+    id = "TPL050"
+    name = "await-atomicity"
+    summary = ("shared `self` state read before an await and mutated "
+               "after it with no re-validation — every other task ran in "
+               "that window, so the guard/local is stale at the write")
+    doc = (
+        "An `await` is a scheduling point: by the time the coroutine "
+        "resumes, any other task may have mutated the object. A guard "
+        "(`if not self.closed:`) or a local snapshot (`n = self.count`) "
+        "taken before the await therefore proves nothing about the state "
+        "the post-await write applies to — the classic check-then-act "
+        "race, TOCTOU at event-loop granularity. The dataplane "
+        "lost-wakeup commit-loop poll and the admission double-count "
+        "both had this shape. Flagged on the CFG: a read of `self.A` "
+        "(test or local-bind), an await-bearing node on the path, then a "
+        "mutation of `self.A` (or a write of the stale local into it) "
+        "with no re-test of `self.A` in between. Mutations inside the "
+        "same `async with` lock block as the read stay silent — the "
+        "lock excludes the interleaving."
+    )
+    example = """\
+async def admit(self):
+    if self.inflight < self.limit:        # guard read
+        await self.backend.reserve()      # every task runs here
+        self.inflight += 1                # stale guard: may overshoot
+"""
+    fix = ("Re-validate after the await (`if self.inflight >= self.limit: "
+           "return` again), mutate BEFORE suspending and roll back on "
+           "failure, or hold an `asyncio.Lock` across the whole "
+           "check-then-act window.")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn in _async_functions(module):
+            cfg = cfg_for(module, fn)
+            if not any(n.has_await for n in cfg.rpo()):
+                continue
+            yield from self._guarded_mutations(module, cfg)
+            yield from self._stale_locals(module, cfg)
+
+    # ------------------------------------------- guard ... await ... mutate
+
+    def _guarded_mutations(self, module: ModuleInfo, cfg) -> Iterator[Finding]:
+        for test in cfg.rpo():
+            if test.kind not in ("if_test", "while_test"):
+                continue
+            attrs = set()
+            for e in test.exprs():
+                attrs |= _self_attr_reads(e)
+            for attr in sorted(attrs):
+                hit = self._first_unvalidated_mutation(test, attr)
+                if hit is None:
+                    continue
+                if _shares_async_with(module, test.stmt, hit.stmt):
+                    continue
+                yield self.finding(
+                    module, hit.stmt,
+                    f"`self.{attr}` is mutated after an await on a path "
+                    f"guarded by the `self.{attr}` test at line "
+                    f"{test.lineno}, with no re-validation after the "
+                    "suspension — the guard is stale by the time this "
+                    "write runs")
+
+    @staticmethod
+    def _first_unvalidated_mutation(start: Node, attr: str) -> Node | None:
+        """BFS from ``start``: does some path cross an await and then
+        mutate ``attr`` before any re-test of ``attr``?"""
+        seen: set[tuple[int, bool]] = set()
+        work: list[tuple[Node, bool]] = [
+            (s, start.has_await) for s, _k in start.succs]
+        while work:
+            node, crossed = work.pop()
+            if crossed and _node_mutates(node, attr):
+                return node
+            if _node_tests_attr(node, attr):
+                continue  # re-validated: this path is clean past here
+            if not crossed and _node_mutates(node, attr):
+                # Pre-await mutation re-establishes the state the
+                # guard was about; stop to avoid flagging the idiom
+                # "mutate first, then await".
+                continue
+            crossed = crossed or node.has_await
+            key = (node.index, crossed)
+            if key in seen:
+                continue
+            seen.add(key)
+            for succ, _kind in node.succs:
+                work.append((succ, crossed))
+        return None
+
+    # ------------------------------------------ local = self.A ... await ...
+
+    def _stale_locals(self, module: ModuleInfo, cfg) -> Iterator[Finding]:
+        for read in cfg.rpo():
+            binds = self._local_binds(read)
+            for local, attr, bind_stmt in binds:
+                hit = self._stale_write(read, local, attr)
+                if hit is None:
+                    continue
+                if _shares_async_with(module, bind_stmt, hit.stmt):
+                    continue
+                yield self.finding(
+                    module, hit.stmt,
+                    f"`self.{attr}` is overwritten from `{local}` — a "
+                    f"snapshot taken at line {read.lineno} BEFORE an "
+                    "await — losing every update that landed during the "
+                    "suspension; re-read or re-validate "
+                    f"`self.{attr}` after resuming")
+
+    @staticmethod
+    def _local_binds(node: Node) -> list[tuple[str, str, ast.AST]]:
+        """``v = <expr reading self.A>`` bindings at this node."""
+        out = []
+        for e in node.exprs():
+            if not (isinstance(e, ast.Assign) and len(e.targets) == 1
+                    and isinstance(e.targets[0], ast.Name)):
+                continue
+            if isinstance(e.value, ast.Await):
+                continue  # value produced after the suspension: fresh
+            for attr in sorted(_self_attr_reads(e.value)):
+                out.append((e.targets[0].id, attr, e))
+        return out
+
+    @staticmethod
+    def _stale_write(start: Node, local: str, attr: str) -> Node | None:
+        """BFS: an await, then ``self.A = f(local)`` (or ``self.A[k] =``)
+        with no rebind of the local and no re-test of the attr between."""
+        def writes_attr_from_local(node: Node) -> bool:
+            for e in node.exprs():
+                for n in ast.walk(e):
+                    if not isinstance(n, (ast.Assign, ast.AugAssign)):
+                        continue
+                    targets = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    if not any(attr in _target_attrs(t) for t in targets):
+                        continue
+                    if attr in _self_attr_reads(n.value):
+                        # The new value incorporates the CURRENT state
+                        # (e.g. `self.q = self.q[n:]`): that re-read is
+                        # the re-validation this rule asks for.
+                        continue
+                    value_names = {
+                        nm.id for nm in ast.walk(n.value)
+                        if isinstance(nm, ast.Name)}
+                    if local in value_names:
+                        return True
+            return False
+
+        def rebinds_local(node: Node) -> bool:
+            for e in node.exprs():
+                for n in ast.walk(e):
+                    if isinstance(n, ast.Assign):
+                        for t in n.targets:
+                            for nm in ast.walk(t):
+                                if isinstance(nm, ast.Name) \
+                                        and nm.id == local:
+                                    return True
+            return False
+
+        seen: set[tuple[int, bool]] = set()
+        work: list[tuple[Node, bool]] = [
+            (s, start.has_await) for s, _k in start.succs]
+        while work:
+            node, crossed = work.pop()
+            if crossed and writes_attr_from_local(node):
+                return node
+            if rebinds_local(node) or _node_tests_attr(node, attr):
+                continue
+            crossed = crossed or node.has_await
+            key = (node.index, crossed)
+            if key in seen:
+                continue
+            seen.add(key)
+            for succ, _kind in node.succs:
+                work.append((succ, crossed))
+        return None
+
+
+# --------------------------------------------------------------- TPL051
+
+
+def _terminal_send_in(call: ast.Call, local_senders: set[str]) -> bool:
+    """A call that puts a TERMINAL frame on the stream: an error helper,
+    a locally-defined abort helper, or ``w.writelines(_pack_frame(h))``
+    where ``h`` is a dict literal carrying ``final`` or ``ok: False``."""
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else \
+        func.id if isinstance(func, ast.Name) else None
+    if name in local_senders or name == "_stream_err":
+        return True
+    if not (isinstance(func, ast.Attribute) and func.attr == "writelines"
+            and call.args):
+        return False
+    packed = call.args[0]
+    if not (isinstance(packed, ast.Call) and isinstance(
+            packed.func, (ast.Attribute, ast.Name))):
+        return False
+    pname = packed.func.attr if isinstance(packed.func, ast.Attribute) \
+        else packed.func.id
+    if pname != "_pack_frame" or not packed.args:
+        return False
+    header = packed.args[0]
+    if not isinstance(header, ast.Dict):
+        return False
+    for k, v in zip(header.keys, header.values):
+        if not isinstance(k, ast.Constant):
+            continue
+        if k.value == "final":
+            return True
+        if k.value == "ok" and isinstance(v, ast.Constant) \
+                and v.value is False:
+            return True
+    return False
+
+
+def _stream_handler_functions(module: ModuleInfo
+                              ) -> Iterator[ast.AsyncFunctionDef]:
+    """Blockport stream handlers: async, and the parameter list ends in
+    the ``(..., r, w)`` connection pair (the framed-stream contract)."""
+    for fn in _async_functions(module):
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        if len(params) >= 2 and params[-2:] == ["r", "w"]:
+            yield fn
+
+
+@register
+class OneTerminalResponse(Rule):
+    id = "TPL051"
+    name = "one-terminal-response"
+    summary = ("framed stream handler path can send two terminal frames "
+               "(or report the connection as framed without sending one) "
+               "— either desyncs the pooled blockport connection")
+    doc = (
+        "Blockport stream handlers own a pooled framed connection: the "
+        "contract (tpudfs/common/blocknet.py) is exactly one terminal "
+        "frame — an error frame or the final ack — per request, then "
+        "`return True` iff the connection is still in frame-sync. A "
+        "path that sends two terminal frames leaves the second one to "
+        "be parsed as the NEXT request's response; a path that returns "
+        "True without having sent any leaves the peer waiting forever "
+        "on a connection the pool will happily reuse. Flagged on the "
+        "CFG of every `(..., r, w)` handler: a terminal send reachable "
+        "after another terminal send, and a `return True` reachable "
+        "with no terminal send. `return False` paths (torn peer, "
+        "connection discarded) are exempt — there is no reader left."
+    )
+    example = """\
+async def rpc_thing(self, req, r, w):
+    if bad(req):
+        await self._stream_err(w, "INVALID_ARGUMENT", "bad")
+        # missing return: falls through to the final ack below
+    w.writelines(blocknet._pack_frame({"ok": True, "final": 1}, None))
+    return True
+"""
+    fix = ("Return immediately after an error frame; funnel every exit "
+           "through exactly one terminal send (the `_abort` helper "
+           "pattern in chunkserver/service.py), and return False when "
+           "the frame boundary is gone instead of responding.")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn in _stream_handler_functions(module):
+            local_senders = self._local_senders(fn)
+            cfg = cfg_for(module, fn)
+            send_nodes = [
+                n for n in cfg.rpo()
+                if any(self._node_sends(e, local_senders)
+                       for e in n.exprs())
+            ]
+            if not send_nodes:
+                continue
+            yield from self._double_sends(module, cfg, send_nodes,
+                                          local_senders)
+            yield from self._silent_framed_returns(module, cfg,
+                                                   local_senders)
+
+    @staticmethod
+    def _node_sends(expr: ast.AST, local_senders: set[str]) -> bool:
+        return any(
+            isinstance(n, ast.Call) and _terminal_send_in(n, local_senders)
+            for n in _walk_shallow(expr))
+
+    @staticmethod
+    def _local_senders(fn: ast.AsyncFunctionDef) -> set[str]:
+        """Nested helpers that themselves send a terminal frame (the
+        `_abort` closure idiom): calling one counts as sending."""
+        out: set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not fn:
+                if any(isinstance(c, ast.Call)
+                       and _terminal_send_in(c, set())
+                       for c in ast.walk(n)):
+                    out.add(n.name)
+        return out
+
+    def _double_sends(self, module, cfg, send_nodes,
+                      local_senders) -> Iterator[Finding]:
+        # The discipline is per REQUEST: a handler loop that serves many
+        # requests sends once per iteration, so retreating edges (loop
+        # back-edges, rpo position not increasing) are not "after".
+        rpo_pos = {n.index: i for i, n in enumerate(cfg.rpo())}
+
+        def forward_succs(node):
+            for s, kind in node.succs:
+                if kind == "exc":
+                    continue
+                if rpo_pos.get(s.index, -1) <= rpo_pos.get(node.index, -1):
+                    continue
+                yield s
+
+        send_ids = {n.index for n in send_nodes}
+        for first in send_nodes:
+            seen: set[int] = set()
+            work = list(forward_succs(first))
+            while work:
+                node = work.pop()
+                if node.index in seen:
+                    continue
+                seen.add(node.index)
+                if node.index in send_ids:
+                    yield self.finding(
+                        module, node.stmt or first.stmt,
+                        f"second terminal frame reachable at line "
+                        f"{node.lineno} after the terminal send at line "
+                        f"{first.lineno} — the peer will parse it as the "
+                        "next request's response (one-terminal-response "
+                        "discipline)")
+                    break  # one finding per origin send is enough
+                work.extend(forward_succs(node))
+
+    def _silent_framed_returns(self, module, cfg,
+                               local_senders) -> Iterator[Finding]:
+        """`return True` (framed!) reachable from entry with zero
+        terminal sends along the way."""
+        targets = [
+            n for n in cfg.rpo()
+            if n.kind == "stmt" and isinstance(n.stmt, ast.Return)
+            and isinstance(n.stmt.value, ast.Constant)
+            and n.stmt.value.value is True
+        ]
+        if not targets:
+            return
+        reachable_clean: set[int] = set()
+        work = [cfg.entry]
+        seen: set[int] = set()
+        while work:
+            node = work.pop()
+            if node.index in seen:
+                continue
+            seen.add(node.index)
+            if any(self._node_sends(e, local_senders)
+                   for e in node.exprs()):
+                continue  # paths through a send are fine
+            reachable_clean.add(node.index)
+            work.extend(s for s, _k in node.succs)
+        for t in targets:
+            if t.index in reachable_clean:
+                yield self.finding(
+                    module, t.stmt,
+                    "`return True` declares the connection framed, but "
+                    "this path sent no terminal frame — the peer waits "
+                    "forever on a connection the pool will reuse "
+                    "(one-terminal-response discipline)")
+
+
+# --------------------------------------------------------------- TPL052
+
+#: Client-surface mutators that are NOT idempotent without a fence.
+_NON_IDEMPOTENT_METHODS = {"create_file", "rename_file", "complete_file"}
+
+#: RPC method strings with the same property.
+_NON_IDEMPOTENT_RPCS = {"CreateFile", "Rename", "CompleteFile",
+                        "RenamePrepare", "RenameCommit"}
+
+#: Keyword/request-dict keys that fence a replay: content addressing,
+#: last-writer-wins, epoch/term fencing, or an explicit idempotency key.
+_FENCE_KEYS = {"etag", "overwrite", "token", "txid", "fence",
+               "request_id", "idempotency_key", "if_match", "master_term"}
+
+
+@register
+class RetryWithoutFence(Rule):
+    id = "TPL052"
+    name = "retry-non-idempotent-without-fence"
+    summary = ("retry loop replays a create/rename/complete-class "
+               "mutation whose request carries no fence (etag/overwrite/"
+               "token/term) — a lost ack makes the replay double-apply "
+               "or misreport")
+    doc = (
+        "A retry after UNAVAILABLE/DEADLINE_EXCEEDED is indeterminate: "
+        "attempt one may have applied and only the ack was lost. "
+        "Replaying an op that is not idempotent then either "
+        "double-applies (a second rename moves the already-moved key's "
+        "new occupant) or turns success into a reported failure "
+        "(create-once replay sees AlreadyExists). Every replayed "
+        "mutation must carry a fence the server can use to recognize "
+        "the replay: a content ETag, `overwrite=True` last-writer-wins, "
+        "a transaction/idempotency token, or the master term for "
+        "epoch-fenced block writes. Flagged: an awaited "
+        "create/rename/complete-class call inside a loop that catches "
+        "an exception and iterates again, with no fence key in the "
+        "call's keywords or its request dict literal."
+    )
+    example = """\
+while True:
+    try:
+        await client.rename_file(src, dst)   # no txid/fence
+        break
+    except DfsError:
+        continue                              # replays the rename
+"""
+    fix = ("Carry a fence on the call (`etag=`, `overwrite=True`, a "
+           "transaction token, `master_term`) so the server detects the "
+           "replay, or hoist the op out of the retry loop and resolve "
+           "indeterminacy by re-reading state (the `_put_if_absent` "
+           "probe idiom in tpu/checkpoint.py).")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        reported: set[int] = set()  # call node ids; nested loops walk
+        # the same Try twice and must not duplicate findings
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn,
+                              (ast.AsyncFunctionDef, ast.FunctionDef)):
+                continue
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.While, ast.For)):
+                    continue
+                if module.enclosing_function(loop) is not fn:
+                    continue
+                loop_vars = self._loop_assigned_names(loop)
+                retrying_tries = [
+                    t for t in ast.walk(loop)
+                    if isinstance(t, ast.Try) and self._retries(t)
+                ]
+                for t in retrying_tries:
+                    yield from self._unfenced_calls(module, t, loop_vars,
+                                                    reported)
+
+    @staticmethod
+    def _retries(t: ast.Try) -> bool:
+        """An except handler that lets the loop take another iteration:
+        its body neither raises, returns, nor breaks on its last
+        statement."""
+        for h in t.handlers:
+            last = h.body[-1] if h.body else None
+            if not isinstance(last, (ast.Raise, ast.Return, ast.Break)):
+                return True
+        return False
+
+    @staticmethod
+    def _loop_assigned_names(loop: ast.While | ast.For) -> set[str]:
+        """Names (re)bound inside the loop body each iteration. A call
+        whose arguments depend on one issues a DIFFERENT op every trip
+        around — a workload driver, not a replay."""
+        out: set[str] = set()
+        if isinstance(loop, ast.For):
+            for nm in ast.walk(loop.target):
+                if isinstance(nm, ast.Name):
+                    out.add(nm.id)
+        for stmt in loop.body:
+            for n in _walk_shallow(stmt):
+                if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                  ast.For)):
+                    targets = (
+                        n.targets if isinstance(n, ast.Assign)
+                        else [n.target])
+                    for t in targets:
+                        for nm in ast.walk(t):
+                            if isinstance(nm, ast.Name):
+                                out.add(nm.id)
+        return out
+
+    def _unfenced_calls(self, module: ModuleInfo, t: ast.Try,
+                        loop_vars: set[str],
+                        reported: set[int]) -> Iterator[Finding]:
+        for n in _walk_shallow(ast.Module(body=t.body, type_ignores=[])):
+            if not (isinstance(n, ast.Await)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            call = n.value
+            if id(call) in reported:
+                continue
+            label = self._non_idempotent(module, call)
+            if label is None:
+                continue
+            if self._fenced(module, call):
+                continue
+            arg_names = {
+                nm.id
+                for a in list(call.args) + [kw.value for kw in call.keywords]
+                for nm in ast.walk(a) if isinstance(nm, ast.Name)}
+            if arg_names & loop_vars:
+                continue  # per-iteration op, not a replay of one op
+            reported.add(id(call))
+            yield self.finding(
+                module, call,
+                f"`{label}` is replayed by this retry loop without a "
+                "fence (no etag/overwrite/token/term in the call or its "
+                "request) — a lost ack makes the retry double-apply or "
+                "misreport the outcome")
+
+    @staticmethod
+    def _non_idempotent(module: ModuleInfo, call: ast.Call) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _NON_IDEMPOTENT_METHODS:
+            return func.attr
+        if isinstance(func, ast.Attribute) and func.attr == "call":
+            # rpc.call(addr, SERVICE, "Method", req): find the method
+            # string among the positional args.
+            for a in call.args:
+                if isinstance(a, ast.Constant) \
+                        and a.value in _NON_IDEMPOTENT_RPCS:
+                    return f"rpc {a.value}"
+        return None
+
+    @staticmethod
+    def _fenced(module: ModuleInfo, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg in _FENCE_KEYS:
+                return True
+            if kw.arg is None and isinstance(kw.value, ast.Dict):
+                for k in kw.value.keys:
+                    if isinstance(k, ast.Constant) \
+                            and k.value in _FENCE_KEYS:
+                        return True
+        for a in call.args:
+            if isinstance(a, ast.Dict):
+                for k in a.keys:
+                    if isinstance(k, ast.Constant) \
+                            and k.value in _FENCE_KEYS:
+                        return True
+        return False

@@ -507,20 +507,22 @@ class ChunkServer:
             t.cancel()
         self._tasks.clear()
         await self.committer.stop()
-        if self._native_dp is not None:
+        # Swap-then-await: claim each handle before suspending so a
+        # concurrent stop() can't double-close it (TPL050).
+        native_dp, self._native_dp = self._native_dp, None
+        if native_dp is not None:
             lib = native.get_lib()
             if lib is not None:
                 await asyncio.to_thread(
-                    lib.tpudfs_dataplane_stop, self._native_dp
+                    lib.tpudfs_dataplane_stop, native_dp
                 )
-            self._native_dp = None
-        if self._blockport is not None:
-            await self._blockport.stop()
-            self._blockport = None
+        blockport, self._blockport = self._blockport, None
+        if blockport is not None:
+            await blockport.stop()
         await self.blocks.close()
-        if self._server:
-            await self._server.stop()
-            self._server = None
+        server, self._server = self._server, None
+        if server:
+            await server.stop()
         if self._owns_client:
             await self.client.close()
 

@@ -228,12 +228,13 @@ class BlockPortServer:
         return self.port
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
+        # Swap-then-await so a concurrent stop() can't double-close.
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
             for w in list(self._conns):
                 w.close()
-            await self._server.wait_closed()
-            self._server = None
+            await server.wait_closed()
 
     async def _handle(self, r: asyncio.StreamReader,
                       w: asyncio.StreamWriter) -> None:
@@ -534,10 +535,7 @@ class BlockConnPool:
                 # connection is still framed — reuse it.
                 self._release(hostport, conn)
                 if e.code == grpc.StatusCode.UNIMPLEMENTED:
-                    # Peer advertised streams but doesn't serve them
-                    # (restart race onto an older build): remember and
-                    # fall back to the whole-block path.
-                    self._stream[addr] = False
+                    self._mark_stream_unsupported(addr)
                     return None
             else:
                 w.close()
@@ -631,6 +629,14 @@ class BlockConnPool:
                            f"blockport {host}:{port}: {e!r}") from None
         self.breakers.record_success(addr)
         return resp
+
+    def _mark_stream_unsupported(self, addr: str) -> None:
+        """Negative stream-capability memo off a fresh UNIMPLEMENTED reply:
+        the peer just told us it doesn't serve streams (restart race onto
+        an older build), so this write is authoritative no matter what a
+        concurrent capability probe recorded meanwhile — later probes may
+        legitimately flip it back."""
+        self._stream[addr] = False
 
     async def _checkout(self, hostport: str):
         """Pop a pooled connection to ``hostport`` or open a fresh one."""

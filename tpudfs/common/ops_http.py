@@ -108,9 +108,10 @@ class OpsServer:
         return self.port
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # Swap-then-await so a concurrent stop() can't double-cleanup.
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
 
 
 async def maybe_start_ops(prefix: str, gauges_fn, raft_status_fn=None, *,

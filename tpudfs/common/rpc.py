@@ -324,9 +324,10 @@ class RpcServer:
         return f"{self._host}:{self.bound_port}"
 
     async def stop(self, grace: float | None = 0.5) -> None:
-        if self._server is not None:
-            await self._server.stop(grace)
-            self._server = None
+        # Swap-then-await so a concurrent stop() can't double-stop.
+        server, self._server = self._server, None
+        if server is not None:
+            await server.stop(grace)
 
 
 class RpcClient:

@@ -312,7 +312,10 @@ class TransactionManager:
             await self._abort_local(txid)
             self.inquiry_attempts.pop(txid, None)
             return
-        self.inquiry_attempts[txid] = attempts + 1
+        # Re-read under the increment: `attempts` predates the inquiry
+        # await, and an overlapping sweep's increment must not be lost
+        # (that would double the effective presumed-abort cap).
+        self.inquiry_attempts[txid] = self.inquiry_attempts.get(txid, 0) + 1
 
     @staticmethod
     def _participant_reports_aborted(e: RpcError) -> bool:

@@ -104,6 +104,9 @@ class S3Handlers:
         self.sse = sse
         self.owner = owner
         self._policy_cache: dict[str, BucketPolicy | None] = {}
+        # Bumped on every invalidation: a cached-miss fetch only inserts
+        # if no put/delete landed while it was suspended on the read.
+        self._policy_epoch = 0
 
     # ------------------------------------------------------------- helpers
 
@@ -176,7 +179,7 @@ class S3Handlers:
                 raise  # a shed delete did NOT happen; don't report success
             except DfsError:
                 pass
-        self._policy_cache.pop(bucket, None)
+        self._invalidate_policy(bucket)
         return S3Response(status=204)
 
     async def get_bucket_location(self) -> S3Response:
@@ -655,11 +658,16 @@ class S3Handlers:
 
     # -------------------------------------------------------- bucket policy
 
+    def _invalidate_policy(self, bucket: str) -> None:
+        self._policy_cache.pop(bucket, None)
+        self._policy_epoch += 1
+
     async def get_bucket_policy_doc(self, bucket: str) -> BucketPolicy | None:
         """Cached lookup used by both the ?policy endpoints and the auth
         middleware (reference evaluates bucket policy in middleware)."""
         if bucket in self._policy_cache:
             return self._policy_cache[bucket]
+        epoch = self._policy_epoch
         try:
             raw = await self.client.get_file(f"/{bucket}/{POLICY_KEY}")
             policy = BucketPolicy.from_json(raw)
@@ -667,7 +675,11 @@ class S3Handlers:
             raise  # never cache "no policy" off a shed — that fails auth open
         except (DfsError, ValueError):
             policy = None
-        self._policy_cache[bucket] = policy
+        # Re-validate after the fetch await: a put/delete that landed while
+        # this read was suspended made the fetched document stale — caching
+        # it would pin pre-update auth decisions indefinitely.
+        if self._policy_epoch == epoch and bucket not in self._policy_cache:
+            self._policy_cache[bucket] = policy
         return policy
 
     async def get_bucket_policy(self, bucket: str) -> S3Response:
@@ -686,7 +698,7 @@ class S3Handlers:
         except (ValueError, json.JSONDecodeError):
             return _err("MalformedPolicy", "invalid policy document", 400)
         await self._publish(bucket, f"/{bucket}/{POLICY_KEY}", body, None)
-        self._policy_cache.pop(bucket, None)
+        self._invalidate_policy(bucket)
         return S3Response(status=204)
 
     async def delete_bucket_policy(self, bucket: str) -> S3Response:
@@ -696,7 +708,7 @@ class S3Handlers:
             raise
         except DfsError:
             pass
-        self._policy_cache.pop(bucket, None)
+        self._invalidate_policy(bucket)
         return S3Response(status=204)
 
 

@@ -66,16 +66,17 @@ class FaultProxy:
         # kill live pipes; then bound the wait — wait_closed() blocks until
         # every handler finishes and a blackholed pipe never would, and
         # losing a listener at teardown must not hang the harness.
-        if self._server is not None:
-            self._server.close()
+        # Swap-then-await so a concurrent stop() can't double-close.
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
         self.sever()
         for t in list(self._conns):
             t.cancel()
         self._conns.clear()
-        if self._server is not None:
+        if server is not None:
             with contextlib.suppress(asyncio.TimeoutError):
-                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
-            self._server = None
+                await asyncio.wait_for(server.wait_closed(), timeout=5.0)
 
     # ------------------------------------------------------------- toxics
 

@@ -1,0 +1,579 @@
+"""tpusched — a deterministic virtual-time asyncio event loop.
+
+The data path is deeply concurrent (the streamed-write pipeline overlaps
+net/CRC/disk/fanout stages; group commit batches concurrent writers; the
+QoS shedder parks and re-kicks waiters), but the stock event loop hides
+almost every interleaving: callbacks run in FIFO arrival order, timers in
+wall-clock order, and ``to_thread`` jobs land whenever the OS scheduler
+feels like it. A race that needs "writer B's commit callback runs between
+writer A's stage and A's ack" may be legal asyncio behavior and still
+never occur under pytest.
+
+This module makes the schedule a *first-class input*:
+
+- :class:`VirtualClockLoop` — an event loop that runs exactly ONE ready
+  callback per step, chosen by a pluggable :class:`Scheduler`; time is
+  virtual (``loop.time()`` only moves when every runnable callback is
+  blocked, jumping straight to the earliest timer), and ``run_in_executor``
+  / ``asyncio.to_thread`` jobs become ordinary scheduled steps instead of
+  real threads — so a whole scenario is a pure function of (code, seed).
+- Schedulers: :class:`FifoScheduler` (the baseline order),
+  :class:`RandomScheduler` (seeded), :class:`PrefixScheduler` (follow a
+  forced prefix of choices, FIFO after — the systematic explorer's
+  probe), :class:`ReplayScheduler` (re-run a recorded trace exactly).
+- :func:`run_scheduled` — run one scenario under one scheduler and
+  return its outcome plus the recorded choice trace.
+- :func:`explore` — bounded-preemption systematic exploration (delay
+  bounding a la CHESS) around the FIFO schedule, then seeded random
+  walks; stops at the first failing schedule and hands back its trace.
+- :func:`replay` — feed a failing trace back in; the same scenario code
+  deterministically reproduces the same failure.
+
+Every decision with more than one runnable candidate is recorded as
+``[chosen_index, n_candidates, label]``; a trace therefore serializes to
+a small JSON document (:func:`trace_to_json`) that CI can attach as an
+artifact and a developer can replay locally.
+
+Scenario contract: the ``body_factory`` passed to the drivers must build
+a FRESH scenario per call (fresh component objects, fresh tmp state) —
+exploration runs it many times, and state leaking across runs would make
+traces lie.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import functools
+import heapq
+import inspect
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Iterable
+
+__all__ = [
+    "DeadlockError",
+    "ExploreReport",
+    "FifoScheduler",
+    "InvariantViolation",
+    "PrefixScheduler",
+    "RandomScheduler",
+    "ReplayDivergence",
+    "ReplayScheduler",
+    "ScheduleResult",
+    "Scheduler",
+    "VirtualClockLoop",
+    "explore",
+    "replay",
+    "run_scheduled",
+    "trace_from_json",
+    "trace_to_json",
+]
+
+
+class InvariantViolation(AssertionError):
+    """A scenario invariant (ack=>durable, no-torn-visible, monotonic
+    step fence, ...) failed under the explored schedule."""
+
+
+class DeadlockError(RuntimeError):
+    """Quiescence with live tasks: nothing is runnable, no timer is
+    pending, and the scenario's root future is not done — a lost wakeup
+    or an await on an event nobody will ever set."""
+
+
+class ReplayDivergence(RuntimeError):
+    """A replayed trace stopped matching the live run — the scenario code
+    changed (or is nondeterministic) since the trace was recorded."""
+
+
+# --------------------------------------------------------------- schedulers
+
+
+class Scheduler:
+    """Chooses which runnable callback executes next. ``choose`` is only
+    consulted when there is a real decision (>= 2 candidates); every
+    decision is recorded in :attr:`choices` so any run is replayable."""
+
+    name = "fifo"
+    seed: int | None = None
+
+    def __init__(self) -> None:
+        self.choices: list[list] = []
+
+    def choose(self, labels: list[str]) -> int:
+        index = self._pick(labels)
+        self.choices.append([index, len(labels), labels[index]])
+        return index
+
+    def _pick(self, labels: list[str]) -> int:
+        return 0
+
+
+class FifoScheduler(Scheduler):
+    """Always the oldest callback — the stock event loop's order."""
+
+
+class RandomScheduler(Scheduler):
+    name = "random"
+
+    def __init__(self, seed: int):
+        super().__init__()
+        self.seed = seed
+        self._rng = random.Random(seed)
+
+    def _pick(self, labels: list[str]) -> int:
+        return self._rng.randrange(len(labels))
+
+
+class PrefixScheduler(Scheduler):
+    """Follow a forced prefix of choice indices, then FIFO. The
+    systematic explorer probes one deviation from a known schedule by
+    replaying its decisions up to the deviation point."""
+
+    name = "prefix"
+
+    def __init__(self, prefix: list[int]):
+        super().__init__()
+        self.prefix = list(prefix)
+
+    def _pick(self, labels: list[str]) -> int:
+        step = len(self.choices)
+        if step < len(self.prefix):
+            return min(self.prefix[step], len(labels) - 1)
+        return 0
+
+
+class ReplayScheduler(Scheduler):
+    """Re-run a recorded trace EXACTLY; any mismatch between the live
+    candidate set and the recorded one raises :class:`ReplayDivergence`
+    rather than silently exploring a different schedule."""
+
+    name = "replay"
+
+    def __init__(self, choices: list[list]):
+        super().__init__()
+        self._recorded = [list(c) for c in choices]
+
+    def _pick(self, labels: list[str]) -> int:
+        step = len(self.choices)
+        if step >= len(self._recorded):
+            raise ReplayDivergence(
+                f"trace exhausted at decision {step}: live run still has "
+                f"{len(labels)} candidates ({labels})")
+        index, ncand, label = self._recorded[step]
+        if ncand != len(labels):
+            raise ReplayDivergence(
+                f"decision {step}: trace saw {ncand} candidates, live run "
+                f"has {len(labels)} ({labels})")
+        return index
+
+
+# ------------------------------------------------------------ the event loop
+
+
+class VirtualClockLoop(asyncio.AbstractEventLoop):
+    """A from-scratch event loop: one scheduler-chosen callback per step,
+    virtual time, inline (but *scheduled*, hence interleavable) executor
+    jobs, deadlock detection on quiescence. Supports the asyncio subset
+    the repo's components use — tasks, futures, timers, to_thread,
+    streams over in-memory transports; real sockets are out of scope by
+    design (:meth:`create_connection` raises)."""
+
+    #: Virtual epoch — far from 0 so deltas against "uninitialized 0.0"
+    #: timestamps in components stay positive.
+    EPOCH = 1_000_000.0
+
+    def __init__(self, scheduler: Scheduler | None = None,
+                 max_steps: int = 200_000):
+        self.scheduler = scheduler or FifoScheduler()
+        self.max_steps = max_steps
+        self.steps = 0
+        self._now = self.EPOCH
+        self._ready: collections.deque[tuple[asyncio.Handle, str]] = \
+            collections.deque()
+        self._timers: list[tuple[float, int, asyncio.TimerHandle, str]] = []
+        self._timer_seq = 0
+        self._task_seq = 0
+        self._closed = False
+        self._running = False
+        self._exception_contexts: list[dict] = []
+        self._debug = False
+
+    # -- clock --------------------------------------------------------------
+
+    def time(self) -> float:
+        return self._now
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _label_for(self, callback: Callable, args: tuple) -> str:
+        cb = callback
+        while isinstance(cb, functools.partial):
+            cb = cb.func
+        owner = getattr(cb, "__self__", None)
+        if isinstance(owner, asyncio.Task):
+            return owner.get_name()
+        if isinstance(owner, asyncio.Future):
+            return "future-callback"
+        name = getattr(cb, "__qualname__", None) or repr(cb)
+        return name
+
+    def call_soon(self, callback, *args, context=None) -> asyncio.Handle:
+        self._check_closed()
+        handle = asyncio.Handle(callback, args, self, context)
+        self._ready.append((handle, self._label_for(callback, args)))
+        return handle
+
+    # Scenarios never touch real threads, so thread-safe == plain.
+    def call_soon_threadsafe(self, callback, *args, context=None):
+        return self.call_soon(callback, *args, context=context)
+
+    def call_later(self, delay, callback, *args, context=None):
+        return self.call_at(self._now + max(0.0, delay), callback, *args,
+                            context=context)
+
+    def call_at(self, when, callback, *args, context=None):
+        self._check_closed()
+        handle = asyncio.TimerHandle(when, callback, args, self, context)
+        self._timer_seq += 1
+        heapq.heappush(
+            self._timers,
+            (when, self._timer_seq, handle,
+             f"timer:{self._label_for(callback, args)}"))
+        return handle
+
+    def _timer_handle_cancelled(self, handle) -> None:
+        pass  # cancelled timers are skipped lazily at pop time
+
+    # -- futures / tasks ----------------------------------------------------
+
+    def create_future(self) -> asyncio.Future:
+        return asyncio.Future(loop=self)
+
+    def create_task(self, coro, *, name=None, context=None):
+        self._check_closed()
+        if name is None:
+            # Deterministic per-loop names: the global asyncio Task
+            # counter survives across runs in one process, which would
+            # make otherwise-identical traces differ by label.
+            self._task_seq += 1
+            name = f"task-{self._task_seq}:{_describe_coro(coro)}"
+        if context is None:
+            return asyncio.Task(coro, loop=self, name=name)
+        return asyncio.Task(coro, loop=self, name=name, context=context)
+
+    # -- executor -----------------------------------------------------------
+
+    def run_in_executor(self, executor, func, *args):
+        """A ``to_thread``/executor job becomes one scheduled step: the
+        callable runs synchronously *when the scheduler elects it*, so
+        "the staging thread finishes before/after X" is explorable
+        instead of being an OS accident."""
+        self._check_closed()
+        fut = self.create_future()
+        fn = func
+        while isinstance(fn, functools.partial):
+            fn = fn.func
+        label = f"thread:{getattr(fn, '__qualname__', repr(fn))}"
+
+        def _job() -> None:
+            if fut.cancelled():
+                return
+            try:
+                result = func(*args)
+            except BaseException as e:  # noqa: BLE001 — executor contract
+                fut.set_exception(e)
+            else:
+                fut.set_result(result)
+
+        handle = asyncio.Handle(_job, (), self, None)
+        self._ready.append((handle, label))
+        return fut
+
+    # -- introspection / plumbing ------------------------------------------
+
+    def get_debug(self) -> bool:
+        return self._debug
+
+    def set_debug(self, enabled: bool) -> None:
+        self._debug = enabled
+
+    def is_running(self) -> bool:
+        return self._running
+
+    def is_closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        self._closed = True
+
+    def _check_closed(self) -> None:
+        if self._closed:
+            raise RuntimeError("virtual clock loop is closed")
+
+    def default_exception_handler(self, context: dict) -> None:
+        self._exception_contexts.append(context)
+
+    def call_exception_handler(self, context: dict) -> None:
+        self.default_exception_handler(context)
+
+    async def shutdown_asyncgens(self) -> None:
+        pass
+
+    async def shutdown_default_executor(self) -> None:
+        pass
+
+    # -- the run loop -------------------------------------------------------
+
+    def _pop_due_timers(self) -> None:
+        while self._timers and self._timers[0][0] <= self._now:
+            _, _, handle, label = heapq.heappop(self._timers)
+            if not handle._cancelled:
+                self._ready.append((handle, label))
+
+    def _advance_to_next_timer(self) -> bool:
+        while self._timers:
+            when, _, handle, _ = self._timers[0]
+            if handle._cancelled:
+                heapq.heappop(self._timers)
+                continue
+            self._now = max(self._now, when)
+            self._pop_due_timers()
+            return True
+        return False
+
+    def _step(self) -> None:
+        """Run exactly one runnable callback, chosen by the scheduler."""
+        candidates = [(h, lb) for h, lb in self._ready if not h._cancelled]
+        self._ready.clear()
+        if len(candidates) > 1:
+            index = self.scheduler.choose([lb for _, lb in candidates])
+        else:
+            index = 0
+        chosen, _ = candidates.pop(index)
+        self._ready.extend(candidates)
+        self.steps += 1
+        chosen._run()
+
+    def run_until_complete(self, future: Awaitable) -> Any:
+        self._check_closed()
+        if self._running:
+            raise RuntimeError("loop already running")
+        main = asyncio.ensure_future(future, loop=self)
+        self._running = True
+        prev = asyncio.events._get_running_loop()
+        asyncio.events._set_running_loop(self)
+        try:
+            while not main.done():
+                if self.steps >= self.max_steps:
+                    main.cancel()
+                    self._drain_cancellation(main)
+                    raise RuntimeError(
+                        f"scenario exceeded {self.max_steps} steps "
+                        "(livelock under this schedule?)")
+                self._pop_due_timers()
+                if self._ready:
+                    self._step()
+                elif not self._advance_to_next_timer():
+                    pending = self._pending_tasks(exclude=main)
+                    main.cancel()
+                    self._drain_cancellation(main)
+                    raise DeadlockError(
+                        "quiescent with the scenario unfinished — blocked "
+                        "tasks: " + (", ".join(pending) or "<root only>"))
+            return main.result()
+        finally:
+            asyncio.events._set_running_loop(prev)
+            self._running = False
+
+    def _drain_cancellation(self, main: asyncio.Future) -> None:
+        """Give a just-cancelled scenario a bounded number of FIFO steps
+        to unwind its finally blocks, so its tasks don't die noisily at
+        interpreter exit."""
+        for _ in range(10_000):
+            if main.done() and not self._pending_tasks(exclude=None):
+                break
+            self._pop_due_timers()
+            if self._ready:
+                candidates = [(h, lb) for h, lb in self._ready
+                              if not h._cancelled]
+                self._ready.clear()
+                if not candidates:
+                    continue
+                chosen, _ = candidates.pop(0)
+                self._ready.extend(candidates)
+                chosen._run()
+            elif not self._advance_to_next_timer():
+                for task in asyncio.all_tasks(self):
+                    task.cancel()
+                if not self._pending_tasks(exclude=None):
+                    break
+        if main.done() and not main.cancelled():
+            main.exception()  # mark retrieved
+
+    def _pending_tasks(self, exclude) -> list[str]:
+        return sorted(
+            t.get_name() for t in asyncio.all_tasks(self)
+            if t is not exclude and not t.done()
+        )
+
+
+def _describe_coro(coro) -> str:
+    if inspect.iscoroutine(coro):
+        return getattr(coro, "__qualname__", coro.__class__.__name__)
+    return coro.__class__.__name__
+
+
+# ------------------------------------------------------------------ drivers
+
+
+@dataclass
+class ScheduleResult:
+    """Outcome of one scenario run under one schedule."""
+
+    ok: bool
+    error: str | None
+    error_type: str | None
+    steps: int
+    trace: dict  # serializable: scheduler, seed, choices
+    value: Any = None
+
+    def describe(self) -> str:
+        sched = self.trace.get("scheduler", "?")
+        seed = self.trace.get("seed")
+        tag = f"{sched}" + (f"(seed={seed})" if seed is not None else "")
+        if self.ok:
+            return f"ok [{tag}, {self.steps} steps]"
+        return f"{self.error_type}: {self.error} [{tag}, {self.steps} steps]"
+
+
+def run_scheduled(body_factory: Callable[[], Awaitable],
+                  scheduler: Scheduler | None = None,
+                  max_steps: int = 200_000) -> ScheduleResult:
+    """Run one fresh scenario under ``scheduler``; never raises — the
+    outcome (including deadlocks and invariant violations) is data."""
+    scheduler = scheduler or FifoScheduler()
+    loop = VirtualClockLoop(scheduler, max_steps=max_steps)
+    trace = {
+        "version": 1,
+        "kind": "tpusched-trace",
+        "scheduler": scheduler.name,
+        "seed": scheduler.seed,
+        "choices": scheduler.choices,
+    }
+    try:
+        value = loop.run_until_complete(body_factory())
+    except ReplayDivergence:
+        raise
+    except BaseException as e:  # noqa: BLE001 — outcome is data
+        return ScheduleResult(
+            ok=False, error=str(e), error_type=type(e).__name__,
+            steps=loop.steps, trace=trace)
+    finally:
+        loop.close()
+    return ScheduleResult(ok=True, error=None, error_type=None,
+                          steps=loop.steps, trace=trace, value=value)
+
+
+def trace_to_json(trace: dict) -> str:
+    """Canonical serialization — byte-identical for identical schedules."""
+    return json.dumps(trace, sort_keys=True, separators=(",", ":"))
+
+
+def trace_from_json(text: str) -> dict:
+    doc = json.loads(text)
+    if doc.get("kind") != "tpusched-trace":
+        raise ValueError("not a tpusched trace document")
+    return doc
+
+
+def replay(body_factory: Callable[[], Awaitable], trace: dict,
+           max_steps: int = 200_000) -> ScheduleResult:
+    """Re-run a recorded schedule exactly. :class:`ReplayDivergence`
+    propagates — a diverging replay is a harness bug, not a scenario
+    outcome."""
+    return run_scheduled(
+        body_factory, ReplayScheduler(trace["choices"]), max_steps=max_steps)
+
+
+@dataclass
+class ExploreReport:
+    """What :func:`explore` covered and what it found."""
+
+    runs: int
+    failure: ScheduleResult | None
+    schedules_ok: int
+    decision_points: int
+    results: list[ScheduleResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.failure is None
+
+
+def explore(body_factory: Callable[[], Awaitable], *,
+            preemption_bound: int = 2,
+            max_runs: int = 64,
+            seeds: Iterable[int] = (),
+            max_steps: int = 200_000,
+            stop_on_fail: bool = True,
+            keep_results: bool = False) -> ExploreReport:
+    """Bounded-preemption systematic exploration + seeded random walks.
+
+    Pass 1 runs the FIFO schedule and records its decision points. The
+    systematic frontier then probes every single-decision deviation from
+    an already-explored schedule, depth-first, never deviating more than
+    ``preemption_bound`` times per schedule (delay bounding: most real
+    ordering bugs need only 1-2 forced preemptions). Whatever budget is
+    left after ``max_runs`` systematic probes goes to seeded
+    :class:`RandomScheduler` walks for long-tail coverage.
+    """
+    seeds = list(seeds)
+    report = ExploreReport(runs=0, failure=None, schedules_ok=0,
+                           decision_points=0)
+
+    def one(scheduler: Scheduler) -> ScheduleResult:
+        result = run_scheduled(body_factory, scheduler,
+                               max_steps=max_steps)
+        report.runs += 1
+        if result.ok:
+            report.schedules_ok += 1
+        elif report.failure is None:
+            report.failure = result
+        if keep_results:
+            report.results.append(result)
+        return result
+
+    first = one(PrefixScheduler([]))
+    report.decision_points = len(first.trace["choices"])
+    if not first.ok and stop_on_fail:
+        return report
+
+    # Depth-first frontier of deviations: (prefix, deviations_used).
+    frontier: list[tuple[list[int], int]] = []
+
+    def push_deviations(choices: list[list], start: int,
+                        used: int) -> None:
+        for i in range(len(choices) - 1, start - 1, -1):
+            index, ncand, _label = choices[i]
+            base = [c[0] for c in choices[:i]]
+            for alt in range(ncand - 1, -1, -1):
+                if alt != index:
+                    frontier.append((base + [alt], used + 1))
+
+    push_deviations(first.trace["choices"], 0, 0)
+    while frontier and report.runs < max_runs:
+        prefix, used = frontier.pop()
+        result = one(PrefixScheduler(prefix))
+        if not result.ok and stop_on_fail:
+            return report
+        if used < preemption_bound:
+            push_deviations(result.trace["choices"], len(prefix), used)
+
+    for seed in seeds:
+        result = one(RandomScheduler(seed))
+        if not result.ok and stop_on_fail:
+            return report
+    return report
