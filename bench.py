@@ -600,6 +600,7 @@ async def _run_ckpt() -> dict:
             "ckpt_restore_degraded_GBps": round(med(degraded_samples), 3),
             "ckpt_restore_degraded_win": _winmm(degraded_samples),
             "plain_write_GBps": round(plain, 3),
+            "copies_per_byte": _ledger_copies_per_byte(),
             "ckpt_shards": CKPT_SHARDS,
             "ckpt_steps": CKPT_STEPS,
             "ckpt_logical_bytes_per_step": logical,
@@ -1331,6 +1332,10 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
         "files": FILES,
         "cache_read_GBps": round(med(cache_samples), 3),
         "cache_read_win": _winmm(cache_samples),
+        # Static copies-per-byte per swept route, from the committed
+        # copy_ledger.json — the budget the lint gate enforces, sitting
+        # next to the GB/s it predicts (TPL06x, docs/static-analysis.md).
+        "copies_per_byte": _ledger_copies_per_byte(),
         "cache_read_p50_ms": round(_pct(cache_lat, 0.50) * 1e3, 2),
         "cache_read_p99_ms": round(_pct(cache_lat, 0.99) * 1e3, 2),
         "cache_read_ops": len(cache_lat),
@@ -1353,6 +1358,31 @@ async def _run_against(maddr: str, cs_addrs: list[str]) -> dict:
             "write": [round(x, 3) for x in write_samples],
         }} if __import__("os").environ.get("BENCH_DEBUG") else {}),
     }
+
+
+def _ledger_copies_per_byte() -> dict:
+    """Static copies-per-byte column from the committed byte-cost ledger
+    (tpudfs/analysis/copy_ledger.json, docs/static-analysis.md TPL06x),
+    keyed by the bench column each route's GB/s lands in. Read straight
+    from the committed file — the budget the CI gate enforces — so the
+    bench path pays no call-graph build."""
+    import os
+
+    route_for_column = {
+        "cache_read": "cache_hit_read",
+        "warm_infeed_read": "warm_infeed_read",
+        "write_pipeline": "chain_write",
+        "ici_ec_scatter": "ec_encode_scatter",
+        "ckpt": "ckpt_stage_publish",
+    }
+    try:
+        with open(_repo_path(
+                os.path.join("tpudfs", "analysis", "copy_ledger.json"))) as f:
+            routes = json.load(f)["routes"]
+    except (OSError, ValueError, KeyError):
+        return {}
+    return {col: routes[name]["copies"]
+            for col, name in route_for_column.items() if name in routes}
 
 
 def _winmm(xs: list, nd: int = 3) -> list:

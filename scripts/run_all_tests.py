@@ -209,6 +209,13 @@ def main() -> None:
     run("lint (tpulint.sarif artifact)",
         [sys.executable, "-m", "tpudfs.analysis",
          "--format", "sarif", "--output", "tpulint.sarif", "-q"])
+    # Byte-cost ledger drift gate: the committed copy_ledger.json must
+    # match the tree exactly (staleness) and no data-plane route may
+    # spend more full-buffer copies than its committed budget (breach).
+    # One injected bytes(view) on the write path fails here with the
+    # exact file:line hop (docs/static-analysis.md, TPL06x).
+    run("byte-cost ledger gate (copy_ledger.json)",
+        [sys.executable, "-m", "tpudfs.analysis", "--check-ledger"])
     # Dynamic half of the TPL042/TPL043 native-concurrency contract: build
     # dataplane.cc with -fsanitize=thread and stress the streaming write
     # engine (concurrent streams, mid-stream aborts, stats polling from a

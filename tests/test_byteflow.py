@@ -1,0 +1,411 @@
+"""tpuflow byte-cost ledger + zero-copy rules (TPL060-TPL064).
+
+Three layers under test:
+
+- the ledger machinery itself (route membership, copy classification,
+  round-trip, staleness, budget breaches) on small fixture trees;
+- the five TPL06x rules with a positive and a negative fixture each —
+  fixtures live at hot-root module paths (``tpudfs/common/blocknet.py``
+  etc.) because the site rules only judge hot-path functions;
+- the mutation proof: one injected ``bytes(view)`` copy in a copy of
+  the REAL write route must flip the ledger gate red and light the
+  TPL060 ratchet — the property the CI gate exists for.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import shutil
+import textwrap
+
+from tpudfs.analysis import byteflow
+from tpudfs.analysis import cli
+from tpudfs.analysis.linter import all_rules, analyze_tree
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def lint_tree(tmp_path, files: dict, rules: list[str]):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    selected = [all_rules()[r] for r in rules]
+    return analyze_tree([tmp_path], tmp_path, selected)
+
+
+def rule_ids(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ------------------------------------------------------------------ TPL060
+
+
+def test_tpl060_flags_memoryview_coerced_to_bytes(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            async def _call_blockport(w, data: bytes):
+                view = memoryview(data)
+                return bytes(view)
+        """,
+    }, rules=["TPL060"])
+    assert rule_ids(findings) == ["TPL060"]
+    assert "bytes(view)" in findings[0].message
+
+
+def test_tpl060_quiet_when_view_stays_a_view(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            async def _call_blockport(w, data: bytes):
+                view = memoryview(data)
+                w.write(view)
+                return len(view)
+        """,
+    }, rules=["TPL060"])
+    assert findings == []
+
+
+def test_tpl060_quiet_off_the_hot_path(tmp_path):
+    # Same escape in a config-loader module: not hot, no finding.
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/confload.py": """
+            def load(data: bytes):
+                view = memoryview(data)
+                return bytes(view)
+        """,
+    }, rules=["TPL060"])
+    assert findings == []
+
+
+# ------------------------------------------------------------------ TPL061
+
+
+def test_tpl061_flags_per_frame_allocation(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            FRAME = 65536
+
+            async def _call_blockport(r):
+                total = 0
+                while True:
+                    buf = bytearray(FRAME)
+                    n = await r.readinto(buf)
+                    if not n:
+                        break
+                    total += n
+                return total
+        """,
+    }, rules=["TPL061"])
+    assert rule_ids(findings) == ["TPL061"]
+    assert "every iteration" in findings[0].message
+
+
+def test_tpl061_quiet_when_hoisted_or_escaping(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            FRAME = 65536
+
+            async def _call_blockport(r, parts):
+                buf = bytearray(FRAME)          # hoisted: fine
+                while True:
+                    n = await r.readinto(buf)
+                    if not n:
+                        break
+                while True:
+                    chunk = bytearray(FRAME)    # escapes: each chunk is
+                    parts.append(chunk)         # retained, no ring fits
+                    if not await r.readinto(chunk):
+                        break
+        """,
+    }, rules=["TPL061"])
+    assert findings == []
+
+
+def test_tpl061_quiet_when_size_is_loop_dependent(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            async def _call_blockport(r, sizes):
+                for n in sizes:
+                    buf = bytearray(n)          # size varies per frame
+                    await r.readinto(buf)
+        """,
+    }, rules=["TPL061"])
+    assert findings == []
+
+
+# ------------------------------------------------------------------ TPL062
+
+
+def test_tpl062_flags_hidden_stdlib_copies(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            async def _call_blockport(w, payload: bytes):
+                frame = b"".join([payload])
+                round_trip = bytes(bytearray(payload))
+                w.write(payload.hex())
+        """,
+    }, rules=["TPL062"])
+    assert rule_ids(findings) == ["TPL062", "TPL062", "TPL062"]
+
+
+def test_tpl062_quiet_on_real_joins_and_digests(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            async def _call_blockport(w, parts, payload: bytes):
+                frame = b"".join(parts)       # real n-way flatten
+                tag = digest.hex()            # 16-byte digest, not payload
+                return frame, tag
+        """,
+    }, rules=["TPL062"])
+    assert findings == []
+
+
+# ------------------------------------------------------------------ TPL063
+
+
+def test_tpl063_flags_double_pack_on_one_path(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            from msgpack import packb
+
+            async def _call_blockport(w, payload: bytes):
+                body = packb(payload)
+                frame = packb(payload)
+                return body, frame
+        """,
+    }, rules=["TPL063"])
+    assert rule_ids(findings) == ["TPL063"]
+    assert "payload" in findings[0].message
+
+
+def test_tpl063_quiet_across_exclusive_branches(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "tpudfs/common/blocknet.py": """
+            from msgpack import packb
+
+            async def _call_blockport(w, payload: bytes, fast: bool):
+                if fast:
+                    return packb(payload)
+                return packb(payload)
+        """,
+    }, rules=["TPL063"])
+    assert findings == []
+
+
+# ------------------------------------------------------------------ TPL064
+
+#: Minimal two-route tree: ChunkServer.rpc_read_block is a
+#: cache_hit_read entry, rpc_read_blocks a warm_infeed_read entry, both
+#: inside a route-scoped module path.
+_TPL064_TREE = {
+    "tpudfs/chunkserver/service.py": """
+        class ChunkServer:
+            async def rpc_read_block(self, req):
+                data = self.store.read(req["block_id"])
+                {cache_body}
+
+            async def rpc_read_blocks(self, req):
+                out = []
+                for bid in req["block_ids"]:
+                    out.append(self.store.read(bid))
+                return {{"data_parts": out}}
+    """,
+}
+
+
+def _tpl064_findings(tmp_path, cache_body: str):
+    files = {
+        rel: src.replace("{cache_body}", cache_body)
+        for rel, src in _TPL064_TREE.items()
+    }
+    return lint_tree(tmp_path, files, rules=["TPL064"])
+
+
+def test_tpl064_fires_when_cache_route_outspends_direct(tmp_path):
+    findings = _tpl064_findings(
+        tmp_path, 'return {"data": bytes(data)}')
+    assert rule_ids(findings) == ["TPL064"]
+    assert "cache-hit route" in findings[0].message
+    # The message names the excess hop so the diff is actionable.
+    assert "service.py" in findings[0].message
+
+
+def test_tpl064_quiet_when_cache_route_is_as_lean(tmp_path):
+    findings = _tpl064_findings(
+        tmp_path, 'return {"data_parts": [memoryview(data)]}')
+    assert findings == []
+
+
+# --------------------------------------------------------- ledger machinery
+
+
+def test_ledger_round_trip_and_staleness(tmp_path):
+    (tmp_path / "tpudfs/chunkserver").mkdir(parents=True)
+    svc = tmp_path / "tpudfs/chunkserver/service.py"
+    svc.write_text(textwrap.dedent("""
+        class ChunkServer:
+            async def rpc_read_block(self, req):
+                data = self.store.read(req["block_id"])
+                return {"data": bytes(data)}
+    """))
+    computed = byteflow.ledger_for_project(tmp_path)
+    assert set(computed["routes"]) == {s.name for s in byteflow.ROUTES}
+    assert computed["routes"]["cache_hit_read"]["copies"] == 1
+
+    byteflow.write_ledger_file(tmp_path, computed)
+    committed = byteflow.load_committed_ledger(tmp_path)
+    assert committed == computed
+    assert not byteflow.ledger_is_stale(computed, committed)
+    assert byteflow.check_ledger(computed, committed) == []
+
+    # Removing the copy makes the committed file stale (budget still
+    # holds — shrinking is legal, staleness is the sync gate's job).
+    svc.write_text(svc.read_text().replace("bytes(data)", "data"))
+    fresh = byteflow.ledger_for_project(tmp_path)
+    assert byteflow.check_ledger(fresh, committed) == []
+    assert byteflow.ledger_is_stale(fresh, committed)
+
+
+def test_check_ledger_names_route_and_new_hop():
+    budget = {"routes": {"chain_write": {"copies": 0, "hops": []}}}
+    live = {"routes": {"chain_write": {
+        "copies": 1,
+        "hops": ["tpudfs/x.py:3 copy:bytes() [f]"],
+    }}}
+    breaches = byteflow.check_ledger(live, budget)
+    assert len(breaches) == 1
+    assert "chain_write" in breaches[0]
+    assert "tpudfs/x.py:3" in breaches[0]
+    # A vanished route is a breach too (the budget lost its subject).
+    assert byteflow.check_ledger({"routes": {}}, budget)
+
+
+def test_routes_for_files_maps_modules_and_ledger():
+    assert "chain_write" in byteflow.routes_for_files(
+        ["tpudfs/common/writestream.py"])
+    assert byteflow.routes_for_files(["tpudfs/raft/core.py"]) == []
+    # A budget edit re-gates every route.
+    assert byteflow.routes_for_files([byteflow.LEDGER_REL_PATH]) \
+        == [s.name for s in byteflow.ROUTES]
+
+
+def test_write_ledger_cli_refuses_silent_growth(tmp_path, capsys):
+    (tmp_path / "tpudfs/chunkserver").mkdir(parents=True)
+    (tmp_path / "tpudfs/chunkserver/service.py").write_text(
+        textwrap.dedent("""
+            class ChunkServer:
+                async def rpc_write_block(self, req):
+                    data = self.store.read(req["block_id"])
+                    return {"n": len(bytes(data))}
+        """))
+    ledger = byteflow.ledger_for_project(tmp_path)
+    assert ledger["routes"]["chain_write"]["copies"] == 1
+    # Commit a stricter budget, then try to regenerate over it.
+    tight = json.loads(json.dumps(ledger))
+    tight["routes"]["chain_write"]["copies"] = 0
+    tight["routes"]["chain_write"]["hops"] = []
+    byteflow.write_ledger_file(tmp_path, tight)
+
+    assert cli.write_ledger(tmp_path) == 2
+    assert "refusing" in capsys.readouterr().err
+    assert byteflow.load_committed_ledger(tmp_path) == tight  # untouched
+
+    assert cli.check_ledger_gate(tmp_path) == 1
+    assert "ledger breach" in capsys.readouterr().err
+
+    # Explicit growth is allowed — and reviewed by the diff it produces.
+    assert cli.write_ledger(tmp_path, allow_growth=True) == 0
+    assert byteflow.load_committed_ledger(tmp_path) == ledger
+    assert cli.check_ledger_gate(tmp_path) == 0
+
+
+def test_check_ledger_gate_flags_stale_file(tmp_path, capsys):
+    (tmp_path / "tpudfs/chunkserver").mkdir(parents=True)
+    svc = tmp_path / "tpudfs/chunkserver/service.py"
+    svc.write_text(textwrap.dedent("""
+        class ChunkServer:
+            async def rpc_read_block(self, req):
+                data = self.store.read(req["block_id"])
+                return {"data": bytes(data)}
+    """))
+    byteflow.write_ledger_file(
+        tmp_path, byteflow.ledger_for_project(tmp_path))
+    assert cli.check_ledger_gate(tmp_path, quiet=True) == 0
+    # The tree gets leaner; the committed file must follow.
+    svc.write_text(svc.read_text().replace("bytes(data)", "data"))
+    assert cli.check_ledger_gate(tmp_path, quiet=True) == 1
+    assert "stale" in capsys.readouterr().err
+
+
+# --------------------------------------------- mutation proof (real tree)
+
+#: The real chain-write route's modules, copied verbatim for mutation.
+REAL_WRITE_ROUTE = (
+    "tpudfs/client/client.py",
+    "tpudfs/common/writestream.py",
+    "tpudfs/common/blocknet.py",
+    "tpudfs/chunkserver/service.py",
+    "tpudfs/chunkserver/blockstore.py",
+)
+
+
+def _copy_write_route(tmp_path) -> pathlib.Path:
+    for rel in REAL_WRITE_ROUTE:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return tmp_path
+
+
+def test_mutation_one_bytes_view_copy_fails_the_gate(tmp_path):
+    """THE ratchet property: inject exactly one `bytes(view)` into the
+    real write route and both gates go red — the ledger budget check
+    (new copy over budget) and the TPL060 ratchet (new finding)."""
+    root = _copy_write_route(tmp_path)
+    baseline = byteflow.ledger_for_project(root)
+    assert byteflow.check_ledger(baseline, baseline) == []
+
+    svc = root / "tpudfs/chunkserver/service.py"
+    src = svc.read_text()
+    needle = "    async def rpc_write_block(self, req: dict) -> dict:\n"
+    assert needle in src, "rpc_write_block entry moved; update the test"
+    src = src.replace(
+        needle,
+        needle + '        _mv = memoryview(req["data"]); '
+                 '_leak = bytes(_mv)\n',
+        1,
+    )
+    svc.write_text(src)
+
+    mutated = byteflow.ledger_for_project(root)
+    assert mutated["routes"]["chain_write"]["copies"] \
+        == baseline["routes"]["chain_write"]["copies"] + 1
+    breaches = byteflow.check_ledger(mutated, baseline)
+    assert breaches and "chain_write" in breaches[0]
+    assert re.search(r"service\.py:\d+ copy:bytes\(\)", breaches[0])
+
+    # And the suppression-proof rule ratchet sees the same copy.
+    findings = analyze_tree(
+        [root], root, [all_rules()["TPL060"]])
+    assert "TPL060" in rule_ids(findings)
+
+
+def test_committed_ledger_matches_tree_and_budgets_hold():
+    """The repo's own gate, as run_all_tests drives it: the committed
+    copy_ledger.json is in exact sync with the tree, every route is
+    present, and the cache route's budget is at/below the direct
+    read's (TPL064 stays quiet)."""
+    committed = byteflow.load_committed_ledger(REPO)
+    assert committed is not None, "copy_ledger.json must be committed"
+    assert set(committed["routes"]) == {s.name for s in byteflow.ROUTES}
+    computed = byteflow.ledger_for_project(REPO)
+    assert byteflow.check_ledger(computed, committed) == []
+    assert not byteflow.ledger_is_stale(computed, committed), (
+        "copy_ledger.json is stale — run "
+        "`python -m tpudfs.analysis --write-ledger`"
+    )
+    cache = committed["routes"][byteflow.CACHE_ROUTE]
+    direct = committed["routes"][byteflow.DIRECT_ROUTE]
+    assert cache["copies"] <= direct["copies"]

@@ -392,7 +392,8 @@ def test_every_rule_is_registered():
             "TPL007", "TPL010", "TPL011", "TPL012", "TPL013", "TPL014",
             "TPL020", "TPL021", "TPL022", "TPL023", "TPL024", "TPL025",
             "TPL030", "TPL031", "TPL032", "TPL033", "TPL034",
-            "TPL050", "TPL051", "TPL052"} <= ids
+            "TPL050", "TPL051", "TPL052",
+            "TPL060", "TPL061", "TPL062", "TPL063", "TPL064"} <= ids
 
 
 def test_every_rule_carries_explain_metadata():
@@ -875,6 +876,18 @@ def test_suppression_inventory_and_baseline_have_not_grown():
             f"suppression of a TPL05x protocol-ordering rule at "
             f"{s['path']}:{s['line']} — fix the interleaving hazard "
             "instead (see docs/static-analysis.md)"
+        )
+    # And for the zero-copy rules (TPL060-TPL064): the byte-cost ledger
+    # launched with the tree at zero via real fixes (the cache-hit route
+    # now serves memoryviews through scatter framing). A suppression
+    # here would hide a copy the committed ledger still budgets for —
+    # the ratchet's red diff is the whole point.
+    flow_rules = {f"TPL06{i}" for i in range(5)}
+    for s in current:
+        assert not flow_rules & set(s["rules"]), (
+            f"suppression of a TPL06x zero-copy rule at "
+            f"{s['path']}:{s['line']} — remove the copy instead "
+            "(see docs/static-analysis.md)"
         )
     baseline = load_baseline(BASELINE)
     assert len(baseline) <= committed["baseline_size"]

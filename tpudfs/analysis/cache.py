@@ -42,7 +42,7 @@ from tpudfs.analysis.linter import (
     iter_python_files,
 )
 
-CACHE_VERSION = 3
+CACHE_VERSION = 4
 
 DEFAULT_CACHE_NAME = ".tpulint_cache.json"
 
@@ -124,6 +124,20 @@ def analyze_tree_cached(
         native_list.append(
             (path.resolve().relative_to(root.resolve()).as_posix(),
              digest))
+
+    # The committed byte-cost ledger enters the tree hash too: TPL064 and
+    # the --check-ledger gate compare the tree against it, so editing the
+    # budget file must invalidate the cached project findings even though
+    # no Python source changed.
+    from tpudfs.analysis.byteflow import LEDGER_REL_PATH
+
+    ledger_path = root / LEDGER_REL_PATH
+    if ledger_path.is_file():
+        try:
+            digest = hashlib.sha256(ledger_path.read_bytes()).hexdigest()
+        except OSError:
+            digest = ""
+        native_list.append((LEDGER_REL_PATH, digest))
 
     tree_hash = hashlib.sha256(
         "\n".join(f"{rel}\x1f{h}" for rel, h in sorted(

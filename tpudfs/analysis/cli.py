@@ -12,6 +12,8 @@
     python -m tpudfs.analysis --profile TPL030  # one rule, per-unit timing
     python -m tpudfs.analysis --no-baseline   # show grandfathered too
     python -m tpudfs.analysis --write-rule-table  # sync docs table
+    python -m tpudfs.analysis --write-ledger  # regenerate copy_ledger.json
+    python -m tpudfs.analysis --check-ledger  # byte-cost budget gate
 
 Full-tree runs reuse a content-hash cache (``.tpulint_cache.json`` at the
 repo root, git-ignored) so the common nothing-changed case costs file
@@ -79,6 +81,19 @@ def _parser() -> argparse.ArgumentParser:
                         "current extern \"C\" dataplane exports; refuses "
                         "if signatures changed without an ABI version "
                         "bump")
+    p.add_argument("--write-ledger", action="store_true",
+                   help="regenerate the byte-cost ledger "
+                        "(tpudfs/analysis/copy_ledger.json) from the "
+                        "current tree; refuses if any route's copy count "
+                        "grew over the committed budget")
+    p.add_argument("--ledger-allow-growth", action="store_true",
+                   help="with --write-ledger: accept a route's copy "
+                        "count growing over the committed budget (use "
+                        "when a copy is added deliberately and reviewed)")
+    p.add_argument("--check-ledger", action="store_true",
+                   help="verify the committed byte-cost ledger: exit 1 "
+                        "when any route's copies exceed its budget or "
+                        "the file is stale vs the tree")
     p.add_argument("--rule", action="append", dest="rules", metavar="TPLxxx",
                    help="run only these rule ids (repeatable)")
     p.add_argument("--format", choices=("text", "json", "sarif"),
@@ -120,6 +135,22 @@ NATIVE_COUNTERPART_MODULES: tuple[str, ...] = (
 )
 
 
+def _ledger_file_changed(root: pathlib.Path) -> bool:
+    """Did the committed copy_ledger.json itself change vs merge-base?
+    A budget edit affects every route, so --changed must re-gate them
+    all even though no Python file moved."""
+    from tpudfs.analysis.byteflow import LEDGER_REL_PATH
+
+    try:
+        base = _git_lines(root, "merge-base", "HEAD", "main")[0]
+        names = _git_lines(root, "diff", "--name-only", base)
+        names += _git_lines(root, "ls-files", "--others",
+                            "--exclude-standard")
+    except (subprocess.CalledProcessError, OSError, IndexError):
+        return False
+    return LEDGER_REL_PATH in names
+
+
 def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
     """Python files differing from ``git merge-base HEAD main``, plus
     untracked ones. None when git/merge-base is unavailable (detached
@@ -129,7 +160,12 @@ def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
     list itself (the tree walker lints Python sources); instead it pulls
     in :data:`NATIVE_COUNTERPART_MODULES`, which makes the TPL04x
     cross-language rules re-check the native tree against its Python
-    counterparts — previously a dataplane.cc edit ran zero rules."""
+    counterparts — previously a dataplane.cc edit ran zero rules. The
+    same widening applies when any ONE counterpart module changes:
+    TPL041 pairs native wire constants against the whole counterpart
+    set, so a subset holding service.py without blocknet.py would
+    "miss" every header key blocknet defines and report phantom
+    drift."""
     try:
         base = _git_lines(root, "merge-base", "HEAD", "main")[0]
         names = _git_lines(root, "diff", "--name-only", base)
@@ -138,15 +174,17 @@ def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
     except (subprocess.CalledProcessError, OSError, IndexError):
         return None
     out = []
-    native_changed = False
+    widen_native = False
     for name in sorted(set(names)):
         p = root / name
         if name.endswith(".py") and p.exists():
             out.append(p)
+            if name in NATIVE_COUNTERPART_MODULES:
+                widen_native = True
         elif name.endswith((".cc", ".h")) and name.startswith("native/") \
                 and p.exists():
-            native_changed = True
-    if native_changed:
+            widen_native = True
+    if widen_native:
         for rel in NATIVE_COUNTERPART_MODULES:
             p = root / rel
             if p.exists():
@@ -155,7 +193,7 @@ def changed_paths(root: pathlib.Path) -> list[pathlib.Path] | None:
 
 
 def hot_caller_files(
-    root: pathlib.Path, changed: list[pathlib.Path]
+    root: pathlib.Path, changed: list[pathlib.Path], project=None
 ) -> list[pathlib.Path]:
     """Unchanged files that contain *hot-path* callers of functions
     defined in ``changed``.
@@ -170,19 +208,13 @@ def hot_caller_files(
     off the data plane the TPL03x rules never fire, and widening to every
     caller would turn most edits into full-tree lints.
     """
-    from tpudfs.analysis.callgraph import Project
+    from tpudfs.analysis import byteflow
     from tpudfs.analysis.hotpath import hot_paths
 
-    pkg = root / "tpudfs"
-    base = pkg if pkg.is_dir() else root
-    modules = {}
-    for path in linter.iter_python_files(base):
-        module, _errors = linter._load_module(path, root)
-        if module is not None:
-            modules[module.rel_path] = module
-    if not modules:
+    if project is None:
+        project = byteflow.load_project(root)
+    if not project.modules:
         return []
-    project = Project(modules)
     hp = hot_paths(project)
     changed_set = {p.resolve() for p in changed}
     extra: set[pathlib.Path] = set()
@@ -250,6 +282,78 @@ def write_native_abi(root: pathlib.Path) -> int:
     return 0
 
 
+def write_ledger(root: pathlib.Path, allow_growth: bool = False) -> int:
+    """Regenerate ``tpudfs/analysis/copy_ledger.json``. Refuses (exit 2)
+    when a route's copy count grew over the committed budget — silent
+    regeneration would turn the ratchet into a rubber stamp; growth must
+    be explicit (``--ledger-allow-growth``) and reviewed."""
+    from tpudfs.analysis import byteflow
+
+    ledger = byteflow.ledger_for_project(root)
+    committed = byteflow.load_committed_ledger(root)
+    if committed is not None and not allow_growth:
+        breaches = byteflow.check_ledger(ledger, committed)
+        if breaches:
+            print("tpulint: --write-ledger: refusing to regenerate — "
+                  "the new ledger GROWS a route's copy budget:",
+                  file=sys.stderr)
+            for msg in breaches:
+                print(f"  {msg}", file=sys.stderr)
+            print("Remove the copy (preferred), or rerun with "
+                  "--ledger-allow-growth if the new copy is deliberate.",
+                  file=sys.stderr)
+            return 2
+    byteflow.write_ledger_file(root, ledger)
+    routes = ledger["routes"]
+    total = sum(r["copies"] for r in routes.values())
+    print(f"wrote byte-cost ledger: {len(routes)} route(s), "
+          f"{total} copy hop(s) -> {root / byteflow.LEDGER_REL_PATH}")
+    return 0
+
+
+def check_ledger_gate(root: pathlib.Path, project=None,
+                      routes: list[str] | None = None,
+                      quiet: bool = False) -> int:
+    """CI gate for the committed byte-cost ledger. Full mode (``routes``
+    None): any budget breach OR staleness (ledger != tree) fails. Changed
+    mode (``routes`` given, from ``--changed``): only budget breaches on
+    the affected routes fail — staleness on untouched routes is the full
+    gate's job, not the warm pre-commit's."""
+    from tpudfs.analysis import byteflow
+
+    committed = byteflow.load_committed_ledger(root)
+    if committed is None:
+        print(f"tpulint: no committed ledger at "
+              f"{root / byteflow.LEDGER_REL_PATH}; run --write-ledger",
+              file=sys.stderr)
+        return 1
+    if project is None:
+        project = byteflow.load_project(root)
+    computed = byteflow.compute_ledger(project)
+    breaches = byteflow.check_ledger(computed, committed)
+    if routes is not None:
+        affected = set(routes)
+        breaches = [m for m in breaches
+                    if m.split(":", 1)[0].removeprefix("route ").strip()
+                    in affected]
+    for msg in breaches:
+        print(f"tpulint: ledger breach: {msg}", file=sys.stderr)
+    if routes is None and not breaches \
+            and byteflow.ledger_is_stale(computed, committed):
+        print("tpulint: copy_ledger.json is stale (the tree's byte-cost "
+              "ledger no longer matches the committed file); run "
+              "`python -m tpudfs.analysis --write-ledger`",
+              file=sys.stderr)
+        return 1
+    if breaches:
+        return 1
+    if not quiet:
+        scope = f"{len(routes)} affected route(s)" if routes is not None \
+            else f"all {len(committed.get('routes', {}))} route(s)"
+        print(f"tpulint: byte-cost ledger holds for {scope}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _parser().parse_args(argv)
 
@@ -280,6 +384,12 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.write_native_abi:
         return write_native_abi(args.root)
+
+    if args.write_ledger:
+        return write_ledger(args.root, args.ledger_allow_growth)
+
+    if args.check_ledger:
+        return check_ledger_gate(args.root, quiet=args.quiet)
 
     selected = None
     if args.rules:
@@ -316,6 +426,7 @@ def main(argv: list[str] | None = None) -> int:
         custom = args.root / "tpudfs"
         paths = [custom if custom.is_dir() else args.root]
     changed_subset = False
+    ledger_rc = 0
     if args.changed:
         if args.paths:
             print("--changed and explicit paths are mutually exclusive",
@@ -336,11 +447,33 @@ def main(argv: list[str] | None = None) -> int:
                       "changed since merge-base with main")
             return 0
         else:
-            extra = hot_caller_files(args.root, subset)
+            from tpudfs.analysis import byteflow
+
+            # One project build serves both the hot-path caller widening
+            # and the per-route ledger drift check — the 2s warm-lint
+            # budget cannot afford two full parses.
+            project = byteflow.load_project(args.root)
+            extra = hot_caller_files(args.root, subset, project=project)
             if extra and not args.quiet:
                 print(f"tpulint: --changed: widening to {len(extra)} "
                       "unchanged file(s) whose hot-path functions call "
                       "into the changed set", file=sys.stderr)
+            root_res = args.root.resolve()
+            rel_changed = [
+                p.resolve().relative_to(root_res).as_posix()
+                for p in subset
+            ]
+            if _ledger_file_changed(args.root):
+                rel_changed.append(byteflow.LEDGER_REL_PATH)
+            affected = byteflow.routes_for_files(rel_changed)
+            if affected:
+                if not args.quiet:
+                    print("tpulint: --changed: checking ledger budget "
+                          f"for route(s): {', '.join(affected)}",
+                          file=sys.stderr)
+                ledger_rc = check_ledger_gate(
+                    args.root, project=project, routes=affected,
+                    quiet=args.quiet)
             paths = sorted({*subset, *extra})
             changed_subset = True
     for p in paths:
@@ -412,7 +545,7 @@ def main(argv: list[str] | None = None) -> int:
                       f"baselined) to {args.output}")
         else:
             print(doc, end="")
-        return 1 if result.new else 0
+        return 1 if result.new or ledger_rc else 0
 
     report = result.findings if args.no_baseline else result.new
     lines = [f.render() for f in report]
@@ -434,4 +567,4 @@ def main(argv: list[str] | None = None) -> int:
                 "entr(ies) — findings fixed but still grandfathered; run "
                 "--write-baseline to shrink the baseline"
             )
-    return 1 if result.new else 0
+    return 1 if result.new or ledger_rc else 0

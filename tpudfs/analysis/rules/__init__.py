@@ -36,4 +36,6 @@ from tpudfs.analysis.rules import (  # noqa: F401
     # tpusched protocol-ordering rules (explorer targets, see
     # tpudfs/testing/vclock.py + tpudfs/analysis/linearize.py)
     interleave,
+    # tpuflow zero-copy rules (byteflow.py byte-cost ledger backed)
+    zerocopy,
 )

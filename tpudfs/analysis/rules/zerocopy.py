@@ -1,0 +1,531 @@
+"""TPL060-TPL064 — tpuflow: zero-copy rules on the byte-cost ledger.
+
+The tpuperf rules (TPL030-034) catch local copy shapes; the byteflow
+ledger (:mod:`tpudfs.analysis.byteflow`) adds the whole-route view.
+These five rules sit between the two: four site-level zero-copy shapes
+that the ledger counts but the TPL03x heuristics deliberately skip, and
+one route-level budget comparison the ledger alone can express.
+
+- **TPL060** — memoryview escape: a value with zero-copy ``memoryview``
+  provenance coerced back to ``bytes`` in a hot function. The view was
+  the optimization; ``bytes(view)`` silently undoes it.
+- **TPL061** — per-frame allocation in a stream loop: a fresh buffer
+  (``bytearray(n)`` / ``np.zeros``) allocated every iteration of a hot
+  loop with a loop-invariant size and no escape from the iteration —
+  hoist it or use a ring like ``writestream.py`` does.
+- **TPL062** — hidden stdlib copy: ``b"".join([one_part])``,
+  ``bytes(bytearray(...))`` round-trips, and full-buffer ``.hex()`` /
+  ``.decode()`` on data payloads in hot functions.
+- **TPL063** — double serialization: the same unmodified buffer passed
+  through ``pack``/``packb``/``dumps`` twice on one path (a forward
+  may-analysis over the CFG, killed on reassignment).
+- **TPL064** — cache-route copy budget: the byteflow ledger's
+  cache-hit route must not cost more copies per byte than the direct
+  warm-infeed read it exists to beat.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpudfs.analysis import byteflow
+from tpudfs.analysis.bufferflow import (
+    PAYLOAD_NAME_RE,
+    buffer_flow,
+    env_from,
+    kind_of,
+)
+from tpudfs.analysis.callgraph import FunctionInfo, Project
+from tpudfs.analysis.cfg import cfg_for
+from tpudfs.analysis.hotpath import hot_paths
+from tpudfs.analysis.linter import (Finding, ProjectRule, profile_units,
+                                    register)
+
+#: Serialize-direction callees for TPL063 (deserializers cannot
+#: "double-serialize" a buffer; unpack of a packed buffer is the normal
+#: wire round-trip).
+_PACK_CALLS = {"pack", "packb", "dumps"}
+
+#: Allocation callees for TPL061: each call materializes a fresh
+#: len(n) buffer.
+_ALLOC_CALLS = {"bytearray", "zeros", "empty"}
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _hot_functions(
+    project: Project, rule_id: str | None = None
+) -> Iterator[FunctionInfo]:
+    hp = hot_paths(project)
+    fns = (fn for fn in project.functions.values() if hp.is_hot(fn))
+    yield from profile_units(rule_id, fns, lambda fn: fn.qualname)
+
+
+def _own_nodes(fn: FunctionInfo):
+    return cfg_for(fn.module, fn.node).nodes
+
+
+def _in_env(fn: FunctionInfo, node):
+    flow = buffer_flow(fn.module, fn.node)
+    in_facts, _ = flow.get(node.index, (None, None))
+    return env_from(in_facts)
+
+
+def _payloadish(expr: ast.AST, env) -> bool:
+    """Payload-name anchored buffer evidence (mirrors byteflow)."""
+    if isinstance(expr, ast.Name):
+        return bool(PAYLOAD_NAME_RE.match(expr.id)) or bool(env.get(expr.id))
+    if isinstance(expr, ast.Attribute):
+        return bool(PAYLOAD_NAME_RE.match(expr.attr))
+    return False
+
+
+@register
+class MemoryviewEscape(ProjectRule):
+    id = "TPL060"
+    name = "memoryview-escape"
+    summary = ("a zero-copy `memoryview` coerced back to `bytes` in a "
+               "hot function — the copy the view existed to avoid")
+    doc = (
+        "A `memoryview` on the data plane is an explicit zero-copy "
+        "decision: frames are sliced, checksummed and scattered to the "
+        "socket without materializing. `bytes(view)` silently reverses "
+        "it — one full-buffer memcpy, usually to satisfy a consumer "
+        "that would have accepted the view (msgpack bin-packs any "
+        "buffer; sockets `writelines` scatter lists; caches store "
+        "buffer-protocol objects unchanged). The rule uses buffer "
+        "provenance from the dataflow solver and fires only where the "
+        "coerced value provably has `memoryview` provenance in a "
+        "hot-path function; `.tobytes()` on a view is flagged the same "
+        "way. Cold config/tool code stays silent."
+    )
+    example = """\
+view = memoryview(frame)[off:off + n]   # zero-copy slice
+await cache.put(block_id, bytes(view))  # full memcpy right back
+"""
+    fix = ("Keep the view: every data-plane consumer (msgpack, "
+           "writelines, crc32c, the block cache) accepts buffer-protocol "
+           "objects. If an immutable owner is truly required, copy once "
+           "at the producer, not per consumer.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for fn in _hot_functions(project, self.id):
+            module = fn.module
+            seen: set[tuple[int, int]] = set()
+            for node in _own_nodes(fn):
+                env = _in_env(fn, node)
+                for top in node.exprs():
+                    for expr in ast.walk(top):
+                        hit = self._escape(expr, env)
+                        if hit is None:
+                            continue
+                        key = (getattr(expr, "lineno", 0),
+                               getattr(expr, "col_offset", 0))
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield self.finding(
+                            module, expr,
+                            f"{hit} coerces a zero-copy `memoryview` "
+                            f"back to `bytes` in hot `{fn.short()}` — "
+                            "one full-buffer memcpy; data-plane "
+                            "consumers accept the view unchanged",
+                        )
+
+    @staticmethod
+    def _escape(expr: ast.AST, env) -> str | None:
+        if not isinstance(expr, ast.Call):
+            return None
+        name = _call_name(expr)
+        if name == "bytes" and len(expr.args) == 1:
+            arg = expr.args[0]
+            if isinstance(arg, ast.Name) \
+                    and "memoryview" in env.get(arg.id, set()):
+                return f"`bytes({arg.id})`"
+            if isinstance(arg, ast.Call) \
+                    and _call_name(arg) == "memoryview":
+                return "`bytes(memoryview(...))`"
+            if kind_of(arg, env) == "memoryview":
+                return "`bytes(<view>)`"
+        if name == "tobytes" and isinstance(expr.func, ast.Attribute) \
+                and isinstance(expr.func.value, ast.Name) \
+                and "memoryview" in env.get(expr.func.value.id, set()):
+            return f"`{expr.func.value.id}.tobytes()`"
+        return None
+
+
+@register
+class PerFrameAllocation(ProjectRule):
+    id = "TPL061"
+    name = "per-frame-allocation"
+    summary = ("fresh buffer allocated every iteration of a hot stream "
+               "loop with a loop-invariant size — hoist it or reuse a "
+               "ring like writestream.py does")
+    doc = (
+        "`bytearray(FRAME_SIZE)` inside a per-frame loop allocates and "
+        "zeroes the same-size buffer thousands of times per block; the "
+        "stream engine (`writestream.py`) carries a reusable frame "
+        "buffer for exactly this reason. The rule fires on "
+        "`bytearray(n)` / `np.zeros(n)` / `np.empty(n)` at loop depth "
+        ">= 1 in a hot function when the size arguments are loop-"
+        "invariant (constants or names not rebound in the loop) and "
+        "the buffer does not escape the iteration (not appended, "
+        "stored, returned or yielded) — i.e. when hoisting the "
+        "allocation above the loop is a semantics-preserving edit."
+    )
+    example = """\
+while remaining:                     # hot per-frame loop
+    buf = bytearray(FRAME_SIZE)      # fresh allocation every frame
+    n = await r.readinto(buf)
+    consume(buf[:n])
+"""
+    fix = ("Allocate once above the loop and reuse: `buf = "
+           "bytearray(FRAME_SIZE)` outside, `readinto(buf)` inside — "
+           "or adopt the writestream ring if frames overlap in flight.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for fn in _hot_functions(project, self.id):
+            module = fn.module
+            for loop in ast.walk(fn.node):
+                if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                    continue
+                if module.enclosing_function(loop) is not fn.node:
+                    continue
+                rebound = self._rebound_names(loop)
+                for stmt in loop.body:
+                    for n in ast.walk(stmt):
+                        if not (isinstance(n, ast.Assign)
+                                and len(n.targets) == 1
+                                and isinstance(n.targets[0], ast.Name)
+                                and isinstance(n.value, ast.Call)):
+                            continue
+                        call = n.value
+                        cname = _call_name(call)
+                        if cname not in _ALLOC_CALLS or not call.args:
+                            continue
+                        if not all(self._invariant(a, rebound)
+                                   for a in call.args):
+                            continue
+                        target = n.targets[0].id
+                        if self._escapes(loop, target, n):
+                            continue
+                        yield self.finding(
+                            module, call,
+                            f"`{cname}(...)` allocates a fresh buffer "
+                            f"every iteration of a hot loop in "
+                            f"`{fn.short()}` with a loop-invariant size "
+                            "— hoist the allocation above the loop (or "
+                            "reuse the stream ring) and refill it in "
+                            "place",
+                        )
+
+    @staticmethod
+    def _rebound_names(loop: ast.AST) -> set[str]:
+        """Names assigned anywhere in the loop (incl. the loop target):
+        a size argument drawn from these is not loop-invariant."""
+        out: set[str] = set()
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(loop.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        for n in ast.walk(loop):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            out.add(leaf.id)
+        return out
+
+    @staticmethod
+    def _invariant(arg: ast.AST, rebound: set[str]) -> bool:
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Call):
+                return False
+            if isinstance(n, ast.Name) and n.id in rebound:
+                return False
+        return True
+
+    @staticmethod
+    def _escapes(loop: ast.AST, name: str, defining: ast.AST) -> bool:
+        """Does ``name`` leave the iteration? Appends, container/attr
+        stores, returns, yields and task spawns all retain the buffer —
+        hoisting would alias every retained copy to one ring slot."""
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Call):
+                cname = _call_name(n)
+                if cname in ("append", "extend", "put", "put_nowait",
+                             "create_task", "ensure_future"):
+                    if any(isinstance(leaf, ast.Name) and leaf.id == name
+                           for a in n.args for leaf in ast.walk(a)):
+                        return True
+            if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and n.value is not None:
+                if any(isinstance(leaf, ast.Name) and leaf.id == name
+                       for leaf in ast.walk(n.value)):
+                    return True
+            if isinstance(n, ast.Assign) and n is not defining:
+                for t in n.targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        if any(isinstance(leaf, ast.Name)
+                               and leaf.id == name
+                               for leaf in ast.walk(n.value)):
+                            return True
+        return False
+
+
+@register
+class HiddenStdlibCopy(ProjectRule):
+    id = "TPL062"
+    name = "hidden-stdlib-copy"
+    summary = ("stdlib idiom that copies a full buffer without looking "
+               "like one: one-part `join`, `bytes(bytearray(...))` "
+               "round-trip, payload `.hex()`/`.decode()`")
+    doc = (
+        "Three stdlib shapes memcpy a whole buffer while reading as "
+        "bookkeeping: `b\"\".join([part])` of a single-element literal "
+        "(the join of one part IS a copy of it), "
+        "`bytes(bytearray(data))` (two full copies to end up with the "
+        "bytes you started from), and `.hex()` / `.decode()` over a "
+        "data payload (2x-expansion string materialization — fine for "
+        "a 16-byte digest, catastrophic for a 1 MiB block in a log "
+        "line). Fires in hot-path functions only; payload evidence "
+        "comes from the buffer-provenance dataflow plus payload "
+        "naming, so header peeks stay silent."
+    )
+    example = """\
+frame = b"".join([payload])       # one part: the join is a pure copy
+logger.debug("got %s", payload.hex())  # 2 MiB string per 1 MiB block
+"""
+    fix = ("Use the part directly (`frame = payload`), keep the "
+           "original `bytes` instead of round-tripping through "
+           "`bytearray`, and log sizes/digests (`len(payload)`, "
+           "`crc32c(payload)`), never hex dumps of payloads.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for fn in _hot_functions(project, self.id):
+            module = fn.module
+            seen: set[tuple[int, int]] = set()
+            for node in _own_nodes(fn):
+                env = _in_env(fn, node)
+                for top in node.exprs():
+                    for expr in ast.walk(top):
+                        msg = self._hidden_copy(expr, env)
+                        if msg is None:
+                            continue
+                        key = (getattr(expr, "lineno", 0),
+                               getattr(expr, "col_offset", 0))
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield self.finding(
+                            module, expr,
+                            f"{msg} in hot `{fn.short()}` — a hidden "
+                            "full-buffer copy; see TPL062 fix",
+                        )
+
+    @staticmethod
+    def _hidden_copy(expr: ast.AST, env) -> str | None:
+        if not isinstance(expr, ast.Call):
+            return None
+        name = _call_name(expr)
+        if name == "join" and isinstance(expr.func, ast.Attribute) \
+                and len(expr.args) == 1 \
+                and isinstance(expr.args[0], (ast.List, ast.Tuple)) \
+                and len(expr.args[0].elts) == 1:
+            return "`join` of a single-element literal"
+        if name == "bytes" and len(expr.args) == 1 \
+                and isinstance(expr.args[0], ast.Call) \
+                and _call_name(expr.args[0]) == "bytearray" \
+                and expr.args[0].args:
+            return "`bytes(bytearray(...))` round-trip"
+        if name in ("hex", "decode") and not expr.args \
+                and isinstance(expr.func, ast.Attribute) \
+                and _payloadish(expr.func.value, env):
+            return f"payload `.{name}()`"
+        return None
+
+
+@register
+class DoubleSerialization(ProjectRule):
+    id = "TPL063"
+    name = "double-serialization"
+    summary = ("the same unmodified buffer serialized twice on one "
+               "path — two O(n) pack passes where one envelope would do")
+    doc = (
+        "Packing a payload with msgpack/struct and then packing the "
+        "result (or the same buffer) again — e.g. a handler that packs "
+        "`data` into a response dict that the transport packs once "
+        "more — doubles the serialization cost of every byte and is "
+        "why scatter framing keeps payload bytes OUT of the envelope. "
+        "The rule runs a forward may-analysis over the CFG: a "
+        "`pack`/`packb`/`dumps` of a payload-provenance name generates "
+        "a serialized fact, reassignment of the name kills it, and a "
+        "second pack of a name whose fact is still live fires on that "
+        "path. Hot-path functions only."
+    )
+    example = """\
+body = packb({"data": payload})        # pass 1 over the payload
+frame = packb({"hdr": hdr, "body": body, "raw": payload})  # pass 2
+"""
+    fix = ("Serialize once: keep the payload out of the packed "
+           "envelope and carry it as a separate scatter segment "
+           "(`writelines([header, payload])`), the blockport `_d` "
+           "framing shape.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for fn in _hot_functions(project, self.id):
+            module = fn.module
+            for call, name in self._double_packs(fn):
+                yield self.finding(
+                    module, call,
+                    f"`{name}` is serialized again on a path where it "
+                    f"was already packed unmodified in `{fn.short()}` "
+                    "— two O(n) passes over the same bytes; pack once "
+                    "and scatter the payload outside the envelope",
+                )
+
+    def _double_packs(self, fn: FunctionInfo):
+        cfg = cfg_for(fn.module, fn.node)
+        flow = buffer_flow(fn.module, fn.node)
+        gens: dict[int, set[str]] = {}
+        kills: dict[int, set[str]] = {}
+        for node in cfg.nodes:
+            env = env_from(flow.get(node.index, (None, None))[0])
+            g: set[str] = set()
+            k: set[str] = set()
+            for top in node.exprs():
+                for expr in ast.walk(top):
+                    packed = self._packed_name(expr, env)
+                    if packed is not None:
+                        g.add(packed)
+                if isinstance(top, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    targets = top.targets if isinstance(top, ast.Assign) \
+                        else [top.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            k.add(t.id)
+            gens[node.index], kills[node.index] = g, k
+
+        ins: dict[int, set[str]] = {n.index: set() for n in cfg.nodes}
+        changed = True
+        while changed:
+            changed = False
+            for node in cfg.rpo():
+                in_facts: set[str] = set()
+                for pred, _kind in node.preds:
+                    in_facts |= (ins[pred.index] - kills[pred.index]) \
+                        | gens[pred.index]
+                if in_facts != ins[node.index]:
+                    ins[node.index] = in_facts
+                    changed = True
+
+        reported: set[tuple[str, int]] = set()
+        for node in cfg.nodes:
+            live = ins[node.index]
+            if not live:
+                continue
+            env = env_from(flow.get(node.index, (None, None))[0])
+            for top in node.exprs():
+                for expr in ast.walk(top):
+                    name = self._packed_name(expr, env)
+                    if name is None or name not in live:
+                        continue
+                    key = (name, getattr(expr, "lineno", 0))
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield expr, name
+
+    @staticmethod
+    def _packed_name(expr: ast.AST, env) -> str | None:
+        if not (isinstance(expr, ast.Call)
+                and _call_name(expr) in _PACK_CALLS):
+            return None
+        for a in expr.args:
+            if isinstance(a, ast.Name) and _payloadish(a, env):
+                return a.id
+        return None
+
+
+@register
+class CacheRouteCopyBudget(ProjectRule):
+    id = "TPL064"
+    name = "cache-route-copy-budget"
+    summary = ("the cache-hit read route costs more ledger copies per "
+               "byte than the direct read path it exists to beat")
+    doc = (
+        "A cache hit that re-buffers and re-serializes what the direct "
+        "path scatters is slower than no cache — the 0.109 GB/s "
+        "cache_read regression against a 1.3 GB/s direct read. This "
+        "rule compares two routes of the byteflow ledger "
+        "(`tpudfs/analysis/byteflow.py`): the `cache_hit_read` route's "
+        "statically-counted full-buffer copies must not exceed the "
+        "`warm_infeed_read` route's. It fires with the exact excess "
+        "hops (`file:line`), so the diff that adds a copy to the cache "
+        "path shows up as a named regression, not a benchmark mystery. "
+        "The committed ledger gate (`--check-ledger`) enforces the "
+        "same budget in CI per route; this rule enforces the "
+        "cache-vs-direct *relation* inside the tree itself."
+    )
+    example = """\
+# cache hit: stat + dict copy + msgpack of the payload (3 copies)
+return {"data": bytes(cached), "total_size": total}
+# direct read: scatter-framed memoryview straight to the socket (1)
+"""
+    fix = ("Serve cache hits the way direct reads are served: return "
+           "`{\"data_parts\": [memoryview(cached)]}` through the "
+           "blockport scatter framing, and skip per-hit disk stats the "
+           "signature check already covers.")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        ledger = byteflow.compute_ledger(project)
+        routes = ledger.get("routes", {})
+        cache = routes.get(byteflow.CACHE_ROUTE)
+        direct = routes.get(byteflow.DIRECT_ROUTE)
+        if not cache or not direct:
+            return
+        if not cache["functions"] or not direct["functions"]:
+            return  # routes absent from this tree (fixture subsets)
+        if cache["copies"] <= direct["copies"]:
+            return
+        anchor = self._anchor(project, cache["functions"])
+        if anchor is None:
+            return
+        cache_hops = [h for h in cache["hops"] if " copy:" in h]
+        yield self.finding(
+            anchor.module, anchor.node,
+            f"cache-hit route costs {cache['copies']} full-buffer "
+            f"copies vs {direct['copies']} on the direct read path "
+            f"({'; '.join(cache_hops[:4])}) — serve cached blocks "
+            "through the scatter-framing path so a hit is never "
+            "slower than a miss",
+        )
+
+    @staticmethod
+    def _anchor(project: Project, quals) -> FunctionInfo | None:
+        """Prefer a route *entry* function (the reader-facing handler)
+        over the alphabetically-first helper as the finding anchor."""
+        import re
+
+        spec = next(s for s in byteflow.ROUTES
+                    if s.name == byteflow.CACHE_ROUTE)
+        pats = [re.compile(p) for p in spec.entries]
+        for qual in quals:
+            if any(p.fullmatch(qual) for p in pats) \
+                    and qual in project.functions:
+                return project.functions[qual]
+        for qual in quals:
+            if qual in project.functions:
+                return project.functions[qual]
+        return None

@@ -1156,6 +1156,26 @@ class ChunkServer:
         block_id = req["block_id"]
         offset = int(req.get("offset", 0))
         length = int(req.get("length", 0))
+        if offset == 0 and length == 0:
+            # Cache consult FIRST: a hit costs one in-memory sync stat
+            # (the freshness signature), not the to_thread size probe the
+            # miss path needs — and the payload goes out as a memoryview
+            # through the blockport scatter framing (data_parts), exactly
+            # like the direct-read path, instead of re-buffering through
+            # the msgpack envelope.
+            cached = self.cache.get(block_id)
+            if cached is not None:
+                data, sig = cached
+                # Freshness check: the native data-plane engine (and peer
+                # recovery) publishes blocks without going through this
+                # process's cache-invalidation calls — a stale entry must
+                # lose to the on-disk file it shadows. A fresh signature
+                # also pins the size: the cached buffer IS the full block.
+                if sig == self._block_sig(block_id):
+                    return {"data_parts": [memoryview(data)],
+                            "bytes_read": len(data),
+                            "total_size": len(data)}
+                self.cache.invalidate(block_id)
         try:
             total = await asyncio.to_thread(self.store.size, block_id)
         except BlockNotFoundError:
@@ -1170,19 +1190,6 @@ class ChunkServer:
             )
         bytes_to_read = min(length, total - offset)
         full_read = offset == 0 and bytes_to_read == total
-
-        if full_read:
-            cached = self.cache.get(block_id)
-            if cached is not None:
-                data, sig = cached
-                # Freshness check: the native data-plane engine (and peer
-                # recovery) publishes blocks without going through this
-                # process's cache-invalidation calls — a stale entry must
-                # lose to the on-disk file it shadows.
-                if sig == self._block_sig(block_id):
-                    return {"data": data, "bytes_read": len(data),
-                            "total_size": total}
-                self.cache.invalidate(block_id)
 
         if not full_read:
             # Fused pread + touched-chunk verify (native engine when built);

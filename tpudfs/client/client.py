@@ -1056,13 +1056,22 @@ class Client:
 
     async def _read_block_range(self, block: dict, offset: int,
                                 length: int, *,
-                                local_verify: bool = True) -> bytes:
+                                local_verify: bool = True,
+                                into=None) -> bytes:
         """Replica read with optional hedging (reference read_block_range
         mod.rs:948-1107): fire the primary, start a delayed hedge at the
         second replica, first success wins; then sequential fallback.
 
         ``local_verify=False``: short-circuit reads skip the host sidecar
-        CRC pass — only for callers doing their own end-to-end verify."""
+        CRC pass — only for callers doing their own end-to-end verify.
+
+        ``into``: optional ``into(nbytes) -> writable buffer`` factory.
+        On the blockport transport the response payload is scattered
+        straight into that buffer (no intermediate ``bytes``), and the
+        filled buffer is returned instead of ``bytes``. Each attempt
+        (primary, hedge, fallback) gets its own buffer, so a losing
+        hedge can never scribble over the winner's. Local short-circuit
+        and gRPC fallbacks still return ``bytes``."""
         locations = [l for l in block["locations"] if l]
         if not locations:
             raise DfsError(f"no locations for block {block['block_id']}")
@@ -1086,9 +1095,23 @@ class Client:
         # ReadBlock is the chunkserver's VERIFIED RPC path: the server
         # checks the sidecar CRC32C before the bytes leave disk.
         async def read_from(addr: str) -> bytes:
+            # Per-attempt sink: the scatter callback fills a fresh
+            # caller-provided buffer, so the winner's result is its own
+            # allocation even when a cancelled hedge raced it.
+            sink = None
+
+            def _scatter(header: dict, plen: int):
+                nonlocal sink
+                if not header.get("ok"):
+                    return None  # error frame: let the transport read it
+                sink = into(plen)
+                return [memoryview(sink)]
+
             try:
-                resp = await self._data_call(addr, "ReadBlock", req,
-                                             timeout=max(self.rpc_timeout, 60.0))
+                resp = await self._data_call(
+                    addr, "ReadBlock", req,
+                    timeout=max(self.rpc_timeout, 60.0),
+                    payload_into=_scatter if into is not None else None)
             except RpcError as e:
                 # Only transport-shaped failures feed the breaker — a
                 # NOT_FOUND replica is a placement problem, not a sick peer.
@@ -1097,6 +1120,8 @@ class Client:
                     self.breakers.record_failure(addr)
                 raise
             self.breakers.record_success(addr)
+            if sink is not None:
+                return sink
             return resp["data"]
 
         errors: list[str] = []

@@ -187,16 +187,34 @@ class HbmReader:
         # short-circuit local read skips the redundant host sidecar pass
         # (the device check subsumes it; bit-rot surfaces at confirm()).
         device_verify = bool(verify) and bool(block.get("checksum_crc32c"))
+
+        def _grid(nbytes: int) -> np.ndarray:
+            # Chunk-padded word grid the blockport payload scatters
+            # straight into: the returned view's .base is the padded
+            # array, so no bytes_to_words pad-copy is needed after.
+            pad = -nbytes % CHECKSUM_CHUNK_SIZE
+            arr = np.zeros(max(nbytes + pad, CHECKSUM_CHUNK_SIZE),
+                           dtype=np.uint8)
+            return arr[:nbytes]
+
         data = await self.client._read_block_range(
-            block, 0, 0, local_verify=safe_local or not device_verify
+            block, 0, 0, local_verify=safe_local or not device_verify,
+            into=_grid,
         )
+        size = len(data)
+        if isinstance(data, np.ndarray):
+            grid = data.base if data.base is not None else data
+            words_np = grid.view("<u4").reshape(-1, WORDS_PER_CHUNK)
+        else:
+            # Local short-circuit / gRPC fallback delivered bytes.
+            words_np = bytes_to_words(data)
         # Off the event loop: device_put blocks for the whole host->HBM
         # transfer (tens of ms per MiB on a tunneled TPU) and would stall
         # the gRPC fetches of every other in-flight block.
         words = await asyncio.to_thread(
-            lambda: jax.device_put(bytes_to_words(data), device)
+            lambda: jax.device_put(words_np, device)
         )
-        return await self._finish_block(block, words, len(data), verify)
+        return await self._finish_block(block, words, size, verify)
 
     async def _ec_block_to_device(self, block: dict, device,
                                   verify: bool | str = True,
