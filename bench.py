@@ -628,23 +628,31 @@ def main_ckpt() -> None:
 # ------------------------------------------------------- tenant QoS bench
 #
 # ``bench.py --tenants``: the multi-tenant QoS data path as its own fast
-# CPU-safe mode. The cluster boots with TPUDFS_QOS=1 (weighted-fair
-# queueing + a per-tenant rate on every chunkserver and the master), a
-# "fair" tenant's read p99 is measured uncontended and then again while an
-# "abuser" tenant floods the same chunkservers at TENANT_FLOOD_CONCURRENCY
-# (~10x the fair tenant's single-stream concurrency). Headline numbers:
-# tenant_fair_p99_ms (fair p99 UNDER the flood), vs_baseline = flood p99 /
-# uncontended p99 (the noisy-neighbor acceptance bound is <= 3), and
-# tenant_abuser_shed_ratio (abuser ops throttled/shed by QoS). Reads run
-# with the local short-circuit OFF — short-circuit reads bypass server
-# admission entirely, and QoS must be in the measured path.
+# CPU-safe mode, run as a native-vs-asyncio A/B. For EACH serving engine
+# (the C++ data plane, then the asyncio blockport via
+# TPUDFS_PYTHON_DATA_PLANE=1) the cluster boots with TPUDFS_QOS=1
+# (weighted-fair queueing + a per-tenant rate on every chunkserver and the
+# master), a "fair" tenant's read p99 is measured uncontended and then
+# again while an "abuser" tenant floods the same chunkservers at
+# TENANT_FLOOD_CONCURRENCY (~10x the fair tenant's single-stream
+# concurrency). The engine each chunkserver actually serves is verified
+# through the DataPort handshake ("native": true/false) — a silent
+# fallback fails the bench rather than A/B-ing the wrong plane. Headline
+# numbers (from the native leg): tenant_fair_p99_ms (fair p99 UNDER the
+# flood), vs_baseline = flood p99 / uncontended p99 (the noisy-neighbor
+# acceptance bound is <= 3), tenant_abuser_shed_ratio (abuser ops
+# throttled/shed by QoS), and read_gbps (uncontended fair-tenant
+# single-stream throughput) — with the asyncio leg's numbers beside them
+# under "engines". Reads run with the local short-circuit OFF —
+# short-circuit reads bypass server admission entirely, and QoS must be
+# in the measured path.
 
 TENANT_FILES = 24
 TENANT_FLOOD_CONCURRENCY = 32
 TENANT_FAIR_READS = 40
 
 
-async def _run_tenants() -> dict:
+async def _run_tenants_engine(engine: str) -> dict:
     import tempfile
 
     from tpudfs.client.client import Client, DfsError
@@ -659,11 +667,25 @@ async def _run_tenants() -> dict:
                "TPUDFS_QOS_QUEUE_WAIT": "0.2",
                "TPUDFS_QOS_WEIGHTS": "fair=8",
                "TPUDFS_CS_MAX_INFLIGHT": "6"}
+    if engine == "asyncio":
+        qos_env["TPUDFS_PYTHON_DATA_PLANE"] = "1"
     tmp = tempfile.TemporaryDirectory(prefix="tpudfs-tenantbench-")
     maddr, cs_addrs, procs = _spawn_cluster(tmp.name, extra_env=qos_env,
                                             http=True)
     try:
         rpc = RpcClient()
+
+        # The A/B is meaningless unless each leg actually serves from the
+        # engine it claims: verify the DataPort handshake on every CS.
+        want_native = engine == "native"
+        for addr in cs_addrs:
+            hello = await rpc.call(addr, "ChunkServerService", "DataPort",
+                                   {}, timeout=10.0)
+            if bool(hello.get("native")) is not want_native:
+                raise RuntimeError(
+                    f"chunkserver {addr} serves native={hello.get('native')}"
+                    f" but the {engine} leg of the A/B requires "
+                    f"native={want_native} (silent engine fallback)")
 
         def tenant_client(tenant: str, op_budget: float = 4.0) -> Client:
             return Client([maddr], rpc_client=rpc,
@@ -697,6 +719,14 @@ async def _run_tenants() -> dict:
                 await fair.create_file(f"/tenants/f{i:04d}", data)
 
         await asyncio.gather(*(put(i) for i in range(TENANT_FILES)))
+        # Let the per-tenant token buckets refill before timing anything:
+        # every dataset write charged the head AND both forwarded replicas,
+        # and a fast engine lands all of that inside one burst window, so
+        # the first baseline reads would ride the LOAD phase's residual
+        # rate debt (the slower the engine, the less debt — inverting the
+        # A/B). burst/rate is 0.2 s here; 1 s is refill-complete for any
+        # sane knob set. Applied to both legs equally.
+        await asyncio.sleep(1.0)
         _tick("tenants-dataset")
 
         async def timed_read(client: Client, i: int, errors: list) -> float:
@@ -821,6 +851,12 @@ async def _run_tenants() -> dict:
         flood_p99 = p99(flood_walls)
         throttled = abuser_srv["shed"] + abuser_srv["rate_limited"]
         srv_attempts = throttled + abuser_srv["admitted"]
+        # Uncontended fair-tenant single-stream throughput: the engine
+        # half of the A/B (sheds and p99 measure the ladder; this
+        # measures the serving path the ladder guards).
+        base_wall = sum(base_walls)
+        read_gbps = (len(base_walls) * len(data) / base_wall / 1e9
+                     if base_wall else 0.0)
         return {
             "metric": (
                 "fair-tenant read p99 ms under a noisy-neighbor flood "
@@ -834,6 +870,8 @@ async def _run_tenants() -> dict:
             "unit": "ms",
             "vs_baseline": (round(flood_p99 / base_p99, 3)
                             if base_p99 else 0.0),
+            "engine": engine,
+            "read_gbps": round(read_gbps, 3),
             "tenant_fair_p99_ms": round(flood_p99 * 1000, 1),
             "tenant_fair_baseline_p99_ms": round(base_p99 * 1000, 1),
             "tenant_fair_error_rate": round(
@@ -857,6 +895,42 @@ async def _run_tenants() -> dict:
 
         terminate_all(procs)
         tmp.cleanup()
+
+
+async def _run_tenants() -> dict:
+    """Native leg first (the headline), then the asyncio blockport on a
+    fresh cluster; the payload carries both legs plus the A/B ratios."""
+    from tpudfs.common import native as native_mod
+
+    legs: dict[str, dict] = {}
+    engines = ["native", "asyncio"]
+    if not native_mod.build_and_load() or not native_mod.has_dataplane():
+        # No toolchain: the asyncio leg still measures the ladder, and
+        # the payload says exactly why the A/B is missing.
+        engines = ["asyncio"]
+    for engine in engines:
+        legs[engine] = await _run_tenants_engine(engine)
+        _tick(f"tenants-{engine}-done")
+
+    headline = dict(legs.get("native") or legs["asyncio"])
+    ab_keys = ("read_gbps", "tenant_fair_p99_ms",
+               "tenant_fair_baseline_p99_ms", "tenant_abuser_shed_ratio",
+               "tenant_abuser_server_throttled", "vs_baseline")
+    headline["engines"] = {
+        eng: {k: leg[k] for k in ab_keys if k in leg}
+        for eng, leg in legs.items()
+    }
+    if "native" in legs and "asyncio" in legs:
+        n, a = legs["native"], legs["asyncio"]
+        headline["native_vs_asyncio_gbps"] = (
+            round(n["read_gbps"] / a["read_gbps"], 3)
+            if a["read_gbps"] else 0.0)
+        headline["native_vs_asyncio_fair_p99"] = (
+            round(n["tenant_fair_p99_ms"] / a["tenant_fair_p99_ms"], 3)
+            if a["tenant_fair_p99_ms"] else 0.0)
+    elif "native" not in legs:
+        headline["ab_skipped"] = "native dataplane unavailable on this host"
+    return headline
 
 
 def main_tenants() -> None:

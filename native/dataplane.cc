@@ -42,6 +42,13 @@
 //   void     tpudfs_dataplane_invalidate(handle, block_id) // cache drop
 //   void     tpudfs_dataplane_stats(handle, uint64_t out[6])
 //               // writes, reads, forwards, errors, cache_hits, cache_misses
+//   void     tpudfs_dataplane_set_qos(handle, cfg, len)
+//               // push the QosShedder config (msgpack flat map from
+//               // resilience.qos_wire_config) — admission/fair-queue/
+//               // rate-limit ladder, weights, jitter seed
+//   void     tpudfs_dataplane_qos_stats(handle, uint64_t out[8])
+//   int64_t  tpudfs_dataplane_take_qos(handle, buf, cap)
+//               // per-tenant counter lines, take_terms contract
 //   int64_t  tpudfs_dataplane_stop(handle)
 //
 // Fencing parity: reference chunkserver.rs:732-743 — requests carrying a
@@ -67,6 +74,8 @@
 #include <list>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -645,6 +654,608 @@ void crc_zero_operator(uint64_t len2, uint32_t result[32]) {
   }
 }
 
+// ------------------------------------------------------------- qos plane
+//
+// Thread-blocking twin of tpudfs/common/resilience.py's QosShedder: the
+// same queue -> rate-limit -> shed degradation ladder, per-tenant
+// time-refilled token buckets, deficit-round-robin fair queueing, and
+// jittered retry_after hints. Python pushes the active QosShedder config
+// in at start (and on change) via tpudfs_dataplane_set_qos — a msgpack
+// flat map built by resilience.qos_wire_config() — and drains the
+// per-tenant counters back out with tpudfs_dataplane_qos_stats /
+// tpudfs_dataplane_take_qos, the same in/out pattern as set_term /
+// take_terms.
+//
+// Determinism contract: both sides draw retry_after jitter from an
+// identical SplitMix64 stream (seeded via the config's jitter_seed), and
+// exactly ONE draw happens per rejection and ZERO per admission, so a
+// fixed seed + fixed request schedule yields the same retry_after values
+// from either engine (tests/test_qos.py holds this draw-for-draw).
+//
+// Failpoints (chaos injection) are re-read from TPUDFS_QOS_FAILPOINT at
+// configure time, same grammar as resilience.QosFailpoints:
+//   freeze_refill       — rate buckets stop refilling (clock frozen)
+//   delay_admit=<secs>  — every admitted request stalls before dispatch
+//   force_shed=<n>      — next n acquires (or in-flight stream frames)
+//                         are refused unconditionally
+
+// Deterministic jitter PRNG — algorithm-identical to
+// resilience.SplitMix64 (same state advance, finalizer, and 53-bit
+// double in [0, 1)).
+struct SplitMix64 {
+  uint64_t s = 0;
+  double next() {
+    s += 0x9E3779B97F4A7C15ull;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    z ^= z >> 31;
+    return static_cast<double>(z >> 11) * 0x1.0p-53;
+  }
+};
+
+// DRR per-visit credit — must match resilience.py QOS_DRR_QUANTUM.
+constexpr int kQosDrrQuantum = 1;
+// Per-tenant admission-queue bound — resilience.py QOS_QUEUE_DEPTH_DEFAULT.
+constexpr int kQosQueueDepthDefault = 32;
+// Rate-bucket burst floor — resilience.py QOS_MIN_BURST.
+constexpr int kQosMinBurst = 1;
+// Per-tenant latency ring capacity — resilience.py _LATENCY_RING.
+constexpr int kQosLatencyRing = 256;
+
+struct QosConfig {
+  bool enabled = false;
+  int64_t max_inflight = 64;
+  double base_retry_after = 0.1;
+  double rate = 0.0;    // per-tenant req/s; <= 0 = unlimited
+  double burst = 1.0;   // resolved Python-side (QosShedder.burst)
+  int64_t queue_depth = kQosQueueDepthDefault;
+  double queue_wait = 0.25;
+  double default_weight = 1.0;
+  std::map<std::string, double> weights;
+};
+
+// One parked admission request (resilience._Waiter). Stack-allocated in
+// Qos::acquire; the DRR holds pointers, and every state transition
+// happens under Qos::mu_, so the pointer never outlives its frame.
+struct QosWaiter {
+  std::string tenant;
+  int state = 0;  // 0 waiting, 1 admitted, 2 rejected
+  std::string detail;
+  double retry_after = 0.0;
+  bool has_deadline = false;
+  double deadline_s = 0.0;
+};
+
+// Deficit round-robin over per-tenant FIFOs — a faithful port of
+// resilience.DeficitRoundRobin (Shreedhar & Varghese): quantum×weight
+// credit per visit, a drained tenant forfeits leftover deficit, and an
+// arbitrarily deep queue buys a tenant zero extra service.
+class QosDrr {
+ public:
+  double quantum = static_cast<double>(kQosDrrQuantum);
+  double default_weight = 1.0;
+  std::map<std::string, double> weights;
+
+  double weight(const std::string& t) const {
+    auto it = weights.find(t);
+    return std::max(it == weights.end() ? default_weight : it->second, 1e-6);
+  }
+  size_t size() const {
+    size_t n = 0;
+    for (const auto& kv : queues_) n += kv.second.size();
+    return n;
+  }
+  size_t depth(const std::string& t) const {
+    auto it = queues_.find(t);
+    return it == queues_.end() ? 0 : it->second.size();
+  }
+  std::vector<std::string> tenants() const {
+    return std::vector<std::string>(ring_.begin(), ring_.end());
+  }
+  void push(const std::string& t, QosWaiter* w) {
+    ensure(t);
+    queues_[t].push_back(w);
+  }
+  // Return an item to the head of its FIFO (dispatch backed out — the
+  // tenant's rate bucket was empty at dispatch time).
+  void push_front(const std::string& t, QosWaiter* w) {
+    ensure(t);
+    queues_[t].push_front(w);
+  }
+  // Next (tenant, item) by DRR order; {"", nullptr} when empty or every
+  // queued tenant is in `skip` (rate-limited this dispatch round).
+  std::pair<std::string, QosWaiter*> pop(const std::set<std::string>& skip) {
+    if (ring_.empty()) return {std::string(), nullptr};
+    // Termination: every eligible visit grows that tenant's deficit by
+    // quantum*weight > 0, so within bounded cycles some head is served.
+    double min_w = weight(ring_.front());
+    for (const auto& t : ring_) min_w = std::min(min_w, weight(t));
+    int visits = 0;
+    const int max_visits = static_cast<int>(ring_.size()) *
+                           (2 + static_cast<int>(1.0 / min_w));
+    while (!ring_.empty() && visits <= max_visits) {
+      visits++;
+      const std::string tenant = ring_.front();
+      if (!skip.empty() && skip.count(tenant)) {
+        bool all = true;
+        for (const auto& t : ring_)
+          if (!skip.count(t)) { all = false; break; }
+        if (all) return {std::string(), nullptr};
+        rotate();
+        continue;
+      }
+      auto& q = queues_[tenant];
+      const double cost = 1.0;  // _Waiter.cost default — always 1.0 here
+      if (deficit_[tenant] >= cost) {
+        QosWaiter* item = q.front();
+        q.pop_front();
+        deficit_[tenant] -= cost;
+        if (q.empty()) {
+          // A drained tenant forfeits its leftover deficit: credit must
+          // not accumulate while idle (classic DRR rule).
+          deficit_[tenant] = 0.0;
+          retire(tenant);
+        }
+        return {tenant, item};
+      }
+      deficit_[tenant] += quantum * weight(tenant);
+      rotate();
+    }
+    return {std::string(), nullptr};
+  }
+  // Remove and return every queued item matching `pred` (expired
+  // waiters); tenants left empty retire from the ring.
+  template <typename Pred>
+  std::vector<QosWaiter*> evict(Pred pred) {
+    std::vector<QosWaiter*> out;
+    std::vector<std::string> names;
+    names.reserve(queues_.size());
+    for (const auto& kv : queues_) names.push_back(kv.first);
+    for (const auto& tenant : names) {
+      auto& q = queues_[tenant];
+      std::deque<QosWaiter*> kept;
+      for (QosWaiter* w : q) {
+        if (pred(w)) out.push_back(w);
+        else kept.push_back(w);
+      }
+      q = std::move(kept);
+      retire(tenant);
+    }
+    return out;
+  }
+
+ private:
+  void ensure(const std::string& t) {
+    if (queues_.find(t) == queues_.end()) {
+      queues_[t];
+      ring_.push_back(t);
+      deficit_.emplace(t, 0.0);
+    }
+  }
+  void rotate() {  // Python deque.rotate(-1): front -> back
+    ring_.push_back(ring_.front());
+    ring_.pop_front();
+  }
+  void retire(const std::string& t) {
+    auto it = queues_.find(t);
+    if (it != queues_.end() && it->second.empty()) {
+      queues_.erase(it);
+      deficit_.erase(t);
+      for (auto rit = ring_.begin(); rit != ring_.end(); ++rit)
+        if (*rit == t) { ring_.erase(rit); break; }
+    }
+  }
+  std::map<std::string, std::deque<QosWaiter*>> queues_;
+  std::deque<std::string> ring_;
+  std::map<std::string, double> deficit_;
+};
+
+// Time-refilled token bucket (resilience.RateBucket): monotone refill —
+// a clock that stalls (the freeze_refill failpoint) never drains tokens.
+struct QosBucket {
+  double rate = 0.0, burst = 0.0, tokens = 0.0, last = 0.0;
+};
+
+// The admission plane. Connection threads block in acquire() (the
+// asyncio shedder parks a future; here the thread parks on a condition
+// variable — same ladder, same counters, same jitter draws).
+class Qos {
+ public:
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  void configure(const QosConfig& cfg, uint64_t seed) {
+    std::lock_guard<std::mutex> lk(mu_);
+    cfg_ = cfg;
+    // System outweighs any single default-weight tenant unless the
+    // operator explicitly pinned it (QosShedder.__init__).
+    if (cfg_.weights.find("system") == cfg_.weights.end())
+      cfg_.weights["system"] = std::max(4.0, cfg_.default_weight);
+    drr_.default_weight = cfg_.default_weight;
+    drr_.weights = cfg_.weights;
+    if (seed != 0) {
+      rng_.s = seed;
+    } else {
+      // Entropy-seeded Python side: decorrelate from other servers so a
+      // shed wave never hands out lockstep retry hints.
+      rng_.s ^= static_cast<uint64_t>(
+          std::chrono::steady_clock::now().time_since_epoch().count());
+    }
+    fp_freeze_refill_ = false;
+    fp_delay_admit_ = 0.0;
+    fp_force_shed_ = 0;
+    const char* raw = ::getenv("TPUDFS_QOS_FAILPOINT");
+    if (raw != nullptr) parse_failpoints(raw);
+    frozen_now_ = now_s();
+    buckets_.clear();
+    enabled_.store(cfg_.enabled, std::memory_order_relaxed);
+    cv_.notify_all();
+  }
+
+  // Admit, queue, or refuse one request. Returns true when admitted
+  // (pair with release()); false fills detail + retry_after. The ladder,
+  // counter increments, and jitter-draw pattern mirror
+  // QosShedder.acquire exactly.
+  bool acquire(const std::string& tenant, bool has_db, double budget,
+               std::string* detail, double* retry_after) {
+    double delay = 0.0;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      if (fp_force_shed_ > 0) {
+        fp_force_shed_--;
+        count_shed(tenant);
+        *detail = "failpoint forced shed";
+        *retry_after = retry_after_for(tenant);
+        return false;
+      }
+      QosBucket* b = bucket(tenant);
+      if (inflight_ < cfg_.max_inflight && drr_.size() == 0 &&
+          (b == nullptr || try_spend(b))) {
+        admit(tenant);
+        delay = fp_delay_admit_;
+      } else {
+        // Contended (or over-rate): degrade to the fair queue.
+        if (drr_.depth(tenant) >= static_cast<size_t>(cfg_.queue_depth)) {
+          evict_expired_locked();
+          if (drr_.depth(tenant) >= static_cast<size_t>(cfg_.queue_depth)) {
+            count_shed(tenant);
+            *detail = "tenant queue full";
+            *retry_after = retry_after_for(tenant);
+            return false;
+          }
+        }
+        QosWaiter w;
+        w.tenant = tenant;
+        if (has_db) {
+          w.has_deadline = true;
+          w.deadline_s = now_s() + budget;
+        }
+        drr_.push(tenant, &w);
+        queued_total_++;
+        queued_by_tenant_[tenant]++;
+        kick_locked();
+        double wait = cfg_.queue_wait;
+        if (has_db) wait = std::min(wait, std::max(budget, 0.0));
+        const double give_up = now_s() + wait;
+        while (w.state == 0) {
+          double now = now_s();
+          if (now >= give_up) break;
+          double wake = give_up;
+          if (refill_kick_at_ > 0 && refill_kick_at_ < wake)
+            wake = refill_kick_at_;
+          // wait_until on system_clock, NOT wait_for: wait_for rides the
+          // steady clock through pthread_cond_clockwait, which TSan does
+          // not intercept (gcc 10 / glibc 2.31) — the missed unlock
+          // corrupts the whole mutex's happens-before state. The loop
+          // re-derives its own deadline from now_s() every iteration, so
+          // a wall-clock step only perturbs one wakeup.
+          cv_.wait_until(
+              lk, std::chrono::system_clock::now() +
+                      std::chrono::microseconds(static_cast<int64_t>(
+                          std::max(wake - now, 1e-4) * 1e6)));
+          if (w.state == 0 && refill_kick_at_ > 0 &&
+              now_s() >= refill_kick_at_) {
+            // QosShedder._timer_kick twin: the first waiter past the
+            // earliest bucket refill re-runs eviction + dispatch, so
+            // rate-limited waiters don't rely on unrelated traffic.
+            refill_kick_at_ = 0.0;
+            evict_expired_locked();
+            kick_locked();
+          }
+        }
+        if (w.state == 0) {
+          // Timed out parked (the asyncio TimeoutError path): reap our
+          // queue slot now rather than waiting for a sweep.
+          drr_.evict([&](QosWaiter* x) { return x == &w; });
+          rate_limited_total_++;
+          rate_limited_by_tenant_[tenant]++;
+          count_shed(tenant);
+          *detail = "rate limited";
+          *retry_after = retry_after_for(tenant);
+          return false;
+        }
+        if (w.state == 2) {
+          *detail = w.detail;
+          *retry_after = w.retry_after;
+          return false;
+        }
+        delay = fp_delay_admit_;
+      }
+    }
+    if (delay > 0)
+      std::this_thread::sleep_for(std::chrono::duration<double>(delay));
+    return true;
+  }
+
+  void release(const std::string& tenant, double elapsed) {
+    std::lock_guard<std::mutex> lk(mu_);
+    inflight_--;
+    auto& ring = latency_by_tenant_[tenant];
+    ring.push_back(elapsed);
+    if (ring.size() > static_cast<size_t>(kQosLatencyRing))
+      ring.pop_front();
+    kick_locked();
+  }
+
+  // Mid-stream per-frame shed (force_shed failpoint re-armed by a config
+  // re-push while a stream is in flight) — lets chaos abort an admitted
+  // stream partway, exercising the client's Overloaded retry path.
+  bool shed_frame(const std::string& tenant, double* retry_after) {
+    if (!enabled()) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    if (fp_force_shed_ <= 0) return false;
+    fp_force_shed_--;
+    count_shed(tenant);
+    *retry_after = retry_after_for(tenant);
+    return true;
+  }
+
+  // inflight, peak_inflight, admitted_total, shed_total, queue_depth,
+  // queued_total, rate_limited_total, evicted_total.
+  void stats(uint64_t out[8]) {
+    std::lock_guard<std::mutex> lk(mu_);
+    out[0] = inflight_ > 0 ? static_cast<uint64_t>(inflight_) : 0;
+    out[1] = static_cast<uint64_t>(peak_inflight_);
+    out[2] = admitted_total_;
+    out[3] = shed_total_;
+    out[4] = static_cast<uint64_t>(drr_.size());
+    out[5] = queued_total_;
+    out[6] = rate_limited_total_;
+    out[7] = evicted_total_;
+  }
+
+  // Per-tenant counter dump: "tenant\tadmitted\tshed\trate_limited\t
+  // queue_depth\tp99_ns\n" lines. Non-destructive (counters only grow;
+  // re-reading is idempotent). Returns bytes written, or -needed when
+  // cap is short — the take_terms contract.
+  int64_t take(char* buf, uint64_t cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::set<std::string> names;
+    for (const auto& kv : admitted_by_tenant_) names.insert(kv.first);
+    for (const auto& kv : shed_by_tenant_) names.insert(kv.first);
+    for (const auto& kv : rate_limited_by_tenant_) names.insert(kv.first);
+    for (const auto& kv : latency_by_tenant_) names.insert(kv.first);
+    for (const auto& t : drr_.tenants()) names.insert(t);
+    std::string joined;
+    for (const auto& raw : names) {
+      std::string t = raw;
+      for (char& c : t)
+        if (c == '\t' || c == '\n') c = '_';
+      uint64_t p99_ns = 0;
+      auto lit = latency_by_tenant_.find(raw);
+      if (lit != latency_by_tenant_.end() && !lit->second.empty()) {
+        std::vector<double> ordered(lit->second.begin(), lit->second.end());
+        std::sort(ordered.begin(), ordered.end());
+        size_t idx = std::min(ordered.size() - 1,
+                              static_cast<size_t>(
+                                  0.99 * (ordered.size() - 1)));
+        p99_ns = static_cast<uint64_t>(ordered[idx] * 1e9);
+      }
+      joined += t + "\t" + std::to_string(counter(admitted_by_tenant_, raw)) +
+                "\t" + std::to_string(counter(shed_by_tenant_, raw)) + "\t" +
+                std::to_string(counter(rate_limited_by_tenant_, raw)) + "\t" +
+                std::to_string(drr_.depth(raw)) + "\t" +
+                std::to_string(p99_ns) + "\n";
+    }
+    if (joined.size() + 1 > cap)
+      return -static_cast<int64_t>(joined.size() + 1);
+    std::memcpy(buf, joined.c_str(), joined.size() + 1);
+    return static_cast<int64_t>(joined.size());
+  }
+
+ private:
+  static double now_s() {
+    return std::chrono::duration<double>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+  static uint64_t counter(const std::map<std::string, uint64_t>& m,
+                          const std::string& t) {
+    auto it = m.find(t);
+    return it == m.end() ? 0 : it->second;
+  }
+  // tpulint: guarded-by(mu_)
+  void parse_failpoints(const std::string& raw) {
+    size_t pos = 0;
+    while (pos <= raw.size()) {
+      size_t comma = raw.find(',', pos);
+      std::string part = raw.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      size_t a = part.find_first_not_of(" \t");
+      size_t z = part.find_last_not_of(" \t");
+      part = a == std::string::npos ? "" : part.substr(a, z - a + 1);
+      size_t eq = part.find('=');
+      std::string name = eq == std::string::npos ? part : part.substr(0, eq);
+      std::string value = eq == std::string::npos ? "" : part.substr(eq + 1);
+      if (name == "freeze_refill") fp_freeze_refill_ = true;
+      else if (name == "delay_admit")
+        fp_delay_admit_ = std::strtod(value.c_str(), nullptr);
+      else if (name == "force_shed")
+        fp_force_shed_ = std::strtol(value.c_str(), nullptr, 10);
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+  }
+  // tpulint: guarded-by(mu_)
+  double bucket_now() const { return fp_freeze_refill_ ? frozen_now_ : now_s(); }
+  // tpulint: guarded-by(mu_)
+  QosBucket* bucket(const std::string& tenant) {
+    // The system tenant (control plane, untenanted clients) is never
+    // rate-limited — QosShedder._bucket parity.
+    if (cfg_.rate <= 0 || tenant == "system") return nullptr;
+    auto it = buckets_.find(tenant);
+    if (it == buckets_.end()) {
+      QosBucket b;
+      b.rate = cfg_.rate;
+      b.burst = std::max(cfg_.burst, static_cast<double>(kQosMinBurst));
+      b.tokens = b.burst;
+      b.last = bucket_now();
+      it = buckets_.emplace(tenant, b).first;
+    }
+    return &it->second;
+  }
+  void refill(QosBucket* b) const {
+    double now = bucket_now();
+    // now <= last: clock stall/regression — tokens unchanged, and last
+    // keeps its high-water mark (RateBucket._refill).
+    if (now > b->last) {
+      b->tokens = std::min(b->burst, b->tokens + (now - b->last) * b->rate);
+      b->last = now;
+    }
+  }
+  bool try_spend(QosBucket* b) const {
+    refill(b);
+    if (b->tokens >= 1.0) {
+      b->tokens -= 1.0;
+      return true;
+    }
+    return false;
+  }
+  double bucket_retry_after(QosBucket* b) const {
+    refill(b);
+    if (b->tokens >= 1.0) return 0.0;
+    return (1.0 - b->tokens) / b->rate;
+  }
+  // tpulint: guarded-by(mu_)
+  double jittered(double seconds) {
+    return std::max(0.0,
+                    seconds * (1.0 + 0.25 * (2.0 * rng_.next() - 1.0)));
+  }
+  // Per-tenant retry-after: the tenant's refill schedule when it has
+  // one, else the pressure-scaled global hint. Exactly one jitter draw —
+  // QosShedder.retry_after_for parity.
+  // tpulint: guarded-by(mu_)
+  double retry_after_for(const std::string& tenant) {
+    QosBucket* b = bucket(tenant);
+    if (b != nullptr) {
+      double hinted = bucket_retry_after(b);
+      if (hinted > 0)
+        return jittered(std::max(hinted, cfg_.base_retry_after));
+    }
+    int64_t over =
+        std::max<int64_t>(0, inflight_ - cfg_.max_inflight + 1) +
+        static_cast<int64_t>(drr_.size());
+    double hint = cfg_.base_retry_after *
+                  (1.0 + static_cast<double>(over) /
+                             static_cast<double>(
+                                 std::max<int64_t>(1, cfg_.max_inflight)));
+    return jittered(hint);
+  }
+  // tpulint: guarded-by(mu_)
+  void admit(const std::string& tenant) {
+    inflight_++;
+    admitted_total_++;
+    if (inflight_ > peak_inflight_) peak_inflight_ = inflight_;
+    admitted_by_tenant_[tenant]++;
+  }
+  // tpulint: guarded-by(mu_)
+  void count_shed(const std::string& tenant) {
+    shed_total_++;
+    shed_by_tenant_[tenant]++;
+  }
+  // Drop queued waiters whose ambient deadline already expired —
+  // admitting doomed work just burns an inflight slot. Caller holds mu_.
+  // tpulint: guarded-by(mu_)
+  void evict_expired_locked() {
+    const double now = now_s();
+    auto evicted = drr_.evict([&](QosWaiter* w) {
+      return w->state != 0 || (w->has_deadline && now >= w->deadline_s);
+    });
+    uint64_t n = 0;
+    for (QosWaiter* w : evicted) {
+      if (w->state != 0) continue;
+      n++;
+      count_shed(w->tenant);
+      w->state = 2;
+      w->detail = "deadline expired in admission queue";
+      w->retry_after = retry_after_for(w->tenant);
+    }
+    evicted_total_ += n;
+    if (n) cv_.notify_all();
+  }
+  // Dispatch queued waiters into free inflight slots, DRR order
+  // (QosShedder._kick). Tenants whose rate bucket is empty are skipped
+  // this round (waiter returns to its FIFO head) and refill_kick_at_
+  // arms the timer-kick twin above. Caller holds mu_.
+  // tpulint: guarded-by(mu_)
+  void kick_locked() {
+    std::set<std::string> skip;
+    double min_refill = -1.0;
+    while (inflight_ < cfg_.max_inflight) {
+      auto nxt = drr_.pop(skip);
+      if (nxt.second == nullptr) break;
+      const std::string& tenant = nxt.first;
+      QosWaiter* w = nxt.second;
+      if (w->state != 0) continue;  // timed out while parked
+      if (w->has_deadline && now_s() >= w->deadline_s) {
+        count_shed(tenant);
+        evicted_total_++;
+        w->state = 2;
+        w->detail = "deadline expired in admission queue";
+        w->retry_after = retry_after_for(tenant);
+        continue;
+      }
+      QosBucket* b = bucket(tenant);
+      if (b != nullptr && !try_spend(b)) {
+        drr_.push_front(tenant, w);
+        skip.insert(tenant);
+        double refill_in = bucket_retry_after(b);
+        if (min_refill < 0 || refill_in < min_refill)
+          min_refill = refill_in;
+        continue;
+      }
+      admit(tenant);
+      w->state = 1;
+    }
+    if (min_refill >= 0 && drr_.size() > 0) {
+      double at = now_s() + std::max(min_refill, 0.005);
+      if (refill_kick_at_ <= 0 || at < refill_kick_at_)
+        refill_kick_at_ = at;
+    }
+    cv_.notify_all();
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  QosConfig cfg_;
+  std::atomic<bool> enabled_{false};
+  SplitMix64 rng_;
+  QosDrr drr_;
+  std::map<std::string, QosBucket> buckets_;
+  bool fp_freeze_refill_ = false;
+  double fp_delay_admit_ = 0.0;
+  int64_t fp_force_shed_ = 0;
+  double frozen_now_ = 0.0;
+  double refill_kick_at_ = 0.0;  // earliest pending timer-kick (0 = none)
+  int64_t inflight_ = 0;
+  int64_t peak_inflight_ = 0;
+  uint64_t admitted_total_ = 0, shed_total_ = 0, queued_total_ = 0,
+      rate_limited_total_ = 0, evicted_total_ = 0;
+  std::map<std::string, uint64_t> admitted_by_tenant_, shed_by_tenant_,
+      queued_by_tenant_, rate_limited_by_tenant_;
+  std::map<std::string, std::deque<double>> latency_by_tenant_;
+};
+
 // --------------------------------------------------------------- engine
 
 struct CommitEntry {
@@ -879,6 +1490,55 @@ class Engine {
     out[7] = stream_aborts_.load();
   }
 
+  // ------------------------------------------------------------ qos plane
+
+  // Parse + install a QoS config pushed from Python (resilience.
+  // qos_wire_config() as a msgpack flat map — scalars and string arrays
+  // only, which is all parse_header reads). Unknown keys are ignored; a
+  // map with enabled=0 switches admission off for subsequent requests.
+  void qos_configure(const uint8_t* buf, uint64_t len) {
+    std::map<std::string, Value> h;
+    if (!parse_header(buf, static_cast<size_t>(len), &h)) return;
+    auto num = [&](const char* key, double dflt) {
+      auto it = h.find(key);
+      if (it == h.end()) return dflt;
+      if (it->second.kind == Value::FLT) return it->second.f;
+      if (it->second.kind == Value::INT)
+        return static_cast<double>(it->second.i);
+      return dflt;
+    };
+    QosConfig cfg;
+    cfg.enabled = num("enabled", 0) != 0;
+    cfg.max_inflight = static_cast<int64_t>(num("max_inflight", 64));
+    cfg.base_retry_after = num("base_retry_after", 0.1);
+    cfg.rate = num("rate", 0.0);
+    cfg.burst = num("burst", 1.0);
+    cfg.queue_depth =
+        static_cast<int64_t>(num("queue_depth", kQosQueueDepthDefault));
+    cfg.queue_wait = num("queue_wait", 0.25);
+    cfg.default_weight = num("default_weight", 1.0);
+    auto wit = h.find("weights");
+    if (wit != h.end() && wit->second.kind == Value::ASTR) {
+      // Weights travel flat as "tenant=weight" strings (the header
+      // parser has no nested maps); split on the LAST '=' so tenant
+      // names containing '=' still round-trip.
+      for (const auto& pair : wit->second.astr) {
+        size_t eq = pair.rfind('=');
+        if (eq == std::string::npos || eq == 0) continue;
+        cfg.weights[pair.substr(0, eq)] =
+            std::strtod(pair.c_str() + eq + 1, nullptr);
+      }
+    }
+    uint64_t seed = 0;
+    auto sit = h.find("jitter_seed");
+    if (sit != h.end() && sit->second.kind == Value::INT)
+      seed = static_cast<uint64_t>(sit->second.i);
+    qos_.configure(cfg, seed);
+  }
+
+  void qos_stats(uint64_t out[8]) { qos_.stats(out); }
+  int64_t take_qos(char* buf, uint64_t cap) { return qos_.take(buf, cap); }
+
   // ------------------------------------------------------ LRU block cache
 
   using CacheData = std::shared_ptr<std::vector<uint8_t>>;
@@ -1011,21 +1671,58 @@ class Engine {
       if (!recv_frame(s, &h, &payload)) break;
       const std::string method = h.count("m") ? h["m"].s : "";
       bool has_data = h.count("_d") && h["_d"].i;
+      const bool known =
+          method == "WriteBlock" || method == "ReplicateBlock" ||
+          method == "WriteStream" || method == "ReadBlock" ||
+          method == "ReadBlocks";
+      if (!known) {
+        respond_err(s, "UNIMPLEMENTED",
+                    "no native blockport method " + method);
+        continue;
+      }
+      // Central pre-execution deadline gate — the twin of
+      // blocknet.BlockPortServer._handle's _db check, message included:
+      // an already-expired budget is refused before admission charges
+      // the QoS plane (or any handler touches the disk) for doomed work.
+      double budget = 0.0;
+      const bool has_db = deadline_budget(h, &budget);
+      if (has_db && budget <= 0) {
+        respond_err(s, "DEADLINE_EXCEEDED",
+                    "deadline budget exhausted before blockport " + method +
+                        " executed");
+        continue;
+      }
+      const std::string tenant =
+          (h.count("_tn") && !h["_tn"].s.empty()) ? h["_tn"].s : "system";
+      bool admitted = false;
+      uint64_t t_admit = 0;
+      if (qos_.enabled()) {
+        std::string detail;
+        double retry_after = 0.0;
+        if (!qos_.acquire(tenant, has_db, budget, &detail, &retry_after)) {
+          respond_shed(s, tenant, detail, retry_after);
+          continue;
+        }
+        admitted = true;
+        t_admit = now_ns();
+      }
+      bool keep = true;
       if (method == "WriteBlock" || method == "ReplicateBlock") {
         handle_write(s, h, has_data ? &payload : nullptr, &downstream);
       } else if (method == "WriteStream") {
         // false = the stream aborted after the ready ack: pipelined
         // frames may still sit unread in the socket, so the request
         // boundary is lost and the connection must close.
-        if (!handle_write_stream(s, h, &downstream)) break;
+        keep = handle_write_stream(s, h, &downstream);
       } else if (method == "ReadBlock") {
         handle_read(s, h);
-      } else if (method == "ReadBlocks") {
-        handle_read_batch(s, h);
       } else {
-        respond_err(s, "UNIMPLEMENTED",
-                    "no native blockport method " + method);
+        handle_read_batch(s, h);
       }
+      if (admitted)
+        qos_.release(tenant,
+                     static_cast<double>(now_ns() - t_admit) * 1e-9);
+      if (!keep) break;
     }
     for (auto& kv : downstream) close_downstream(kv.second);
   }
@@ -1052,6 +1749,30 @@ class Engine {
     w.str(code);
     w.str("message");
     w.str(msg);
+    send_frame(s, w.out, nullptr, 0);
+  }
+
+  // QoS refusal frame. Message parity with resilience.overloaded_message
+  // as raised by admission_controlled — "Overloaded|<hint>|ChunkServer
+  // <detail> (tenant=<t>)" — so client.py's text parser finds the hint,
+  // and the explicit retry_after key is the structured twin blocknet.py
+  // reads first.
+  void respond_shed(Stream& s, const std::string& tenant,
+                    const std::string& detail, double retry_after) {
+    errors_.fetch_add(1);
+    char hint[32];
+    std::snprintf(hint, sizeof(hint), "%.3f", retry_after);
+    Writer w;
+    w.map_head(4);
+    w.str("ok");
+    w.boolean(false);
+    w.str("code");
+    w.str("RESOURCE_EXHAUSTED");
+    w.str("message");
+    w.str(std::string("Overloaded|") + hint + "|ChunkServer " + detail +
+          " (tenant=" + tenant + ")");
+    w.str("retry_after");
+    w.flt(retry_after);
     send_frame(s, w.out, nullptr, 0);
   }
 
@@ -1247,6 +1968,8 @@ class Engine {
     const uint64_t t_start = now_ns();
     const uint64_t deadline_ns =
         has_db ? t_start + static_cast<uint64_t>(budget * 1e9) : 0;
+    const std::string qos_tenant =
+        (h.count("_tn") && !h["_tn"].s.empty()) ? h["_tn"].s : "system";
 
     // Open the staged file before acking ready; a failure here is still a
     // clean in-sync rejection.
@@ -1408,6 +2131,21 @@ class Engine {
         err_code = "DEADLINE_EXCEEDED";
         err_msg = "deadline budget exhausted at frame " +
                   std::to_string(seq);
+        break;
+      }
+      // Mid-stream shed: the force_shed failpoint (re-armed by a config
+      // re-push while this stream is in flight) aborts an ADMITTED
+      // stream between frames — the rpc_write_stream twin of the
+      // per-frame deadline abort above, driving the client's Overloaded
+      // retry path from inside a stream.
+      double shed_after = 0.0;
+      if (qos_.shed_frame(qos_tenant, &shed_after)) {
+        char hint[32];
+        std::snprintf(hint, sizeof(hint), "%.3f", shed_after);
+        err_code = "RESOURCE_EXHAUSTED";
+        err_msg = std::string("Overloaded|") + hint +
+                  "|ChunkServer stream shed at frame " +
+                  std::to_string(seq) + " (tenant=" + qos_tenant + ")";
         break;
       }
       Slot* sl;
@@ -2188,6 +2926,7 @@ class Engine {
   std::atomic<uint64_t> cache_hits_{0}, cache_misses_{0};
   void* srv_ctx_ = nullptr;  // SSL_CTX*, set by configure_tls
   void* cli_ctx_ = nullptr;  // SSL_CTX* for chain forwards
+  Qos qos_;  // tenant admission plane (off until set_qos enables it)
 };
 
 std::mutex g_engines_mu;
@@ -2206,7 +2945,7 @@ extern "C" {
 // Bumped on any signature/behavior change of the dataplane C ABI; the
 // Python loader refuses to bind mismatched prebuilt libraries
 // (TPUDFS_NATIVE_LIB) instead of calling with wrong arity.
-int64_t tpudfs_dataplane_abi(void) { return 5; }
+int64_t tpudfs_dataplane_abi(void) { return 6; }
 
 int64_t tpudfs_dataplane_start(const char* host, const char* hot_dir,
                                const char* cold_dir, uint32_t chunk_size,
@@ -2285,6 +3024,32 @@ void tpudfs_dataplane_stream_stats(int64_t h, uint64_t out[8]) {
   Engine* e = get_engine(h);
   if (e) e->stream_stage_stats(out);
   else for (int i = 0; i < 8; i++) out[i] = 0;
+}
+
+// QoS control contract (ABI 6). Python pushes the QosShedder config in
+// (a msgpack flat map built by resilience.qos_wire_config) at start and
+// on every change — the set_term of the admission plane.
+void tpudfs_dataplane_set_qos(int64_t h, const char* cfg, uint64_t len) {
+  Engine* e = get_engine(h);
+  if (e && cfg != nullptr)
+    e->qos_configure(reinterpret_cast<const uint8_t*>(cfg), len);
+}
+
+// Aggregate QoS counters: inflight, peak_inflight, admitted_total,
+// shed_total, queue_depth, queued_total, rate_limited_total,
+// evicted_total.
+void tpudfs_dataplane_qos_stats(int64_t h, uint64_t out[8]) {
+  Engine* e = get_engine(h);
+  if (e) e->qos_stats(out);
+  else for (int i = 0; i < 8; i++) out[i] = 0;
+}
+
+// Per-tenant "tenant\tadmitted\tshed\trate_limited\tqueue_depth\tp99_ns"
+// lines (non-destructive); returns bytes written, or -needed when cap is
+// short — the take_terms contract.
+int64_t tpudfs_dataplane_take_qos(int64_t h, char* buf, uint64_t cap) {
+  Engine* e = get_engine(h);
+  return e ? e->take_qos(buf, cap) : -1;
 }
 
 int64_t tpudfs_dataplane_stop(int64_t h) {

@@ -212,6 +212,10 @@ async def chaos(eps: dict) -> None:
     # contract: ops stay inside budget + grace (hedges dodge the slow
     # replica, the budget bounds whatever is left), retry volume stays
     # within 2x first tries, and throughput recovers once the shaping lifts.
+    # The overload runs against the C++ admission plane — a chunkserver
+    # that silently fell back to the asyncio blockport fails the run.
+    from tpudfs.testing.livecluster import assert_native_data_planes
+    await assert_native_data_planes(procs, tls, "t9")
     dead_cs = [n for n in procs if n.startswith("cs")][0]
     slow_addr = next(v["addr"] for k, v in procs.items()
                      if k.startswith("cs") and k != dead_cs and v["addr"])
@@ -317,6 +321,9 @@ async def chaos(eps: dict) -> None:
     # concurrency; QoS must keep the fair tenant's latency and error rate
     # bounded, visibly throttle the abuser, and re-admit the abuser once
     # the flood stops.
+    # Handshake first: the noisy-neighbor assertions below are only
+    # meaningful against the native engine's DRR/rate-bucket ladder.
+    await assert_native_data_planes(procs, tls, "t11")
     t11_payload = os.urandom(4 * 256 * 1024)
     t11_md5 = hashlib.md5(t11_payload).hexdigest()
     # local_reads=False: the whole cluster is on 127.0.0.1, and the

@@ -258,6 +258,11 @@ async def run_round(eps: dict, rng: random.Random, rnd: int,
     tn_budget_grace = 6.0 + 1.0
     tn_stop = asyncio.Event()
     if axes.get("tenant"):
+        # The tenant axis exercises the native engine's admission ladder;
+        # a chunkserver that silently fell back to the asyncio blockport
+        # fails the round before any fault fires.
+        from tpudfs.testing.livecluster import assert_native_data_planes
+        await assert_native_data_planes(procs, tls, "tenant axis")
         # local_reads=False: everything is on 127.0.0.1 and the local-read
         # short circuit would bypass server admission entirely.
         tn_fair = Client(masters, config_addrs=[eps["config_server"]],

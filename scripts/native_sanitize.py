@@ -6,9 +6,11 @@ the dynamic half of the contract: it builds the native library with
 ThreadSanitizer (or ASan/UBSan via --sanitizer), LD_PRELOADs the sanitizer
 runtime into a child Python, and stress-drives the streaming write engine
 the way a hot chunkserver does — concurrent WriteStream connections,
-mid-stream aborts, deliberately corrupt frames, and a second OS thread
-polling the stats/term/bad-block exports the whole time. Any sanitizer
-report anchored in native/ sources fails the gate.
+mid-stream aborts, deliberately corrupt frames, a multi-tenant admission
+flood against the QoS ladder (admits, queue parks, sheds, and config
+re-pushes racing the serving path), and a second OS thread polling the
+stats/term/bad-block/QoS exports the whole time. Any sanitizer report
+anchored in native/ sources fails the gate.
 
 Hosts that cannot run the sanitizer (no compiler, no libtsan, container
 ASLR/mmap restrictions) print ``SKIP native-sanitize: <reason>`` and exit
@@ -362,6 +364,49 @@ async def _corrupt_stream(port: int, lib, block_id: str, size: int) -> None:
         w.close()
 
 
+#: QoS config pushed into the instrumented engine (the wire shape of
+#: resilience.qos_wire_config, inlined so the child never imports grpc).
+#: Inflight stays generous and the rate bites only bursty NAMED tenants, so
+#: the happy-path "sanitize" streams are admitted while the flood tenants
+#: below drive the queue -> rate-limit -> shed ladder hard.
+QOS_CONFIG = {
+    "enabled": 1, "max_inflight": 64, "base_retry_after": 0.005,
+    "rate": 10.0, "burst": 8.0, "queue_depth": 4, "queue_wait": 0.02,
+    "default_weight": 1.0, "weights": ["flood0=2"], "jitter_seed": 7,
+}
+
+
+def _push_qos(lib, handle: int) -> None:
+    import msgpack
+
+    cfg = msgpack.packb(QOS_CONFIG, use_bin_type=True)
+    lib.tpudfs_dataplane_set_qos(handle, cfg, len(cfg))
+
+
+async def _tenant_flood(port: int, tenant: str, n: int) -> tuple[int, int]:
+    """One tenant hammering ReadBlock on a missing block id, far past its
+    rate: admitted requests come back NOT_FOUND, the rest park in the DRR
+    queue and shed with a retry hint. Returns (admitted, shed)."""
+    import asyncio
+
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    admitted = shed = 0
+    try:
+        for i in range(n):
+            w.writelines(_pack_frame(
+                {"m": "ReadBlock", "block_id": f"no-such-{tenant}",
+                 "offset": 0, "length": 0, "_tn": tenant}, None))
+            await w.drain()
+            resp, _ = await _read_frame(r)
+            if "retry_after" in resp:
+                shed += 1
+            else:
+                admitted += 1
+    finally:
+        w.close()
+    return admitted, shed
+
+
 def stress(args: argparse.Namespace) -> int:
     import asyncio
     import ctypes
@@ -397,6 +442,7 @@ def stress(args: argparse.Namespace) -> int:
         def poll() -> None:
             vals6 = (ctypes.c_uint64 * 6)()
             vals8 = (ctypes.c_uint64 * 8)()
+            qos8 = (ctypes.c_uint64 * 8)()
             buf = ctypes.create_string_buffer(4096)
             while not stop_evt.is_set():
                 lib.tpudfs_dataplane_stats(handle, vals6)
@@ -404,13 +450,22 @@ def stress(args: argparse.Namespace) -> int:
                 lib.tpudfs_dataplane_stage_stats(handle, vals8)
                 lib.tpudfs_dataplane_take_bad(handle, buf, len(buf))
                 lib.tpudfs_dataplane_take_terms(handle, buf, len(buf))
+                lib.tpudfs_dataplane_qos_stats(handle, qos8)
+                lib.tpudfs_dataplane_take_qos(handle, buf, len(buf))
                 lib.tpudfs_dataplane_term(handle, b"shard-0")
                 stop_evt.wait(0.002)
 
         poller = threading.Thread(target=poll, name="stats-poller")
         poller.start()
 
+        # Tenant QoS live for the whole run: stream begins and the flood
+        # below go through the native admission ladder concurrently.
+        _push_qos(lib, handle)
+        flood_admitted = 0
+        flood_shed = 0
+
         async def one_round(rnd: int) -> None:
+            nonlocal flood_admitted, flood_shed
             size = FRAME_SIZE * 2 + 1031  # 3 frames, last one partial
             tasks = []
             for i in range(args.streams):
@@ -419,7 +474,21 @@ def stress(args: argparse.Namespace) -> int:
             tasks.append(_aborted_stream(port, lib, f"san-{rnd}-torn0", size))
             tasks.append(_aborted_stream(port, lib, f"san-{rnd}-torn1", size))
             tasks.append(_corrupt_stream(port, lib, f"san-{rnd}-crc", size))
-            await asyncio.gather(*tasks)
+            # Multi-tenant admission flood: four tenants, each well past
+            # its rate, racing the stream traffic through the QoS lock.
+            floods = [_tenant_flood(port, f"flood{t}", 40) for t in range(4)]
+
+            async def repush() -> None:
+                # Config re-pushes mid-flood: configure() clears buckets
+                # and re-seeds the rng while acquire()/shed run.
+                for _ in range(3):
+                    await asyncio.sleep(0.01)
+                    _push_qos(lib, handle)
+
+            results = await asyncio.gather(*tasks, *floods, repush())
+            for res in results[len(tasks):len(tasks) + len(floods)]:
+                flood_admitted += res[0]
+                flood_shed += res[1]
             # Control-plane calls interleaved from the loop thread while
             # the poller thread reads the same state.
             lib.tpudfs_dataplane_invalidate(handle, f"san-{rnd}-ok0".encode())
@@ -435,6 +504,9 @@ def stress(args: argparse.Namespace) -> int:
         vals8 = (ctypes.c_uint64 * 8)()
         lib.tpudfs_dataplane_stream_stats(handle, vals8)
         streams, aborts = int(vals8[5]), int(vals8[7])
+        qos8 = (ctypes.c_uint64 * 8)()
+        lib.tpudfs_dataplane_qos_stats(handle, qos8)
+        qos_admitted, qos_shed = int(qos8[2]), int(qos8[3])
         rc_stop = int(lib.tpudfs_dataplane_stop(handle))
         expect = args.rounds * args.streams
         if streams < expect:
@@ -445,10 +517,22 @@ def stress(args: argparse.Namespace) -> int:
             print(f"stress: engine reports {aborts} aborts, "
                   f"expected >= {args.rounds}")
             return 1
+        # The flood must have driven BOTH admission outcomes, or the QoS
+        # lock was never actually contended and the stage proved nothing.
+        if flood_admitted == 0 or flood_shed == 0:
+            print(f"stress: tenant flood admitted={flood_admitted} "
+                  f"shed={flood_shed}; both must be > 0")
+            return 1
+        if qos_admitted < flood_admitted or qos_shed < flood_shed:
+            print(f"stress: engine qos counters (admitted={qos_admitted}, "
+                  f"shed={qos_shed}) below client-observed "
+                  f"({flood_admitted}, {flood_shed})")
+            return 1
         if rc_stop != 0:
             print(f"stress: dataplane_stop returned {rc_stop}")
             return 1
         print(f"stress: {streams} streams, {aborts} aborts, "
+              f"{flood_admitted} flood admits, {flood_shed} flood sheds, "
               f"{args.rounds} rounds ok")
     return 0
 

@@ -293,8 +293,12 @@ def main() -> None:
         # and an abuser tenant floods the data path through the seeded
         # fault window — the fair tenant stays inside its deadline budget
         # and never starves, and both tenants read clean post-faults
-        # (the noisy-neighbor tier, docs/qos.md).
-        run("live chaos roulette (tenant axis)",
+        # (the noisy-neighbor tier, docs/qos.md). Since ABI 6 the QoS
+        # ladder lives in the C++ engine, so this round runs against the
+        # NATIVE data plane: the roulette asserts the DataPort handshake
+        # reports "native": true on every chunkserver before flooding —
+        # a silent fall-back to the asyncio blockport fails the round.
+        run("live chaos roulette (tenant axis, native QoS)",
             [sys.executable, "-u", "scripts/chaos_roulette.py", "1",
              "--seed=4680", "--force-axes=tenant",
              "--topology", args.topology])

@@ -281,6 +281,55 @@ def test_tpl041_canonical_status_code_is_silent(tmp_path):
     assert native_lint(tmp_path, cc, rule="TPL041") == []
 
 
+def test_tpl041_flags_qos_constant_drift(tmp_path):
+    # ABI 6: the admission-ladder defaults are paired — retuning the DRR
+    # quantum on one side makes native and asyncio shed differently.
+    findings = native_lint(
+        tmp_path,
+        "constexpr int kQosDrrQuantum = 2;\n",
+        "QOS_DRR_QUANTUM = 1\n",
+        rule="TPL041", py_rel="tpudfs/common/resilience.py")
+    assert rule_ids(findings) == ["TPL041"]
+    assert "kQosDrrQuantum" in findings[0].message
+    assert "disagree" in findings[0].message
+
+
+def test_tpl041_qos_equal_constants_are_silent(tmp_path):
+    assert native_lint(
+        tmp_path,
+        "constexpr int kQosDrrQuantum = 1;\n"
+        "constexpr int kQosMinBurst = 1;\n",
+        "QOS_DRR_QUANTUM = 1\nQOS_MIN_BURST = 1\n",
+        rule="TPL041", py_rel="tpudfs/common/resilience.py") == []
+
+
+def test_tpl041_flags_qos_config_key_missing_from_native(tmp_path):
+    # qos_wire_config emits "jitter_seed" but the engine never reads it:
+    # the native plane would draw unseeded jitter and parity tests drift.
+    findings = native_lint(
+        tmp_path,
+        "void f() {}\n",
+        'KEY = "jitter_seed"\n',
+        rule="TPL041", py_rel="tpudfs/common/resilience.py")
+    assert rule_ids(findings) == ["TPL041"]
+    assert "`jitter_seed`" in findings[0].message
+    assert findings[0].path.endswith("resilience.py")
+
+
+def test_tpl041_flags_shed_detail_missing_from_python(tmp_path):
+    cc = """\
+        void f() {
+          const char* d = "tenant queue full";
+          use(d);
+        }
+    """
+    findings = native_lint(tmp_path, cc, "X = 1\n", rule="TPL041",
+                           py_rel="tpudfs/common/resilience.py")
+    assert rule_ids(findings) == ["TPL041"]
+    assert "`tenant queue full`" in findings[0].message
+    assert findings[0].path == "native/dataplane.cc"
+
+
 # ------------------------------------------------------------- TPL042
 
 
@@ -305,6 +354,64 @@ def test_tpl042_flags_unguarded_write_to_shared_field(tmp_path):
     assert "terms_" in findings[0].message
     assert "holds no lock" in findings[0].message
     assert "mu_" in findings[0].message  # hints at the guarded site
+
+
+def test_tpl042_guarded_by_annotation_silences_helper(tmp_path):
+    # The Qos idiom: public methods take mu_, private helpers assert the
+    # caller holds it via `// tpulint: guarded-by(mu_)`.
+    cc = SHARED_STATE_CC.replace(
+        "  void set_term(uint64_t t) {",
+        "  // tpulint: guarded-by(mu_)\n  void set_term(uint64_t t) {")
+    assert native_lint(tmp_path, cc, rule="TPL042") == []
+
+
+def test_tpl042_guarded_by_wrong_mutex_still_flags(tmp_path):
+    cc = SHARED_STATE_CC.replace(
+        "  void set_term(uint64_t t) {",
+        "  // tpulint: guarded-by(other_mu_)\n  void set_term(uint64_t t) {")
+    findings = native_lint(tmp_path, cc, rule="TPL042")
+    assert rule_ids(findings) == ["TPL042"]
+    assert "no single lock" in findings[0].message
+
+
+def test_tpl042_internally_synced_member_is_exempt(tmp_path):
+    # Engine holds `Qos qos_` — Qos owns its own mutex, so calls into it
+    # from connection threads need no Engine-level lock.
+    cc = """\
+        class Qos {
+          std::mutex mu_;
+          uint64_t n_ = 0;
+          void bump() { std::lock_guard<std::mutex> g(mu_); n_++; }
+        };
+        struct Engine {
+          std::mutex emu_;
+          Qos qos_;
+          uint64_t other_ = 0;
+          void handle() { qos_.bump(); }
+          void count() { std::lock_guard<std::mutex> g(emu_); other_++; }
+        };
+    """
+    assert native_lint(tmp_path, cc, rule="TPL042") == []
+
+
+def test_tpl043_guarded_by_method_blocking_call_is_flagged(tmp_path):
+    # guarded-by means the lock IS held — blocking inside is worse, not
+    # better, and must still trip TPL043.
+    cc = """\
+        struct Engine {
+          std::mutex mu_;
+          uint64_t n_ = 0;
+          // tpulint: guarded-by(mu_)
+          void drain() {
+            n_++;
+            fsync(3);
+          }
+        };
+    """
+    findings = native_lint(tmp_path, cc, rule="TPL043")
+    assert rule_ids(findings) == ["TPL043"]
+    assert "fsync" in findings[0].message
+    assert "mu_" in findings[0].message
 
 
 def test_tpl042_locked_accesses_are_silent(tmp_path):
@@ -504,6 +611,34 @@ def test_mutating_one_wire_constant_fails_lint(tmp_path):
     findings = _native_findings(root)
     assert any(f.rule == "TPL041" and "kAckEvery" in f.message
                for f in findings), rule_ids(findings)
+
+
+def test_mutating_qos_shed_detail_fails_lint(tmp_path):
+    root = _copy_real_tree(tmp_path)
+    dp = root / "native" / "dataplane.cc"
+    src = dp.read_text()
+    assert '"tenant queue full"' in src
+    dp.write_text(src.replace('"tenant queue full"', '"tenant q full"'))
+    findings = _native_findings(root)
+    assert any(f.rule == "TPL041" and "tenant queue full" in f.message
+               for f in findings), rule_ids(findings)
+
+
+def test_abi6_bump_without_manifest_regen_fails_lint(tmp_path):
+    # The discipline the ABI 6 bump itself had to follow: bumping the
+    # version constant without regenerating native_abi.json must fail.
+    root = _copy_real_tree(tmp_path)
+    dp = root / "native" / "dataplane.cc"
+    src = dp.read_text()
+    needle = "int64_t tpudfs_dataplane_abi(void) { return 6; }"
+    assert needle in src
+    dp.write_text(src.replace(
+        needle, "int64_t tpudfs_dataplane_abi(void) { return 7; }"))
+    findings = _native_findings(root)
+    tpl040 = [f for f in findings if f.rule == "TPL040"]
+    assert tpl040, rule_ids(findings)
+    assert any("--write-native-abi" in f.message or "manifest" in f.message
+               for f in tpl040)
 
 
 def test_mutating_one_export_arity_fails_lint(tmp_path):

@@ -21,6 +21,7 @@ from tpudfs.common.resilience import (
     Deadline,
     DeficitRoundRobin,
     LoadShedder,
+    QosFailpoints,
     QosRejected,
     QosShedder,
     RateBucket,
@@ -28,6 +29,7 @@ from tpudfs.common.resilience import (
     as_system_tenant,
     current_tenant,
     deadline_scope,
+    jittered,
     raw_tenant,
     seed_retry_jitter,
     set_deadline,
@@ -503,3 +505,208 @@ async def test_noisy_neighbor_fair_tenant_latency_bounded(tmp_path):
         assert not post, post
     finally:
         await c.stop()
+
+
+# ------------------------------------------ native / asyncio engine parity
+
+
+async def _bare_cs(tmp_path, name: str, rpc, *, python_data_plane: bool):
+    """A chunkserver with no master and no heartbeat loop: the only jitter
+    draws during these tests come from the shedders under test."""
+    from tpudfs.chunkserver.blockstore import BlockStore
+    from tpudfs.chunkserver.service import ChunkServer
+
+    store = BlockStore(tmp_path / name / "hot")
+    cs = ChunkServer(store, rack_id=name, master_addrs=[], rpc_client=rpc,
+                     python_data_plane=python_data_plane)
+    await cs.start(scrubber=False)
+    assert cs.data_port > 0
+    return cs
+
+
+def _parity_shedder() -> QosShedder:
+    """burst=2 admits exactly two requests; with ``freeze_refill`` the
+    bucket never recovers, so every later request queues, times out after
+    50ms, and is refused with ``jittered(1.0)`` — fully deterministic."""
+    return QosShedder(max_inflight=4, base_retry_after=0.1, rate=1.0,
+                      burst=2.0, queue_depth=2, max_queue_wait=0.05,
+                      failpoints=QosFailpoints.from_env())
+
+
+async def _drive_ladder(pool, port: int, n: int) -> list[tuple]:
+    """n sequential ReadBlocks of a missing block as tenant ``parity``:
+    admitted requests surface NOT_FOUND, refused ones RESOURCE_EXHAUSTED
+    with the wire-precision retry hint."""
+    out = []
+    with tenant_scope("parity"):
+        for _ in range(n):
+            try:
+                await pool._call_blockport(
+                    f"127.0.0.1:{port}", "ReadBlock",
+                    {"block_id": "parity-missing", "offset": 0, "length": 0})
+                out.append(("OK", None, ""))
+            except RpcError as e:
+                hint = (None if e.retry_after is None
+                        else f"{e.retry_after:.3f}")
+                out.append((e.code.name, hint, e.message))
+    return out
+
+
+async def test_qos_ladder_parity_native_vs_asyncio(tmp_path, monkeypatch):
+    """THE cross-engine contract: with a fixed jitter seed and a frozen
+    refill clock, the queue -> rate-limit -> shed ladder makes the same
+    decisions, mints the same retry_after values (to wire precision), and
+    counts the same per-tenant totals on the C++ engine and the asyncio
+    blockport for the same request schedule."""
+    from tpudfs.common import native
+    from tpudfs.common.blocknet import BlockConnPool
+
+    if not native.has_dataplane():
+        pytest.skip("native dataplane unavailable")
+    monkeypatch.setenv("TPUDFS_QOS_FAILPOINT", "freeze_refill")
+
+    # The expected tail, from the shared SplitMix64 stream: one draw per
+    # rejection, none per admission, formatted at the wire's %.3f.
+    seed_retry_jitter(1234)
+    expected_hints = [f"{jittered(1.0):.3f}" for _ in range(4)]
+
+    rpc = RpcClient()
+    pool = BlockConnPool()
+    observed: dict[str, list] = {}
+    counters: dict[str, dict] = {}
+    try:
+        for engine, python_dp in (("native", False), ("asyncio", True)):
+            seed_retry_jitter(1234)
+            cs = await _bare_cs(tmp_path, engine, rpc,
+                                python_data_plane=python_dp)
+            try:
+                hello = await cs.rpc_data_port({})
+                assert hello["native"] is (engine == "native")
+                cs.shedder = _parity_shedder()
+                if engine == "native":
+                    assert cs._native_dp is not None
+                    cs.push_native_qos()  # seeds the C++ rng with 1234
+                observed[engine] = await _drive_ladder(
+                    pool, cs.data_port, 6)
+                counters[engine] = (cs.drain_native_qos()
+                                    if engine == "native"
+                                    else cs.shedder.counters())
+            finally:
+                await cs.stop()
+    finally:
+        await pool.close()
+        await rpc.close()
+
+    assert observed["native"] == observed["asyncio"], observed
+    codes = [c for c, _, _ in observed["native"]]
+    assert codes == (["NOT_FOUND"] * 2 + ["RESOURCE_EXHAUSTED"] * 4), codes
+    assert [h for _, h, _ in observed["native"][2:]] == expected_hints
+    for _, _, msg in observed["native"][2:]:
+        assert "ChunkServer rate limited (tenant=parity)" in msg, msg
+
+    for key, want in (("shed_admitted_total", 2.0), ("shed_total", 4.0),
+                      ("qos_rate_limited_total", 4.0),
+                      ("qos_tenant_parity_admitted_total", 2.0),
+                      ("qos_tenant_parity_shed_total", 4.0),
+                      ("qos_tenant_parity_rate_limited_total", 4.0)):
+        assert counters["native"].get(key, 0.0) == want, (key, counters)
+        assert counters["asyncio"].get(key, 0.0) == want, (key, counters)
+
+
+async def test_mixed_chain_downstream_shed_degrades_not_fails(tmp_path,
+                                                              monkeypatch):
+    """Mixed native<->asyncio chains where the DOWNSTREAM hop sheds: the
+    head absorbs the refusal, keeps its durable local replica, and acks
+    success with a degraded replica count (the healer's contract) — in
+    both directions."""
+    from tpudfs.common import native
+    from tpudfs.common.blocknet import BlockConnPool
+    from tpudfs.common.checksum import crc32c
+
+    if not native.has_dataplane():
+        pytest.skip("native dataplane unavailable")
+    monkeypatch.delenv("TPUDFS_QOS_FAILPOINT", raising=False)
+
+    rpc = RpcClient()
+    pool = BlockConnPool()
+    data = b"mixed-chain-shed" * 512
+    try:
+        for head_engine in ("native", "asyncio"):
+            head = await _bare_cs(tmp_path, f"head-{head_engine}", rpc,
+                                  python_data_plane=head_engine == "asyncio")
+            down = await _bare_cs(tmp_path, f"down-{head_engine}", rpc,
+                                  python_data_plane=head_engine == "native")
+            try:
+                # Zero admission downstream: inflight 0 + queue 0 refuses
+                # every request at the door, deterministically.
+                down.shedder = QosShedder(max_inflight=0, queue_depth=0,
+                                          max_queue_wait=0.01)
+                down.push_native_qos()
+                bid = f"mix-{head_engine}"
+                with tenant_scope("parity"):
+                    resp = await pool._call_blockport(
+                        f"127.0.0.1:{head.data_port}", "WriteBlock",
+                        {"block_id": bid, "data": data,
+                         "next_servers": [down.address],
+                         "next_data_ports": [down.data_port],
+                         "expected_crc32c": crc32c(data),
+                         "master_term": 0})
+                assert resp["success"]
+                assert resp["replicas_written"] == 1, resp
+                assert head.store.read(bid) == data
+                down_counts = (down.drain_native_qos()
+                               if down._native_dp is not None
+                               else down.shedder.counters())
+                assert down_counts.get("shed_total", 0.0) >= 1.0, down_counts
+                assert down_counts.get(
+                    "qos_tenant_parity_shed_total", 0.0) >= 1.0, down_counts
+            finally:
+                await down.stop()
+                await head.stop()
+    finally:
+        await pool.close()
+        await rpc.close()
+
+
+async def test_stop_drains_native_qos_counters_and_terms(tmp_path,
+                                                         monkeypatch):
+    """Regression (stats-drain ride-along): QoS counters and request-learned
+    terms drained from the native engine at stop() survive the engine —
+    they used to exist only between heartbeats, so a restart lost them."""
+    from tpudfs.common import native
+    from tpudfs.common.blocknet import BlockConnPool
+
+    if not native.has_dataplane():
+        pytest.skip("native dataplane unavailable")
+    monkeypatch.setenv("TPUDFS_QOS_FAILPOINT", "freeze_refill")
+
+    seed_retry_jitter(99)
+    rpc = RpcClient()
+    pool = BlockConnPool()
+    cs = await _bare_cs(tmp_path, "drain", rpc, python_data_plane=False)
+    try:
+        cs.shedder = _parity_shedder()
+        cs.push_native_qos()
+        decisions = await _drive_ladder(pool, cs.data_port, 4)
+        assert [c for c, _, _ in decisions] == \
+            ["NOT_FOUND", "NOT_FOUND",
+             "RESOURCE_EXHAUSTED", "RESOURCE_EXHAUSTED"]
+        # A request-learned term (stale-term fencing state) to drain too.
+        with tenant_scope("parity"):
+            with pytest.raises(RpcError):
+                await pool._call_blockport(
+                    f"127.0.0.1:{cs.data_port}", "ReadBlock",
+                    {"block_id": "parity-missing", "offset": 0, "length": 0})
+    finally:
+        await cs.stop()
+        await pool.close()
+        await rpc.close()
+
+    # Engine is gone; the final snapshot still reports the run's totals.
+    assert cs._native_dp is None
+    final = cs.drain_native_qos()
+    assert final.get("shed_admitted_total") == 2.0, final
+    assert final.get("qos_tenant_parity_shed_total", 0.0) >= 2.0, final
+    # And the ops surface keeps exporting them after stop.
+    gauges = cs.ops_gauges()
+    assert gauges.get("qos_tenant_parity_shed_total", 0.0) >= 2.0

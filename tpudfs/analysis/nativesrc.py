@@ -28,7 +28,10 @@ the real tree. Suppression grammar mirrors the Python one with C++
 comments: ``// tpulint: disable=TPL042`` (line or line above) and
 ``// tpulint: disable-file=TPL042``; ``// tpulint: pre-start`` above a
 method marks it as running before any engine thread exists (constructor
-and destructor get that for free).
+and destructor get that for free); ``// tpulint: guarded-by(mu_)`` above
+a method asserts that every caller already holds ``mu_`` — the lock
+analysis treats the whole body as running under that mutex (the lexical
+twin of Clang's ``REQUIRES()`` thread-safety annotation).
 """
 
 from __future__ import annotations
@@ -370,6 +373,9 @@ class CMethod:
     is_ctor: bool = False
     is_dtor: bool = False
     pre_start: bool = False
+    #: Mutexes every caller is asserted to hold (`// tpulint:
+    #: guarded-by(mu_)` above the method) — seeds the lock analysis.
+    guarded_by: tuple[str, ...] = ()
 
 
 @dataclass
@@ -562,6 +568,8 @@ _SUPPRESS_CC_RE = re.compile(
     r"//\s*tpulint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\s]+)"
 )
 _PRE_START_RE = re.compile(r"//\s*tpulint:\s*pre-start\b")
+_GUARDED_BY_RE = re.compile(
+    r"//\s*tpulint:\s*guarded-by\(\s*([A-Za-z_]\w*)\s*\)")
 
 
 class NativeSource:
@@ -587,6 +595,7 @@ class NativeSource:
         self._line_suppressions: dict[int, set[str]] = {}
         self._file_suppressions: set[str] = set()
         self._pre_start_lines: set[int] = set()
+        self._guarded_by_lines: dict[int, tuple[str, ...]] = {}
         self._parse_comments()
         self._parse()
 
@@ -596,6 +605,9 @@ class NativeSource:
         for line, text in self.comments:
             if _PRE_START_RE.search(text):
                 self._pre_start_lines.add(line)
+            mutexes = tuple(_GUARDED_BY_RE.findall(text))
+            if mutexes:
+                self._guarded_by_lines[line] = mutexes
             m = _SUPPRESS_CC_RE.search(text)
             if not m:
                 continue
@@ -620,6 +632,12 @@ class NativeSource:
     def _is_pre_start(self, decl_line: int) -> bool:
         return any(ln in self._pre_start_lines
                    for ln in range(decl_line - 2, decl_line + 1))
+
+    def _guarded_by(self, decl_line: int) -> tuple[str, ...]:
+        out: tuple[str, ...] = ()
+        for ln in range(decl_line - 2, decl_line + 1):
+            out += self._guarded_by_lines.get(ln, ())
+        return out
 
     def snippet(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -865,6 +883,7 @@ class NativeSource:
                         is_ctor=m_name_tok.text == name and not is_dtor,
                         is_dtor=is_dtor,
                         pre_start=self._is_pre_start(unit[0].line),
+                        guarded_by=self._guarded_by(unit[0].line),
                     )
                     cls.methods.append(method)
                     i = close + 1
@@ -920,11 +939,13 @@ class _HeldLock:
     active: bool = True
 
 
-def iter_with_locks(body: list[Token]):
+def iter_with_locks(body: list[Token], base: tuple[str, ...] = ()):
     """Yield ``(index, token, held)`` for each token of a method body,
     where ``held`` is the tuple of mutex names lexically locked at that
     point (``lock_guard``/``unique_lock`` declarations, honoring
-    ``.unlock()``/``.lock()`` toggles and scope ends)."""
+    ``.unlock()``/``.lock()`` toggles and scope ends). ``base`` seeds
+    the held set for the whole body — the caller-holds-the-lock contract
+    a ``// tpulint: guarded-by(mu_)`` annotation asserts."""
     depth = 0
     locks: list[_HeldLock] = []
     n = len(body)
@@ -955,7 +976,7 @@ def iter_with_locks(body: list[Token]):
                 # new lock for access purposes; skip past the ctor args.
                 close_p = _find_matching(body, j + 1, "(", ")")
                 for idx in range(i, close_p + 1):
-                    yield idx, body[idx], tuple(
+                    yield idx, body[idx], base + tuple(
                         lk.mutex for lk in locks[:-1] if lk.active)
                 i = close_p + 1
                 continue
@@ -966,7 +987,7 @@ def iter_with_locks(body: list[Token]):
                 if lk.var == t.text:
                     lk.active = body[i + 2].text == "lock"
                     break
-        yield i, t, tuple(lk.mutex for lk in locks if lk.active)
+        yield i, t, base + tuple(lk.mutex for lk in locks if lk.active)
         i += 1
 
 

@@ -25,7 +25,13 @@ lexically, on every lint:
 Both rules are pragmatic lexical passes tuned for the native sources'
 idiom (members named ``foo_``, ``std::lock_guard<std::mutex> g(mu_)``),
 biased to zero false positives on the real tree; genuinely clever code
-can opt out per line with ``// tpulint: disable=TPL042``.
+can opt out per line with ``// tpulint: disable=TPL042``. Two idioms
+are recognized structurally instead of suppressed: a private helper
+annotated ``// tpulint: guarded-by(mu_)`` is analyzed as if ``mu_``
+were held for its whole body (callers take the lock — the Qos admission
+plane's `_locked` helpers), and a member whose type is a lock-owning
+class defined in the same file (``Qos qos_``) is exempt from TPL042 in
+the enclosing class because it synchronizes itself.
 """
 
 from __future__ import annotations
@@ -135,7 +141,7 @@ def _field_accesses(cls: CClass, field_name: str,
     out: list[_Access] = []
     for m in methods:
         body = m.body
-        for i, tok, held in iter_with_locks(body):
+        for i, tok, held in iter_with_locks(body, base=m.guarded_by):
             if tok.kind != "id" or tok.text != field_name:
                 continue
             if _is_member_access(body, i):
@@ -181,9 +187,11 @@ struct Engine {
 """
     fix = ("Take the field's mutex (`std::lock_guard<std::mutex> "
            "g(mu_);`) around the access, make the field std::atomic if "
-           "it is a scalar counter, or — if the method really runs "
-           "before any thread is spawned — annotate it with "
-           "`// tpulint: pre-start` on the line above.")
+           "it is a scalar counter, annotate a helper whose callers "
+           "all hold the lock with `// tpulint: guarded-by(mu_)` on "
+           "the line above, or — if the method really runs before any "
+           "thread is spawned — annotate it with `// tpulint: "
+           "pre-start`.")
 
     def check_project(self, project) -> Iterator[Finding]:
         _root, sources = native_context(project)
@@ -200,8 +208,17 @@ struct Engine {
                      ) -> Iterator[Finding]:
         normal = [m for m in cls.methods
                   if not (m.is_ctor or m.is_dtor or m.pre_start)]
+        # A member whose type is itself a lock-owning class defined in
+        # this file (e.g. `Qos qos_`) is a synchronization domain of its
+        # own — its internals are checked when that class is analyzed,
+        # and calls into it from any thread are the intended interface.
+        sync_classes = {c.name for c in src.classes
+                        if c.has_sync and c.name != cls.name}
         for name, fld in cls.fields.items():
             if fld.sync or fld.const:
+                continue
+            type_words = fld.type_text.split()
+            if type_words and type_words[0] in sync_classes:
                 continue
             accesses = _field_accesses(cls, name, normal)
             if not accesses:
@@ -253,7 +270,8 @@ struct Engine {
             accesses: list[_Access] = []
             for m in bodies:
                 body = m.body
-                for i, tok, held in iter_with_locks(body):
+                for i, tok, held in iter_with_locks(body,
+                                                    base=m.guarded_by):
                     if tok.kind != "id" or tok.text != name:
                         continue
                     if _is_member_access(body, i):
@@ -384,7 +402,7 @@ int64_t persist(const std::string& id, const uint8_t* p, uint64_t n) {
     def _check_body(self, src: NativeSource, scope: str, m: CMethod,
                     blocking: dict[str, str]) -> Iterator[Finding]:
         body = m.body
-        for i, tok, held in iter_with_locks(body):
+        for i, tok, held in iter_with_locks(body, base=m.guarded_by):
             if not held or tok.kind != "id":
                 continue
             nxt = body[i + 1] if i + 1 < len(body) else None

@@ -11,8 +11,10 @@ lexically, on every lint:
 
 - paired numeric constants (``ACK_EVERY`` ↔ ``kAckEvery``,
   ``_MAX_HEADER`` ↔ ``kMaxHeader``, ``_MAX_PAYLOAD`` ↔ ``kMaxPayload``,
-  ``MAX_STREAM_BYTES`` ↔ ``kMaxStreamBytes``, the CRC32C polynomial)
-  must exist on both sides with equal values — edit one and lint fails;
+  ``MAX_STREAM_BYTES`` ↔ ``kMaxStreamBytes``, the CRC32C polynomial,
+  and — since ABI 6 — the QoS admission defaults ``QOS_DRR_QUANTUM`` ↔
+  ``kQosDrrQuantum`` etc.) must exist on both sides with equal values —
+  edit one and lint fails;
 - every required msgpack header key (``m``/``q``/``c``/``w``/``final``/
   ``_d``/``_db``/``_tn``/... ) must appear as a string literal on both
   sides — a renamed or dropped key is drift even before values diverge;
@@ -58,6 +60,17 @@ CONSTANT_PAIRS: tuple[tuple[str, str, str, str], ...] = (
      "native/dataplane.cc", "kCrcPoly"),
     ("tpudfs/common/checksum.py", "_POLY",
      "native/crc32c.cc", "kPoly"),
+    # ABI 6: QoS admission ladder defaults. The native engine re-implements
+    # QosShedder's degradation ladder; these tuning constants must stay in
+    # lockstep or the two planes shed at different thresholds.
+    ("tpudfs/common/resilience.py", "QOS_DRR_QUANTUM",
+     "native/dataplane.cc", "kQosDrrQuantum"),
+    ("tpudfs/common/resilience.py", "QOS_QUEUE_DEPTH_DEFAULT",
+     "native/dataplane.cc", "kQosQueueDepthDefault"),
+    ("tpudfs/common/resilience.py", "QOS_MIN_BURST",
+     "native/dataplane.cc", "kQosMinBurst"),
+    ("tpudfs/common/resilience.py", "_LATENCY_RING",
+     "native/dataplane.cc", "kQosLatencyRing"),
 )
 
 #: Python modules whose (non-docstring) string literals form the Python
@@ -78,6 +91,19 @@ REQUIRED_KEYS: tuple[tuple[str, tuple[str, ...]], ...] = (
                       "next_servers", "next_data_ports")),
     ("stream acks", ("ok", "ready", "q", "c", "w", "final", "success",
                      "error_message", "replicas_written")),
+    # ABI 6: the native QoS plane's shed envelope and the detail strings
+    # parity tests key on. RESOURCE_EXHAUSTED itself is covered by the
+    # status-code check; the Overloaded| prefix and the retry_after header
+    # key are what the client retry-budget path parses.
+    ("qos shed envelope", ("retry_after", "Overloaded|", "rate limited",
+                           "tenant queue full",
+                           "deadline expired in admission queue",
+                           "failpoint forced shed")),
+    # ABI 6: qos_wire_config msgpack keys consumed by
+    # tpudfs_dataplane_set_qos.
+    ("qos config", ("enabled", "max_inflight", "base_retry_after", "rate",
+                    "burst", "queue_depth", "queue_wait", "default_weight",
+                    "weights", "jitter_seed")),
 )
 
 #: The canonical grpc.StatusCode names. Hardcoded (not imported from

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 
 from tpudfs.common.ops_http import maybe_start_ops
 from tpudfs.common.rpc import add_tls_args, tls_from_args
@@ -33,6 +34,13 @@ def parse_args(argv=None):
     p.add_argument("--http-port", type=int, default=-1,
                    help="ops HTTP (/health /metrics); "
                         "-1 = rpc port + 1000, 0 = disabled")
+    p.add_argument("--python-data-plane", action="store_true",
+                   default=os.environ.get(
+                       "TPUDFS_PYTHON_DATA_PLANE", "0") == "1",
+                   help="serve the blockport from the asyncio fallback "
+                        "instead of the native C++ engine (engine A/B "
+                        "benches; collective-write-group members select "
+                        "this implicitly). Env: TPUDFS_PYTHON_DATA_PLANE=1")
     return p.parse_args(argv)
 
 
@@ -49,6 +57,7 @@ async def amain(args) -> None:
         master_addrs=masters,
         scrub_interval=args.scrub_interval,
         rpc_client=RpcClient(tls=ctls) if ctls else None,
+        python_data_plane=args.python_data_plane,
     )
     await cs.start(args.host, args.port, tls=stls)
     hb = HeartbeatLoop(cs, masters, configs, interval=args.heartbeat_interval)

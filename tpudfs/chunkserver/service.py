@@ -31,6 +31,7 @@ import uuid
 from collections import OrderedDict
 
 import grpc
+import msgpack
 
 from tpudfs.common import blocknet, native, writestream
 from tpudfs.common.blocknet import BlockConnPool
@@ -39,8 +40,13 @@ from tpudfs.common.erasure import encode as ec_encode, reconstruct
 from tpudfs.common.resilience import (
     TENANT_FRAME_KEY,
     QosRejected,
+    RetryBudget,
     admission_controlled,
+    capped_by_key,
     current_tenant,
+    metric_key,
+    overloaded_message,
+    qos_wire_config,
     raw_tenant,
     remaining_budget,
     shedder_from_env,
@@ -255,6 +261,10 @@ class ChunkServer:
         self._server: RpcServer | None = None
         self._blockport = None
         self._native_dp: int | None = None
+        #: Final QoS-counter snapshot drained from the native engine at
+        #: stop() — /metrics keeps reporting the run's totals after the
+        #: engine is gone (same survival contract as learned terms).
+        self._native_qos_final: dict[str, float] = {}
         self.data_port = 0
         #: pooled raw-TCP data plane for CS<->CS block payloads (forwarding,
         #: recovery, EC shard distribution); falls back to gRPC per peer.
@@ -420,23 +430,15 @@ class ChunkServer:
             # cluster NEVER falls back to a plaintext engine.
             # build_and_load may run make on first use — off the loop.
             lib = await asyncio.to_thread(native.build_and_load)
-            # Tenant QoS (TPUDFS_QOS=1) is enforced by admission_controlled
-            # wrappers on the Python handlers; the C++ engine serves reads
-            # and the write chain without ever entering Python, so a
-            # QoS-enabled chunkserver runs the asyncio blockport or the
-            # per-tenant fair queue would see none of the data traffic.
-            # That no longer costs the streamed write path: the asyncio
-            # blockport speaks the same WriteStream frames (per-stream
-            # admission in rpc_write_stream), and native hops elsewhere in
-            # the chain preserve `_db`/`_tn`, so budgets and tenant
-            # attribution survive mixed QoS/non-QoS chains.
-            qos_active = getattr(self.shedder, "acquire", None) is not None
-            if qos_active and native.has_dataplane() \
-                    and not self.python_data_plane:
-                logger.info("tenant QoS active: using asyncio blockport so "
-                            "data-path traffic passes per-tenant admission")
+            # Tenant QoS (TPUDFS_QOS=1) no longer forces the asyncio
+            # blockport: the engine carries the full admission contract
+            # (ABI 6) — the same queue→rate-limit→shed ladder, per-tenant
+            # rate buckets, DRR fair queue, and jittered retry hints as
+            # QosShedder, configured by push_native_qos() below. The
+            # asyncio blockport remains for ICI members (their write path
+            # lives in rpc_write_block) and hosts without the toolchain.
             if native.has_dataplane() and not self.python_data_plane \
-                    and not qos_active and self._ici_group is None:
+                    and self._ici_group is None:
                 # ICI members run the asyncio blockport: its handlers
                 # route through rpc_write_block, where the collective
                 # write path lives (the C++ engine serves the whole chain
@@ -462,6 +464,7 @@ class ChunkServer:
                         lib.tpudfs_dataplane_set_term(
                             handle, shard.encode(), term
                         )
+                    self.push_native_qos()
                 else:
                     logger.warning("native dataplane failed to start (%d); "
                                    "using asyncio blockport", handle)
@@ -507,6 +510,15 @@ class ChunkServer:
             t.cancel()
         self._tasks.clear()
         await self.committer.stop()
+        # Final drains BEFORE the engine goes away: request-learned terms,
+        # corrupt-read findings, and QoS counters must survive the stop
+        # instead of dying with the engine — the heartbeat loop is the
+        # only other drain site, and a restart between its ticks would
+        # silently lose everything learned since the last one.
+        if self._native_dp is not None:
+            self.sync_native_terms()
+            self.poll_native_bad_blocks(recover=False)
+            self._native_qos_final = self.drain_native_qos()
         # Swap-then-await: claim each handle before suspending so a
         # concurrent stop() can't double-close it (TPL050).
         native_dp, self._native_dp = self._native_dp, None
@@ -610,10 +622,12 @@ class ChunkServer:
             if t > self.known_terms.get(shard, 0):
                 self.known_terms[shard] = t
 
-    def poll_native_bad_blocks(self) -> None:
+    def poll_native_bad_blocks(self, recover: bool = True) -> None:
         """Drain the native engine's corrupt-read findings into the same
         bad-block pipeline the Python read path feeds (heartbeat report +
-        background recovery)."""
+        background recovery). ``recover=False`` records the findings
+        without spawning recovery — the stop()-time drain, where new
+        background tasks would outlive the service."""
         if self._native_dp is None:
             return
         lib = native.get_lib()
@@ -629,7 +643,93 @@ class ChunkServer:
             if bid and bid not in self.pending_bad_blocks:
                 self.pending_bad_blocks.add(bid)
                 self.cache.invalidate(bid)
-                self._spawn(self._recover_silently(bid))
+                if recover:
+                    self._spawn(self._recover_silently(bid))
+
+    # ---------------------------------------------------------- native QoS
+
+    def push_native_qos(self) -> None:
+        """Push the current admission config into the native engine — the
+        ``set_term`` of the QoS plane. Called at start and again whenever
+        the shedder (or its failpoints) changes; a flat
+        :class:`LoadShedder` maps to ``enabled=0``, admission off."""
+        if self._native_dp is None:
+            return
+        lib = native.get_lib()
+        if lib is None or not getattr(lib, "tpudfs_has_dataplane", False):
+            return
+        cfg = msgpack.packb(qos_wire_config(self.shedder))
+        lib.tpudfs_dataplane_set_qos(self._native_dp, cfg, len(cfg))
+
+    def drain_native_qos(self) -> dict[str, float]:
+        """QoS counters drained out of the native engine, shaped exactly
+        like :meth:`QosShedder.counters` so /metrics merges the two
+        admission planes into one namespace (totals sum, gauges max).
+        After engine stop this returns the final pre-stop snapshot."""
+        if self._native_dp is None:
+            return dict(self._native_qos_final)
+        lib = native.get_lib()
+        if lib is None or not hasattr(lib, "tpudfs_dataplane_qos_stats"):
+            return dict(self._native_qos_final)
+        import ctypes
+
+        agg = (ctypes.c_uint64 * 8)()
+        lib.tpudfs_dataplane_qos_stats(self._native_dp, agg)
+        out = {
+            "shed_inflight": float(agg[0]),
+            "shed_peak_inflight": float(agg[1]),
+            "shed_admitted_total": float(agg[2]),
+            "shed_total": float(agg[3]),
+            "qos_queue_depth": float(agg[4]),
+            "qos_queued_total": float(agg[5]),
+            "qos_rate_limited_total": float(agg[6]),
+            "qos_evicted_total": float(agg[7]),
+        }
+        buf = ctypes.create_string_buffer(65536)
+        n = lib.tpudfs_dataplane_take_qos(self._native_dp, buf, len(buf))
+        if n < 0:
+            # -n is the needed size (take_terms contract) — retry, never
+            # silently drop tenants on large fleets.
+            buf = ctypes.create_string_buffer(-n)
+            n = lib.tpudfs_dataplane_take_qos(self._native_dp, buf,
+                                              len(buf))
+        admitted: dict[str, float] = {}
+        shed: dict[str, float] = {}
+        limited: dict[str, float] = {}
+        depth: dict[str, float] = {}
+        p99: dict[str, float] = {}
+        if n > 0:
+            for line in buf.raw[:n].decode("utf-8", "replace").split("\n"):
+                parts = line.split("\t")
+                if len(parts) != 6:
+                    continue
+                try:
+                    admitted[parts[0]] = float(parts[1])
+                    shed[parts[0]] = float(parts[2])
+                    limited[parts[0]] = float(parts[3])
+                    depth[parts[0]] = float(parts[4])
+                    p99[parts[0]] = float(parts[5]) / 1e9
+                except ValueError:
+                    continue
+        top = RetryBudget.EXPORT_TOP_N
+        out.update(capped_by_key("qos_tenant", admitted, top_n=top,
+                                 suffix="_admitted_total"))
+        out.update(capped_by_key("qos_tenant", shed, top_n=top,
+                                 suffix="_shed_total"))
+        out.update(capped_by_key("qos_tenant", limited, top_n=top,
+                                 suffix="_rate_limited_total"))
+        out.update(capped_by_key("qos_tenant", depth, top_n=top,
+                                 suffix="_queue_depth"))
+        # Gauge rollup by max, not sum — an averaged-away p99 is a lie
+        # (QosShedder.counters twin).
+        ranked = sorted(p99.items(), key=lambda kv: (-kv[1], kv[0]))
+        for i, (t, v) in enumerate(ranked):
+            if i < top:
+                out[f"qos_tenant_{metric_key(t)}_p99_seconds"] = float(v)
+            else:
+                key = "qos_tenant_other_p99_seconds"
+                out[key] = max(out.get(key, 0.0), float(v))
+        return out
 
     # ------------------------------------------------------------ write path
 
@@ -781,9 +881,16 @@ class ChunkServer:
             try:
                 await acquire(tenant)
             except QosRejected as e:
+                # Same Overloaded|<hint>| envelope admission_controlled
+                # raises (and the native engine's respond_shed sends) —
+                # without it the client's retry-budget path saw a QoS
+                # stream rejection as a hintless generic error.
                 await self._stream_err(
                     w, "RESOURCE_EXHAUSTED",
-                    f"{type(self).__name__} {e.detail} (tenant={tenant})")
+                    overloaded_message(
+                        e.retry_after,
+                        f"{type(self).__name__} {e.detail} "
+                        f"(tenant={tenant})"))
                 return True
             t0 = time.monotonic()
             try:
@@ -793,8 +900,10 @@ class ChunkServer:
         if not shedder.try_acquire():
             await self._stream_err(
                 w, "RESOURCE_EXHAUSTED",
-                f"{type(self).__name__} at admission limit "
-                f"({shedder.max_inflight} inflight)")
+                overloaded_message(
+                    shedder.retry_after(),
+                    f"{type(self).__name__} at admission limit "
+                    f"({shedder.max_inflight} inflight)"))
             return True
         try:
             return await self._serve_write_stream(req, r, w)
@@ -1304,6 +1413,17 @@ class ChunkServer:
         this build's addition)."""
         stats = self.store.stats()
         dp = self.data_plane_stats()
+        # Both admission planes in one namespace: the Python shedder
+        # (gRPC handlers + asyncio blockport) and the native engine's QoS
+        # counters, drained via take_qos. Totals sum; gauges (inflight,
+        # queue depth, p99) take the max — averaging them away would hide
+        # whichever plane is actually hot.
+        shed = dict(self.shedder.counters())
+        for k, v in self.drain_native_qos().items():
+            if k.endswith("_total"):
+                shed[k] = shed.get(k, 0.0) + v
+            else:
+                shed[k] = max(shed.get(k, 0.0), v)
         return {
             "used_space_bytes": stats["used_space"],
             "available_space_bytes": stats["available_space"],
@@ -1318,7 +1438,7 @@ class ChunkServer:
             "dataplane_reads_total": dp["reads"],
             "dataplane_forwards_total": dp["forwards"],
             "dataplane_errors_total": dp["errors"],
-            **self.shedder.counters(),
+            **shed,
             **self.blocks.breakers.counters(),
             **self._ici_gauges(),
         }

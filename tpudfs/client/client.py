@@ -87,7 +87,12 @@ class OverloadedError(DfsError):
     """The cluster shed this request (RESOURCE_EXHAUSTED) and in-call
     retries were used up. DETERMINATE — shed work was never executed. The
     S3 gateway maps this to 503 SlowDown; batch callers should back off and
-    retry with jitter."""
+    retry with jitter. ``retry_after`` carries the server's pacing hint
+    (seconds) when the shed envelope included one, else ``None``."""
+
+    def __init__(self, message: str, retry_after: float | None = None):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 def _budgeted(fn):
@@ -469,7 +474,8 @@ class Client:
                         backoff = min(backoff * 2, BACKOFF_CAP)
                         continue
                     raise OverloadedError(
-                        f"{method} shed by {target}: {e.message}"
+                        f"{method} shed by {target}: {e.message}",
+                        retry_after=e.retry_after,
                     ) from None
                 if hint and not _refused(hint):
                     # Leader hint: try it next. The first couple of

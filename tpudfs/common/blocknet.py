@@ -45,10 +45,12 @@ import msgpack
 
 from tpudfs.common.resilience import (
     TENANT_FRAME_KEY,
+    OVERLOADED_PREFIX,
     BreakerBoard,
     BudgetExhausted,
     Deadline,
     attempt_timeout,
+    overloaded_message,
     raw_tenant,
     remaining_budget,
     set_deadline,
@@ -691,7 +693,16 @@ class BlockConnPool:
         if not resp.pop("ok", False):
             code = getattr(grpc.StatusCode, str(resp.get("code")),
                            grpc.StatusCode.INTERNAL)
-            raise RpcError(code, str(resp.get("message") or ""))
+            message = str(resp.get("message") or "")
+            hinted = resp.get("retry_after")
+            if (isinstance(hinted, (int, float))
+                    and code is grpc.StatusCode.RESOURCE_EXHAUSTED
+                    and not message.startswith(OVERLOADED_PREFIX)):
+                # Native sheds carry a structured retry_after next to the
+                # human-readable message; fold it into the Overloaded envelope
+                # so the retry budget sleeps the server-suggested interval.
+                message = overloaded_message(float(hinted), message)
+            raise RpcError(code, message)
         if has_data:
             resp["data"] = payload
         return resp

@@ -224,7 +224,7 @@ def _locked_load() -> ctypes.CDLL | None:
         # symbols and call them with mismatched arguments.
         lib.tpudfs_dataplane_abi.restype = ctypes.c_int64
         lib.tpudfs_dataplane_abi.argtypes = []
-        if lib.tpudfs_dataplane_abi() != 5:
+        if lib.tpudfs_dataplane_abi() != 6:
             raise AttributeError("dataplane ABI mismatch")
         lib.tpudfs_dataplane_start.restype = ctypes.c_int64
         lib.tpudfs_dataplane_start.argtypes = [
@@ -259,6 +259,20 @@ def _locked_load() -> ctypes.CDLL | None:
         lib.tpudfs_dataplane_stats.restype = None
         lib.tpudfs_dataplane_stats.argtypes = [ctypes.c_int64,
                                                ctypes.c_void_p]
+        # ABI 6: QoS admission plane — config push (msgpack flat map
+        # from resilience.qos_wire_config), aggregate counters, and the
+        # per-tenant take-style drain.
+        lib.tpudfs_dataplane_set_qos.restype = None
+        lib.tpudfs_dataplane_set_qos.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.tpudfs_dataplane_qos_stats.restype = None
+        lib.tpudfs_dataplane_qos_stats.argtypes = [ctypes.c_int64,
+                                                   ctypes.c_void_p]
+        lib.tpudfs_dataplane_take_qos.restype = ctypes.c_int64
+        lib.tpudfs_dataplane_take_qos.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+        ]
         lib.tpudfs_dataplane_stop.restype = ctypes.c_int64
         lib.tpudfs_dataplane_stop.argtypes = [ctypes.c_int64]
         _dataplane_ok = True

@@ -50,7 +50,6 @@ import asyncio
 import contextlib
 import contextvars
 import os
-import random
 import time
 from collections import deque
 from collections.abc import Callable, Iterator, Mapping
@@ -232,13 +231,58 @@ def as_system_tenant() -> Iterator[None]:
 # Retry-after jitter + metrics-cardinality helpers
 # ---------------------------------------------------------------------------
 
+class SplitMix64:
+    """Deterministic jitter PRNG, algorithm-identical to the native engine's
+    ``SplitMix64`` (native/dataplane.cc): same state advance, same finalizer,
+    same 53-bit double in [0, 1). Seeding Python and the engine with one seed
+    therefore yields the SAME jitter stream — the QoS parity tests compare
+    ``retry_after`` values across engines draw-for-draw."""
+
+    __slots__ = ("_state",)
+
+    MASK64 = (1 << 64) - 1
+
+    def __init__(self, seed: int | None = None):
+        self.seed(seed)
+
+    def seed(self, seed: int | None = None) -> None:
+        if seed is None:
+            seed = int.from_bytes(os.urandom(8), "little")
+        self._state = seed & self.MASK64
+
+    def random(self) -> float:
+        self._state = (self._state + 0x9E3779B97F4A7C15) & self.MASK64
+        z = self._state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & self.MASK64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & self.MASK64
+        z ^= z >> 31
+        return (z >> 11) * 2.0 ** -53
+
+
 #: Module RNG for retry-after jitter; tests seed it for determinism.
-_jitter_rng = random.Random()
+_jitter_rng = SplitMix64()
+
+#: Last explicit seed handed to :func:`seed_retry_jitter` (0 = entropy
+#: seeded). Pushed to the native engine with the QoS config so both planes
+#: draw the same jitter stream under a seeded chaos/parity run.
+_jitter_seed = 0
 
 
 def seed_retry_jitter(seed: int | None) -> None:
     """Re-seed the retry-after jitter RNG (tests/chaos determinism)."""
-    _jitter_rng.seed(seed)
+    global _jitter_seed
+    if seed is None:
+        _jitter_rng.seed(None)
+        _jitter_seed = 0
+        return
+    s = seed if isinstance(seed, int) else hash(seed)
+    _jitter_rng.seed(s)
+    _jitter_seed = s & SplitMix64.MASK64
+
+
+def jitter_seed() -> int:
+    """The seed behind the jitter stream (0 when entropy-seeded)."""
+    return _jitter_seed
 
 
 def jittered(seconds: float, spread: float = 0.25) -> float:
@@ -627,6 +671,65 @@ def admission_controlled(fn: Any) -> Any:
 # Tenant QoS: rate buckets, weighted-fair queueing, tenant-aware admission
 # ---------------------------------------------------------------------------
 
+#: QoS plane defaults shared value-for-value with the native engine
+#: (native/dataplane.cc ``kQosDrrQuantum``/``kQosQueueDepthDefault``/
+#: ``kQosMinBurst``; TPL041 pairs them): the DRR per-visit credit, the
+#: per-tenant admission-queue bound, and the rate-bucket burst floor.
+QOS_DRR_QUANTUM = 1
+QOS_QUEUE_DEPTH_DEFAULT = 32
+QOS_MIN_BURST = 1
+
+
+class QosFailpoints:
+    """Env-selected fault injection for the QoS admission plane
+    (``TPUDFS_QOS_FAILPOINT``, comma-separated directives) — honored by BOTH
+    the Python shedder and the native engine, so the chaos tiers can drive
+    either plane through the same degraded regimes:
+
+    - ``freeze_refill``: rate buckets stop refilling (their clock freezes at
+      construction). Limited tenants drain their burst and stay drained —
+      and retry-after hints become a pure function of the token deficit,
+      which is what makes cross-engine parity assertable.
+    - ``delay_admit=<seconds>``: every admitted request stalls before the
+      handler runs (a degraded disk/NIC *behind* admission — queue pressure
+      builds while admission itself stays honest).
+    - ``force_shed=<n>``: the next ``n`` acquires are refused unconditionally
+      with detail ``"failpoint forced shed"`` (client retry-path drills).
+    """
+
+    __slots__ = ("freeze_refill", "delay_admit", "force_shed")
+
+    def __init__(self, freeze_refill: bool = False, delay_admit: float = 0.0,
+                 force_shed: int = 0):
+        self.freeze_refill = freeze_refill
+        self.delay_admit = delay_admit
+        self.force_shed = force_shed
+
+    @classmethod
+    def from_env(cls, raw: str | None = None) -> "QosFailpoints":
+        if raw is None:
+            raw = os.environ.get("TPUDFS_QOS_FAILPOINT", "")
+        fp = cls()
+        for part in raw.split(","):
+            name, _, value = part.strip().partition("=")
+            if name == "freeze_refill":
+                fp.freeze_refill = True
+            elif name == "delay_admit":
+                try:
+                    fp.delay_admit = float(value or 0.0)
+                except ValueError:
+                    pass
+            elif name == "force_shed":
+                try:
+                    fp.force_shed = int(value or 0)
+                except ValueError:
+                    pass
+        return fp
+
+    def any(self) -> bool:
+        return bool(self.freeze_refill or self.delay_admit > 0
+                    or self.force_shed > 0)
+
 
 class RateBucket:
     """Time-refilled token bucket for per-tenant request-rate limits.
@@ -645,7 +748,7 @@ class RateBucket:
             raise ValueError("rate must be > 0 (omit the bucket for "
                              "unlimited tenants)")
         self.rate = float(rate)
-        self.burst = max(float(burst), 1.0)
+        self.burst = max(float(burst), float(QOS_MIN_BURST))
         self.tokens = self.burst
         self._last = clock()
         self._clock = clock
@@ -699,7 +802,8 @@ class DeficitRoundRobin:
     queue buys a tenant *zero* extra service — exactly the noisy-neighbor
     property a flat FIFO lacks."""
 
-    def __init__(self, quantum: float = 1.0, default_weight: float = 1.0):
+    def __init__(self, quantum: float = float(QOS_DRR_QUANTUM),
+                 default_weight: float = 1.0):
         self.quantum = quantum
         self.default_weight = default_weight
         self.weights: dict[str, float] = {}
@@ -837,9 +941,11 @@ class QosShedder:
     def __init__(self, max_inflight: int = 64, base_retry_after: float = 0.1,
                  *, weights: Mapping[str, float] | None = None,
                  default_weight: float = 1.0, rate: float = 0.0,
-                 burst: float | None = None, queue_depth: int = 32,
+                 burst: float | None = None,
+                 queue_depth: int = QOS_QUEUE_DEPTH_DEFAULT,
                  max_queue_wait: float = 0.25,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 failpoints: "QosFailpoints | None" = None):
         self.max_inflight = max_inflight
         self.base_retry_after = base_retry_after
         self.inflight = 0
@@ -856,6 +962,14 @@ class QosShedder:
         self.queue_depth = queue_depth
         self.max_queue_wait = max_queue_wait
         self._clock = clock
+        self.failpoints = failpoints
+        # freeze_refill failpoint: buckets see a clock pinned at the
+        # shedder's construction instant, so they never refill — the
+        # admission ladder past the burst becomes deterministic.
+        self._bucket_clock = clock
+        if failpoints is not None and failpoints.freeze_refill:
+            frozen = clock()
+            self._bucket_clock = lambda: frozen
         self._buckets: dict[str, RateBucket] = {}
         self._admitted_by_tenant: dict[str, int] = {}
         self._shed_by_tenant: dict[str, int] = {}
@@ -875,7 +989,7 @@ class QosShedder:
         b = self._buckets.get(tenant)
         if b is None:
             b = self._buckets[tenant] = RateBucket(
-                self.rate, self.burst, self._clock)
+                self.rate, self.burst, self._bucket_clock)
         return b
 
     def retry_after_for(self, tenant: str) -> float:
@@ -930,10 +1044,19 @@ class QosShedder:
         Raises :class:`QosRejected` (rate-limited or shed); returns when
         admitted. Callers must pair with :meth:`release`.
         """
+        fp = self.failpoints
+        if fp is not None and fp.force_shed > 0:
+            fp.force_shed -= 1
+            self._count_shed(tenant)
+            raise QosRejected(
+                "failpoint forced shed",
+                retry_after=self.retry_after_for(tenant), tenant=tenant)
         bucket = self._bucket(tenant)
         if (self.inflight < self.max_inflight and len(self.queue) == 0
                 and (bucket is None or bucket.try_spend())):
             self._admit(tenant)
+            if fp is not None and fp.delay_admit > 0:
+                await asyncio.sleep(fp.delay_admit)
             return
         # Contended (or over-rate): degrade to the fair queue.
         if self.queue.depth(tenant) >= self.queue_depth:
@@ -968,6 +1091,8 @@ class QosShedder:
                 "rate limited",
                 retry_after=self.retry_after_for(tenant),
                 tenant=tenant) from None
+        if fp is not None and fp.delay_admit > 0:
+            await asyncio.sleep(fp.delay_admit)
 
     def release(self, tenant: str, elapsed: float = 0.0) -> None:
         self.inflight -= 1
@@ -1081,10 +1206,19 @@ def shedder_from_env(inflight_env: str, default_inflight: int
       (rate 0 = unlimited; ``system`` is always unlimited).
     - ``TPUDFS_QOS_QUEUE_DEPTH`` / ``TPUDFS_QOS_QUEUE_WAIT``: per-tenant
       queue bound and max park time before the rate-limited refusal.
+    - ``TPUDFS_QOS_JITTER_SEED``: seed the retry-after jitter stream (pushed
+      to the native engine too — parity/chaos determinism).
+    - ``TPUDFS_QOS_FAILPOINT``: fault injection, see :class:`QosFailpoints`.
     """
     max_inflight = int(os.environ.get(inflight_env, str(default_inflight)))
     if os.environ.get("TPUDFS_QOS", "0") != "1":
         return LoadShedder(max_inflight=max_inflight)
+    seed_raw = os.environ.get("TPUDFS_QOS_JITTER_SEED", "")
+    if seed_raw:
+        try:
+            seed_retry_jitter(int(seed_raw))
+        except ValueError:
+            pass
     weights: dict[str, float] = {}
     for part in os.environ.get("TPUDFS_QOS_WEIGHTS", "").split(","):
         if "=" not in part:
@@ -1096,11 +1230,38 @@ def shedder_from_env(inflight_env: str, default_inflight: int
             continue
     rate = float(os.environ.get("TPUDFS_QOS_RATE", "0") or 0.0)
     burst_raw = os.environ.get("TPUDFS_QOS_BURST", "")
+    failpoints = QosFailpoints.from_env()
     return QosShedder(
         max_inflight=max_inflight,
         weights=weights,
         rate=rate,
         burst=float(burst_raw) if burst_raw else None,
-        queue_depth=int(os.environ.get("TPUDFS_QOS_QUEUE_DEPTH", "32")),
+        queue_depth=int(os.environ.get("TPUDFS_QOS_QUEUE_DEPTH",
+                                       str(QOS_QUEUE_DEPTH_DEFAULT))),
         max_queue_wait=float(os.environ.get("TPUDFS_QOS_QUEUE_WAIT", "0.25")),
+        failpoints=failpoints if failpoints.any() else None,
     )
+
+
+def qos_wire_config(shedder: "LoadShedder | QosShedder") -> dict:
+    """The QoS control contract as a FLAT msgpack-able dict for the native
+    engine (``tpudfs_dataplane_set_qos``). Flat on purpose: the engine's
+    header parser reads scalar values and string arrays only, so tenant
+    weights travel as ``"tenant=weight"`` strings rather than a nested map.
+    A :class:`LoadShedder` maps to ``{"enabled": 0}`` — pushing it after a
+    config change switches the engine's admission plane off."""
+    if getattr(shedder, "acquire", None) is None:
+        return {"enabled": 0}
+    return {
+        "enabled": 1,
+        "max_inflight": int(shedder.max_inflight),
+        "base_retry_after": float(shedder.base_retry_after),
+        "rate": float(shedder.rate),
+        "burst": float(shedder.burst),
+        "queue_depth": int(shedder.queue_depth),
+        "queue_wait": float(shedder.max_queue_wait),
+        "default_weight": float(shedder.queue.default_weight),
+        "weights": [f"{t}={w:g}" for t, w in
+                    sorted(shedder.queue.weights.items())],
+        "jitter_seed": jitter_seed(),
+    }

@@ -60,6 +60,7 @@ from tpudfs.common.blocknet import (
     _read_frame,
 )
 from tpudfs.common.checksum import crc32c
+from tpudfs.common.resilience import OVERLOADED_PREFIX, overloaded_message
 from tpudfs.common.rpc import RpcError
 
 #: Frame payload size. Big enough that per-frame header/syscall overhead
@@ -103,7 +104,15 @@ def begin_header(block_id: str, size: int, *, expected_crc32c: int,
 def _raise_error_frame(header: dict) -> None:
     code = getattr(grpc.StatusCode, str(header.get("code")),
                    grpc.StatusCode.INTERNAL)
-    raise RpcError(code, str(header.get("message") or ""))
+    message = str(header.get("message") or "")
+    hinted = header.get("retry_after")
+    if (isinstance(hinted, (int, float))
+            and code is grpc.StatusCode.RESOURCE_EXHAUSTED
+            and not message.startswith(OVERLOADED_PREFIX)):
+        # Mid-stream native sheds carry a structured retry_after; fold it
+        # into the Overloaded envelope for the retry-budget path.
+        message = overloaded_message(float(hinted), message)
+    raise RpcError(code, message)
 
 
 async def send_block_stream(r: asyncio.StreamReader, w: asyncio.StreamWriter,

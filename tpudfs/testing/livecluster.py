@@ -62,6 +62,50 @@ async def find_leader_async(addrs: list[str],
     return None
 
 
+async def assert_native_data_planes(procs: dict, tls, stage: str) -> int:
+    """Require every REACHABLE chunkserver to serve its blockport from the
+    C++ engine (``"native": true`` in the DataPort handshake).
+
+    The QoS chaos stages are a contract with the native admission plane: a
+    silent asyncio fallback would pass the fairness assertions against the
+    wrong engine, so it fails the run loudly instead. Chaos corpses (killed
+    earlier in the timeline) are skipped; at least one live chunkserver
+    must answer. Returns the number of engines verified."""
+    from tpudfs.common.rpc import RpcClient
+
+    rpc = RpcClient(tls=tls)
+    try:
+        checked = 0
+        for name, v in sorted(procs.items()):
+            if not name.startswith("cs") or not v.get("addr"):
+                continue
+            try:
+                hello = await rpc.call(v["addr"], "ChunkServerService",
+                                       "DataPort", {}, timeout=3.0)
+            except Exception as e:
+                # Killed by an earlier stage of the fault schedule — say
+                # so, then move on: corpses don't fail the handshake gate.
+                print(f"{stage}: {name} ({v['addr']}) unreachable "
+                      f"({type(e).__name__}); skipping handshake")
+                continue
+            checked += 1
+            if not hello.get("native"):
+                raise SystemExit(
+                    f"{stage}: chunkserver {name} ({v['addr']}) is serving "
+                    "the asyncio blockport, not the native engine — the "
+                    "QoS chaos stages must exercise the C++ admission "
+                    "plane (silent fallback is a failure)")
+        if checked == 0:
+            raise SystemExit(
+                f"{stage}: no live chunkserver answered the DataPort "
+                "handshake — cannot verify the native data plane")
+        print(f"{stage}: {checked} live chunkserver(s) confirmed on the "
+              "native engine")
+        return checked
+    finally:
+        await rpc.close()
+
+
 @contextlib.contextmanager
 def boot_cluster(topology: str, *, tls: bool = False, s3_port: str = "0",
                  extra_env: dict | None = None):
